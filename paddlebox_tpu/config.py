@@ -1,0 +1,101 @@
+"""Global flag/config system.
+
+TPU-native equivalent of the reference's three config layers (SURVEY.md §5.6):
+gflags env-settable ``FLAGS_*`` (reference: paddle/fluid/platform/flags.cc,
+padbox block :946-975), the ``TrainerDesc``/``DataFeedDesc`` protos, and
+per-wrapper config maps. Here: one typed dataclass, every field overridable
+from the environment as ``FLAGS_<name>`` at import time or via
+``FLAGS.update(...)`` / ``flags_scope(...)`` at runtime.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+from typing import Any, Iterator
+
+
+def _env_cast(raw: str, ty: type) -> Any:
+    if ty is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    if ty is int:
+        return int(raw)
+    if ty is float:
+        return float(raw)
+    return raw
+
+
+@dataclasses.dataclass
+class Flags:
+    """Process-wide tunables. Defaults mirror the reference's flag defaults
+    where a counterpart exists (cited per field)."""
+
+    # --- sparse pull/push (reference: FLAGS_enable_pullpush_dedup_keys,
+    # box_wrapper_impl.h:20) ---
+    enable_pullpush_dedup_keys: bool = True
+    # zero-pad embedding outputs for zero-length slots
+    # (reference: pull_box_sparse_op.h:25 FLAGS_padding_zeros)
+    padding_zeros: bool = True
+
+    # --- data pipeline (reference: platform/flags.cc:946-975) ---
+    record_pool_max_size: int = 2_000_000
+    shuffle_thread_num: int = 8
+    read_thread_num: int = 8
+    channel_capacity: int = 65536
+
+    # --- trainer (reference: boxps_worker.cc) ---
+    check_nan_inf: bool = False
+    enable_gc: bool = True
+    sync_dense_every_steps: int = 1  # K-step dense sync (boxps_worker.cc:1317)
+    enable_sharding_stage: int = 0   # FLAGS_padbox_enable_sharding_stage
+
+    # --- embedding store ---
+    # Default per-shard row capacity; tables are statically sized for XLA.
+    table_capacity_per_shard: int = 1 << 20
+    # embedx (mf) lazy-creation threshold semantics (optimizer.cuh.h:105)
+    mf_create_threshold: float = 0.0
+    # feature shrink: drop rows whose decayed show falls below this
+    shrink_delete_threshold: float = 0.0
+    show_click_decay_rate: float = 0.98
+
+    # --- metrics (reference: metrics.h:46 table_size 1e6+1) ---
+    auc_num_buckets: int = 1_000_000
+
+    # --- runtime ---
+    profile: bool = False
+    log_period_steps: int = 100
+    seed: int = 0
+
+    def update(self, **kwargs: Any) -> None:
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise AttributeError(f"unknown flag: {k}")
+            setattr(self, k, v)
+
+    @classmethod
+    def from_env(cls) -> "Flags":
+        self = cls()
+        for f in dataclasses.fields(self):
+            raw = os.environ.get(f"FLAGS_{f.name}")
+            if raw is not None:
+                ty = f.type if isinstance(f.type, type) else type(getattr(self, f.name))
+                try:
+                    setattr(self, f.name, _env_cast(raw, ty))
+                except ValueError as e:
+                    raise ValueError(f"bad value for env flag FLAGS_{f.name}={raw!r}: {e}") from None
+        return self
+
+
+FLAGS = Flags.from_env()
+
+
+@contextlib.contextmanager
+def flags_scope(**kwargs: Any) -> Iterator[Flags]:
+    """Temporarily override flags (tests use this heavily)."""
+    old = {k: getattr(FLAGS, k) for k in kwargs}
+    FLAGS.update(**kwargs)
+    try:
+        yield FLAGS
+    finally:
+        FLAGS.update(**old)

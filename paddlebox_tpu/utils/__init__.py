@@ -1,0 +1,5 @@
+from paddlebox_tpu.utils.timer import Timer
+from paddlebox_tpu.utils.monitor import StatRegistry, STATS, stat_add
+from paddlebox_tpu.utils.channel import Channel, ChannelClosed
+
+__all__ = ["Timer", "StatRegistry", "STATS", "stat_add", "Channel", "ChannelClosed"]

@@ -1,0 +1,91 @@
+"""Bounded MPMC channel — backbone of every data-pipeline stage.
+
+Reference: paddle/fluid/framework/channel.h:39 (``ChannelObject``): bounded
+block/batch read-write with close semantics. We keep the same contract
+(capacity, block_size batched reads, ``close()`` drains then raises) on top of
+a condition-variable deque; readers get whole batches to amortize locking just
+like the reference's ``ReadMove`` batched path.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Deque, Generic, Iterable, Iterator, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class Channel(Generic[T]):
+    def __init__(self, capacity: int = 65536, block_size: int = 1024) -> None:
+        self._capacity = max(1, capacity)
+        self._block_size = max(1, block_size)
+        self._q: Deque[T] = collections.deque()
+        self._lock = threading.Lock()
+        self._not_full = threading.Condition(self._lock)
+        self._not_empty = threading.Condition(self._lock)
+        self._closed = False
+
+    # -- write side ---------------------------------------------------------
+    def put(self, item: T) -> None:
+        with self._not_full:
+            while len(self._q) >= self._capacity and not self._closed:
+                self._not_full.wait()
+            if self._closed:
+                raise ChannelClosed("put on closed channel")
+            self._q.append(item)
+            self._not_empty.notify()
+
+    def put_many(self, items: Iterable[T]) -> None:
+        for it in items:
+            self.put(it)
+
+    # -- read side ----------------------------------------------------------
+    def get(self, timeout: Optional[float] = None) -> T:
+        with self._not_empty:
+            while not self._q and not self._closed:
+                if not self._not_empty.wait(timeout=timeout):
+                    raise TimeoutError("channel get timed out")
+            if self._q:
+                item = self._q.popleft()
+                self._not_full.notify()
+                return item
+            raise ChannelClosed("get on closed empty channel")
+
+    def get_batch(self, max_items: Optional[int] = None) -> List[T]:
+        """Blocking batched read; returns [] only when closed and drained."""
+        n = max_items or self._block_size
+        with self._not_empty:
+            while not self._q and not self._closed:
+                self._not_empty.wait()
+            out: List[T] = []
+            while self._q and len(out) < n:
+                out.append(self._q.popleft())
+            if out:
+                self._not_full.notify_all()
+            return out
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def __iter__(self) -> Iterator[T]:
+        while True:
+            batch = self.get_batch()
+            if not batch:
+                return
+            yield from batch

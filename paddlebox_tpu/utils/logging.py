@@ -1,0 +1,27 @@
+"""Framework logger (reference uses glog/VLOG throughout)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_LOGGER: logging.Logger | None = None
+
+
+def get_logger(name: str = "paddlebox_tpu") -> logging.Logger:
+    global _LOGGER
+    if _LOGGER is None:
+        logger = logging.getLogger("paddlebox_tpu")
+        level = os.environ.get("PADDLEBOX_TPU_LOGLEVEL", "INFO").upper()
+        logger.setLevel(level)
+        if not logger.handlers:
+            h = logging.StreamHandler(sys.stderr)
+            h.setFormatter(logging.Formatter(
+                "%(asctime)s %(levelname).1s %(name)s] %(message)s", "%H:%M:%S"))
+            logger.addHandler(h)
+        logger.propagate = False
+        _LOGGER = logger
+    if name == "paddlebox_tpu":
+        return _LOGGER
+    return _LOGGER.getChild(name.removeprefix("paddlebox_tpu."))

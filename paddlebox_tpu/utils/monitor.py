@@ -1,0 +1,47 @@
+"""Global named int64 stat registry.
+
+Reference: paddle/fluid/platform/monitor.h:80 (``StatRegistry``; ``STAT_ADD``
+macro :133) — e.g. ``STAT_total_feasign_num_in_mem``. Thread-safe counters,
+queryable and resettable by name.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class StatRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._stats: Dict[str, int] = {}
+
+    def add(self, name: str, value: int) -> None:
+        with self._lock:
+            self._stats[name] = self._stats.get(name, 0) + int(value)
+
+    def set(self, name: str, value: int) -> None:
+        with self._lock:
+            self._stats[name] = int(value)
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._stats.get(name, 0)
+
+    def reset(self, name: str | None = None) -> None:
+        with self._lock:
+            if name is None:
+                self._stats.clear()
+            else:
+                self._stats.pop(name, None)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._stats)
+
+
+STATS = StatRegistry()
+
+
+def stat_add(name: str, value: int = 1) -> None:
+    STATS.add(name, value)

@@ -1,0 +1,71 @@
+import threading
+import time
+
+import pytest
+
+from paddlebox_tpu.config import FLAGS, flags_scope
+from paddlebox_tpu.utils import Channel, ChannelClosed, STATS, Timer, stat_add
+
+
+def test_flags_scope_and_update():
+    base = FLAGS.read_thread_num
+    with flags_scope(read_thread_num=3):
+        assert FLAGS.read_thread_num == 3
+    assert FLAGS.read_thread_num == base
+    with pytest.raises(AttributeError):
+        FLAGS.update(no_such_flag=1)
+
+
+def test_timer_pause_resume():
+    t = Timer()
+    t.start()
+    time.sleep(0.01)
+    t.pause()
+    e1 = t.elapsed_sec()
+    assert e1 >= 0.009
+    time.sleep(0.01)
+    assert t.elapsed_sec() == e1  # paused
+    t.resume()
+    time.sleep(0.005)
+    t.pause()
+    assert t.elapsed_sec() > e1
+    assert t.count() == 2
+
+
+def test_stat_registry():
+    STATS.reset()
+    stat_add("total_feasign_num_in_mem", 10)
+    stat_add("total_feasign_num_in_mem", 5)
+    assert STATS.get("total_feasign_num_in_mem") == 15
+    STATS.reset("total_feasign_num_in_mem")
+    assert STATS.get("total_feasign_num_in_mem") == 0
+
+
+def test_channel_mpmc_and_close():
+    ch = Channel(capacity=8, block_size=4)
+    out = []
+
+    def consumer():
+        for x in ch:
+            out.append(x)
+
+    threads = [threading.Thread(target=consumer) for _ in range(2)]
+    for th in threads:
+        th.start()
+    for i in range(100):
+        ch.put(i)
+    ch.close()
+    for th in threads:
+        th.join()
+    assert sorted(out) == list(range(100))
+    with pytest.raises(ChannelClosed):
+        ch.put(1)
+
+
+def test_channel_get_batch_drains_after_close():
+    ch = Channel(capacity=4)
+    ch.put(1)
+    ch.put(2)
+    ch.close()
+    assert ch.get_batch() == [1, 2]
+    assert ch.get_batch() == []
