@@ -35,7 +35,7 @@ class Flags:
     # box_wrapper_impl.h:20) ---
     enable_pullpush_dedup_keys: bool = True
     # zero-pad embedding outputs for zero-length slots
-    # (reference: pull_box_sparse_op.h:25 FLAGS_padding_zeros)
+    # (reference: FLAGS_enable_pull_box_padding_zero, pull_box_sparse_op.h:25)
     padding_zeros: bool = True
 
     # --- data pipeline (reference: platform/flags.cc:946-975) ---
@@ -79,7 +79,7 @@ class Flags:
         for f in dataclasses.fields(self):
             raw = os.environ.get(f"FLAGS_{f.name}")
             if raw is not None:
-                ty = f.type if isinstance(f.type, type) else type(getattr(self, f.name))
+                ty = type(getattr(self, f.name))
                 try:
                     setattr(self, f.name, _env_cast(raw, ty))
                 except ValueError as e:

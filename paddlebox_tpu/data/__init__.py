@@ -1,0 +1,16 @@
+from paddlebox_tpu.data.schema import SlotDef, DataFeedDesc
+from paddlebox_tpu.data.record import SlotRecord, SlotRecordPool
+from paddlebox_tpu.data.batch import SlotBatch, BatchBuilder
+from paddlebox_tpu.data.parser import (
+    SlotTextParser, CriteoParser, register_parser, get_parser,
+)
+from paddlebox_tpu.data.dataset import (
+    DatasetFactory, InMemoryDataset, QueueDataset, PaddleBoxDataset,
+)
+
+__all__ = [
+    "SlotDef", "DataFeedDesc", "SlotRecord", "SlotRecordPool", "SlotBatch",
+    "BatchBuilder", "SlotTextParser", "CriteoParser", "register_parser",
+    "get_parser", "DatasetFactory", "InMemoryDataset", "QueueDataset",
+    "PaddleBoxDataset",
+]

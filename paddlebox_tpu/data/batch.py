@@ -1,0 +1,114 @@
+"""Device-ready batch layout + builder.
+
+Reference: the GPU minibatch packer ``MiniBatchGpuPack`` + copy kernels
+(data_feed.h:529-652, data_feed.cu:1210-1259) which build per-slot LoDTensors.
+
+TPU-native redesign: one flattened key tensor for ALL slots with segment ids,
+padded to a static bucket capacity. Ragged per-slot LoD never reaches the
+device — pooling is a single ``segment_sum`` over ``segments`` (ins*S + slot),
+which XLA lowers to one fused scatter-add; slot boundaries are implicit in the
+segment id. Static bucket shapes keep jit recompiles bounded.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from paddlebox_tpu.data.record import SlotRecord
+from paddlebox_tpu.data.schema import DataFeedDesc
+
+
+@dataclasses.dataclass
+class SlotBatch:
+    """Host (numpy) batch; fields are what the jit train step consumes.
+
+    ``segments[k] == ins*S + slot`` for valid keys, ``B*S`` for padding —
+    so ``segment_sum(values, segments, B*S+1)[:-1]`` pools every slot of
+    every instance in one op and the padding falls into a discarded bin.
+    """
+
+    keys: np.ndarray        # uint64 [K_pad]
+    segments: np.ndarray    # int32  [K_pad]
+    num_keys: int           # valid prefix length
+    dense: np.ndarray       # float32 [B, dense_dim]
+    label: np.ndarray       # float32 [B]
+    show: np.ndarray        # float32 [B]
+    clk: np.ndarray         # float32 [B]
+    batch_size: int
+    num_slots: int          # S (sparse slots)
+    # metric side-channels (WuAUC / cmatch_rank variants)
+    uid: Optional[np.ndarray] = None     # int64 [B]
+    rank: Optional[np.ndarray] = None    # int32 [B]
+    cmatch: Optional[np.ndarray] = None  # int32 [B]
+
+    @property
+    def key_capacity(self) -> int:
+        return int(self.keys.shape[0])
+
+    @property
+    def pad_segment(self) -> int:
+        return self.batch_size * self.num_slots
+
+
+class BatchBuilder:
+    """records → SlotBatch with static-bucket key padding."""
+
+    def __init__(self, desc: DataFeedDesc) -> None:
+        self.desc = desc
+        self.num_slots = len(desc.sparse_slots)
+        self.dense_dim = desc.dense_dim
+
+    def build(self, records: Sequence[SlotRecord]) -> SlotBatch:
+        desc = self.desc
+        bs = desc.batch_size
+        n = len(records)
+        if n == 0:
+            raise ValueError("empty batch")
+        if n > bs:
+            raise ValueError(f"{n} records > batch_size {bs}")
+        S = self.num_slots
+
+        key_arrays: List[np.ndarray] = []
+        seg_arrays: List[np.ndarray] = []
+        slot_base = np.arange(S, dtype=np.int64)
+        for i, r in enumerate(records):
+            key_arrays.append(r.keys)
+            counts = np.diff(r.slot_offsets)
+            seg_arrays.append(np.repeat(i * S + slot_base, counts).astype(np.int32))
+        keys = np.concatenate(key_arrays) if key_arrays else np.empty(0, np.uint64)
+        segs = np.concatenate(seg_arrays) if seg_arrays else np.empty(0, np.int32)
+        nk = int(keys.shape[0])
+
+        cap = desc.key_capacity(nk)
+        pad_seg = bs * S
+        keys_p = np.zeros(cap, dtype=np.uint64)
+        segs_p = np.full(cap, pad_seg, dtype=np.int32)
+        keys_p[:nk] = keys
+        segs_p[:nk] = segs
+
+        dense = np.zeros((bs, self.dense_dim), dtype=np.float32)
+        label = np.zeros(bs, dtype=np.float32)
+        show = np.zeros(bs, dtype=np.float32)
+        clk = np.zeros(bs, dtype=np.float32)
+        uid = np.zeros(bs, dtype=np.int64)
+        rank = np.zeros(bs, dtype=np.int32)
+        cmatch = np.zeros(bs, dtype=np.int32)
+        for i, r in enumerate(records):
+            if r.dense.size:
+                dense[i, :r.dense.size] = r.dense
+            label[i] = r.label
+            show[i] = r.show
+            clk[i] = r.clk
+            uid[i] = r.uid
+            rank[i] = r.rank
+            cmatch[i] = r.cmatch
+        # short batches (tail of a pass): instances [n, bs) have show=0 so
+        # they contribute nothing to pooled sums, loss, or metrics.
+        return SlotBatch(
+            keys=keys_p, segments=segs_p, num_keys=nk, dense=dense,
+            label=label, show=show, clk=clk, batch_size=bs, num_slots=S,
+            uid=uid, rank=rank, cmatch=cmatch,
+        )

@@ -1,0 +1,303 @@
+"""Dataset hierarchy: streaming slot datasets with pass lifecycle.
+
+Reference: paddle/fluid/framework/data_set.{h,cc} — ``Dataset`` interface
+(data_set.h:58: filelist, thread num, load/release, local/global shuffle),
+``MultiSlotDataset``, ``PadBoxSlotDataset`` (:466 — pass dataset with
+preload/wait, MergeInsKeys, MPI global shuffle) — and the Python surface
+python/paddle/fluid/dataset.py (``DatasetFactory`` :24, ``InMemoryDataset``
+:399, ``QueueDataset`` :1191, ``BoxPSDataset`` :1313).
+
+TPU-native redesign: readers are threads feeding a Channel (no pipe
+subprocess per reader unless requested); records are numpy-columnar;
+the pass key-set for the embedding store (MergeInsKeys → PSAgent::AddKey)
+is collected as a deduped uint64 np array during load; multi-host global
+shuffle routes records by hash(ins_id) % nhosts through a pluggable
+transport (single-host default is an in-proc identity).
+"""
+
+from __future__ import annotations
+
+import glob as globlib
+import random
+import threading
+from typing import Callable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from paddlebox_tpu.config import FLAGS
+from paddlebox_tpu.data.batch import BatchBuilder, SlotBatch
+from paddlebox_tpu.data.parser import get_parser
+from paddlebox_tpu.data.record import SlotRecord
+from paddlebox_tpu.data.schema import DataFeedDesc
+from paddlebox_tpu.utils import Channel, stat_add
+from paddlebox_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class Dataset:
+    """Base: file list + schema + threaded readers."""
+
+    def __init__(self, desc: Optional[DataFeedDesc] = None) -> None:
+        self.desc = desc or DataFeedDesc()
+        self.filelist: List[str] = []
+        self.thread_num = FLAGS.read_thread_num
+        self._builder: Optional[BatchBuilder] = None
+
+    # --- config surface (mirrors dataset.py setters) ---
+    def set_feed_desc(self, desc: DataFeedDesc) -> None:
+        self.desc = desc
+        self._builder = None
+
+    def set_filelist(self, files: Sequence[str]) -> None:
+        self.filelist = list(files)
+
+    def set_glob(self, pattern: str) -> None:
+        self.filelist = sorted(globlib.glob(pattern))
+
+    def set_batch_size(self, bs: int) -> None:
+        self.desc.batch_size = bs
+
+    def set_thread(self, n: int) -> None:
+        self.thread_num = n
+
+    @property
+    def builder(self) -> BatchBuilder:
+        if self._builder is None:
+            self._builder = BatchBuilder(self.desc)
+        return self._builder
+
+    # --- reading ---
+    def _read_files_into(self, files: Sequence[str], out: Channel,
+                         n_threads: int) -> "ReaderGroup":
+        parser_factory = lambda: get_parser(self.desc)
+        file_ch: Channel[str] = Channel(capacity=len(files) + 1)
+        for f in files:
+            file_ch.put(f)
+        file_ch.close()
+        group = ReaderGroup()
+
+        def worker() -> None:
+            try:
+                parser = parser_factory()
+                for path in file_ch:
+                    n_ok = n_bad = 0
+                    with open(path, "r") as fh:
+                        for line in fh:
+                            rec = parser.parse(line)
+                            if rec is None:
+                                n_bad += 1
+                                continue
+                            out.put(rec)
+                            n_ok += 1
+                    stat_add("records_parsed", n_ok)
+                    stat_add("records_dropped", n_bad)
+            except BaseException as e:
+                group.errors.append(e)
+
+        group.threads = [threading.Thread(target=worker, daemon=True)
+                         for _ in range(max(1, n_threads))]
+        for t in group.threads:
+            t.start()
+        return group
+
+
+class ReaderGroup:
+    """Reader threads + their errors; join() re-raises the first failure so
+    a dead reader never silently truncates a pass."""
+
+    def __init__(self) -> None:
+        self.threads: List[threading.Thread] = []
+        self.errors: List[BaseException] = []
+
+    def join(self) -> None:
+        for t in self.threads:
+            t.join()
+        if self.errors:
+            raise self.errors[0]
+
+
+class InMemoryDataset(Dataset):
+    """Load-everything-then-iterate dataset (reference dataset.py:399).
+
+    Also collects the deduped pass key-set during load — the
+    ``MergeInsKeys``/``PSAgentBase::AddKey`` role (data_set.cc:2423) that
+    feeds the embedding store's per-pass working set."""
+
+    def __init__(self, desc: Optional[DataFeedDesc] = None) -> None:
+        super().__init__(desc)
+        self.records: List[SlotRecord] = []
+        self._pass_keys: Optional[np.ndarray] = None
+
+    def load_into_memory(self) -> None:
+        if not self.filelist:
+            raise ValueError("set_filelist first")
+        ch: Channel[SlotRecord] = Channel(capacity=FLAGS.channel_capacity)
+        group = self._read_files_into(self.filelist, ch, self.thread_num)
+
+        def closer() -> None:
+            for t in group.threads:
+                t.join()
+            ch.close()
+
+        threading.Thread(target=closer, daemon=True).start()
+        self.records = list(ch)
+        group.join()  # re-raise reader errors
+        self._pass_keys = None
+        log.info("loaded %d records from %d files",
+                 len(self.records), len(self.filelist))
+
+    def release_memory(self) -> None:
+        self.records = []
+        self._pass_keys = None
+
+    def local_shuffle(self, seed: Optional[int] = None) -> None:
+        rng = random.Random(FLAGS.seed if seed is None else seed)
+        rng.shuffle(self.records)
+
+    def global_shuffle(self, shuffler: Optional["Shuffler"] = None,
+                       seed: Optional[int] = None) -> None:
+        """Cross-host record exchange by hash — data_set.cc:2573 ShuffleData.
+        Single-host default degenerates to local_shuffle."""
+        if shuffler is not None:
+            self.records = shuffler.exchange(self.records)
+        self.local_shuffle(seed)
+
+    def pass_keys(self) -> np.ndarray:
+        """Deduped uint64 key-set of the loaded pass."""
+        if self._pass_keys is None:
+            if self.records:
+                all_keys = np.concatenate([r.keys for r in self.records])
+                self._pass_keys = np.unique(all_keys)
+            else:
+                self._pass_keys = np.empty(0, dtype=np.uint64)
+        return self._pass_keys
+
+    def __len__(self) -> int:
+        return len(self.records)
+
+    def batches(self, drop_last: bool = False) -> Iterator[SlotBatch]:
+        bs = self.desc.batch_size
+        n = len(self.records)
+        for i in range(0, n, bs):
+            chunk = self.records[i:i + bs]
+            if len(chunk) < bs and drop_last:
+                return
+            yield self.builder.build(chunk)
+
+
+class QueueDataset(Dataset):
+    """Streaming dataset: batches come off the reader channel without
+    materializing the pass (reference dataset.py:1191)."""
+
+    def batches(self) -> Iterator[SlotBatch]:
+        if not self.filelist:
+            raise ValueError("set_filelist first")
+        ch: Channel[SlotRecord] = Channel(capacity=FLAGS.channel_capacity,
+                                          block_size=self.desc.batch_size)
+        group = self._read_files_into(self.filelist, ch, self.thread_num)
+
+        def closer() -> None:
+            for t in group.threads:
+                t.join()
+            ch.close()
+
+        threading.Thread(target=closer, daemon=True).start()
+        pending: List[SlotRecord] = []
+        while True:
+            got = ch.get_batch(self.desc.batch_size - len(pending))
+            if not got and ch.closed and len(ch) == 0:
+                break
+            pending.extend(got)
+            if len(pending) >= self.desc.batch_size:
+                yield self.builder.build(pending[:self.desc.batch_size])
+                pending = pending[self.desc.batch_size:]
+        if pending:
+            yield self.builder.build(pending)
+        group.join()  # surface reader errors at stream end
+
+
+class PaddleBoxDataset(InMemoryDataset):
+    """Pass-lifecycle dataset — the ``BoxPSDataset``/``PadBoxSlotDataset``
+    surface (dataset.py:1313,:1446): double-buffered preload of pass k+1
+    while pass k trains, begin/end pass hooks that stage the embedding
+    store's working set (SURVEY.md §3.3)."""
+
+    def __init__(self, desc: Optional[DataFeedDesc] = None) -> None:
+        super().__init__(desc)
+        self._preload_thread: Optional[threading.Thread] = None
+        self._preload_exc: Optional[BaseException] = None
+        self._date: Optional[str] = None
+        self.pass_id = 0
+        # hooks the trainer/PS wires up (BoxHelper Begin/EndFeedPass etc.)
+        self.on_begin_pass: Optional[Callable[["PaddleBoxDataset"], None]] = None
+        self.on_end_pass: Optional[Callable[["PaddleBoxDataset", bool], None]] = None
+
+    def set_date(self, date: str) -> None:
+        self._date = date
+
+    @property
+    def date(self) -> Optional[str]:
+        return self._date
+
+    def preload_into_memory(self) -> None:
+        if self._preload_thread is not None:
+            raise RuntimeError("preload already in flight")
+        self._preload_exc = None
+
+        def run() -> None:
+            try:
+                self.load_into_memory()
+            except BaseException as e:  # surfaced in wait_preload_done
+                self._preload_exc = e
+
+        self._preload_thread = threading.Thread(target=run, daemon=True)
+        self._preload_thread.start()
+
+    def wait_preload_done(self) -> None:
+        if self._preload_thread is None:
+            return
+        self._preload_thread.join()
+        self._preload_thread = None
+        if self._preload_exc is not None:
+            raise self._preload_exc
+
+    def begin_pass(self) -> None:
+        self.pass_id += 1
+        if self.on_begin_pass is not None:
+            self.on_begin_pass(self)
+
+    def end_pass(self, need_save_delta: bool = False) -> None:
+        if self.on_end_pass is not None:
+            self.on_end_pass(self, need_save_delta)
+        self.release_memory()
+
+
+class Shuffler:
+    """Cross-host record exchange transport (PaddleShuffler analogue,
+    data_set.cc:2573). Implementations route each record to
+    ``hash(record) % world_size`` and return the records received."""
+
+    def exchange(self, records: List[SlotRecord]) -> List[SlotRecord]:
+        raise NotImplementedError
+
+
+class DatasetFactory:
+    """Reference: dataset.py:24."""
+
+    _KINDS = {
+        "InMemoryDataset": InMemoryDataset,
+        "QueueDataset": QueueDataset,
+        "PaddleBoxDataset": PaddleBoxDataset,
+        "BoxPSDataset": PaddleBoxDataset,       # alias for migration
+        "PadBoxSlotDataset": PaddleBoxDataset,  # alias for migration
+    }
+
+    def create_dataset(self, kind: str = "QueueDataset",
+                       desc: Optional[DataFeedDesc] = None) -> Dataset:
+        try:
+            return self._KINDS[kind](desc)
+        except KeyError:
+            raise KeyError(
+                f"unknown dataset kind {kind!r}; one of {sorted(self._KINDS)}"
+            ) from None

@@ -1,0 +1,144 @@
+"""Text parsers: line → SlotRecord.
+
+Reference: paddle/fluid/framework/data_feed.{h,cc} — ``MultiSlotDataFeed``
+text format parsing (data_feed.cc) and the plugin parser API
+``CustomParser``/``ISlotParser`` loaded via dlopen (data_feed.h:450,:1984,
+``DLManager`` :698). TPU-native port: parsers are registered python callables
+(a custom parser is just an imported class), same extension point without
+the .so machinery; a C++ fast-path parser can be slotted in behind the same
+registry (see paddlebox_tpu/native).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Type
+
+import numpy as np
+
+from paddlebox_tpu.data.record import SlotRecord
+from paddlebox_tpu.data.schema import DataFeedDesc
+
+
+class BaseParser:
+    """Parse one text line into a SlotRecord (None = drop the line)."""
+
+    def __init__(self, desc: DataFeedDesc) -> None:
+        self.desc = desc
+
+    def parse(self, line: str) -> Optional[SlotRecord]:
+        raise NotImplementedError
+
+
+class SlotTextParser(BaseParser):
+    """Generic multi-slot text format, one record per line:
+
+        <num> v0 v1 ... <num> v0 ...        (one group per slot, schema order)
+
+    — the ``MultiSlotDataFeed`` wire format (data_feed.cc text path). Sparse
+    slot values are uint64 feasigns; float slot groups must carry exactly
+    ``dim`` values. The slot named by desc.label_slot feeds ``label``;
+    show/clk slots likewise if configured.
+    """
+
+    def parse(self, line: str) -> Optional[SlotRecord]:
+        toks = line.split()
+        desc = self.desc
+        pos = 0
+        sparse_chunks: List[np.ndarray] = []
+        offsets = [0]
+        dense_parts: List[float] = []
+        label = show = clk = None
+        try:
+            for slot in desc.slots:
+                n = int(toks[pos]); pos += 1
+                vals = toks[pos:pos + n]; pos += n
+                if len(vals) != n:
+                    return None
+                if slot.type == "uint64":
+                    if slot.is_used:
+                        arr = np.array(vals, dtype=np.uint64)
+                        sparse_chunks.append(arr)
+                        offsets.append(offsets[-1] + n)
+                else:
+                    fvals = [float(v) for v in vals]
+                    if slot.name == desc.label_slot:
+                        label = fvals[0] if fvals else 0.0
+                    elif slot.name == desc.show_slot:
+                        show = fvals[0] if fvals else 1.0
+                    elif slot.name == desc.clk_slot:
+                        clk = fvals[0] if fvals else 0.0
+                    elif slot.is_used:
+                        if len(fvals) != slot.dim:
+                            return None
+                        dense_parts.extend(fvals)
+        except (ValueError, IndexError):
+            return None
+        keys = (np.concatenate(sparse_chunks) if sparse_chunks
+                else np.empty(0, dtype=np.uint64))
+        return SlotRecord(
+            keys=keys,
+            slot_offsets=np.array(offsets, dtype=np.int32),
+            dense=np.array(dense_parts, dtype=np.float32),
+            label=0.0 if label is None else label,
+            show=1.0 if show is None else show,
+            clk=(label if clk is None and label is not None else (clk or 0.0)),
+        )
+
+
+class CriteoParser(BaseParser):
+    """Criteo display-ads TSV: label \\t I1..I13 \\t C1..C26 (hex).
+
+    Dense ints get the standard log(x+1) transform; missing dense → 0;
+    missing categorical → slot-salted sentinel key. Each categorical value is
+    salted with its slot index so ids don't collide across slots in a single
+    shared table (the reference keeps per-slot feasign spaces; we fold the
+    slot id into the key's high bits instead — one unified key space is the
+    TPU-friendly layout for a single sharded table)."""
+
+    _SLOT_SHIFT = 52  # 26 slots fit in high bits; low 52 bits hash payload
+
+    def parse(self, line: str) -> Optional[SlotRecord]:
+        f = line.rstrip("\n").split("\t")
+        if len(f) != 40:
+            return None
+        try:
+            label = float(f[0])
+        except ValueError:
+            return None
+        dense = np.zeros(13, dtype=np.float32)
+        for i in range(13):
+            v = f[1 + i]
+            if v:
+                try:
+                    dense[i] = np.log1p(max(float(v), 0.0))
+                except ValueError:
+                    pass
+        keys = np.empty(26, dtype=np.uint64)
+        mask = (np.uint64(1) << np.uint64(self._SLOT_SHIFT)) - np.uint64(1)
+        for i in range(26):
+            v = f[14 + i]
+            h = np.uint64(int(v, 16)) if v else np.uint64(0xFFFFFFFF)
+            keys[i] = (np.uint64(i + 1) << np.uint64(self._SLOT_SHIFT)) | (h & mask)
+        offsets = np.arange(27, dtype=np.int32)  # one key per slot
+        return SlotRecord(keys=keys, slot_offsets=offsets, dense=dense,
+                          label=label, show=1.0, clk=label)
+
+
+_PARSERS: Dict[str, Type[BaseParser]] = {}
+
+
+def register_parser(name: str, cls: Type[BaseParser]) -> None:
+    _PARSERS[name] = cls
+
+
+def get_parser(desc: DataFeedDesc) -> BaseParser:
+    try:
+        return _PARSERS[desc.parser](desc)
+    except KeyError:
+        raise KeyError(
+            f"unknown parser {desc.parser!r}; registered: {sorted(_PARSERS)}"
+        ) from None
+
+
+register_parser("slot_text", SlotTextParser)
+register_parser("criteo", CriteoParser)

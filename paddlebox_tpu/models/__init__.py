@@ -1,0 +1,9 @@
+from paddlebox_tpu.models.ctr_dnn import CtrDnn
+from paddlebox_tpu.models.deepfm import DeepFM
+
+MODEL_REGISTRY = {
+    "ctr_dnn": CtrDnn,
+    "deepfm": DeepFM,
+}
+
+__all__ = ["CtrDnn", "DeepFM", "MODEL_REGISTRY"]

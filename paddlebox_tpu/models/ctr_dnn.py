@@ -1,0 +1,37 @@
+"""ctr_dnn — the PaddleRec classic CTR MLP (BASELINE.json config #1).
+
+Reference model shape: pooled slot embeddings (+CVM columns) concatenated
+with dense features into an MLP tower; the reference builds it from
+``_pull_box_sparse`` + ``fused_seqpool_cvm`` + stacked ``fc`` ops
+(python/paddle/fluid/layers/nn.py:793, contrib/layers/nn.py:1750).
+
+Input here is the fused_seqpool_cvm output: ``pooled [B, S, D]`` where
+D = cvm_offset(2) + embed_w(1) + mf_dim. bfloat16 matmuls on the MXU with
+f32 params/accumulation.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class CtrDnn(nn.Module):
+    hidden: Sequence[int] = (400, 400, 400)
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, pooled: jax.Array, dense: jax.Array) -> jax.Array:
+        b = pooled.shape[0]
+        x = jnp.concatenate(
+            [pooled.reshape(b, -1), dense], axis=1).astype(self.compute_dtype)
+        for h in self.hidden:
+            x = nn.Dense(h, dtype=self.compute_dtype,
+                         kernel_init=nn.initializers.glorot_uniform())(x)
+            x = nn.relu(x)
+        logit = nn.Dense(1, dtype=jnp.float32,
+                         kernel_init=nn.initializers.glorot_uniform())(x)
+        return logit[:, 0].astype(jnp.float32)

@@ -1,0 +1,52 @@
+"""DeepFM — wide (1st-order) + FM (2nd-order) + deep tower
+(BASELINE.json config #2: DeepFM on Criteo).
+
+The table's pull layout maps onto DeepFM naturally: ``embed_w`` (1-dim wide
+weight per feature, reference FeatureValue lr field) is the FM first-order
+term; ``embedx`` (mf vector) feeds both the FM pairwise term and the deep
+tower — exactly how the reference's CTR models consume
+pull_box_sparse outputs (embed + embedx split,
+pull_box_extended_sparse_op semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class DeepFM(nn.Module):
+    hidden: Sequence[int] = (400, 400)
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    cvm_offset: int = 2
+
+    @nn.compact
+    def __call__(self, pooled: jax.Array, dense: jax.Array) -> jax.Array:
+        b, s, d = pooled.shape
+        co = self.cvm_offset
+        wide = pooled[..., co]           # [B, S] per-slot 1st-order weights
+        vecs = pooled[..., co + 1:]      # [B, S, mf] FM factors
+
+        # first order: Σ wide + linear(dense)
+        first = jnp.sum(wide, axis=1) + nn.Dense(
+            1, dtype=jnp.float32)(dense)[:, 0]
+
+        # FM second order: 0.5 * Σ_k [(Σ_s v)² - Σ_s v²]
+        vs = vecs.astype(jnp.float32)
+        sum_sq = jnp.square(jnp.sum(vs, axis=1))
+        sq_sum = jnp.sum(jnp.square(vs), axis=1)
+        fm = 0.5 * jnp.sum(sum_sq - sq_sum, axis=1)
+
+        # deep tower over [cvm stats + vectors + dense]
+        x = jnp.concatenate(
+            [pooled.reshape(b, -1), dense], axis=1).astype(self.compute_dtype)
+        for h in self.hidden:
+            x = nn.Dense(h, dtype=self.compute_dtype,
+                         kernel_init=nn.initializers.glorot_uniform())(x)
+            x = nn.relu(x)
+        deep = nn.Dense(1, dtype=jnp.float32)(x)[:, 0]
+
+        return (first + fm + deep).astype(jnp.float32)

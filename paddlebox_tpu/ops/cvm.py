@@ -1,0 +1,52 @@
+"""Unfused CVM transform op.
+
+Reference: paddle/fluid/operators/cvm_op.{h,cc,cu} — ``CvmComputeKernel``
+(cvm_op.h:25-40): with use_cvm, y0=log(x0+1), y1=log(x1+1)-y0, rest copied
+(same width); without, the two cvm columns are stripped. Backward
+``CvmGradComputeKernel`` (:43-58): dx[0:2] = CVM batch values, embed dims
+pass the upstream grad straight through (log is NOT differentiated — the
+show/clk columns are statistics channels for the PS, not trained weights).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def cvm(x: jax.Array, batch_cvm: jax.Array, use_cvm: bool = True) -> jax.Array:
+    """x: [B, D] with x[:,0]=show, x[:,1]=clk; batch_cvm: [B, 2].
+    Returns [B, D] (use_cvm) or [B, D-2]."""
+    out, _ = _fwd(x, batch_cvm, use_cvm)
+    return out
+
+
+def _fwd(x, batch_cvm, use_cvm):
+    if use_cvm:
+        show = jnp.log1p(x[:, 0:1])
+        ctr = jnp.log1p(x[:, 1:2]) - show
+        out = jnp.concatenate([show, ctr, x[:, 2:]], axis=1)
+    else:
+        out = x[:, 2:]
+    return out, (batch_cvm, jnp.zeros((0,), x.dtype))
+
+
+def _bwd(use_cvm, res, g):
+    batch_cvm, xtoken = res
+    g_embed = g[:, 2:] if use_cvm else g
+    dx = jnp.concatenate([batch_cvm.astype(g_embed.dtype), g_embed], axis=1)
+    return (dx.astype(xtoken.dtype), None)
+
+
+cvm.defvjp(_fwd, _bwd)
+
+
+def cvm_grad_passthrough(x: jax.Array) -> jax.Array:
+    """Identity whose gradient skips the first two (show/clk) columns —
+    convenience for models wiring raw pulled values into non-CVM heads."""
+    zero2 = jnp.concatenate(
+        [jnp.zeros_like(x[:, :2]), jnp.ones_like(x[:, 2:])], axis=1)
+    return x * zero2 + jax.lax.stop_gradient(x * (1 - zero2))

@@ -1,0 +1,112 @@
+"""fused_seqpool_cvm — the core CTR fusion.
+
+Reference: paddle/fluid/operators/fused/fused_seqpool_cvm_op.{cc,cu}
+(attrs at fused_seqpool_cvm_op.cc:28-106; kernels: FusedSeqpoolKernelNormal/
+Quant/QuantFilter :36-133, FusedCVMKernelWithCVM :276-298 —
+out0=log(show+1), out1=log(click+1)-log(show+1) — and the backward
+FusedSeqpoolCVMGradKernelWithCVM :634-657 where the first ``cvm_offset``
+output dims receive the batch CVM values instead of chain-rule grads, so the
+pushed sparse grad carries show/clk statistics to the PS).
+
+TPU-native redesign: the reference launches one CUDA kernel over N per-slot
+LoDTensors with a device LoD table. Here every slot of every instance is one
+segment of a single flattened ``[K, D]`` value tensor (segment id =
+ins*S + slot, built host-side by BatchBuilder), so the whole 1000-slot fusion
+is ONE ``jax.ops.segment_sum`` + elementwise epilogue — XLA fuses the filter,
+quantization, and CVM transform into the scatter-add; no per-slot launches,
+no dynamic shapes. Backward is a ``custom_vjp`` replicating the reference's
+show/clk-value-as-grad contract (a gather over segments — also one fused op).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11, 12))
+def fused_seqpool_cvm(
+    values: jax.Array,          # [K, D] pulled embeddings (D includes cvm dims)
+    segments: jax.Array,        # [K] int32, ins*S + slot; pad rows → B*S
+    batch_show_clk: jax.Array,  # [B, cvm_offset] batch show/clk (CVM input)
+    batch_size: int,
+    num_slots: int,
+    use_cvm: bool = True,
+    cvm_offset: int = 2,
+    pad_value: float = 0.0,
+    need_filter: bool = False,
+    show_coeff: float = 0.2,
+    clk_coeff: float = 1.0,
+    threshold: float = 0.96,
+    quant_ratio: int = 0,
+) -> jax.Array:
+    """Returns [B, S, D] if use_cvm else [B, S, D - cvm_offset]."""
+    out, _ = _fwd(values, segments, batch_show_clk, batch_size, num_slots,
+                  use_cvm, cvm_offset, pad_value, need_filter, show_coeff,
+                  clk_coeff, threshold, quant_ratio)
+    return out
+
+
+def _fwd(values, segments, batch_show_clk, batch_size, num_slots, use_cvm,
+         cvm_offset, pad_value, need_filter, show_coeff, clk_coeff,
+         threshold, quant_ratio):
+    k, d = values.shape
+    if need_filter:
+        # FusedSeqpoolKernelQuantFilter :93-133: drop items failing the
+        # show/clk significance test
+        show, clk = values[:, 0], values[:, 1]
+        keep = ((show - clk) * show_coeff + clk * clk_coeff) >= threshold
+    else:
+        keep = jnp.ones((k,), dtype=bool)
+    v = values
+    if quant_ratio > 0:
+        # quantize embedx dims only; cvm dims pass through (:78-90)
+        q = jnp.floor(v * quant_ratio + 0.5) / quant_ratio
+        col = jnp.arange(d) >= cvm_offset
+        v = jnp.where(col[None, :], q, v)
+    v = jnp.where(keep[:, None], v, 0.0)
+    num_segments = batch_size * num_slots + 1  # +1 pad bin, dropped below
+    pooled = jax.ops.segment_sum(v, segments, num_segments=num_segments)
+    pooled = pooled[:-1].reshape(batch_size, num_slots, d) + pad_value
+    if use_cvm:
+        # FusedCVMKernelWithCVM :276: [log(show+1), log(clk+1)-log(show+1), …]
+        show_l = jnp.log1p(pooled[..., 0:1])
+        ctr = jnp.log1p(pooled[..., 1:2]) - show_l
+        out = jnp.concatenate([show_l, ctr, pooled[..., cvm_offset:]], axis=-1)
+    else:
+        out = pooled[..., cvm_offset:]
+    # zero-size token carries the primal dtype/width through residuals
+    vtoken = jnp.zeros((0, values.shape[1]), values.dtype)
+    return out, (segments, keep, vtoken, batch_show_clk)
+
+
+def _bwd(batch_size, num_slots, use_cvm, cvm_offset, pad_value, need_filter,
+         show_coeff, clk_coeff, threshold, quant_ratio, res, g):
+    segments, keep, vtoken, batch_show_clk = res
+    d = vtoken.shape[1]
+    vdtype = vtoken.dtype
+    # Reference backward (:634-657): embedx dims broadcast the output grad to
+    # every surviving sequence item; the first cvm_offset dims carry the
+    # *batch CVM values* (show/clk) so the sparse push learns counters.
+    # Quant and the log transform are straight-through, exactly as the CUDA
+    # grad kernel ignores them.
+    embedx_g = g[..., cvm_offset:] if use_cvm else g
+    flat = embedx_g.reshape(batch_size * num_slots, d - cvm_offset)
+    flat = jnp.concatenate(
+        [flat, jnp.zeros((1, d - cvm_offset), flat.dtype)], axis=0)
+    g_embedx = flat[segments]                              # [K, D-cvm]
+    ins = jnp.minimum(segments // num_slots, batch_size - 1)
+    g_cvm = batch_show_clk[ins]                            # [K, cvm_offset]
+    pad = segments >= batch_size * num_slots
+    g_values = jnp.where(
+        (keep & ~pad)[:, None],
+        jnp.concatenate([g_cvm.astype(g_embedx.dtype), g_embedx], axis=-1),
+        0.0,
+    ).astype(vdtype)
+    return (g_values, None, None)
+
+
+fused_seqpool_cvm.defvjp(_fwd, _bwd)

@@ -1,0 +1,9 @@
+from paddlebox_tpu.ps.sgd import SparseSGDConfig, SparseAdamConfig
+from paddlebox_tpu.ps.table import (
+    EmbeddingTable, TableState, PullIndex, pull_rows, expand_pull,
+    apply_push, merge_push, push_stats, init_table_state,
+)
+
+__all__ = ["SparseSGDConfig", "SparseAdamConfig", "EmbeddingTable",
+           "TableState", "PullIndex", "pull_rows", "expand_pull",
+           "apply_push", "merge_push", "push_stats", "init_table_state"]

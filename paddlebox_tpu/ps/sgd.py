@@ -1,0 +1,133 @@
+"""Sparse in-table optimizers (Adagrad / Adam) — pure JAX row updates.
+
+Reference: paddle/fluid/framework/fleet/heter_ps/optimizer.cuh.h —
+``SparseAdagradOptimizer::dy_mf_update_value`` (:80-133): show/clk/delta_score
+counter updates, Adagrad with ``ratio = lr * sqrt(g0 / (g0 + g2sum))`` and
+per-show gradient scaling (``scaled_grad = g / g_show``), ±bound clipping,
+g2sum += mean(scaled²), and lazy embedx creation when
+``nonclk_coeff*(show-clk) + clk_coeff*clk`` crosses ``mf_create_thresholds``
+(init: uniform[0,1) * mf_initial_range, :105-122). ``SparseAdamOptimizer``
+(:148-330) keeps per-row beta1/beta2 powers. Defaults mirror
+optimizer_conf.h:22-45.
+
+TPU-native formulation: the CUDA version mutates one packed float* per row
+inside the hashtable kernel; here updates are batched pure functions over
+row-major SoA arrays (``[U]``/``[U, mf_dim]``), vectorized on the VPU and
+applied by one scatter per state leaf. Lazy mf creation becomes a two-phase
+masked select (update stats → init-new-rows with jax PRNG) instead of an
+in-kernel curand side effect — same math, no per-row control flow.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class SparseSGDConfig:
+    """Adagrad config; field names/defaults from optimizer_conf.h:22-45."""
+
+    nonclk_coeff: float = 0.1
+    clk_coeff: float = 1.0
+    # embed (wide 1-dim) part
+    min_bound: float = -10.0
+    max_bound: float = 10.0
+    learning_rate: float = 0.05
+    initial_g2sum: float = 3.0
+    initial_range: float = 0.0
+    # embedx (mf) part
+    mf_create_thresholds: float = 10.0
+    mf_learning_rate: float = 0.05
+    mf_initial_g2sum: float = 3.0
+    mf_initial_range: float = 1e-4
+    mf_min_bound: float = -10.0
+    mf_max_bound: float = 10.0
+
+
+@dataclasses.dataclass(frozen=True)
+class SparseAdamConfig(SparseSGDConfig):
+    beta1_decay_rate: float = 0.9
+    beta2_decay_rate: float = 0.999
+    ada_epsilon: float = 1e-8
+
+
+class RowState(NamedTuple):
+    """Per-row slice of the table state touched by one update (SoA)."""
+
+    show: jax.Array          # [U]
+    clk: jax.Array           # [U]
+    delta_score: jax.Array   # [U]
+    embed_w: jax.Array       # [U]
+    embed_g2sum: jax.Array   # [U]
+    embedx_w: jax.Array      # [U, mf_dim]
+    embedx_g2sum: jax.Array  # [U]
+    mf_size: jax.Array       # [U] 0/1 — embedx materialized flag
+
+
+def _adagrad_dir(g: jax.Array, g2sum: jax.Array, scale: jax.Array,
+                 lr: float, g0: float, lo: float, hi: float,
+                 w: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """One update_value_work (optimizer.cuh.h:42-72) on [U] or [U, n] grads.
+    Returns (new_w, g2sum_increment). ``scale`` broadcasts over the last dim."""
+    ratio = lr * jnp.sqrt(g0 / (g0 + g2sum))
+    safe = jnp.maximum(scale, 1e-20)  # rows with g_show==0 are masked upstream
+    scaled = g / safe[..., None] if g.ndim == 2 else g / safe
+    if g.ndim == 2:
+        neww = jnp.clip(w + scaled * ratio[:, None], lo, hi)
+        inc = jnp.mean(scaled * scaled, axis=-1)
+    else:
+        neww = jnp.clip(w + scaled * ratio, lo, hi)
+        inc = scaled * scaled
+    return neww, inc
+
+
+def adagrad_update(
+    rows: RowState,
+    g_show: jax.Array,    # [U]
+    g_clk: jax.Array,     # [U]
+    g_embed: jax.Array,   # [U]
+    g_embedx: jax.Array,  # [U, mf_dim]
+    touched: jax.Array,   # [U] bool — at least one real key hit this row
+    cfg: SparseSGDConfig,
+    rng: jax.Array,
+) -> RowState:
+    """Batched dy_mf_update_value. Untouched (padding) rows pass through."""
+    show = rows.show + g_show
+    clk = rows.clk + g_clk
+    delta = rows.delta_score + cfg.nonclk_coeff * (g_show - g_clk) \
+        + cfg.clk_coeff * g_clk
+
+    embed_w, embed_inc = _adagrad_dir(
+        g_embed, rows.embed_g2sum, g_show, cfg.learning_rate,
+        cfg.initial_g2sum, cfg.min_bound, cfg.max_bound, rows.embed_w)
+    embed_g2sum = rows.embed_g2sum + embed_inc
+
+    # existing mf rows: normal adagrad step
+    embedx_new, embedx_inc = _adagrad_dir(
+        g_embedx, rows.embedx_g2sum, g_show, cfg.mf_learning_rate,
+        cfg.mf_initial_g2sum, cfg.mf_min_bound, cfg.mf_max_bound,
+        rows.embedx_w)
+    has_mf = rows.mf_size > 0
+    # lazy creation: threshold on the *post-update* counters (:105-113)
+    score = cfg.nonclk_coeff * (show - clk) + cfg.clk_coeff * clk
+    create = (~has_mf) & (score >= cfg.mf_create_thresholds)
+    init = jax.random.uniform(rng, rows.embedx_w.shape,
+                              rows.embedx_w.dtype) * cfg.mf_initial_range
+    embedx_w = jnp.where(create[:, None], init,
+                         jnp.where(has_mf[:, None], embedx_new,
+                                   rows.embedx_w))
+    embedx_g2sum = jnp.where(has_mf, rows.embedx_g2sum + embedx_inc,
+                             rows.embedx_g2sum)
+    mf_size = jnp.where(create, 1.0, rows.mf_size)
+
+    upd = RowState(show, clk, delta, embed_w, embed_g2sum, embedx_w,
+                   embedx_g2sum, mf_size)
+    t = touched
+    return RowState(*[
+        jnp.where(t[:, None] if new.ndim == 2 else t, new, old)
+        for new, old in zip(upd, rows)
+    ])

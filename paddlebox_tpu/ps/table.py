@@ -1,0 +1,369 @@
+"""HBM-resident embedding table — the BoxPS/HeterPS store, single shard.
+
+Reference capabilities re-implemented (SURVEY.md §2.1-2.2):
+- ``BoxWrapper::PullSparse/PushSparseGrad`` (fleet/box_wrapper.h:488,526)
+  with key dedup (``DedupKeysAndFillIdx``, box_wrapper_impl.h:129);
+- the HeterPS GPU hashtable value store (heter_ps/hashtable.h:113,
+  feature_value.h:570 ``FeatureValue`` layout) with in-table optimizer
+  application (optimizer.cuh.h);
+- pass/save lifecycle hooks (BeginPass/EndPass/SaveBase/SaveDelta/
+  ShrinkTable, box_wrapper.cc:171-186,1383-1415).
+
+TPU-native redesign: XLA needs static shapes, so the device side is a
+statically-sized SoA of ``[capacity+1]`` arrays (row ``capacity`` is a
+permanent zero "sentinel" used for padding); the key→row mapping is a host
+hash index updated during batch preparation (overlapped with device compute
+by the trainer's prefetch pipeline). Per-batch key dedup happens on host
+(np.unique == DedupKeysAndFillIdx), so the device step is three fused ops:
+gather unique rows → model fwd/bwd → segment-sum grads + one scatter update.
+No dynamic growth inside jit — the riskiest reference behavior (SSD-backed
+dynamic hashtable) maps to host-index growth + static device capacity
+(+ Phase-5 host backing store).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.config import FLAGS
+from paddlebox_tpu.data.batch import SlotBatch
+from paddlebox_tpu.ps.sgd import RowState, SparseSGDConfig, adagrad_update
+from paddlebox_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class TableState(NamedTuple):
+    """Device SoA, leaves shaped [C+1] / [C+1, mf_dim]; row C is the zero
+    sentinel (FeatureValue fields, feature_value.h:570)."""
+
+    show: jax.Array
+    clk: jax.Array
+    delta_score: jax.Array
+    slot: jax.Array
+    embed_w: jax.Array
+    embed_g2sum: jax.Array
+    embedx_w: jax.Array
+    embedx_g2sum: jax.Array
+    mf_size: jax.Array
+
+    @property
+    def capacity(self) -> int:
+        return self.show.shape[0] - 1
+
+    @property
+    def mf_dim(self) -> int:
+        return self.embedx_w.shape[1]
+
+
+class PullIndex(NamedTuple):
+    """Host-built per-batch dedup index (DedupKeysAndFillIdx analogue)."""
+
+    unique_rows: np.ndarray  # int32 [U_pad]; pads → sentinel row C
+    gather_idx: np.ndarray   # int32 [K_pad]; pads → sentinel slot
+    key_valid: np.ndarray    # f32   [K_pad]; 1.0 for real keys
+    num_unique: int
+
+
+class HostKV:
+    """Host key→row hash index with free-list reuse. The python-dict stand-in
+    for the cuDF concurrent map (hashtable.h:113); swapped for the C++
+    native index when built (paddlebox_tpu/native)."""
+
+    def __init__(self, capacity: int) -> None:
+        self.capacity = capacity
+        self._map: Dict[int, int] = {}
+        self._free: list[int] = []
+        self._next = 0
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def assign(self, keys: np.ndarray) -> np.ndarray:
+        """uint64 keys → int32 rows, allocating new rows for unseen keys."""
+        rows = np.empty(len(keys), dtype=np.int32)
+        m = self._map
+        for i, k in enumerate(keys.tolist()):
+            r = m.get(k)
+            if r is None:
+                if self._free:
+                    r = self._free.pop()
+                elif self._next < self.capacity:
+                    r = self._next
+                    self._next += 1
+                else:
+                    raise RuntimeError(
+                        f"embedding table full ({self.capacity} rows); raise "
+                        "FLAGS.table_capacity_per_shard or enable shrink")
+                m[k] = r
+            rows[i] = r
+        return rows
+
+    def lookup(self, keys: np.ndarray) -> np.ndarray:
+        """Like assign but unseen keys → sentinel (-1)."""
+        m = self._map
+        return np.array([m.get(k, -1) for k in keys.tolist()], dtype=np.int32)
+
+    def release(self, keys: np.ndarray) -> np.ndarray:
+        rows = np.empty(len(keys), dtype=np.int32)
+        for i, k in enumerate(keys.tolist()):
+            r = self._map.pop(k, -1)
+            if r >= 0:
+                self._free.append(r)
+            rows[i] = r
+        return rows[rows >= 0]
+
+    def items(self) -> Tuple[np.ndarray, np.ndarray]:
+        if not self._map:
+            return (np.empty(0, np.uint64), np.empty(0, np.int32))
+        ks = np.fromiter(self._map.keys(), dtype=np.uint64, count=len(self._map))
+        rs = np.fromiter(self._map.values(), dtype=np.int32, count=len(self._map))
+        return ks, rs
+
+
+def init_table_state(capacity: int, mf_dim: int,
+                     dtype=jnp.float32) -> TableState:
+    c1 = capacity + 1
+    z = lambda *shape: jnp.zeros(shape, dtype)
+    return TableState(
+        show=z(c1), clk=z(c1), delta_score=z(c1), slot=z(c1),
+        embed_w=z(c1), embed_g2sum=z(c1),
+        embedx_w=z(c1, mf_dim), embedx_g2sum=z(c1), mf_size=z(c1),
+    )
+
+
+def pull_rows(state: TableState, unique_rows: jax.Array) -> jax.Array:
+    """Gather pull-values for deduped rows → [U, 3+mf_dim] laid out as
+    [show, clk, embed_w, embedx…] (FeaturePullValue, feature_value.h:161).
+    Non-materialized mf (mf_size==0) reads as zeros, as in CopyForPull."""
+    show = state.show[unique_rows]
+    clk = state.clk[unique_rows]
+    w = state.embed_w[unique_rows]
+    gate = (state.mf_size[unique_rows] > 0).astype(state.embedx_w.dtype)
+    mf = state.embedx_w[unique_rows] * gate[:, None]
+    return jnp.concatenate(
+        [show[:, None], clk[:, None], w[:, None], mf], axis=1)
+
+
+def expand_pull(values_u: jax.Array, gather_idx: jax.Array) -> jax.Array:
+    """[U, D] unique values → [K, D] per-key-occurrence values."""
+    return values_u[gather_idx]
+
+
+def merge_push(key_grads: jax.Array, gather_idx: jax.Array,
+               key_valid: jax.Array, slot_of_key: jax.Array,
+               num_unique: int) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Dedup-merge per-key-occurrence grads into per-unique-row grads —
+    PushMergeCopy (box_wrapper.cu:417). Returns (unique_grads [U, D],
+    touched [U] bool, slot_val [U]). NOTE: when grads come from autodiff
+    through ``expand_pull`` they are ALREADY occurrence-merged; use
+    ``push_stats`` for just touched/slot then."""
+    g = jax.ops.segment_sum(key_grads * key_valid[:, None], gather_idx,
+                            num_segments=num_unique)
+    touched, slot_val = push_stats(gather_idx, key_valid, slot_of_key,
+                                   num_unique)
+    return g, touched, slot_val
+
+
+def push_stats(gather_idx: jax.Array, key_valid: jax.Array,
+               slot_of_key: jax.Array,
+               num_unique: int) -> Tuple[jax.Array, jax.Array]:
+    """Per-unique-row touched flag and mean slot id."""
+    cnt = jax.ops.segment_sum(key_valid, gather_idx, num_segments=num_unique)
+    slot_sum = jax.ops.segment_sum(slot_of_key * key_valid, gather_idx,
+                                   num_segments=num_unique)
+    touched = cnt > 0
+    slot_val = jnp.where(touched, slot_sum / jnp.maximum(cnt, 1.0), 0.0)
+    return touched, slot_val
+
+
+def apply_push(
+    state: TableState,
+    unique_rows: jax.Array,   # int32 [U_pad]
+    unique_grads: jax.Array,  # [U_pad, 3+mf_dim]: [g_show, g_clk, g_embed, g_embedx…]
+    touched: jax.Array,       # bool [U_pad]
+    slot_val: jax.Array,      # f32 [U_pad]
+    cfg: SparseSGDConfig,
+    rng: jax.Array,
+) -> TableState:
+    """In-table optimizer on merged grads — dy_mf_update_value
+    (optimizer.cuh.h:80) + scatter write-back."""
+    g = unique_grads
+    rows = RowState(
+        show=state.show[unique_rows], clk=state.clk[unique_rows],
+        delta_score=state.delta_score[unique_rows],
+        embed_w=state.embed_w[unique_rows],
+        embed_g2sum=state.embed_g2sum[unique_rows],
+        embedx_w=state.embedx_w[unique_rows],
+        embedx_g2sum=state.embedx_g2sum[unique_rows],
+        mf_size=state.mf_size[unique_rows],
+    )
+    mf_dim = state.mf_dim
+    new = adagrad_update(rows, g[:, 0], g[:, 1], g[:, 2], g[:, 3:3 + mf_dim],
+                         touched, cfg, rng)
+    slot_new = jnp.where(touched, slot_val,
+                         state.slot[unique_rows])
+
+    st = TableState(
+        show=state.show.at[unique_rows].set(new.show),
+        clk=state.clk.at[unique_rows].set(new.clk),
+        delta_score=state.delta_score.at[unique_rows].set(new.delta_score),
+        slot=state.slot.at[unique_rows].set(slot_new),
+        embed_w=state.embed_w.at[unique_rows].set(new.embed_w),
+        embed_g2sum=state.embed_g2sum.at[unique_rows].set(new.embed_g2sum),
+        embedx_w=state.embedx_w.at[unique_rows].set(new.embedx_w),
+        embedx_g2sum=state.embedx_g2sum.at[unique_rows].set(new.embedx_g2sum),
+        mf_size=state.mf_size.at[unique_rows].set(new.mf_size),
+    )
+    # restore the zero sentinel row (pads scatter pass-through values there)
+    c = state.capacity
+    return TableState(*[
+        leaf.at[c].set(0.0) for leaf in st
+    ])
+
+
+class EmbeddingTable:
+    """Single-shard embedding PS facade (BoxWrapper role)."""
+
+    def __init__(self, mf_dim: int = 8, capacity: Optional[int] = None,
+                 cfg: Optional[SparseSGDConfig] = None, seed: int = 0,
+                 unique_bucket_min: int = 1024) -> None:
+        self.mf_dim = mf_dim
+        self.capacity = capacity or FLAGS.table_capacity_per_shard
+        self.cfg = cfg or SparseSGDConfig()
+        self.index = HostKV(self.capacity)
+        self.state = init_table_state(self.capacity, mf_dim)
+        self._rng = jax.random.PRNGKey(seed)
+        self._push_count = 0
+        self.unique_bucket_min = unique_bucket_min
+        self._touched = np.zeros(self.capacity + 1, dtype=bool)
+
+    # ---- per-batch host prep (dedup + row assignment) ----
+    def prepare(self, batch: SlotBatch) -> PullIndex:
+        valid = batch.keys[:batch.num_keys]
+        uniq, inv = np.unique(valid, return_inverse=True)
+        rows = self.index.assign(uniq)
+        u = len(uniq)
+        cap = self.unique_bucket_min
+        while cap < u + 1:
+            cap *= 2
+        unique_rows = np.full(cap, self.capacity, dtype=np.int32)
+        unique_rows[:u] = rows
+        k_pad = batch.keys.shape[0]
+        gather_idx = np.full(k_pad, u, dtype=np.int32)  # pads → sentinel slot
+        gather_idx[:batch.num_keys] = inv.astype(np.int32)
+        key_valid = np.zeros(k_pad, dtype=np.float32)
+        key_valid[:batch.num_keys] = 1.0
+        self._touched[rows] = True
+        return PullIndex(unique_rows, gather_idx, key_valid, u)
+
+    def next_rng(self) -> jax.Array:
+        self._push_count += 1
+        return jax.random.fold_in(self._rng, self._push_count)
+
+    # ---- eager convenience (tests / small runs) ----
+    def pull(self, idx: PullIndex) -> jax.Array:
+        vals_u = pull_rows(self.state, jnp.asarray(idx.unique_rows))
+        return expand_pull(vals_u, jnp.asarray(idx.gather_idx))
+
+    def push(self, idx: PullIndex, key_grads: jax.Array,
+             slot_of_key: Optional[jax.Array] = None) -> None:
+        """Per-key-occurrence grads in → dedup-merge → optimizer apply."""
+        if slot_of_key is None:
+            slot_of_key = jnp.zeros(idx.gather_idx.shape[0], jnp.float32)
+        gi = jnp.asarray(idx.gather_idx)
+        kv = jnp.asarray(idx.key_valid)
+        g, touched, slot_val = merge_push(
+            key_grads, gi, kv, slot_of_key, idx.unique_rows.shape[0])
+        self.state = apply_push(
+            self.state, jnp.asarray(idx.unique_rows), g, touched, slot_val,
+            self.cfg, self.next_rng())
+
+    # ---- lifecycle: save / load / shrink (box_wrapper.cc:1383-1415) ----
+    def _gather_host(self, rows: np.ndarray) -> Dict[str, np.ndarray]:
+        st = jax.device_get(self.state)
+        return {f: np.asarray(leaf)[rows] for f, leaf in zip(TableState._fields, st)}
+
+    def save_base(self, path: str) -> int:
+        """Full model dump (day-level batch model). Returns rows saved."""
+        keys, rows = self.index.items()
+        data = self._gather_host(rows)
+        np.savez_compressed(path, keys=keys, **data)
+        self._touched[:] = False
+        return len(keys)
+
+    def save_delta(self, path: str) -> int:
+        """Incremental dump of rows touched since last save ("xbox delta")."""
+        keys, rows = self.index.items()
+        mask = self._touched[rows]
+        keys, rows = keys[mask], rows[mask]
+        data = self._gather_host(rows)
+        np.savez_compressed(path, keys=keys, **data)
+        self._touched[:] = False
+        return len(keys)
+
+    def load(self, path: str, merge: bool = False) -> int:
+        """Load a save_base/save_delta file; merge=True keeps existing rows
+        (delta apply), else resets the table first."""
+        blob = np.load(path)
+        keys = blob["keys"]
+        if not merge:
+            self.index = HostKV(self.capacity)
+            self.state = init_table_state(self.capacity, self.mf_dim)
+            self._touched[:] = False
+        rows = self.index.assign(keys)
+        st = jax.device_get(self.state)
+        new_leaves = []
+        for f, leaf in zip(TableState._fields, st):
+            arr = np.asarray(leaf).copy()
+            arr[rows] = blob[f]
+            new_leaves.append(jnp.asarray(arr))
+        self.state = TableState(*new_leaves)
+        return len(keys)
+
+    def shrink(self, delete_threshold: Optional[float] = None,
+               decay: Optional[float] = None) -> int:
+        """Age features: decay show/clk/delta_score, then drop rows whose
+        decayed score falls below threshold (ShrinkTable semantics:
+        box_wrapper.h:638, ctr_accessor shrink rules). Returns rows freed."""
+        thr = (FLAGS.shrink_delete_threshold
+               if delete_threshold is None else delete_threshold)
+        dk = FLAGS.show_click_decay_rate if decay is None else decay
+        keys, rows = self.index.items()
+        if len(keys) == 0:
+            return 0
+        st = jax.device_get(self.state)
+        show = np.asarray(st.show).copy() * dk
+        clk = np.asarray(st.clk).copy() * dk
+        delta = np.asarray(st.delta_score).copy() * dk
+        score = (self.cfg.nonclk_coeff * (show[rows] - clk[rows])
+                 + self.cfg.clk_coeff * clk[rows])
+        drop = score < thr
+        drop_keys = keys[drop]
+        freed_rows = self.index.release(drop_keys)
+        zero_mask = np.zeros(self.capacity + 1, dtype=bool)
+        zero_mask[freed_rows] = True
+        new_leaves = []
+        for f, leaf in zip(TableState._fields, st):
+            arr = np.asarray(leaf).copy()
+            if f == "show":
+                arr = show
+            elif f == "clk":
+                arr = clk
+            elif f == "delta_score":
+                arr = delta
+            arr[zero_mask] = 0.0
+            new_leaves.append(jnp.asarray(arr))
+        self.state = TableState(*new_leaves)
+        self._touched[freed_rows] = False
+        log.info("shrink: freed %d/%d rows", len(freed_rows), len(keys))
+        return int(len(freed_rows))
+
+    @property
+    def feature_count(self) -> int:
+        return len(self.index)

@@ -1,0 +1,4 @@
+from paddlebox_tpu.train.step import TrainStep, DeviceBatch, make_device_batch
+from paddlebox_tpu.train.trainer import Trainer
+
+__all__ = ["TrainStep", "DeviceBatch", "make_device_batch", "Trainer"]

@@ -1,0 +1,161 @@
+"""The fused train step — pull → fwd → bwd → push → dense update → metrics,
+one jit-compiled XLA program.
+
+Reference hot loop: BoxPSWorker::TrainFiles (framework/boxps_worker.cc:1278)
+runs the ProgramDesc op list per batch: pull_box_sparse →
+fused_seqpool_cvm → dense net fwd/bwd → push_box_sparse, then metric add.
+Here the entire loop body is ONE traced function: XLA fuses the gather,
+segment ops, MXU matmuls, scatter update and AUC histogram into a single
+device program with zero host round-trips; buffer donation makes the table
+and optimizer states update in place.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from paddlebox_tpu.data.batch import SlotBatch
+from paddlebox_tpu.metrics import AucState, auc_add_batch
+from paddlebox_tpu.ops import fused_seqpool_cvm
+from paddlebox_tpu.ps.sgd import SparseSGDConfig
+from paddlebox_tpu.ps.table import (PullIndex, TableState, apply_push,
+                                    expand_pull, pull_rows, push_stats)
+
+
+class DeviceBatch(NamedTuple):
+    """Everything the device step consumes for one batch."""
+
+    unique_rows: jax.Array  # int32 [U_pad]
+    gather_idx: jax.Array   # int32 [K_pad]
+    key_valid: jax.Array    # f32 [K_pad]
+    segments: jax.Array     # int32 [K_pad]
+    dense: jax.Array        # f32 [B, Dd]
+    label: jax.Array        # f32 [B]
+    show: jax.Array         # f32 [B]
+    clk: jax.Array          # f32 [B]
+
+
+def make_device_batch(batch: SlotBatch, idx: PullIndex) -> DeviceBatch:
+    return DeviceBatch(
+        unique_rows=jnp.asarray(idx.unique_rows),
+        gather_idx=jnp.asarray(idx.gather_idx),
+        key_valid=jnp.asarray(idx.key_valid),
+        segments=jnp.asarray(batch.segments),
+        dense=jnp.asarray(batch.dense),
+        label=jnp.asarray(batch.label),
+        show=jnp.asarray(batch.show),
+        clk=jnp.asarray(batch.clk),
+    )
+
+
+class StepState(NamedTuple):
+    table: TableState
+    params: Any
+    opt_state: Any
+    auc: AucState
+    step: jax.Array  # int32 scalar
+
+
+class TrainStep:
+    """Builds and caches the jitted step for a (model, table cfg) pair.
+    One compilation per (K_pad, U_pad) bucket combo."""
+
+    def __init__(
+        self,
+        model,               # flax Module: (pooled, dense) -> logits [B]
+        tx: optax.GradientTransformation,
+        sgd_cfg: SparseSGDConfig,
+        batch_size: int,
+        num_slots: int,
+        use_cvm: bool = True,
+        cvm_offset: int = 2,
+        need_filter: bool = False,
+        quant_ratio: int = 0,
+        rng_seed: int = 0,
+    ) -> None:
+        self.model = model
+        self.tx = tx
+        self.sgd_cfg = sgd_cfg
+        self.batch_size = batch_size
+        self.num_slots = num_slots
+        self.use_cvm = use_cvm
+        self.cvm_offset = cvm_offset
+        self.need_filter = need_filter
+        self.quant_ratio = quant_ratio
+        self.rng = jax.random.PRNGKey(rng_seed)
+        self._jit = jax.jit(self._step, donate_argnums=(0,))
+
+    def init_params(self, mf_dim: int, dense_dim: int) -> Any:
+        d = self.cvm_offset + 1 + mf_dim if self.use_cvm else 1 + mf_dim
+        pooled = jnp.zeros((self.batch_size, self.num_slots, d))
+        dense = jnp.zeros((self.batch_size, dense_dim))
+        return self.model.init(jax.random.PRNGKey(0), pooled, dense)
+
+    def init_state(self, table_state: TableState, params: Any,
+                   auc: AucState) -> StepState:
+        return StepState(table=table_state, params=params,
+                         opt_state=self.tx.init(params), auc=auc,
+                         step=jnp.zeros((), jnp.int32))
+
+    # ---- the traced step ----
+    def _step(self, state: StepState, batch: DeviceBatch,
+              rng: jax.Array) -> Tuple[StepState, Dict[str, jax.Array]]:
+        b, s = self.batch_size, self.num_slots
+        batch_show_clk = jnp.stack([batch.show, batch.clk], axis=1)
+        ins_w = (batch.show > 0).astype(jnp.float32)  # mask tail padding
+
+        vals_u = pull_rows(state.table, batch.unique_rows)
+
+        def loss_fn(params, vals_u):
+            values_k = expand_pull(vals_u, batch.gather_idx)
+            pooled = fused_seqpool_cvm(
+                values_k, batch.segments, batch_show_clk, b, s,
+                self.use_cvm, self.cvm_offset, 0.0, self.need_filter,
+                0.2, 1.0, 0.96, self.quant_ratio)
+            logits = self.model.apply(params, pooled, batch.dense)
+            ls = optax.sigmoid_binary_cross_entropy(logits, batch.label)
+            loss = jnp.sum(ls * ins_w) / jnp.maximum(jnp.sum(ins_w), 1.0)
+            return loss, logits
+
+        (loss, logits), (g_params, g_vals_u) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1), has_aux=True)(state.params, vals_u)
+
+        # sparse push: autodiff through expand_pull (a gather) already
+        # occurrence-merged the per-key grads into per-unique-row grads —
+        # g_vals_u[:, 0] is Σ show over occurrences, etc. (the
+        # PushMergeCopy/DedupKeys contract for free). Embed grads are scaled
+        # by -batch_size as in PushCopy (box_wrapper.cu:368-372: the in-table
+        # adagrad ADDS ratio*g/g_show, so push carries the negated sum-grad).
+        g_vals_u = jnp.concatenate(
+            [g_vals_u[:, :2], g_vals_u[:, 2:] * (-1.0 * b)], axis=1)
+        slot_of_key = (batch.segments % s).astype(jnp.float32)
+        touched, slot_val = push_stats(
+            batch.gather_idx, batch.key_valid, slot_of_key,
+            batch.unique_rows.shape[0])
+        table = apply_push(state.table, batch.unique_rows, g_vals_u,
+                           touched, slot_val, self.sgd_cfg, rng)
+
+        updates, opt_state = self.tx.update(g_params, state.opt_state,
+                                            state.params)
+        params = optax.apply_updates(state.params, updates)
+
+        pred = jax.nn.sigmoid(logits)
+        auc = auc_add_batch(state.auc, pred, batch.label, ins_w)
+
+        new_state = StepState(table=table, params=params,
+                              opt_state=opt_state, auc=auc,
+                              step=state.step + 1)
+        stats = {"loss": loss,
+                 "pred_mean": jnp.sum(pred * ins_w) /
+                 jnp.maximum(jnp.sum(ins_w), 1.0)}
+        return new_state, stats
+
+    def __call__(self, state: StepState, batch: DeviceBatch,
+                 rng: jax.Array) -> Tuple[StepState, Dict[str, jax.Array]]:
+        return self._jit(state, batch, rng)

@@ -1,0 +1,161 @@
+"""Trainer runtime: per-pass training loop with host/device pipelining.
+
+Reference: framework/boxps_trainer.cc (BoxPSTrainer::Run :282 — worker per
+device) + boxps_worker.cc (TrainFiles :1278 hot loop, NaN guard :1326,
+AddAucMonitor :1267) + the Python surface ``exe.train_from_dataset``
+(python/paddle/fluid/executor.py:2412).
+
+TPU-native redesign: instead of one CPU thread per GPU running an op
+interpreter, ONE jit step consumes the whole device mesh (data parallelism
+lives inside the step as shardings, §parallel); the host side is a prefetch
+thread doing what the reference's DataFeed+dedup CUDA kernels did — batch
+build + key dedup + row assignment — overlapped with device compute through
+a bounded channel.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Dict, Iterable, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from paddlebox_tpu.config import FLAGS
+from paddlebox_tpu.data.batch import SlotBatch
+from paddlebox_tpu.data.dataset import Dataset, InMemoryDataset
+from paddlebox_tpu.metrics import (AucResult, MetricRegistry, auc_compute,
+                                   init_auc_state)
+from paddlebox_tpu.ps.table import EmbeddingTable, PullIndex
+from paddlebox_tpu.train.step import (DeviceBatch, StepState, TrainStep,
+                                      make_device_batch)
+from paddlebox_tpu.utils import Channel, Timer
+from paddlebox_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class NanInfError(RuntimeError):
+    pass
+
+
+class Trainer:
+    """Single-replica trainer (multi-chip variant in parallel/)."""
+
+    def __init__(
+        self,
+        model,
+        table: EmbeddingTable,
+        desc,                       # DataFeedDesc
+        tx: Optional[optax.GradientTransformation] = None,
+        use_cvm: bool = True,
+        prefetch: int = 4,
+        seed: int = 0,
+    ) -> None:
+        self.model = model
+        self.table = table
+        self.desc = desc
+        self.tx = tx or optax.adam(1e-3)
+        self.step_fn = TrainStep(
+            model, self.tx, table.cfg, desc.batch_size,
+            len(desc.sparse_slots), use_cvm=use_cvm, rng_seed=seed)
+        params = self.step_fn.init_params(table.mf_dim, desc.dense_dim)
+        self.state = self.step_fn.init_state(table.state, params,
+                                             init_auc_state())
+        # table.state now lives inside self.state; keep table's handle in
+        # sync lazily (sync_table()) for save/shrink.
+        self.metrics = MetricRegistry()
+        self.prefetch = prefetch
+        self._rng = jax.random.PRNGKey(seed + 1)
+        self.global_step = 0
+
+    # ---- host-side prefetch: batch build + dedup + row assign ----
+    def _prefetch_iter(
+        self, batches: Iterable[SlotBatch]
+    ) -> Iterator[Tuple[SlotBatch, PullIndex]]:
+        ch: Channel = Channel(capacity=self.prefetch)
+        err: list = []
+
+        def producer() -> None:
+            try:
+                for b in batches:
+                    ch.put((b, self.table.prepare(b)))
+            except BaseException as e:
+                err.append(e)
+            finally:
+                ch.close()
+
+        th = threading.Thread(target=producer, daemon=True)
+        th.start()
+        for item in ch:
+            yield item
+        th.join()
+        if err:
+            raise err[0]
+
+    def train_pass(self, dataset: Dataset,
+                   log_prefix: str = "") -> Dict[str, float]:
+        """One pass over the dataset — train_from_dataset analogue."""
+        timer = Timer()
+        timer.start()
+        nb = 0
+        stats = None
+        for batch, idx in self._prefetch_iter(dataset.batches()):
+            dev = make_device_batch(batch, idx)
+            self.global_step += 1
+            rng = jax.random.fold_in(self._rng, self.global_step)
+            self.state, stats = self.step_fn(self.state, dev, rng)
+            nb += 1
+            # loss fetch forces a device sync — only on guard/log steps
+            if FLAGS.check_nan_inf or nb % FLAGS.log_period_steps == 0:
+                loss = float(stats["loss"])
+                if math.isnan(loss) or math.isinf(loss):
+                    # reference aborts and dumps scope (boxps_worker.cc:1326)
+                    raise NanInfError(
+                        f"nan/inf loss at step {self.global_step}")
+                if nb % FLAGS.log_period_steps == 0:
+                    log.info("%spass step %d loss=%.5f", log_prefix,
+                             self.global_step, loss)
+        last_loss = float(stats["loss"]) if stats is not None else float("nan")
+        timer.pause()
+        self.sync_table()
+        res = auc_compute(self.state.auc)
+        ex = res.ins_num
+        out = res.as_dict()
+        out.update(batches=nb, elapsed_sec=timer.elapsed_sec(),
+                   examples_per_sec=ex / max(timer.elapsed_sec(), 1e-9),
+                   last_loss=last_loss)
+        log.info("%spass done: %d batches, %.0f ex/s, auc=%.4f",
+                 log_prefix, nb, out["examples_per_sec"], res.auc)
+        return out
+
+    def sync_table(self) -> None:
+        """Write the jit-updated table state back to the EmbeddingTable
+        facade (for save/shrink/load host ops)."""
+        self.table.state = self.state.table
+
+    def reset_metrics(self) -> None:
+        self.state = self.state._replace(auc=init_auc_state())
+
+    # ---- checkpoint glue (dense + sparse) ----
+    def save(self, prefix: str) -> None:
+        import pickle
+        self.sync_table()
+        self.table.save_base(prefix + ".sparse.npz")
+        with open(prefix + ".dense.pkl", "wb") as fh:
+            pickle.dump(jax.device_get((self.state.params,
+                                        self.state.opt_state)), fh)
+
+    def load(self, prefix: str) -> None:
+        import pickle
+        self.table.load(prefix + ".sparse.npz")
+        with open(prefix + ".dense.pkl", "rb") as fh:
+            params, opt_state = pickle.load(fh)
+        self.state = StepState(
+            table=self.table.state,
+            params=jax.device_put(params),
+            opt_state=jax.device_put(opt_state),
+            auc=self.state.auc, step=self.state.step)

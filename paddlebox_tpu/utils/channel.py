@@ -46,19 +46,28 @@ class Channel(Generic[T]):
 
     # -- read side ----------------------------------------------------------
     def get(self, timeout: Optional[float] = None) -> T:
+        import time as _time
+        deadline = None if timeout is None else _time.monotonic() + timeout
         with self._not_empty:
             while not self._q and not self._closed:
-                if not self._not_empty.wait(timeout=timeout):
-                    raise TimeoutError("channel get timed out")
+                remaining = None if deadline is None \
+                    else deadline - _time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    break
+                self._not_empty.wait(timeout=remaining)
             if self._q:
                 item = self._q.popleft()
                 self._not_full.notify()
                 return item
-            raise ChannelClosed("get on closed empty channel")
+            if self._closed:
+                raise ChannelClosed("get on closed empty channel")
+            raise TimeoutError("channel get timed out")
 
     def get_batch(self, max_items: Optional[int] = None) -> List[T]:
         """Blocking batched read; returns [] only when closed and drained."""
-        n = max_items or self._block_size
+        n = self._block_size if max_items is None else max_items
+        if n <= 0:
+            raise ValueError(f"max_items must be positive, got {n}")
         with self._not_empty:
             while not self._q and not self._closed:
                 self._not_empty.wait()

@@ -1,14 +1,22 @@
 """Test harness: force an 8-device virtual CPU platform so multi-chip
 sharding paths (mesh/pjit/shard_map/all_to_all) are exercised without TPUs.
 Mirrors the reference's strategy of testing its distributed PS
-single-process multi-device (SURVEY.md §4, heter_ps/test_comm.cu)."""
+single-process multi-device (SURVEY.md §4, heter_ps/test_comm.cu).
+
+Note: this environment preloads a TPU plugin via sitecustomize and pins
+JAX_PLATFORMS; plain env vars in conftest are too late, so we override
+through jax.config before any backend is initialized."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,134 @@
+import numpy as np
+import pytest
+
+from paddlebox_tpu.data import (
+    BatchBuilder, CriteoParser, DataFeedDesc, DatasetFactory, InMemoryDataset,
+    PaddleBoxDataset, QueueDataset, SlotDef, SlotTextParser, get_parser,
+)
+from paddlebox_tpu.data.criteo import generate_criteo_files
+
+
+def small_desc(bs=4):
+    return DataFeedDesc(
+        slots=[
+            SlotDef("label", "float", 1),
+            SlotDef("s1", "uint64"),
+            SlotDef("s2", "uint64"),
+            SlotDef("d1", "float", 2),
+        ],
+        batch_size=bs, parser="slot_text", label_slot="label",
+        key_bucket_min=8,
+    )
+
+
+def test_slot_text_parser_roundtrip():
+    p = SlotTextParser(small_desc())
+    rec = p.parse("1 1.0 2 11 12 1 21 2 0.5 0.25")
+    assert rec is not None
+    assert rec.label == 1.0
+    np.testing.assert_array_equal(rec.slot_keys(0), np.array([11, 12], np.uint64))
+    np.testing.assert_array_equal(rec.slot_keys(1), np.array([21], np.uint64))
+    np.testing.assert_allclose(rec.dense, [0.5, 0.25])
+    # malformed lines dropped, not raised
+    assert p.parse("garbage") is None
+    assert p.parse("1 1.0 5 1 2") is None
+
+
+def test_criteo_parser_slot_salting():
+    desc = DataFeedDesc.criteo(batch_size=2)
+    p = CriteoParser(desc)
+    line = "1\t" + "\t".join(str(i) for i in range(13)) + "\t" + "\t".join("ab" for _ in range(26))
+    rec = p.parse(line)
+    assert rec is not None and rec.num_keys == 26
+    # same hex value in different slots must map to different keys
+    assert len(np.unique(rec.keys)) == 26
+    assert rec.label == 1.0
+    # missing dense + missing categorical tolerated
+    line2 = "0\t" + "\t".join("" for _ in range(13)) + "\t" + "\t".join("" for _ in range(26))
+    rec2 = p.parse(line2)
+    assert rec2 is not None and np.all(rec2.dense == 0)
+
+
+def test_batch_builder_layout():
+    desc = small_desc(bs=3)
+    p = get_parser(desc)
+    recs = [
+        p.parse("1 0.0 2 11 12 1 21 2 0.5 0.25"),
+        p.parse("1 1.0 1 13 2 22 23 2 0.1 0.2"),
+    ]
+    b = BatchBuilder(desc).build(recs)
+    S = 2
+    assert b.num_slots == S and b.batch_size == 3
+    assert b.num_keys == 6
+    assert b.key_capacity == 8  # bucket_min
+    # segments: rec0 slot0 x2 =0,0; rec0 slot1 x1 =1; rec1 slot0 x1 =2; rec1 slot1 x2 =3,3
+    np.testing.assert_array_equal(b.segments[:6], [0, 0, 1, 2, 3, 3])
+    assert np.all(b.segments[6:] == b.pad_segment)
+    np.testing.assert_array_equal(b.keys[:6], [11, 12, 21, 13, 22, 23])
+    # short batch: padding instances have show == 0
+    assert b.show[2] == 0.0 and b.show[0] == 1.0
+
+
+def test_key_bucket_ladder():
+    desc = small_desc()
+    assert desc.key_capacity(1) == 8
+    assert desc.key_capacity(9) == 16
+    assert desc.key_capacity(16) == 16
+    assert desc.key_capacity(100) == 128
+
+
+def test_in_memory_dataset_end_to_end(tmp_path):
+    files = generate_criteo_files(str(tmp_path), num_files=2, rows_per_file=200)
+    desc = DataFeedDesc.criteo(batch_size=64)
+    ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+    ds.set_filelist(files)
+    ds.set_thread(2)
+    ds.load_into_memory()
+    assert len(ds) == 400
+    ds.local_shuffle(seed=1)
+    keys = ds.pass_keys()
+    assert keys.dtype == np.uint64 and len(keys) == len(np.unique(keys)) > 0
+    batches = list(ds.batches())
+    assert sum(b.show.sum() for b in batches) == 400  # every record counted once
+    assert all(b.keys.shape[0] == b.key_capacity for b in batches)
+
+
+def test_queue_dataset_streams(tmp_path):
+    files = generate_criteo_files(str(tmp_path), num_files=1, rows_per_file=100)
+    desc = DataFeedDesc.criteo(batch_size=32)
+    ds = DatasetFactory().create_dataset("QueueDataset", desc)
+    ds.set_filelist(files)
+    total = 0
+    nb = 0
+    for b in ds.batches():
+        total += int(b.show.sum())
+        nb += 1
+    assert total == 100 and nb == 4  # 3 full + 1 tail
+
+
+def test_paddlebox_dataset_pass_lifecycle(tmp_path):
+    files = generate_criteo_files(str(tmp_path), num_files=1, rows_per_file=50)
+    ds = DatasetFactory().create_dataset("PaddleBoxDataset", DataFeedDesc.criteo(16))
+    ds.set_filelist(files)
+    ds.set_date("20260729")
+    events = []
+    ds.on_begin_pass = lambda d: events.append(("begin", d.pass_id))
+    ds.on_end_pass = lambda d, save: events.append(("end", d.pass_id, save))
+    ds.preload_into_memory()
+    ds.wait_preload_done()
+    assert len(ds) == 50
+    ds.begin_pass()
+    ds.end_pass(need_save_delta=True)
+    assert events == [("begin", 1), ("end", 1, True)]
+    assert len(ds) == 0  # released
+
+    # preload error surfaces at wait
+    ds.set_filelist(["/nonexistent/file.txt"])
+    ds.preload_into_memory()
+    with pytest.raises(FileNotFoundError):
+        ds.wait_preload_done()
+
+
+def test_factory_rejects_unknown():
+    with pytest.raises(KeyError):
+        DatasetFactory().create_dataset("NoSuchDataset")

@@ -1,0 +1,157 @@
+"""Embedding store tests: pull/push/dedup/optimizer math vs numpy reference
+(mirrors heter_ps/test_comm.cu's insert→pull→push→verify pattern)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from paddlebox_tpu.data.batch import SlotBatch
+from paddlebox_tpu.ps import EmbeddingTable, SparseSGDConfig
+from paddlebox_tpu.ps.table import HostKV
+
+
+def mkbatch(keys, k_pad=16, B=2, S=2):
+    keys = np.asarray(keys, np.uint64)
+    kp = np.zeros(k_pad, np.uint64)
+    kp[:len(keys)] = keys
+    segs = np.full(k_pad, B * S, np.int32)
+    segs[:len(keys)] = np.arange(len(keys)) % (B * S)
+    return SlotBatch(keys=kp, segments=segs, num_keys=len(keys),
+                     dense=np.zeros((B, 1), np.float32),
+                     label=np.zeros(B, np.float32),
+                     show=np.ones(B, np.float32), clk=np.zeros(B, np.float32),
+                     batch_size=B, num_slots=S)
+
+
+def test_hostkv_assign_reuse_release():
+    kv = HostKV(capacity=4)
+    r1 = kv.assign(np.array([10, 20, 30], np.uint64))
+    assert len(set(r1.tolist())) == 3
+    r2 = kv.assign(np.array([20, 10], np.uint64))
+    np.testing.assert_array_equal(r2, [r1[1], r1[0]])
+    kv.release(np.array([10], np.uint64))
+    r3 = kv.assign(np.array([99], np.uint64))
+    assert r3[0] == r1[0]  # row reused
+    kv.assign(np.array([1], np.uint64))  # row 3: now 4/4 used
+    with pytest.raises(RuntimeError):
+        kv.assign(np.array([2], np.uint64))  # capacity 4 exhausted
+
+
+def test_pull_new_keys_zero_and_dedup():
+    t = EmbeddingTable(mf_dim=4, capacity=64, unique_bucket_min=8)
+    b = mkbatch([5, 7, 5, 9])
+    idx = t.prepare(b)
+    assert idx.num_unique == 3
+    vals = np.asarray(t.pull(idx))
+    assert vals.shape == (16, 7)  # K_pad x (3 + mf_dim)
+    np.testing.assert_array_equal(vals[:4], 0)  # fresh rows are zero
+    # duplicate keys share a unique slot
+    assert idx.gather_idx[0] == idx.gather_idx[2]
+    # pad positions map to sentinel slot → sentinel row
+    assert np.all(idx.unique_rows[idx.gather_idx[4:]] == t.capacity)
+
+
+def test_push_updates_counters_and_weights():
+    cfg = SparseSGDConfig(mf_create_thresholds=1e9)  # no mf creation yet
+    t = EmbeddingTable(mf_dim=2, capacity=32, cfg=cfg, unique_bucket_min=8)
+    b = mkbatch([5, 7, 5], k_pad=8)
+    idx = t.prepare(b)
+    # grads: [g_show, g_clk, g_embed, g_embedx x2]
+    kg = np.zeros((8, 5), np.float32)
+    kg[0] = [1, 0, 0.5, 0.1, 0.1]
+    kg[1] = [1, 1, 0.2, 0.2, 0.2]
+    kg[2] = [1, 0, 0.3, 0.1, 0.1]
+    t.push(idx, jnp.asarray(kg))
+    st = t.state
+    rows = t.index.lookup(np.array([5, 7], np.uint64))
+    show = np.asarray(st.show)[rows]
+    clk = np.asarray(st.clk)[rows]
+    np.testing.assert_allclose(show, [2.0, 1.0])  # key 5 hit twice
+    np.testing.assert_allclose(clk, [0.0, 1.0])
+    # embed update (reference math): g=0.8 for key5, scale=g_show=2,
+    # ratio = lr*sqrt(g0/(g0+0)) = 0.05; w = 0 + (0.8/2)*0.05
+    w5 = np.asarray(st.embed_w)[rows[0]]
+    np.testing.assert_allclose(w5, 0.4 * 0.05, rtol=1e-5)
+    g2 = np.asarray(st.embed_g2sum)[rows[0]]
+    np.testing.assert_allclose(g2, 0.4 ** 2, rtol=1e-5)
+    # delta_score: nonclk*.1*(2-0)+1*0 = 0.2
+    np.testing.assert_allclose(np.asarray(st.delta_score)[rows[0]], 0.2,
+                               rtol=1e-5)
+    # mf not created (threshold huge) → embedx still zero, mf_size 0
+    assert np.all(np.asarray(st.mf_size)[rows] == 0)
+    assert np.all(np.asarray(st.embedx_w)[rows] == 0)
+    # sentinel row stays zero
+    assert np.all(np.asarray(st.show)[t.capacity] == 0)
+
+
+def test_lazy_mf_creation_threshold():
+    cfg = SparseSGDConfig(mf_create_thresholds=0.5, mf_initial_range=0.01)
+    t = EmbeddingTable(mf_dim=4, capacity=16, cfg=cfg, unique_bucket_min=8)
+    b = mkbatch([3], k_pad=8)
+    idx = t.prepare(b)
+    kg = np.zeros((8, 7), np.float32)
+    kg[0] = [1, 1, 0.1, 0, 0, 0, 0]  # score = .1*(1-1) + 1*1 = 1 >= 0.5
+    t.push(idx, jnp.asarray(kg))
+    row = t.index.lookup(np.array([3], np.uint64))[0]
+    assert np.asarray(t.state.mf_size)[row] == 1
+    mf = np.asarray(t.state.embedx_w)[row]
+    assert np.all(mf >= 0) and np.all(mf <= 0.01) and mf.std() > 0
+    # second push: now a normal adagrad step on embedx
+    idx2 = t.prepare(b)
+    kg2 = np.zeros((8, 7), np.float32)
+    kg2[0] = [1, 0, 0.0, 0.4, 0.4, 0.4, 0.4]
+    t.push(idx2, jnp.asarray(kg2))
+    mf2 = np.asarray(t.state.embedx_w)[row]
+    expect = np.clip(mf + (0.4 / 1.0) * 0.05 * np.sqrt(3.0 / 3.0), -10, 10)
+    np.testing.assert_allclose(mf2, expect, rtol=1e-5)
+
+
+def test_save_base_delta_load(tmp_path):
+    t = EmbeddingTable(mf_dim=2, capacity=32, unique_bucket_min=8)
+    b = mkbatch([11, 22], k_pad=8)
+    idx = t.prepare(b)
+    kg = np.zeros((8, 5), np.float32)
+    kg[0] = [1, 0, 0.5, 0, 0]
+    kg[1] = [1, 1, 0.1, 0, 0]
+    t.push(idx, jnp.asarray(kg))
+    base = str(tmp_path / "base.npz")
+    assert t.save_base(base) == 2
+
+    # touch only key 11 → delta has 1 row
+    idx2 = t.prepare(mkbatch([11], k_pad=8))
+    kg2 = np.zeros((8, 5), np.float32)
+    kg2[0] = [1, 0, 0.2, 0, 0]
+    t.push(idx2, jnp.asarray(kg2))
+    delta = str(tmp_path / "delta.npz")
+    assert t.save_delta(delta) == 1
+
+    # fresh table: load base then apply delta → equals live table
+    t2 = EmbeddingTable(mf_dim=2, capacity=32, unique_bucket_min=8)
+    t2.load(base)
+    t2.load(delta, merge=True)
+    for k in (11, 22):
+        r_live = t.index.lookup(np.array([k], np.uint64))[0]
+        r_new = t2.index.lookup(np.array([k], np.uint64))[0]
+        np.testing.assert_allclose(
+            np.asarray(t2.state.embed_w)[r_new],
+            np.asarray(t.state.embed_w)[r_live], rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(t2.state.show)[r_new],
+            np.asarray(t.state.show)[r_live], rtol=1e-6)
+
+
+def test_shrink_frees_low_score_rows():
+    t = EmbeddingTable(mf_dim=2, capacity=16, unique_bucket_min=8)
+    idx = t.prepare(mkbatch([1, 2], k_pad=8))
+    kg = np.zeros((8, 5), np.float32)
+    kg[0] = [20, 15, 0, 0, 0]   # high score: .1*5 + 15 = 15.5
+    kg[1] = [1, 0, 0, 0, 0]     # low score: .1*1 = 0.1
+    t.push(idx, jnp.asarray(kg))
+    freed = t.shrink(delete_threshold=1.0, decay=1.0)
+    assert freed == 1
+    assert t.index.lookup(np.array([2], np.uint64))[0] == -1
+    r1 = t.index.lookup(np.array([1], np.uint64))[0]
+    assert r1 >= 0 and np.asarray(t.state.show)[r1] == 20.0
+    # freed row is zeroed on device
+    st = np.asarray(t.state.show)
+    assert (st > 0).sum() == 1

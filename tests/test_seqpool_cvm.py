@@ -1,0 +1,147 @@
+"""Numpy-reference tests for fused_seqpool_cvm / cvm — the OpTest pattern
+(reference: python/paddle/fluid/tests/unittests/test_cvm_op.py,
+test_fusion_seqpool_cvm_concat_op.py)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddlebox_tpu.ops import cvm, fused_seqpool_cvm
+
+
+def make_batch(B=3, S=2, D=4, max_len=3, seed=0):
+    """Random ragged batch in the flattened segment layout."""
+    rng = np.random.default_rng(seed)
+    lens = rng.integers(0, max_len + 1, size=(B, S))
+    segs, vals = [], []
+    for i in range(B):
+        for s in range(S):
+            for _ in range(lens[i, s]):
+                segs.append(i * S + s)
+                vals.append(rng.uniform(0, 2, size=D))
+    K = len(segs)
+    cap = 1 << max(3, (K - 1).bit_length())
+    values = np.zeros((cap, D), np.float32)
+    segments = np.full(cap, B * S, np.int32)
+    if K:
+        values[:K] = np.array(vals, np.float32)
+        segments[:K] = np.array(segs, np.int32)
+    return values, segments, lens
+
+
+def ref_seqpool_cvm(values, segments, B, S, use_cvm=True, cvm_offset=2,
+                    need_filter=False, show_coeff=0.2, clk_coeff=1.0,
+                    threshold=0.96, quant_ratio=0):
+    # accumulate in f32: the reference CUDA kernel sums in double
+    # (fused_seqpool_cvm_op.cu:50 `double val`), but f32 is the TPU-native
+    # accumulator; deviation is ~1e-4 relative, below AUC-affecting scale.
+    D = values.shape[1]
+    pooled = np.zeros((B * S, D), np.float32)
+    for k in range(values.shape[0]):
+        seg = segments[k]
+        if seg >= B * S:
+            continue
+        v = values[k].astype(np.float32)
+        if need_filter:
+            show, clk = v[0], v[1]
+            if (show - clk) * show_coeff + clk * clk_coeff < threshold:
+                continue
+        if quant_ratio > 0:
+            q = np.floor(v * quant_ratio + 0.5) / quant_ratio
+            v = np.concatenate([v[:cvm_offset], q[cvm_offset:]])
+        pooled[seg] += v
+    pooled = pooled.reshape(B, S, D)
+    if use_cvm:
+        out = pooled.copy()
+        out[..., 0] = np.log1p(pooled[..., 0])
+        out[..., 1] = np.log1p(pooled[..., 1]) - np.log1p(pooled[..., 0])
+        return out
+    return pooled[..., cvm_offset:]
+
+
+@pytest.mark.parametrize("use_cvm", [True, False])
+@pytest.mark.parametrize("need_filter,quant_ratio", [(False, 0), (True, 128)])
+def test_fused_seqpool_cvm_forward(use_cvm, need_filter, quant_ratio):
+    B, S, D = 4, 3, 5
+    values, segments, _ = make_batch(B, S, D, seed=1)
+    bsc = np.ones((B, 2), np.float32)
+    out = fused_seqpool_cvm(
+        jnp.asarray(values), jnp.asarray(segments), jnp.asarray(bsc),
+        B, S, use_cvm, 2, 0.0, need_filter, 0.2, 1.0, 0.96, quant_ratio)
+    ref = ref_seqpool_cvm(values, segments, B, S, use_cvm,
+                          need_filter=need_filter, quant_ratio=quant_ratio)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_fused_seqpool_cvm_empty_slots_zero():
+    # zero-length slots must pool to zeros (log1p(0)=0) — the PaddingZeros
+    # contract (pull_box_sparse_op.h:31)
+    B, S, D = 2, 2, 4
+    values = np.zeros((8, D), np.float32)
+    segments = np.full(8, B * S, np.int32)  # everything is padding
+    out = fused_seqpool_cvm(
+        jnp.asarray(values), jnp.asarray(segments),
+        jnp.ones((B, 2), jnp.float32), B, S, True, 2)
+    np.testing.assert_allclose(np.asarray(out), np.zeros((B, S, D)), atol=1e-7)
+
+
+def test_fused_seqpool_cvm_backward_contract():
+    """Embedx dims: upstream grad broadcast to every item; cvm dims: batch
+    show/clk values; padding/filtered rows: zero."""
+    B, S, D = 2, 2, 4
+    values, segments, _ = make_batch(B, S, D, seed=2)
+    bsc = np.tile(np.array([[3.0, 1.5]], np.float32), (B, 1))
+
+    def loss(v):
+        out = fused_seqpool_cvm(v, jnp.asarray(segments), jnp.asarray(bsc),
+                                B, S, True, 2)
+        return jnp.sum(out * jnp.arange(out.size).reshape(out.shape))
+
+    g = jax.grad(loss)(jnp.asarray(values))
+    g = np.asarray(g)
+    w = np.arange(B * S * D).reshape(B, S, D).astype(np.float32)
+    for k in range(values.shape[0]):
+        seg = segments[k]
+        if seg >= B * S:
+            np.testing.assert_array_equal(g[k], 0)
+            continue
+        i, s = divmod(seg, S)
+        np.testing.assert_allclose(g[k, 2:], w[i, s, 2:], rtol=1e-6)
+        np.testing.assert_allclose(g[k, :2], bsc[i], rtol=1e-6)
+
+
+def test_fused_seqpool_cvm_filter_zeroes_grad():
+    B, S, D = 1, 1, 4
+    values = np.array([[0.1, 0.0, 5.0, 5.0],      # filtered out
+                       [1.0, 1.0, 2.0, 2.0]], np.float32)  # kept
+    segments = np.array([0, 0], np.int32)
+    bsc = np.ones((1, 2), np.float32)
+
+    def loss(v):
+        return jnp.sum(fused_seqpool_cvm(
+            v, jnp.asarray(segments), jnp.asarray(bsc), B, S,
+            True, 2, 0.0, True, 0.2, 1.0, 0.96, 0))
+
+    g = np.asarray(jax.grad(loss)(jnp.asarray(values)))
+    np.testing.assert_array_equal(g[0], 0)
+    assert np.all(g[1, 2:] == 1.0)
+
+
+def test_cvm_op():
+    B, D = 3, 5
+    rng = np.random.default_rng(0)
+    x = rng.uniform(0, 3, size=(B, D)).astype(np.float32)
+    bcvm = rng.uniform(0, 2, size=(B, 2)).astype(np.float32)
+    y = np.asarray(cvm(jnp.asarray(x), jnp.asarray(bcvm), True))
+    np.testing.assert_allclose(y[:, 0], np.log1p(x[:, 0]), rtol=1e-6)
+    np.testing.assert_allclose(
+        y[:, 1], np.log1p(x[:, 1]) - np.log1p(x[:, 0]), rtol=1e-6)
+    np.testing.assert_allclose(y[:, 2:], x[:, 2:])
+    y2 = np.asarray(cvm(jnp.asarray(x), jnp.asarray(bcvm), False))
+    np.testing.assert_allclose(y2, x[:, 2:])
+    # backward: dx[:, :2] = CVM values; dx[:, 2:] = upstream
+    g = np.asarray(jax.grad(
+        lambda v: jnp.sum(cvm(v, jnp.asarray(bcvm), True)))(jnp.asarray(x)))
+    np.testing.assert_allclose(g[:, :2], bcvm, rtol=1e-6)
+    np.testing.assert_allclose(g[:, 2:], 1.0)

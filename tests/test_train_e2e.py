@@ -1,0 +1,85 @@
+"""End-to-end: synthetic criteo → DeepFM/CtrDnn training learns signal
+(parity checkpoint #1 of SURVEY.md §7 Phase 2, run on CPU)."""
+
+import numpy as np
+import optax
+import pytest
+
+from paddlebox_tpu.config import flags_scope
+from paddlebox_tpu.data import DataFeedDesc, DatasetFactory
+from paddlebox_tpu.data.criteo import generate_criteo_files
+from paddlebox_tpu.metrics import auc_compute
+from paddlebox_tpu.models import CtrDnn, DeepFM
+from paddlebox_tpu.ps import EmbeddingTable, SparseSGDConfig
+from paddlebox_tpu.train import Trainer
+
+
+@pytest.fixture(scope="module")
+def criteo_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("criteo")
+    return generate_criteo_files(str(d), num_files=2, rows_per_file=2000,
+                                 vocab_per_slot=50, seed=7)
+
+
+def make_trainer(model, files, bs=128, mf_dim=8):
+    desc = DataFeedDesc.criteo(batch_size=bs)
+    desc.key_bucket_min = 4096
+    ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+    ds.set_filelist(files)
+    ds.set_thread(2)
+    ds.load_into_memory()
+    ds.local_shuffle(seed=0)
+    cfg = SparseSGDConfig(mf_create_thresholds=0.0,  # create mf immediately
+                          mf_initial_range=1e-3,
+                          learning_rate=0.1, mf_learning_rate=0.1)
+    table = EmbeddingTable(mf_dim=mf_dim, capacity=1 << 14, cfg=cfg,
+                           unique_bucket_min=4096)
+    tr = Trainer(model, table, desc, tx=optax.adam(2e-3))
+    return tr, ds
+
+
+def test_deepfm_learns(criteo_files):
+    with flags_scope(log_period_steps=1000):
+        tr, ds = make_trainer(DeepFM(hidden=(64, 64)), criteo_files)
+        r1 = tr.train_pass(ds)
+        tr.reset_metrics()
+        r2 = tr.train_pass(ds)  # second epoch
+    assert np.isfinite(r1["last_loss"])
+    assert r2["auc"] > 0.60, f"AUC too low: {r2['auc']}"
+    assert r2["auc"] > r1["auc"] - 0.02
+    assert 0.0 < r2["predicted_ctr"] < 1.0
+    assert abs(r2["actual_ctr"] - np.mean(
+        [rec.label for rec in ds.records])) < 1e-3
+    # table grew and created mf vectors
+    assert tr.table.feature_count > 100
+    assert float(np.asarray(tr.state.table.mf_size).sum()) > 100
+
+
+def test_ctr_dnn_smoke(criteo_files):
+    with flags_scope(log_period_steps=1000):
+        tr, ds = make_trainer(CtrDnn(hidden=(32, 32)), criteo_files)
+        tr.train_pass(ds)
+        tr.reset_metrics()
+        res = tr.train_pass(ds)
+    assert np.isfinite(res["last_loss"])
+    assert res["auc"] > 0.55
+
+
+def test_checkpoint_roundtrip(criteo_files, tmp_path):
+    with flags_scope(log_period_steps=1000):
+        tr, ds = make_trainer(DeepFM(hidden=(32,)), criteo_files)
+        tr.train_pass(ds)
+        prefix = str(tmp_path / "ckpt")
+        tr.save(prefix)
+
+        tr2, _ = make_trainer(DeepFM(hidden=(32,)), criteo_files)
+        tr2.load(prefix)
+        # same feature count and identical embed weights for a sample key
+        assert tr2.table.feature_count == tr.table.feature_count
+        ks, rs = tr.table.index.items()
+        k = ks[:5]
+        r_old = tr.table.index.lookup(k)
+        r_new = tr2.table.index.lookup(k)
+        np.testing.assert_allclose(
+            np.asarray(tr2.table.state.embed_w)[r_new],
+            np.asarray(tr.table.state.embed_w)[r_old], rtol=1e-6)
