@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Benchmark: DeepFM CTR training throughput on one chip.
+
+Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline derivation (BASELINE.md): north-star is 1M examples/sec on a
+v5p-32 slice (16 chips) ⇒ 62,500 examples/sec/chip. vs_baseline is
+measured chip throughput / 62,500.
+
+The measured pass mirrors the reference's steady state (SURVEY.md §3.2):
+data already resident in memory (loaded during the previous pass window),
+per-batch host prep (dedup + row assign) overlapped with device compute via
+the prefetch thread, one fused jit step per batch.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def build_records(num_records: int, num_slots: int = 26,
+                  vocab_per_slot: int = 100_000, seed: int = 0):
+    """Synthetic criteo-shaped records, built columnar-fast."""
+    from paddlebox_tpu.data.record import SlotRecord
+    rng = np.random.default_rng(seed)
+    keys_all = rng.integers(0, vocab_per_slot, size=(num_records, num_slots))
+    keys_all = (keys_all + np.arange(num_slots) * vocab_per_slot).astype(np.uint64)
+    dense_all = rng.normal(size=(num_records, 13)).astype(np.float32)
+    labels = (rng.random(num_records) < 0.25).astype(np.float32)
+    offsets = np.arange(num_slots + 1, dtype=np.int32)
+    recs = [
+        SlotRecord(keys=keys_all[i], slot_offsets=offsets,
+                   dense=dense_all[i], label=float(labels[i]), show=1.0,
+                   clk=float(labels[i]))
+        for i in range(num_records)
+    ]
+    return recs
+
+
+def main() -> None:
+    import optax
+    from paddlebox_tpu.config import FLAGS
+    from paddlebox_tpu.data import DataFeedDesc, InMemoryDataset, SlotDef
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.ps import EmbeddingTable, SparseSGDConfig
+    from paddlebox_tpu.train import Trainer
+
+    bs = int(os.environ.get("BENCH_BATCH_SIZE", 8192))
+    num_records = int(os.environ.get("BENCH_RECORDS", 262_144))
+    mf_dim = int(os.environ.get("BENCH_MF_DIM", 8))
+    FLAGS.log_period_steps = 10 ** 9
+
+    slots = [SlotDef("label", "float", 1), SlotDef("dense", "float", 13)]
+    slots += [SlotDef(f"C{i}", "uint64") for i in range(1, 27)]
+    desc = DataFeedDesc(slots=slots, batch_size=bs, label_slot="label",
+                        key_bucket_min=1 << 10)
+
+    ds = InMemoryDataset(desc)
+    ds.records = build_records(num_records)
+
+    cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=1e-3)
+    table = EmbeddingTable(mf_dim=mf_dim, capacity=1 << 23, cfg=cfg,
+                           unique_bucket_min=1 << 12)
+    tr = Trainer(DeepFM(hidden=(512, 256, 128)), table, desc,
+                 tx=optax.adam(1e-3), prefetch=8)
+
+    # warmup: compile all key-bucket variants on a slice of the data
+    warm = InMemoryDataset(desc)
+    warm.records = ds.records[: bs * 3]
+    tr.train_pass(warm)
+
+    res = tr.train_pass(ds)
+    value = res["examples_per_sec"]
+    baseline_per_chip = 1_000_000 / 16  # v5p-32 north-star / chips
+    print(json.dumps({
+        "metric": "deepfm_ctr_examples_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "examples/sec/chip",
+        "vs_baseline": round(value / baseline_per_chip, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+from paddlebox_tpu.parallel.mesh import make_mesh, data_axis_size
+
+__all__ = ["make_mesh", "data_axis_size"]

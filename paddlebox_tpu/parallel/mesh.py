@@ -1,0 +1,33 @@
+"""Device mesh construction.
+
+Reference communication stacks (SURVEY.md §5.8): NCCL rings
+(platform/collective_helper.*), MPI (boxps::MPICluster), Gloo
+(fleet/gloo_wrapper.*), brpc PS RPC — all collapse into XLA collectives over
+one jax Mesh: the "dp" axis carries both the data-parallel dense allreduce
+(NCCL SyncParam role) and the embedding all-to-all (HeterComm P2P role),
+riding ICI intra-slice and DCN across slices.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+DATA_AXIS = "dp"
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              devices: Optional[Sequence[jax.Device]] = None,
+              axis_name: str = DATA_AXIS) -> Mesh:
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis_name,))
+
+
+def data_axis_size(mesh: Mesh, axis_name: str = DATA_AXIS) -> int:
+    return mesh.shape[axis_name]

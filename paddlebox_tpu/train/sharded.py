@@ -1,0 +1,308 @@
+"""Multi-chip fused train step: data-parallel dense + model-parallel
+embedding shards, one jit program under shard_map.
+
+Reference execution model being replaced (SURVEY.md §2.6): one worker thread
+per GPU (BoxPSTrainer), NCCL allreduce for dense grads (SyncParam,
+boxps_worker.cc:1191-1258), HeterComm P2P for sparse pull/push, MPI for
+cross-node. Here ALL of it is one traced program over the mesh: two
+``all_to_all`` collectives route embedding rows/grads between shards
+(ps/sharded.py), a ``psum`` reduces dense grads, and XLA schedules the
+collectives against compute on ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddlebox_tpu.data.batch import SlotBatch
+from paddlebox_tpu.metrics import AucState, auc_add_batch, init_auc_state
+from paddlebox_tpu.ops import fused_seqpool_cvm
+from paddlebox_tpu.parallel.mesh import DATA_AXIS
+from paddlebox_tpu.ps.sgd import SparseSGDConfig
+from paddlebox_tpu.ps.sharded import ShardedEmbeddingTable, ShardedPullIndex
+from paddlebox_tpu.ps.table import TableState, apply_push, pull_rows
+
+
+class GlobalBatch(NamedTuple):
+    """One global batch: per-device blocks stacked on axis 0 (sharded dp)."""
+
+    resp_idx: jax.Array     # int32 [N, N, A]
+    serve_rows: jax.Array   # int32 [N, A2]
+    serve_valid: jax.Array  # f32   [N, A2]
+    serve_slot: jax.Array   # f32   [N, A2]
+    gather_idx: jax.Array   # int32 [N, K]
+    segments: jax.Array     # int32 [N, K]
+    dense: jax.Array        # f32   [N, B, Dd]
+    label: jax.Array        # f32   [N, B]
+    show: jax.Array         # f32   [N, B]
+    clk: jax.Array          # f32   [N, B]
+
+
+def make_global_batch(batches: List[SlotBatch],
+                      idx: ShardedPullIndex) -> GlobalBatch:
+    """Stack N local batches + routing plan into device-ready arrays.
+    Local batches may have landed in different key buckets; re-pad to max."""
+    k_pad = max(b.keys.shape[0] for b in batches)
+    segs, dense, label, show, clk = [], [], [], [], []
+    for b in batches:
+        s = np.full(k_pad, b.pad_segment, np.int32)
+        s[:b.segments.shape[0]] = b.segments
+        segs.append(s)
+        dense.append(b.dense)
+        label.append(b.label)
+        show.append(b.show)
+        clk.append(b.clk)
+    gi = idx.gather_idx
+    if gi.shape[1] < k_pad:
+        pad = ((0, 0), (0, k_pad - gi.shape[1]))
+        gi = np.pad(gi, pad, constant_values=gi.max())
+    return GlobalBatch(
+        resp_idx=jnp.asarray(idx.resp_idx),
+        serve_rows=jnp.asarray(idx.serve_rows),
+        serve_valid=jnp.asarray(idx.serve_valid),
+        serve_slot=jnp.asarray(idx.serve_slot),
+        gather_idx=jnp.asarray(gi),
+        segments=jnp.asarray(np.stack(segs)),
+        dense=jnp.asarray(np.stack(dense)),
+        label=jnp.asarray(np.stack(label)),
+        show=jnp.asarray(np.stack(show)),
+        clk=jnp.asarray(np.stack(clk)),
+    )
+
+
+class ShardedStepState(NamedTuple):
+    table: TableState   # leaves [N, C+1, …] sharded over dp
+    params: Any         # replicated
+    opt_state: Any      # replicated
+    auc: AucState       # leaves [N, …] sharded over dp
+    step: jax.Array
+
+
+def init_sharded_auc(n: int, nbins: Optional[int] = None) -> AucState:
+    s = init_auc_state(nbins)
+    return AucState(*[jnp.broadcast_to(l[None], (n,) + l.shape).copy()
+                      for l in s])
+
+
+class ShardedTrainStep:
+    """Builds the jitted multi-chip step for a mesh."""
+
+    def __init__(
+        self,
+        model,
+        tx: optax.GradientTransformation,
+        sgd_cfg: SparseSGDConfig,
+        mesh: Mesh,
+        batch_size_per_device: int,
+        num_slots: int,
+        use_cvm: bool = True,
+        cvm_offset: int = 2,
+    ) -> None:
+        self.model = model
+        self.tx = tx
+        self.sgd_cfg = sgd_cfg
+        self.mesh = mesh
+        self.n = mesh.shape[DATA_AXIS]
+        self.batch_size = batch_size_per_device
+        self.num_slots = num_slots
+        self.use_cvm = use_cvm
+        self.cvm_offset = cvm_offset
+
+        shard0 = P(DATA_AXIS)
+        rep = P()
+        state_spec = ShardedStepState(
+            table=TableState(*([shard0] * len(TableState._fields))),
+            params=rep, opt_state=rep,
+            auc=AucState(*([shard0] * len(AucState._fields))),
+            step=rep)
+        batch_spec = GlobalBatch(*([shard0] * len(GlobalBatch._fields)))
+        self._sharded = jax.jit(
+            jax.shard_map(
+                self._device_step, mesh=mesh,
+                in_specs=(state_spec, batch_spec, rep),
+                out_specs=(state_spec, rep),
+                check_vma=False),
+            donate_argnums=(0,))
+
+    def init_params(self, mf_dim: int, dense_dim: int) -> Any:
+        d = self.cvm_offset + 1 + mf_dim if self.use_cvm else 1 + mf_dim
+        pooled = jnp.zeros((self.batch_size, self.num_slots, d))
+        dense = jnp.zeros((self.batch_size, dense_dim))
+        return self.model.init(jax.random.PRNGKey(0), pooled, dense)
+
+    def init_state(self, table: ShardedEmbeddingTable, params: Any) -> ShardedStepState:
+        return ShardedStepState(
+            table=table.state, params=params, opt_state=self.tx.init(params),
+            auc=init_sharded_auc(self.n), step=jnp.zeros((), jnp.int32))
+
+    # ---- per-device block program (runs under shard_map) ----
+    def _device_step(self, state: ShardedStepState, batch: GlobalBatch,
+                     rng: jax.Array):
+        n, b, s = self.n, self.batch_size, self.num_slots
+        me = jax.lax.axis_index(DATA_AXIS)
+        # blocks arrive with leading dim 1; drop it
+        table = TableState(*[l[0] for l in state.table])
+        auc = AucState(*[l[0] for l in state.auc])
+        resp_idx = batch.resp_idx[0]       # [N, A]
+        serve_rows = batch.serve_rows[0]   # [A2]
+        serve_valid = batch.serve_valid[0]
+        serve_slot = batch.serve_slot[0]
+        gather_idx = batch.gather_idx[0]   # [K]
+        segments = batch.segments[0]
+        dense = batch.dense[0]
+        label = batch.label[0]
+        show = batch.show[0]
+        clk = batch.clk[0]
+        a = resp_idx.shape[1]
+        a2 = serve_rows.shape[0]
+        d = 3 + table.mf_dim
+
+        # ---- pull: serve my rows, exchange, reassemble ----
+        serve_vals = pull_rows(table, serve_rows)          # [A2, D]
+        resp = serve_vals[resp_idx]                        # [N, A, D]
+        recv = jax.lax.all_to_all(resp, DATA_AXIS, 0, 0, tiled=True)
+        vals_flat = recv.reshape(n * a, d)
+
+        ins_w = (show > 0).astype(jnp.float32)
+        wsum_global = jax.lax.psum(jnp.sum(ins_w), DATA_AXIS)
+        batch_show_clk = jnp.stack([show, clk], axis=1)
+
+        def loss_fn(params, vals_flat):
+            values_k = vals_flat[gather_idx]
+            pooled = fused_seqpool_cvm(
+                values_k, segments, batch_show_clk, b, s,
+                self.use_cvm, self.cvm_offset)
+            logits = self.model.apply(params, pooled, dense)
+            ls = optax.sigmoid_binary_cross_entropy(logits, label)
+            loss_local = jnp.sum(ls * ins_w) / jnp.maximum(wsum_global, 1.0)
+            return loss_local, logits
+
+        (loss_local, logits), (g_params, g_vals_flat) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1), has_aux=True)(state.params, vals_flat)
+
+        # ---- push: route grads back to owners, merge, update ----
+        g_back = jax.lax.all_to_all(
+            g_vals_flat.reshape(n, a, d), DATA_AXIS, 0, 0, tiled=True)
+        g_serve = jax.ops.segment_sum(
+            g_back.reshape(n * a, d), resp_idx.reshape(n * a),
+            num_segments=a2)
+        # PushCopy scaling (box_wrapper.cu:368): negate embed grads × global
+        # batch size (loss above is the global mean)
+        gb = jnp.concatenate(
+            [g_serve[:, :2], g_serve[:, 2:] * (-1.0 * b * n)], axis=1)
+        touched = serve_valid > 0
+        table = apply_push(table, serve_rows, gb, touched, serve_slot,
+                           self.sgd_cfg, jax.random.fold_in(rng, me))
+
+        # ---- dense sync: psum == SyncParam's allreduce ----
+        g_params = jax.lax.psum(g_params, DATA_AXIS)
+        updates, opt_state = self.tx.update(g_params, state.opt_state,
+                                            state.params)
+        params = optax.apply_updates(state.params, updates)
+
+        pred = jax.nn.sigmoid(logits)
+        auc = auc_add_batch(auc, pred, label, ins_w)
+        loss = jax.lax.psum(loss_local, DATA_AXIS)
+
+        new_state = ShardedStepState(
+            table=TableState(*[l[None] for l in table]),
+            params=params, opt_state=opt_state,
+            auc=AucState(*[l[None] for l in auc]),
+            step=state.step + 1)
+        return new_state, {"loss": loss}
+
+    def __call__(self, state: ShardedStepState, batch: GlobalBatch,
+                 rng: jax.Array):
+        return self._sharded(state, batch, rng)
+
+
+class ShardedTrainer:
+    """Multi-chip trainer: groups the batch stream into N-device global
+    batches, builds routing plans on host (prefetched), runs the sharded
+    step. The BoxPSTrainer::Run role with the mesh replacing worker threads."""
+
+    def __init__(self, model, table: ShardedEmbeddingTable, desc, mesh: Mesh,
+                 tx: Optional[optax.GradientTransformation] = None,
+                 use_cvm: bool = True, prefetch: int = 4, seed: int = 0) -> None:
+        import threading as _threading
+        self.model = model
+        self.table = table
+        self.desc = desc
+        self.mesh = mesh
+        self.n = mesh.shape[DATA_AXIS]
+        self.tx = tx or optax.adam(1e-3)
+        self.step_fn = ShardedTrainStep(
+            model, self.tx, table.cfg, mesh, desc.batch_size,
+            len(desc.sparse_slots), use_cvm=use_cvm)
+        params = self.step_fn.init_params(table.mf_dim, desc.dense_dim)
+        self.state = self.step_fn.init_state(table, params)
+        self._rng = jax.random.PRNGKey(seed + 1)
+        self.global_step = 0
+        self.prefetch = prefetch
+        self._threading = _threading
+
+    def _group_iter(self, batches):
+        """Pack the batch stream into groups of N; the tail group is padded
+        by repeating the last batch with show=0 (contributes nothing)."""
+        group: List[SlotBatch] = []
+        for bt in batches:
+            group.append(bt)
+            if len(group) == self.n:
+                yield group
+                group = []
+        if group:
+            filler = group[-1]
+            import dataclasses as _dc
+            # dead batch: zero show AND clk so neither loss, metrics, nor the
+            # pushed show/clk counters see the duplicated instances
+            dead = _dc.replace(filler, show=np.zeros_like(filler.show),
+                               clk=np.zeros_like(filler.clk))
+            while len(group) < self.n:
+                group.append(dead)
+            yield group
+
+    def _prefetch_iter(self, batches):
+        from paddlebox_tpu.utils.prefetch import prefetch_iter
+
+        def prep(group):
+            return make_global_batch(group, self.table.prepare_global(group))
+
+        return prefetch_iter(self._group_iter(batches), prep,
+                             capacity=self.prefetch)
+
+    def train_pass(self, dataset, log_prefix: str = "") -> Dict[str, float]:
+        from paddlebox_tpu.metrics import auc_compute
+        from paddlebox_tpu.utils import Timer
+        from paddlebox_tpu.utils.logging import get_logger
+        log = get_logger(__name__)
+        timer = Timer()
+        timer.start()
+        nb = 0
+        stats = None
+        for gb in self._prefetch_iter(dataset.batches()):
+            self.global_step += 1
+            rng = jax.random.fold_in(self._rng, self.global_step)
+            self.state, stats = self.step_fn(self.state, gb, rng)
+            nb += 1
+        timer.pause()
+        self.table.state = self.state.table
+        auc_host = AucState(*[jnp.sum(l, axis=0) for l in self.state.auc])
+        res = auc_compute(auc_host)
+        out = res.as_dict()
+        out.update(
+            batches=nb, elapsed_sec=timer.elapsed_sec(),
+            examples_per_sec=res.ins_num / max(timer.elapsed_sec(), 1e-9),
+            last_loss=float(stats["loss"]) if stats is not None else float("nan"))
+        log.info("%ssharded pass done: %d global batches, %.0f ex/s, auc=%.4f",
+                 log_prefix, nb, out["examples_per_sec"], res.auc)
+        return out
+
+    def reset_metrics(self) -> None:
+        self.state = self.state._replace(auc=init_sharded_auc(self.n))

@@ -76,25 +76,9 @@ class Trainer:
     def _prefetch_iter(
         self, batches: Iterable[SlotBatch]
     ) -> Iterator[Tuple[SlotBatch, PullIndex]]:
-        ch: Channel = Channel(capacity=self.prefetch)
-        err: list = []
-
-        def producer() -> None:
-            try:
-                for b in batches:
-                    ch.put((b, self.table.prepare(b)))
-            except BaseException as e:
-                err.append(e)
-            finally:
-                ch.close()
-
-        th = threading.Thread(target=producer, daemon=True)
-        th.start()
-        for item in ch:
-            yield item
-        th.join()
-        if err:
-            raise err[0]
+        from paddlebox_tpu.utils.prefetch import prefetch_iter
+        return prefetch_iter(batches, lambda b: (b, self.table.prepare(b)),
+                             capacity=self.prefetch)
 
     def train_pass(self, dataset: Dataset,
                    log_prefix: str = "") -> Dict[str, float]:

@@ -1,0 +1,38 @@
+"""Producer-thread prefetch over a bounded channel — shared by trainers to
+overlap host batch prep with device compute."""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable, Iterator, TypeVar
+
+from paddlebox_tpu.utils.channel import Channel
+
+T = TypeVar("T")
+U = TypeVar("U")
+
+
+def prefetch_iter(items: Iterable[T], prepare: Callable[[T], U],
+                  capacity: int = 4) -> Iterator[U]:
+    """Yield prepare(item) for each item, with preparation running in a
+    producer thread up to `capacity` items ahead. Producer exceptions
+    re-raise at the consumer."""
+    ch: Channel = Channel(capacity=capacity)
+    err: list = []
+
+    def producer() -> None:
+        try:
+            for it in items:
+                ch.put(prepare(it))
+        except BaseException as e:
+            err.append(e)
+        finally:
+            ch.close()
+
+    th = threading.Thread(target=producer, daemon=True)
+    th.start()
+    for out in ch:
+        yield out
+    th.join()
+    if err:
+        raise err[0]
