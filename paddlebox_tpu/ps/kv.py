@@ -1,0 +1,138 @@
+"""Host key→row index: native (C++) fast path with a python-dict fallback.
+
+See paddlebox_tpu/native/kv_index.cpp for the role citation. Both
+implementations share the contract used by the tables: assign / lookup /
+release / items / len, uint64 keys → int32 rows with free-list reuse and a
+hard row capacity (raises when full — Phase-5 eviction is the relief valve).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, Tuple
+
+import numpy as np
+
+
+class TableFullError(RuntimeError):
+    pass
+
+
+def _full_error(capacity: int) -> TableFullError:
+    return TableFullError(
+        f"embedding table full ({capacity} rows); raise "
+        "FLAGS.table_capacity_per_shard or enable shrink")
+
+
+class PyKV:
+    """Pure-python fallback (the original HostKV)."""
+
+    def __init__(self, capacity: int) -> None:
+        self.capacity = capacity
+        self._map: Dict[int, int] = {}
+        self._free: list[int] = []
+        self._next = 0
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def assign(self, keys: np.ndarray) -> np.ndarray:
+        rows = np.empty(len(keys), dtype=np.int32)
+        m = self._map
+        for i, k in enumerate(keys.tolist()):
+            r = m.get(k)
+            if r is None:
+                if self._free:
+                    r = self._free.pop()
+                elif self._next < self.capacity:
+                    r = self._next
+                    self._next += 1
+                else:
+                    raise _full_error(self.capacity)
+                m[k] = r
+            rows[i] = r
+        return rows
+
+    def lookup(self, keys: np.ndarray) -> np.ndarray:
+        m = self._map
+        return np.array([m.get(k, -1) for k in keys.tolist()], dtype=np.int32)
+
+    def release(self, keys: np.ndarray) -> np.ndarray:
+        rows = np.empty(len(keys), dtype=np.int32)
+        for i, k in enumerate(keys.tolist()):
+            r = self._map.pop(k, -1)
+            if r >= 0:
+                self._free.append(r)
+            rows[i] = r
+        return rows[rows >= 0]
+
+    def items(self) -> Tuple[np.ndarray, np.ndarray]:
+        if not self._map:
+            return (np.empty(0, np.uint64), np.empty(0, np.int32))
+        ks = np.fromiter(self._map.keys(), dtype=np.uint64,
+                         count=len(self._map))
+        rs = np.fromiter(self._map.values(), dtype=np.int32,
+                         count=len(self._map))
+        return ks, rs
+
+
+class NativeKV:
+    """ctypes wrapper over native/kv_index.cpp."""
+
+    def __init__(self, capacity: int, lib) -> None:
+        self.capacity = capacity
+        self._lib = lib
+        self._h = lib.kv_create(min(capacity, 1 << 22), capacity)
+
+    def __del__(self) -> None:
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.kv_destroy(h)
+            self._h = None
+
+    def __len__(self) -> int:
+        return int(self._lib.kv_size(self._h))
+
+    @staticmethod
+    def _buf(a: np.ndarray):
+        return a.ctypes.data_as(ctypes.c_void_p)
+
+    def assign(self, keys: np.ndarray) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        rows = np.empty(len(keys), dtype=np.int32)
+        done = self._lib.kv_assign(self._h, self._buf(keys), len(keys),
+                                   self._buf(rows))
+        if done != len(keys):
+            raise _full_error(self.capacity)
+        return rows
+
+    def lookup(self, keys: np.ndarray) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        rows = np.empty(len(keys), dtype=np.int32)
+        self._lib.kv_lookup(self._h, self._buf(keys), len(keys),
+                            self._buf(rows))
+        return rows
+
+    def release(self, keys: np.ndarray) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        rows = np.empty(len(keys), dtype=np.int32)
+        self._lib.kv_release(self._h, self._buf(keys), len(keys),
+                             self._buf(rows))
+        return rows[rows >= 0]
+
+    def items(self) -> Tuple[np.ndarray, np.ndarray]:
+        n = len(self)
+        ks = np.empty(n, dtype=np.uint64)
+        rs = np.empty(n, dtype=np.int32)
+        if n:
+            self._lib.kv_items(self._h, self._buf(ks), self._buf(rs))
+        return ks, rs
+
+
+def make_kv(capacity: int):
+    """Native index when buildable, python fallback otherwise."""
+    from paddlebox_tpu.native import load_native
+    lib = load_native()
+    if lib is not None:
+        return NativeKV(capacity, lib)
+    return PyKV(capacity)

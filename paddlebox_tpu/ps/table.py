@@ -70,60 +70,9 @@ class PullIndex(NamedTuple):
     num_unique: int
 
 
-class HostKV:
-    """Host key→row hash index with free-list reuse. The python-dict stand-in
-    for the cuDF concurrent map (hashtable.h:113); swapped for the C++
-    native index when built (paddlebox_tpu/native)."""
-
-    def __init__(self, capacity: int) -> None:
-        self.capacity = capacity
-        self._map: Dict[int, int] = {}
-        self._free: list[int] = []
-        self._next = 0
-
-    def __len__(self) -> int:
-        return len(self._map)
-
-    def assign(self, keys: np.ndarray) -> np.ndarray:
-        """uint64 keys → int32 rows, allocating new rows for unseen keys."""
-        rows = np.empty(len(keys), dtype=np.int32)
-        m = self._map
-        for i, k in enumerate(keys.tolist()):
-            r = m.get(k)
-            if r is None:
-                if self._free:
-                    r = self._free.pop()
-                elif self._next < self.capacity:
-                    r = self._next
-                    self._next += 1
-                else:
-                    raise RuntimeError(
-                        f"embedding table full ({self.capacity} rows); raise "
-                        "FLAGS.table_capacity_per_shard or enable shrink")
-                m[k] = r
-            rows[i] = r
-        return rows
-
-    def lookup(self, keys: np.ndarray) -> np.ndarray:
-        """Like assign but unseen keys → sentinel (-1)."""
-        m = self._map
-        return np.array([m.get(k, -1) for k in keys.tolist()], dtype=np.int32)
-
-    def release(self, keys: np.ndarray) -> np.ndarray:
-        rows = np.empty(len(keys), dtype=np.int32)
-        for i, k in enumerate(keys.tolist()):
-            r = self._map.pop(k, -1)
-            if r >= 0:
-                self._free.append(r)
-            rows[i] = r
-        return rows[rows >= 0]
-
-    def items(self) -> Tuple[np.ndarray, np.ndarray]:
-        if not self._map:
-            return (np.empty(0, np.uint64), np.empty(0, np.int32))
-        ks = np.fromiter(self._map.keys(), dtype=np.uint64, count=len(self._map))
-        rs = np.fromiter(self._map.values(), dtype=np.int32, count=len(self._map))
-        return ks, rs
+# Host key→row index implementations live in ps/kv.py (native C++ fast path
+# + python fallback). HostKV is the factory used across the tables.
+from paddlebox_tpu.ps.kv import make_kv as HostKV  # noqa: N813
 
 
 def init_table_state(capacity: int, mf_dim: int,

@@ -132,3 +132,31 @@ def test_paddlebox_dataset_pass_lifecycle(tmp_path):
 def test_factory_rejects_unknown():
     with pytest.raises(KeyError):
         DatasetFactory().create_dataset("NoSuchDataset")
+
+
+def test_columnar_batches_match_record_batches(tmp_path):
+    files = generate_criteo_files(str(tmp_path), num_files=1,
+                                  rows_per_file=150)
+    desc = DataFeedDesc.criteo(batch_size=64)
+    ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    rec_batches = list(ds.batches())
+    ds.columnarize()
+    col_batches = list(ds.batches())
+    assert len(ds) == 150
+    assert len(rec_batches) == len(col_batches)
+    for rb, cb in zip(rec_batches, col_batches):
+        np.testing.assert_array_equal(rb.keys, cb.keys)
+        np.testing.assert_array_equal(rb.segments, cb.segments)
+        np.testing.assert_allclose(rb.dense, cb.dense)
+        np.testing.assert_allclose(rb.label, cb.label)
+        np.testing.assert_allclose(rb.show, cb.show)
+        np.testing.assert_allclose(rb.clk, cb.clk)
+        np.testing.assert_array_equal(rb.uid, cb.uid)
+        np.testing.assert_array_equal(rb.rank, cb.rank)
+        np.testing.assert_array_equal(rb.cmatch, cb.cmatch)
+    # shuffle on columnar keeps the multiset of labels/keys
+    keys_before = np.sort(ds.columnar.keys)
+    ds.local_shuffle(seed=3)
+    np.testing.assert_array_equal(np.sort(ds.columnar.keys), keys_before)

@@ -1,0 +1,81 @@
+"""Native C++ kv index: parity vs the python fallback + edge cases."""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.native import load_native
+from paddlebox_tpu.ps.kv import NativeKV, PyKV, TableFullError
+
+
+requires_native = pytest.mark.skipif(load_native() is None,
+                                     reason="native lib unavailable")
+
+
+@requires_native
+def test_native_matches_python_randomized():
+    rng = np.random.default_rng(0)
+    nat = NativeKV(5000, load_native())
+    py = PyKV(5000)
+    for _ in range(20):
+        keys = rng.integers(0, 3000, size=500).astype(np.uint64)
+        np.testing.assert_array_equal(nat.assign(keys), py.assign(keys))
+        probe = rng.integers(0, 6000, size=200).astype(np.uint64)
+        np.testing.assert_array_equal(nat.lookup(probe), py.lookup(probe))
+        rel = rng.integers(0, 3000, size=50).astype(np.uint64)
+        # release order of freed rows differs is fine; compare sets + len
+        r1, r2 = nat.release(rel), py.release(rel)
+        assert sorted(r1.tolist()) == sorted(r2.tolist())
+        assert len(nat) == len(py)
+    k1, _ = nat.items()
+    k2, _ = py.items()
+    np.testing.assert_array_equal(np.sort(k1), np.sort(k2))
+
+
+@requires_native
+def test_native_edge_keys_and_reuse():
+    nat = NativeKV(8, load_native())
+    edge = np.array([0, 1, 2**64 - 1, 2**64 - 2], dtype=np.uint64)
+    rows = nat.assign(edge)
+    assert len(set(rows.tolist())) == 4
+    np.testing.assert_array_equal(nat.assign(edge), rows)  # stable
+    np.testing.assert_array_equal(nat.lookup(edge), rows)
+    freed = nat.release(edge[:2])
+    assert len(freed) == 2 and len(nat) == 2
+    # released keys gone; rows recycled for new keys
+    assert nat.lookup(edge[:1])[0] == -1
+    r_new = nat.assign(np.array([12345], np.uint64))
+    assert r_new[0] in freed
+
+
+@requires_native
+def test_native_capacity_exhaustion():
+    nat = NativeKV(4, load_native())
+    nat.assign(np.arange(4, dtype=np.uint64))
+    with pytest.raises(TableFullError):
+        nat.assign(np.array([99], np.uint64))
+    # failed assign must not corrupt: existing keys still resolve
+    assert all(nat.lookup(np.arange(4, dtype=np.uint64)) >= 0)
+
+
+@requires_native
+def test_native_churn_tombstone_rehash():
+    """assign/release churn must not exhaust EMPTY slots (probe-loop hang)
+    and must keep mappings exact across tombstone-triggered rehashes."""
+    nat = NativeKV(64, load_native())
+    py = PyKV(64)
+    rng = np.random.default_rng(2)
+    for round_ in range(200):  # 200*50 >> bucket count → many rehashes
+        keys = (rng.integers(0, 2**62, size=50) + round_ * 1000).astype(np.uint64)
+        r1, r2 = nat.assign(keys), py.assign(keys)
+        assert len(set(r1.tolist())) == len(set(r2.tolist()))
+        nat.release(keys)
+        py.release(keys)
+    assert len(nat) == 0
+    # survivors after churn still resolve exactly
+    keep = rng.integers(0, 2**62, size=40).astype(np.uint64)
+    rows = nat.assign(keep)
+    for round_ in range(100):
+        junk = (rng.integers(2**62, 2**63, size=20)).astype(np.uint64)
+        nat.assign(junk)
+        nat.release(junk)
+    np.testing.assert_array_equal(nat.lookup(keep), rows)
