@@ -1,9 +1,13 @@
 from paddlebox_tpu.models.ctr_dnn import CtrDnn
 from paddlebox_tpu.models.deepfm import DeepFM
+from paddlebox_tpu.models.wide_deep import WideDeep
+from paddlebox_tpu.models.dcn import DCNv2
 
 MODEL_REGISTRY = {
     "ctr_dnn": CtrDnn,
     "deepfm": DeepFM,
+    "wide_deep": WideDeep,
+    "dcn_v2": DCNv2,
 }
 
-__all__ = ["CtrDnn", "DeepFM", "MODEL_REGISTRY"]
+__all__ = ["CtrDnn", "DeepFM", "WideDeep", "DCNv2", "MODEL_REGISTRY"]

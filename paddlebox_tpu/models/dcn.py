@@ -1,0 +1,55 @@
+"""DCN-v2 — deep & cross network v2 (BASELINE.json config #3).
+
+Cross layers: x_{l+1} = x_0 ⊙ (W_l x_l + b_l) + x_l (the v2 full-matrix
+form), stacked alongside a deep tower, combined for the logit. The cross
+layers are dense matmuls — MXU-native — over the flattened pooled
+embeddings + dense features.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class CrossLayer(nn.Module):
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x0: jax.Array, xl: jax.Array) -> jax.Array:
+        d = x0.shape[-1]
+        w = nn.Dense(d, dtype=self.compute_dtype,
+                     kernel_init=nn.initializers.glorot_uniform())(xl)
+        return x0 * w + xl
+
+
+class DCNv2(nn.Module):
+    num_cross_layers: int = 3
+    hidden: Sequence[int] = (400, 400)
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    structure: str = "parallel"  # "parallel" | "stacked"
+
+    @nn.compact
+    def __call__(self, pooled: jax.Array, dense: jax.Array) -> jax.Array:
+        b = pooled.shape[0]
+        x0 = jnp.concatenate(
+            [pooled.reshape(b, -1), dense], axis=1).astype(self.compute_dtype)
+
+        xc = x0
+        for _ in range(self.num_cross_layers):
+            xc = CrossLayer(self.compute_dtype)(x0, xc)
+
+        if self.structure == "stacked":
+            xd = xc  # deep tower consumes the cross output
+        else:
+            xd = x0
+        for h in self.hidden:
+            xd = nn.Dense(h, dtype=self.compute_dtype,
+                          kernel_init=nn.initializers.glorot_uniform())(xd)
+            xd = nn.relu(xd)
+        feat = xd if self.structure == "stacked" \
+            else jnp.concatenate([xc, xd], axis=1)
+        return nn.Dense(1, dtype=jnp.float32)(feat)[:, 0].astype(jnp.float32)

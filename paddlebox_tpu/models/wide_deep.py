@@ -1,0 +1,39 @@
+"""Wide&Deep — linear (wide) + MLP (deep) joint model
+(BASELINE.json config #3).
+
+Wide part: the per-feature 1-dim ``embed_w`` weights (pull layout col 2)
+summed per instance + a linear layer over dense features — the reference
+builds this from pull_box_sparse's embed output + partial_sum/concat wide
+graphs. Deep part: pooled embedx vectors + dense through an MLP tower.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class WideDeep(nn.Module):
+    hidden: Sequence[int] = (400, 400, 400)
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    cvm_offset: int = 2
+
+    @nn.compact
+    def __call__(self, pooled: jax.Array, dense: jax.Array) -> jax.Array:
+        b = pooled.shape[0]
+        co = self.cvm_offset
+        wide_sparse = jnp.sum(pooled[..., co], axis=1)       # Σ embed_w
+        wide_dense = nn.Dense(1, dtype=jnp.float32,
+                              name="wide_linear")(dense)[:, 0]
+
+        x = jnp.concatenate(
+            [pooled.reshape(b, -1), dense], axis=1).astype(self.compute_dtype)
+        for h in self.hidden:
+            x = nn.Dense(h, dtype=self.compute_dtype,
+                         kernel_init=nn.initializers.glorot_uniform())(x)
+            x = nn.relu(x)
+        deep = nn.Dense(1, dtype=jnp.float32, name="deep_out")(x)[:, 0]
+        return (wide_sparse + wide_dense + deep).astype(jnp.float32)

@@ -1,0 +1,25 @@
+"""batch_fc — per-slot batched fully-connected.
+
+Reference: paddle/fluid/operators/batch_fc_op.{cc,cu,h} (567-line CUDA
+batched GEMM). Default mode: Input [slot_pairs, ins, in_dim] × W
+[slot_pairs, in_dim, out_dim] + Bias [slot_pairs, out_dim]; batchcount mode
+flattens a [bc*ins, in] input against [bc, in, out] weights
+(transpose_weight option). One einsum on the MXU replaces the hand-rolled
+stream-batched GEMMs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def batch_fc(x: jax.Array, w: jax.Array, bias: jax.Array,
+             batchcount: int = 0, transpose_weight: bool = False) -> jax.Array:
+    if batchcount > 0:
+        ins = x.shape[0] // batchcount
+        xb = x.reshape(batchcount, ins, x.shape[-1])
+        wb = jnp.swapaxes(w, 1, 2) if transpose_weight else w
+        out = jnp.einsum("bni,bio->bno", xb, wb) + bias[:, None, :]
+        return out.reshape(batchcount * ins, -1)
+    return jnp.einsum("sni,sio->sno", x, w) + bias[:, None, :]

@@ -1,0 +1,52 @@
+"""cross_norm_hadamard — fused cross-network hadamard + normalization.
+
+Reference: paddle/fluid/operators/cross_norm_hadamard_op.{cc,cu} +
+cross_norm_hadamard.cu.h (nncross_normforward_multi :*): input is n field
+PAIRS of embed_dim vectors ``[B, 2*n*d]``; per pair the output block of
+``3d+1`` columns is [a, b, a⊙b, a·b], each column normalized with
+data_norm-style summary stats (mean = sum/size, scale = sqrt(size/sq_sum)).
+Output ``[B, n*(3d+1)]``. The summary updates with decay
+``summary_decay_rate`` (default 0.9999999); ``sync_stats`` (multi-GPU NCCL
+reduce of batch stats) maps to a psum over the data axis before
+``cross_norm_update`` when training sharded.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.ops.data_norm import (DataNormSummary, data_norm,
+                                         data_norm_update,
+                                         init_data_norm_summary)
+
+
+def cross_features(x: jax.Array, fields_num: int, embed_dim: int) -> jax.Array:
+    """[B, 2*n*d] → raw cross features [B, n*(3d+1)] (pre-normalization)."""
+    b = x.shape[0]
+    n, d = fields_num, embed_dim
+    pairs = x.reshape(b, n, 2, d)
+    a, bb = pairs[:, :, 0], pairs[:, :, 1]          # [B, n, d]
+    had = a * bb
+    dot = jnp.sum(had, axis=-1, keepdims=True)      # [B, n, 1]
+    return jnp.concatenate([a, bb, had, dot], axis=-1).reshape(b, n * (3 * d + 1))
+
+
+def cross_norm_hadamard(x: jax.Array, summary: DataNormSummary,
+                        fields_num: int, embed_dim: int,
+                        epsilon: float = 1e-4) -> jax.Array:
+    feats = cross_features(x, fields_num, embed_dim)
+    return data_norm(feats, summary, epsilon=epsilon)
+
+
+def cross_norm_update(summary: DataNormSummary, x: jax.Array,
+                      fields_num: int, embed_dim: int,
+                      decay: float = 0.9999999) -> DataNormSummary:
+    feats = cross_features(x, fields_num, embed_dim)
+    return data_norm_update(summary, jax.lax.stop_gradient(feats),
+                            decay=decay)
+
+
+def init_cross_norm_summary(fields_num: int,
+                            embed_dim: int) -> DataNormSummary:
+    return init_data_norm_summary(fields_num * (3 * embed_dim + 1))

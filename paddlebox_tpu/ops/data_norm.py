@@ -1,0 +1,66 @@
+"""data_norm — batch-statistics normalization with running summaries.
+
+Reference: paddle/fluid/operators/data_norm_op.{cc,cu}: per-column summaries
+{batch_size, batch_sum, batch_square_sum}; forward uses
+``mean = batch_sum / batch_size`` and ``scale = sqrt(batch_size /
+batch_square_sum)`` (data_norm_op.cc means_arr/scales_arr), y = (x-mean)*
+scale. The summary is itself trained: the backward emits per-column summary
+"gradients" (counts/sums of the batch) that the dense table applies with a
+decay (BoxPSAsynDenseTable DataNorm handling, boxps_worker.cc:93-98).
+
+Functional port: ``data_norm`` is the pure forward; ``data_norm_update``
+folds a batch into the summary with the reference decay semantics
+(summary = summary*decay + batch_stats), returned as a new summary pytree.
+``slot_dim``: skip normalization for all-zero (no-show) slot blocks.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class DataNormSummary(NamedTuple):
+    batch_size: jax.Array        # f32 [C]
+    batch_sum: jax.Array         # f32 [C]
+    batch_square_sum: jax.Array  # f32 [C]
+
+
+def init_data_norm_summary(c: int, init_size: float = 1e4) -> DataNormSummary:
+    # reference initializes size=1e4, sum=0, square_sum=1e4 (unit scale)
+    return DataNormSummary(
+        batch_size=jnp.full((c,), init_size, jnp.float32),
+        batch_sum=jnp.zeros((c,), jnp.float32),
+        batch_square_sum=jnp.full((c,), init_size, jnp.float32),
+    )
+
+
+def data_norm(x: jax.Array, summary: DataNormSummary,
+              slot_dim: int = -1, epsilon: float = 1e-7) -> jax.Array:
+    mean = summary.batch_sum / summary.batch_size
+    scale = jnp.sqrt(summary.batch_size /
+                     jnp.maximum(summary.batch_square_sum, epsilon))
+    y = (x - mean[None, :]) * scale[None, :]
+    if slot_dim > 0:
+        # skip normalization for slot blocks whose first column (show) is 0
+        b, c = x.shape
+        blocks = x.reshape(b, c // slot_dim, slot_dim)
+        has_show = (blocks[..., 0:1] > epsilon)
+        y = jnp.where(
+            jnp.broadcast_to(has_show, blocks.shape).reshape(b, c),
+            y, x)
+    return y
+
+
+def data_norm_update(summary: DataNormSummary, x: jax.Array,
+                     decay: float = 0.9999999,
+                     squared_sum_epsilon: float = 1e-4) -> DataNormSummary:
+    b = x.shape[0]
+    return DataNormSummary(
+        batch_size=summary.batch_size * decay + b,
+        batch_sum=summary.batch_sum * decay + jnp.sum(x, axis=0),
+        batch_square_sum=summary.batch_square_sum * decay +
+        jnp.sum(jnp.square(x), axis=0) + squared_sum_epsilon,
+    )

@@ -1,0 +1,35 @@
+"""partial_concat / partial_sum — column-slice concat/sum over N inputs.
+
+Reference: paddle/fluid/operators/partial_concat_op.* and
+partial_sum_op.*: each input [N, C] contributes columns
+[start, start+length) (length -1 ⇒ to end); outputs are the slices
+concatenated (or summed) — used by wide/LR parts of CTR models.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def _slice(x: jax.Array, start: int, length: int) -> jax.Array:
+    c = x.shape[1]
+    s = start if start >= 0 else c + start
+    e = c if length < 0 else min(s + length, c)
+    return x[:, s:e]
+
+
+def partial_concat(xs: Sequence[jax.Array], start_index: int = 0,
+                   length: int = -1) -> jax.Array:
+    return jnp.concatenate([_slice(x, start_index, length) for x in xs],
+                           axis=1)
+
+
+def partial_sum(xs: Sequence[jax.Array], start_index: int = 0,
+                length: int = -1) -> jax.Array:
+    out = _slice(xs[0], start_index, length)
+    for x in xs[1:]:
+        out = out + _slice(x, start_index, length)
+    return out

@@ -1,0 +1,52 @@
+"""rank_attention — per-ad rank-position attention.
+
+Reference: paddle/fluid/operators/rank_attention_op.{cc,cu,h} +
+rank_attention.cu.h. Semantics (expand_input_by_rank_kernel :30-45,
+expand_rank_attention_param_kernel :60-90): ``rank_offset[:, 0]`` is the
+instance's own 1-based rank (0 ⇒ invalid); for each k < max_rank the pair
+(rank_offset[:, 2k+1], rank_offset[:, 2k+2]) gives the 1-based rank and the
+X-row index of the k-th co-shown ad. Output[i] = Σ_k X[idx_k] @
+P[(own-1)*max_rank + (rank_k-1)] where RankParam is viewed as
+[max_rank*max_rank, input_dim, out_dim] blocks; invalid entries contribute 0.
+
+TPU-native: the CUDA path materializes expanded input/param then runs a
+batched GEMM; here it's two gathers + one einsum — XLA fuses the masking and
+batches the GEMM on the MXU. X gradients flow only when ``enable_input_bp``
+is True (rank_attention_op.cu computes dX only under EnableInputBp).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rank_attention(x: jax.Array, rank_offset: jax.Array,
+                   rank_param: jax.Array, max_rank: int = 3,
+                   enable_input_bp: bool = False) -> jax.Array:
+    """x: [N, D]; rank_offset: int32 [N, 1+2*max_rank];
+    rank_param: [max_rank*max_rank*D, P] (reference layout) or
+    [max_rank*max_rank, D, P]. Returns [N, P]."""
+    n, d = x.shape
+    if rank_param.ndim == 2:
+        p = rank_param.shape[-1]
+        param = rank_param.reshape(max_rank * max_rank, d, p)
+    else:
+        param = rank_param
+        p = param.shape[-1]
+    if not enable_input_bp:
+        x = jax.lax.stop_gradient(x)
+
+    own = rank_offset[:, 0] - 1                      # [N] -1 ⇒ invalid
+    ks = jnp.arange(max_rank)
+    faster = rank_offset[:, 1 + 2 * ks] - 1          # [N, K]
+    idx = rank_offset[:, 2 + 2 * ks]                 # [N, K]
+    valid = (own[:, None] >= 0) & (faster >= 0)      # [N, K]
+
+    x_k = jnp.where(valid[..., None],
+                    x[jnp.clip(idx, 0, n - 1)], 0.0)          # [N, K, D]
+    block = jnp.clip(own[:, None], 0, max_rank - 1) * max_rank \
+        + jnp.clip(faster, 0, max_rank - 1)                   # [N, K]
+    # x_k is already zeroed for invalid (i,k), so the param gather needs no
+    # mask — the einsum contribution and the param cotangent are both 0
+    return jnp.einsum("nkd,nkdp->np", x_k, param[block])

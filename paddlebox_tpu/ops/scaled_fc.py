@@ -1,0 +1,39 @@
+"""scaled_fc / scaled_int8fc — reduced-precision FC with scale factors.
+
+Reference: paddle/fluid/operators/scaled_fc_op.{cc,cu}: X and bias are
+scaled (input_scale_factor/bias_scale_factor), cast to fp16, padded to
+multiples of the GEMM tile, multiplied, then the output is unscaled by
+1/(input_scale*bias_scale) with inf→nan so bad values propagate to the
+NaN guard (kernel_cast_and_cut). grad_scale_factor applies the same trick
+to backward. scaled_int8fc_op quantizes to int8 with per-tensor scales.
+
+TPU-native: bf16 shares fp32's exponent range, so loss-scaling is
+unnecessary — the op keeps the API (scales still applied/removed for
+bit-compat of the math) but runs the matmul in bf16 on the MXU, f32
+accumulation. int8 variant uses jnp.int8 with rounding, for parity.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def scaled_fc(x: jax.Array, w: jax.Array, bias: jax.Array,
+              input_scale_factor: float = 1.0,
+              bias_scale_factor: float = 1.0,
+              compute_dtype=jnp.bfloat16) -> jax.Array:
+    # reference wiring (scaled_fc_op.cu:211-222): GEMM alpha=si, bias added
+    # scaled by sb, output unscaled by 1/si ⇒ out = x@w + (sb/si)·b
+    mm = jnp.dot(x.astype(compute_dtype), w.astype(compute_dtype),
+                 preferred_element_type=jnp.float32) * input_scale_factor
+    out = mm + (bias * bias_scale_factor).astype(jnp.float32)[None, :]
+    return out / input_scale_factor
+
+
+def scaled_int8fc(x: jax.Array, w: jax.Array, bias: jax.Array,
+                  input_scale: float, weight_scale: float) -> jax.Array:
+    xq = jnp.clip(jnp.round(x * input_scale), -127, 127).astype(jnp.int8)
+    wq = jnp.clip(jnp.round(w * weight_scale), -127, 127).astype(jnp.int8)
+    acc = jnp.dot(xq, wq, preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) / (input_scale * weight_scale) + bias[None, :]

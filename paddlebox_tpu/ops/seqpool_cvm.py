@@ -53,24 +53,19 @@ def fused_seqpool_cvm(
 def _fwd(values, segments, batch_show_clk, batch_size, num_slots, use_cvm,
          cvm_offset, pad_value, need_filter, show_coeff, clk_coeff,
          threshold, quant_ratio):
-    k, d = values.shape
-    if need_filter:
-        # FusedSeqpoolKernelQuantFilter :93-133: drop items failing the
-        # show/clk significance test
-        show, clk = values[:, 0], values[:, 1]
-        keep = ((show - clk) * show_coeff + clk * clk_coeff) >= threshold
-    else:
-        keep = jnp.ones((k,), dtype=bool)
+    d = values.shape[1]
     v = values
     if quant_ratio > 0:
-        # quantize embedx dims only; cvm dims pass through (:78-90)
+        # quantize embedx dims only; cvm dims pass through (:78-90) — safe
+        # before the filter since the filter reads only the cvm columns
         q = jnp.floor(v * quant_ratio + 0.5) / quant_ratio
         col = jnp.arange(d) >= cvm_offset
         v = jnp.where(col[None, :], q, v)
-    v = jnp.where(keep[:, None], v, 0.0)
-    num_segments = batch_size * num_slots + 1  # +1 pad bin, dropped below
-    pooled = jax.ops.segment_sum(v, segments, num_segments=num_segments)
-    pooled = pooled[:-1].reshape(batch_size, num_slots, d) + pad_value
+    # filter: FusedSeqpoolKernelQuantFilter :93-133 — drop items failing the
+    # show/clk significance test
+    pooled, keep = _filtered_pool(v, segments, batch_size, num_slots,
+                                  pad_value, need_filter, show_coeff,
+                                  clk_coeff, threshold)
     if use_cvm:
         # FusedCVMKernelWithCVM :276: [log(show+1), log(clk+1)-log(show+1), …]
         show_l = jnp.log1p(pooled[..., 0:1])
@@ -110,3 +105,101 @@ def _bwd(batch_size, num_slots, use_cvm, cvm_offset, pad_value, need_filter,
 
 
 fused_seqpool_cvm.defvjp(_fwd, _bwd)
+
+
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11))
+def fused_seqpool_cvm_with_conv(
+    values: jax.Array,          # [K, D], D includes 3 cvm cols (show,clk,conv)
+    segments: jax.Array,
+    batch_show_clk_conv: jax.Array,  # [B, 3]
+    batch_size: int,
+    num_slots: int,
+    use_cvm: bool = True,
+    show_filter: bool = False,
+    pad_value: float = 0.0,
+    need_filter: bool = False,
+    show_coeff: float = 0.2,
+    clk_coeff: float = 1.0,
+    threshold: float = 0.96,
+) -> jax.Array:
+    """Show/click/conversion-rate variant
+    (fused/fused_seqpool_cvm_with_conv_op.cu:143-147): CVM head is
+    [log(show+1), log(clk+1), log(conv+1)-log(clk+1)]; show_filter strips
+    the show column from the output."""
+    out, _ = _fwd_conv(values, segments, batch_show_clk_conv, batch_size,
+                       num_slots, use_cvm, show_filter, pad_value,
+                       need_filter, show_coeff, clk_coeff, threshold)
+    return out
+
+
+_CONV_OFFSET = 3
+
+
+def _filtered_pool(values, segments, batch_size, num_slots, pad_value,
+                   need_filter, show_coeff, clk_coeff, threshold):
+    """Shared filter + segment-sum (both seqpool variants)."""
+    k, d = values.shape
+    if need_filter:
+        show, clk = values[:, 0], values[:, 1]
+        keep = ((show - clk) * show_coeff + clk * clk_coeff) >= threshold
+    else:
+        keep = jnp.ones((k,), dtype=bool)
+    v = jnp.where(keep[:, None], values, 0.0)
+    num_segments = batch_size * num_slots + 1
+    pooled = jax.ops.segment_sum(v, segments, num_segments=num_segments)
+    return pooled[:-1].reshape(batch_size, num_slots, d) + pad_value, keep
+
+
+def _fwd_conv(values, segments, batch_cvm, batch_size, num_slots, use_cvm,
+              show_filter, pad_value, need_filter, show_coeff, clk_coeff,
+              threshold):
+    d = values.shape[1]
+    pooled, keep = _filtered_pool(values, segments, batch_size, num_slots,
+                                  pad_value, need_filter, show_coeff,
+                                  clk_coeff, threshold)
+    if use_cvm:
+        show_l = jnp.log1p(pooled[..., 0:1])
+        clk_l = jnp.log1p(pooled[..., 1:2])
+        cvr = jnp.log1p(pooled[..., 2:3]) - clk_l
+        head = [clk_l, cvr] if show_filter else [show_l, clk_l, cvr]
+        out = jnp.concatenate(head + [pooled[..., _CONV_OFFSET:]], axis=-1)
+    else:
+        out = pooled[..., _CONV_OFFSET:]
+    vtoken = jnp.zeros((0, d), values.dtype)
+    return out, (segments, keep, vtoken, batch_cvm)
+
+
+def _bwd_conv(batch_size, num_slots, use_cvm, show_filter, pad_value,
+              need_filter, show_coeff, clk_coeff, threshold, res, g):
+    segments, keep, vtoken, batch_cvm = res
+    d = vtoken.shape[1]
+    co = _CONV_OFFSET
+    n_head = (co - 1 if show_filter else co) if use_cvm else 0
+    embedx_g = g[..., n_head:]
+    flat = embedx_g.reshape(batch_size * num_slots, d - co)
+    flat = jnp.concatenate(
+        [flat, jnp.zeros((1, d - co), flat.dtype)], axis=0)
+    g_embedx = flat[segments]
+    ins = jnp.minimum(segments // num_slots, batch_size - 1)
+    g_cvm = batch_cvm[ins]
+    pad = segments >= batch_size * num_slots
+    g_values = jnp.where(
+        (keep & ~pad)[:, None],
+        jnp.concatenate([g_cvm.astype(g_embedx.dtype), g_embedx], axis=-1),
+        0.0,
+    ).astype(vtoken.dtype)
+    return (g_values, None, None)
+
+
+fused_seqpool_cvm_with_conv.defvjp(_fwd_conv, _bwd_conv)
+
+
+def fused_seqpool_concat(values, segments, batch_size, num_slots,
+                         pad_value=0.0):
+    """Plain seqpool + concat (fusion_seqpool_concat_op): our fused op with
+    no CVM columns (cvm_offset=0, use_cvm=False path without stripping)."""
+    num_segments = batch_size * num_slots + 1
+    pooled = jax.ops.segment_sum(values, segments,
+                                 num_segments=num_segments)
+    return pooled[:-1].reshape(batch_size, num_slots, -1) + pad_value

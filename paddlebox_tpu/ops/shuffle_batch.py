@@ -1,0 +1,27 @@
+"""shuffle_batch — in-batch row shuffle for negative sampling.
+
+Reference: paddle/fluid/operators/shuffle_batch_op.{cc,h}: forward permutes
+rows (recording ShuffleIdx), backward routes grads through the inverse
+permutation. Functional port: permutation from a jax PRNG key; the inverse
+scatter comes from autodiff through ``take`` for free.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def shuffle_batch(x: jax.Array, rng: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Returns (shuffled_x, shuffle_idx). Gradient w.r.t. x is unshuffled
+    automatically (gather autodiff)."""
+    idx = jax.random.permutation(rng, x.shape[0])
+    return jnp.take(x, idx, axis=0), idx
+
+
+def unshuffle_batch(y: jax.Array, shuffle_idx: jax.Array) -> jax.Array:
+    """Restore original order (ShuffleIdx consumer)."""
+    inv = jnp.argsort(shuffle_idx)
+    return jnp.take(y, inv, axis=0)

@@ -1,0 +1,201 @@
+"""Numpy-reference tests for the extended CTR op set (mirrors the
+reference's OpTest pattern: test_rank_attention_op.py, test_batch_fc_op.py,
+test_shuffle_batch_op.py, …)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.ops import (
+    batch_fc, cross_norm_hadamard, cross_norm_update, data_norm,
+    data_norm_update, fused_seqpool_cvm_with_conv, init_cross_norm_summary,
+    init_data_norm_summary, partial_concat, partial_sum, rank_attention,
+    scaled_fc, scaled_int8fc, shuffle_batch, unshuffle_batch,
+)
+
+
+def ref_rank_attention(x, rank_offset, param, max_rank):
+    n, d = x.shape
+    p = param.shape[-1]
+    param3 = param.reshape(max_rank * max_rank, d, p)
+    out = np.zeros((n, p), np.float32)
+    for i in range(n):
+        own = rank_offset[i, 0] - 1
+        if own < 0:
+            continue
+        for k in range(max_rank):
+            faster = rank_offset[i, 1 + 2 * k] - 1
+            idx = rank_offset[i, 2 + 2 * k]
+            if faster < 0:
+                continue
+            blk = param3[own * max_rank + faster]
+            out[i] += x[idx] @ blk
+    return out
+
+
+def test_rank_attention_matches_reference():
+    rng = np.random.default_rng(0)
+    n, d, p, mr = 6, 4, 3, 3
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    param = rng.normal(size=(mr * mr * d, p)).astype(np.float32)
+    ro = np.zeros((n, 1 + 2 * mr), np.int32)
+    for i in range(n):
+        ro[i, 0] = rng.integers(0, mr + 1)  # 0 = invalid
+        for k in range(mr):
+            if rng.random() < 0.7:
+                ro[i, 1 + 2 * k] = rng.integers(1, mr + 1)
+                ro[i, 2 + 2 * k] = rng.integers(0, n)
+    got = np.asarray(rank_attention(jnp.asarray(x), jnp.asarray(ro),
+                                    jnp.asarray(param), mr))
+    np.testing.assert_allclose(got, ref_rank_attention(x, ro, param, mr),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_batch_fc_modes():
+    rng = np.random.default_rng(1)
+    s, n, i, o = 3, 5, 4, 2
+    x = rng.normal(size=(s, n, i)).astype(np.float32)
+    w = rng.normal(size=(s, i, o)).astype(np.float32)
+    b = rng.normal(size=(s, o)).astype(np.float32)
+    got = np.asarray(batch_fc(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b)))
+    ref = np.einsum("sni,sio->sno", x, w) + b[:, None, :]
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+    # batchcount mode with transposed weights
+    xf = x.reshape(s * n, i)
+    wt = np.swapaxes(w, 1, 2).copy()
+    got2 = np.asarray(batch_fc(jnp.asarray(xf), jnp.asarray(wt),
+                               jnp.asarray(b), batchcount=s,
+                               transpose_weight=True))
+    np.testing.assert_allclose(got2, ref.reshape(s * n, o), rtol=1e-5)
+
+
+def test_shuffle_roundtrip_and_grad():
+    x = jnp.arange(12.0).reshape(6, 2)
+    y, idx = shuffle_batch(x, jax.random.PRNGKey(0))
+    assert sorted(np.asarray(y)[:, 0].tolist()) == \
+        sorted(np.asarray(x)[:, 0].tolist())
+    np.testing.assert_allclose(np.asarray(unshuffle_batch(y, idx)),
+                               np.asarray(x))
+    # grad of sum(w*shuffled) lands back on the right rows
+    w = jnp.arange(6.0)[:, None]
+
+    def loss(x):
+        y, _ = shuffle_batch(x, jax.random.PRNGKey(0))
+        return jnp.sum(y * w)
+
+    g = np.asarray(jax.grad(loss)(x))
+    inv = np.argsort(np.asarray(idx))
+    np.testing.assert_allclose(g, np.asarray(w)[inv].repeat(2, axis=1))
+
+
+def test_partial_ops():
+    a = jnp.arange(12.0).reshape(3, 4)
+    b = a * 10
+    got = np.asarray(partial_concat([a, b], 1, 2))
+    np.testing.assert_allclose(got, np.concatenate(
+        [np.asarray(a)[:, 1:3], np.asarray(b)[:, 1:3]], axis=1))
+    got2 = np.asarray(partial_sum([a, b], 1, 2))
+    np.testing.assert_allclose(got2, np.asarray(a)[:, 1:3] * 11)
+    # length -1 = to end; negative start
+    np.testing.assert_allclose(np.asarray(partial_concat([a], -2, -1)),
+                               np.asarray(a)[:, 2:])
+
+
+def test_data_norm_forward_and_update():
+    rng = np.random.default_rng(2)
+    x = rng.normal(2.0, 3.0, size=(50, 4)).astype(np.float32)
+    s = init_data_norm_summary(4)
+    y = np.asarray(data_norm(jnp.asarray(x), s))
+    mean = np.asarray(s.batch_sum) / np.asarray(s.batch_size)
+    scale = np.sqrt(np.asarray(s.batch_size) /
+                    np.asarray(s.batch_square_sum))
+    np.testing.assert_allclose(y, (x - mean) * scale, rtol=1e-5)
+    # after many updates the normalized output approaches zero-mean/unit-var
+    for _ in range(200):
+        s = data_norm_update(s, jnp.asarray(x), decay=0.9)
+    y2 = np.asarray(data_norm(jnp.asarray(x), s))
+    assert abs(y2.mean()) < 0.1
+    assert 0.5 < y2.std() < 1.5
+
+
+def test_data_norm_slot_dim_skips_no_show():
+    s = init_data_norm_summary(4)
+    x = np.array([[0.0, 5.0, 1.0, 7.0],   # slot0 show=0 → passthrough
+                  [1.0, 5.0, 0.0, 7.0]], np.float32)  # slot1 show=0
+    # bias the summary so normalization actually changes values
+    s = data_norm_update(s, jnp.asarray(np.full((10, 4), 3.0, np.float32)),
+                         decay=0.5)
+    y = np.asarray(data_norm(jnp.asarray(x), s, slot_dim=2))
+    np.testing.assert_allclose(y[0, :2], x[0, :2])  # skipped
+    np.testing.assert_allclose(y[1, 2:], x[1, 2:])  # skipped
+    assert not np.allclose(y[1, :2], x[1, :2])      # normalized
+
+
+def test_cross_norm_hadamard_layout():
+    rng = np.random.default_rng(3)
+    b, n, d = 4, 2, 3
+    x = rng.normal(size=(b, 2 * n * d)).astype(np.float32)
+    s = init_cross_norm_summary(n, d)
+    y = np.asarray(cross_norm_hadamard(jnp.asarray(x), s, n, d))
+    assert y.shape == (b, n * (3 * d + 1))
+    # with identity summary (mean 0, scale 1): block = [a, b, a*b, a.b]
+    pairs = x.reshape(b, n, 2, d)
+    blk0 = y[:, :3 * d + 1]
+    np.testing.assert_allclose(blk0[:, :d], pairs[:, 0, 0], rtol=1e-5)
+    np.testing.assert_allclose(blk0[:, d:2 * d], pairs[:, 0, 1], rtol=1e-5)
+    np.testing.assert_allclose(blk0[:, 2 * d:3 * d],
+                               pairs[:, 0, 0] * pairs[:, 0, 1], rtol=1e-5)
+    np.testing.assert_allclose(
+        blk0[:, 3 * d], np.sum(pairs[:, 0, 0] * pairs[:, 0, 1], -1),
+        rtol=1e-5)
+    s2 = cross_norm_update(s, jnp.asarray(x), n, d, decay=0.5)
+    assert float(np.asarray(s2.batch_size)[0]) > float(
+        np.asarray(s.batch_size)[0]) * 0.5
+
+
+def test_scaled_fc_matches_fp32():
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(8, 16)).astype(np.float32)
+    w = rng.normal(size=(16, 8)).astype(np.float32)
+    b = rng.normal(size=(8,)).astype(np.float32)
+    got = np.asarray(scaled_fc(jnp.asarray(x), jnp.asarray(w),
+                               jnp.asarray(b), 8.0, 8.0))
+    ref = x @ w + b[None, :]
+    np.testing.assert_allclose(got, ref, rtol=0.05, atol=0.05)  # bf16
+    got8 = np.asarray(scaled_int8fc(jnp.asarray(x), jnp.asarray(w),
+                                    jnp.asarray(b), 16.0, 16.0))
+    np.testing.assert_allclose(got8, ref, rtol=0.2, atol=0.5)  # int8
+
+
+def test_seqpool_cvm_with_conv():
+    b_sz, s_num, d = 2, 2, 5  # 3 cvm + 2 embed
+    vals = np.zeros((8, d), np.float32)
+    vals[0] = [2, 1, 1, 0.5, 0.5]
+    vals[1] = [1, 0, 0, 0.3, 0.3]
+    segs = np.full(8, b_sz * s_num, np.int32)
+    segs[0], segs[1] = 0, 3
+    bcvm = np.ones((b_sz, 3), np.float32)
+    out = np.asarray(fused_seqpool_cvm_with_conv(
+        jnp.asarray(vals), jnp.asarray(segs), jnp.asarray(bcvm),
+        b_sz, s_num, True, False))
+    assert out.shape == (b_sz, s_num, d)
+    np.testing.assert_allclose(out[0, 0, 0], np.log1p(2), rtol=1e-5)
+    np.testing.assert_allclose(out[0, 0, 1], np.log1p(1), rtol=1e-5)
+    np.testing.assert_allclose(out[0, 0, 2], np.log1p(1) - np.log1p(1),
+                               rtol=1e-5)
+    np.testing.assert_allclose(out[0, 0, 3:], [0.5, 0.5], rtol=1e-5)
+    # show_filter drops the show column
+    out2 = np.asarray(fused_seqpool_cvm_with_conv(
+        jnp.asarray(vals), jnp.asarray(segs), jnp.asarray(bcvm),
+        b_sz, s_num, True, True))
+    assert out2.shape == (b_sz, s_num, d - 1)
+    np.testing.assert_allclose(out2[0, 0, 0], np.log1p(1), rtol=1e-5)
+    # backward: cvm dims get batch values, embed dims broadcast
+    def loss(v):
+        return jnp.sum(fused_seqpool_cvm_with_conv(
+            v, jnp.asarray(segs), jnp.asarray(bcvm), b_sz, s_num, True,
+            False))
+    g = np.asarray(jax.grad(loss)(jnp.asarray(vals)))
+    np.testing.assert_allclose(g[0, :3], bcvm[0], rtol=1e-6)
+    np.testing.assert_allclose(g[0, 3:], 1.0)
+    np.testing.assert_array_equal(g[2], 0)  # padding

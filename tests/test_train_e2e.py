@@ -83,3 +83,18 @@ def test_checkpoint_roundtrip(criteo_files, tmp_path):
         np.testing.assert_allclose(
             np.asarray(tr2.table.state.embed_w)[r_new],
             np.asarray(tr.table.state.embed_w)[r_old], rtol=1e-6)
+
+
+@pytest.mark.parametrize("model_name", ["wide_deep", "dcn_v2"])
+def test_model_zoo_learns(criteo_files, model_name):
+    from paddlebox_tpu.models import MODEL_REGISTRY
+    cls = MODEL_REGISTRY[model_name]
+    model = cls(hidden=(32, 32)) if model_name == "wide_deep" else \
+        cls(num_cross_layers=2, hidden=(32,))
+    with flags_scope(log_period_steps=1000):
+        tr, ds = make_trainer(model, criteo_files)
+        tr.train_pass(ds)
+        tr.reset_metrics()
+        res = tr.train_pass(ds)
+    assert np.isfinite(res["last_loss"])
+    assert res["auc"] > 0.58, f"{model_name} AUC too low: {res['auc']}"
