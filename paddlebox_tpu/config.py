@@ -59,6 +59,11 @@ class Flags:
     shrink_delete_threshold: float = 0.0
     show_click_decay_rate: float = 0.98
 
+    # --- pallas kernels (ops/pallas_kernels.py; interpret-mode off-TPU) ---
+    use_pallas_gather: bool = False
+    use_pallas_scatter: bool = False
+    use_pallas_seqpool: bool = False
+
     # --- metrics (reference: metrics.h:46 table_size 1e6+1) ---
     auc_num_buckets: int = 1_000_000
 

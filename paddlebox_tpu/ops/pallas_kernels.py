@@ -1,0 +1,258 @@
+"""Pallas TPU kernels for the embedding-PS hot paths.
+
+Reference hot kernels being replaced (SURVEY.md §2.1-2.2, §2.4):
+- ``PullCopy``/``CopyForPull`` gather (fleet/box_wrapper.cu:75,945) and the
+  HeterPS hashtable ``get`` → here ``gather_rows``: a scalar-prefetch row
+  gather where the Pallas pipeline double-buffers one row-block DMA per grid
+  step (HBM→VMEM), overlapping fetches across steps.
+- ``PushMergeCopy`` scatter (box_wrapper.cu:417) + in-kernel optimizer write
+  (heter_ps/optimizer.cuh.h) → ``scatter_rows``: aliased in-place row
+  scatter (the optimizer math itself stays in jnp where XLA fuses it against
+  the gathered rows; only the irregular-access scatter needs a kernel).
+- ``FusedSeqpoolKernelNormal`` (fused/fused_seqpool_cvm_op.cu:36) →
+  ``segment_sum_mxu``: the ragged per-slot sum-pool recast as a blocked
+  one-hot × values matmul so it runs on the MXU systolic array instead of
+  scalar scatter-adds — the TPU-first formulation of segment_sum.
+
+All kernels auto-fall back to interpret mode off-TPU so the whole suite is
+testable on the CPU mesh (SURVEY.md §4 implication).
+
+Status (measured on one v5p chip, DeepFM/criteo bench, mf_dim=8):
+- XLA's native gather/scatter-add is FASTER at small embedding dims (the
+  lane padding 11→128 and per-row DMA granularity dominate), so all three
+  flags default to False and the jnp paths are the production defaults.
+- ``segment_sum_mxu`` is the right shape for wide-D, high-slot-count
+  configs (1000-slot fused pipelines, D≥128); re-evaluate there.
+- ``gather_rows`` needs a batched-DMA redesign (8 rows/step via manual
+  async copies) before it can compete with XLA's gather.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from paddlebox_tpu.config import FLAGS
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+# ---------------------------------------------------------------------------
+# Row gather (pull_sparse hot path)
+# ---------------------------------------------------------------------------
+
+def gather_rows(table: jax.Array, rows: jax.Array) -> jax.Array:
+    """table [C, D], rows [U] int32 → [U, D].
+
+    One grid step per row; the row index is scalar-prefetched so the
+    pipeline issues the HBM→VMEM DMA for step i+1 while step i copies out.
+    """
+    c, d = table.shape
+    u = rows.shape[0]
+
+    def kernel(rows_ref, tbl_ref, out_ref):
+        del rows_ref
+        out_ref[...] = tbl_ref[...]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(u,),
+        in_specs=[pl.BlockSpec((1, d), lambda i, rows_ref: (rows_ref[i], 0))],
+        out_specs=pl.BlockSpec((1, d), lambda i, rows_ref: (i, 0)),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((u, d), table.dtype),
+        interpret=_interpret(),
+    )(rows, table)
+
+
+# ---------------------------------------------------------------------------
+# Row scatter (push_sparse write-back)
+# ---------------------------------------------------------------------------
+
+def scatter_rows(table: jax.Array, rows: jax.Array,
+                 values: jax.Array) -> jax.Array:
+    """Write values[i] into table[rows[i]] in place (buffer aliased).
+
+    Rows must be unique except for a designated pad/sentinel row, which may
+    be written multiple times (last-write-wins nondeterminism is confined to
+    that row; callers reset it — see table.apply_push).
+    """
+    c, d = table.shape
+    u = rows.shape[0]
+
+    def kernel(rows_ref, tbl_ref, val_ref, out_ref):
+        del rows_ref, tbl_ref
+        out_ref[...] = val_ref[...]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(u,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # aliased table, untouched
+            pl.BlockSpec((1, d), lambda i, rows_ref: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, d), lambda i, rows_ref: (rows_ref[i], 0)),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((c, d), table.dtype),
+        input_output_aliases={1: 0},  # tensor input 0 (table) → output 0
+        interpret=_interpret(),
+    )(rows, table, values)
+
+
+# ---------------------------------------------------------------------------
+# MXU segment-sum (fused_seqpool hot path)
+# ---------------------------------------------------------------------------
+#
+# Block-sparse formulation: segments MUST be nondecreasing (batch builder
+# emits segment ids ins*S+slot in key order, so this holds for every seqpool
+# caller). A key block of TK keys then overlaps at most TK/TB+1 output
+# blocks, so instead of the full (segments × keys) cross product the grid is
+# a flat list of (output-block, key-block) pairs built host-side: per key
+# block j, pairs i = start_block[j]..end_block[j] (clamped, padded to the
+# static TK/TB+1 per block). Work is O(K·TB·D) on the MXU — independent of
+# num_segments — vs the scatter-add's O(K·D) serialized irregular writes.
+
+def _seg_sum_kernel(i_ref, first_ref, valid_ref, seg_ref, vals_ref, out_ref,
+                    *, tb: int, tk: int):
+    p = pl.program_id(0)
+
+    @pl.when(first_ref[p] != 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    @pl.when(valid_ref[p] != 0)
+    def _acc():
+        base = i_ref[p] * tb
+        # onehot[r, k] = 1 iff segments[k] == base + r (never true for -1)
+        row_ids = jax.lax.broadcasted_iota(jnp.int32, (tb, tk), 0) + base
+        onehot = (row_ids == seg_ref[...]).astype(jnp.float32)
+        out_ref[...] += jnp.dot(onehot, vals_ref[...],
+                                preferred_element_type=jnp.float32,
+                                precision=jax.lax.Precision.HIGHEST)
+
+
+def _segment_sum_mxu_impl(values: jax.Array, segments: jax.Array,
+                          num_segments: int) -> jax.Array:
+    k, d = values.shape
+    tb = 128
+    tk = min(512, max(128, _round_up(max(k, 1), 128)))
+    k_pad = _round_up(max(k, 1), tk)
+    s_pad = _round_up(num_segments, tb)
+    d_pad = _round_up(d, 128)
+    nkb = k_pad // tk            # key blocks
+    ppb = tk // tb + 1           # max output blocks one key block overlaps
+    n_pairs = nkb * ppb
+
+    v = jnp.zeros((k_pad, d_pad), jnp.float32)
+    v = v.at[:k, :d].set(values.astype(jnp.float32))
+    seg = jnp.full((k_pad,), -1, jnp.int32)
+    seg = seg.at[:k].set(segments.astype(jnp.int32))
+
+    # host-side (traced, static shapes) pair construction
+    segs2 = seg.reshape(nkb, tk)
+    has_valid = segs2[:, 0] >= 0              # pads form a suffix
+    last_seg = jnp.max(segs2, axis=1)         # nondecreasing ⇒ max = last
+    start_b = jnp.where(has_valid, segs2[:, 0] // tb, 0)
+    end_b = jnp.where(has_valid, last_seg // tb, -1)
+    # carry forward so all-pad blocks produce in-bounds, monotone i indices
+    prev_end = jnp.maximum(jax.lax.cummax(end_b), 0)
+    start_b = jnp.where(has_valid, start_b, prev_end)
+    end_b = jnp.where(has_valid, end_b, prev_end)
+
+    slot = jnp.arange(n_pairs, dtype=jnp.int32) % ppb
+    jb = jnp.arange(n_pairs, dtype=jnp.int32) // ppb
+    i_raw = start_b[jb] + slot
+    i_arr = jnp.minimum(i_raw, end_b[jb])
+    valid = (i_raw <= end_b[jb]) & has_valid[jb]
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), i_arr[1:] != i_arr[:-1]])
+
+    # The static ppb bound holds only when segment occupancy is dense (the
+    # CTR seqpool shape: num_segments ≈ B*S ≲ K). If any key block spans
+    # more output blocks than ppb (sparse occupancy), branch to the XLA
+    # scatter-add at runtime — correctness is unconditional.
+    overflow = jnp.any((end_b - start_b + 1) > ppb)
+
+    def pallas_branch(_):
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(n_pairs,),
+            in_specs=[
+                pl.BlockSpec((1, tk), lambda p, i_a, f, v_: (0, p // ppb)),
+                pl.BlockSpec((tk, d_pad),
+                             lambda p, i_a, f, v_: (p // ppb, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (tb, d_pad), lambda p, i_a, f, v_: (i_a[p], 0)),
+        )
+        out = pl.pallas_call(
+            functools.partial(_seg_sum_kernel, tb=tb, tk=tk),
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((s_pad, d_pad), jnp.float32),
+            interpret=_interpret(),
+        )(i_arr, first.astype(jnp.int32), valid.astype(jnp.int32),
+          seg.reshape(1, k_pad), v)
+        # segment ranges with no keys map to output blocks no pair visits;
+        # their buffers are uninitialized — mask them to zero.
+        visited = jnp.zeros((s_pad // tb,), bool).at[i_arr].max(valid)
+        return jnp.where(jnp.repeat(visited, tb)[:, None], out, 0.0)
+
+    def xla_branch(_):
+        safe = jnp.where(seg >= 0, seg, num_segments)
+        out = jax.ops.segment_sum(v, safe, num_segments=num_segments + 1)
+        return jnp.zeros((s_pad, d_pad), jnp.float32).at[
+            :num_segments].set(out[:num_segments])
+
+    out = jax.lax.cond(overflow, xla_branch, pallas_branch, None)
+    return out[:num_segments, :d].astype(values.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def segment_sum_mxu(values: jax.Array, segments: jax.Array,
+                    num_segments: int) -> jax.Array:
+    """values [K, D], segments [K] int32 NONDECREASING (−1 = drop)
+    → [num_segments, D]. See block-sparse notes above."""
+    return _segment_sum_mxu_impl(values, segments, num_segments)
+
+
+def _seg_sum_fwd(values, segments, num_segments):
+    out = _segment_sum_mxu_impl(values, segments, num_segments)
+    vtoken = jnp.zeros((0,), values.dtype)  # carries primal dtype
+    return out, (segments, vtoken)
+
+
+def _seg_sum_bwd(num_segments, res, g):
+    segments, vtoken = res
+    # d/dvalues of a segment sum is a gather of the cotangent rows
+    safe = jnp.clip(segments, 0, num_segments - 1)
+    g_values = jnp.where((segments >= 0)[:, None], g[safe], 0.0)
+    return (g_values.astype(vtoken.dtype), None)
+
+
+segment_sum_mxu.defvjp(_seg_sum_fwd, _seg_sum_bwd)
+
+
+def segment_sum(values: jax.Array, segments: jax.Array,
+                num_segments: int) -> jax.Array:
+    """Backend dispatch: MXU kernel when enabled (requires nondecreasing
+    segments — true for all seqpool callers), XLA scatter-add otherwise
+    (flag: FLAGS.use_pallas_seqpool)."""
+    if FLAGS.use_pallas_seqpool:
+        return segment_sum_mxu(values, segments, num_segments)
+    return jax.ops.segment_sum(values, segments, num_segments=num_segments)

@@ -25,6 +25,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from paddlebox_tpu.ops.pallas_kernels import segment_sum
+
 
 @functools.partial(
     jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11, 12))
@@ -147,7 +149,7 @@ def _filtered_pool(values, segments, batch_size, num_slots, pad_value,
         keep = jnp.ones((k,), dtype=bool)
     v = jnp.where(keep[:, None], values, 0.0)
     num_segments = batch_size * num_slots + 1
-    pooled = jax.ops.segment_sum(v, segments, num_segments=num_segments)
+    pooled = segment_sum(v, segments, num_segments)
     return pooled[:-1].reshape(batch_size, num_slots, d) + pad_value, keep
 
 
@@ -200,6 +202,5 @@ def fused_seqpool_concat(values, segments, batch_size, num_slots,
     """Plain seqpool + concat (fusion_seqpool_concat_op): our fused op with
     no CVM columns (cvm_offset=0, use_cvm=False path without stripping)."""
     num_segments = batch_size * num_slots + 1
-    pooled = jax.ops.segment_sum(values, segments,
-                                 num_segments=num_segments)
+    pooled = segment_sum(values, segments, num_segments)
     return pooled[:-1].reshape(batch_size, num_slots, -1) + pad_value

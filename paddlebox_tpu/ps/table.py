@@ -32,6 +32,7 @@ import numpy as np
 
 from paddlebox_tpu.config import FLAGS
 from paddlebox_tpu.data.batch import SlotBatch
+from paddlebox_tpu.ops.pallas_kernels import gather_rows, scatter_rows
 from paddlebox_tpu.ps.sgd import RowState, SparseSGDConfig, adagrad_update
 from paddlebox_tpu.utils.logging import get_logger
 
@@ -94,7 +95,10 @@ def pull_rows(state: TableState, unique_rows: jax.Array) -> jax.Array:
     clk = state.clk[unique_rows]
     w = state.embed_w[unique_rows]
     gate = (state.mf_size[unique_rows] > 0).astype(state.embedx_w.dtype)
-    mf = state.embedx_w[unique_rows] * gate[:, None]
+    if FLAGS.use_pallas_gather:
+        mf = gather_rows(state.embedx_w, unique_rows) * gate[:, None]
+    else:
+        mf = state.embedx_w[unique_rows] * gate[:, None]
     return jnp.concatenate(
         [show[:, None], clk[:, None], w[:, None], mf], axis=1)
 
@@ -158,6 +162,10 @@ def apply_push(
     slot_new = jnp.where(touched, slot_val,
                          state.slot[unique_rows])
 
+    if FLAGS.use_pallas_scatter:
+        embedx_w_new = scatter_rows(state.embedx_w, unique_rows, new.embedx_w)
+    else:
+        embedx_w_new = state.embedx_w.at[unique_rows].set(new.embedx_w)
     st = TableState(
         show=state.show.at[unique_rows].set(new.show),
         clk=state.clk.at[unique_rows].set(new.clk),
@@ -165,7 +173,7 @@ def apply_push(
         slot=state.slot.at[unique_rows].set(slot_new),
         embed_w=state.embed_w.at[unique_rows].set(new.embed_w),
         embed_g2sum=state.embed_g2sum.at[unique_rows].set(new.embed_g2sum),
-        embedx_w=state.embedx_w.at[unique_rows].set(new.embedx_w),
+        embedx_w=embedx_w_new,
         embedx_g2sum=state.embedx_g2sum.at[unique_rows].set(new.embedx_g2sum),
         mf_size=state.mf_size.at[unique_rows].set(new.mf_size),
     )
