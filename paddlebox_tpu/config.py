@@ -53,6 +53,8 @@ class Flags:
     # --- embedding store ---
     # Default per-shard row capacity; tables are statically sized for XLA.
     table_capacity_per_shard: int = 1 << 20
+    # host-RAM backing store capacity (Phase 5; rows beyond HBM)
+    host_store_capacity: int = 1 << 24
     # embedx (mf) lazy-creation threshold semantics (optimizer.cuh.h:105)
     mf_create_threshold: float = 0.0
     # feature shrink: drop rows whose decayed show falls below this

@@ -164,11 +164,15 @@ def _segment_sum_mxu_impl(values: jax.Array, segments: jax.Array,
     seg = jnp.full((k_pad,), -1, jnp.int32)
     seg = seg.at[:k].set(segments.astype(jnp.int32))
 
-    # host-side (traced, static shapes) pair construction
+    # host-side (traced, static shapes) pair construction. −1 drop markers
+    # may appear anywhere; only the valid entries must be nondecreasing.
     segs2 = seg.reshape(nkb, tk)
-    has_valid = segs2[:, 0] >= 0              # pads form a suffix
+    valid_m = segs2 >= 0
+    has_valid = valid_m.any(axis=1)
+    first_seg = jnp.min(jnp.where(valid_m, segs2, jnp.iinfo(jnp.int32).max),
+                        axis=1)
     last_seg = jnp.max(segs2, axis=1)         # nondecreasing ⇒ max = last
-    start_b = jnp.where(has_valid, segs2[:, 0] // tb, 0)
+    start_b = jnp.where(has_valid, first_seg // tb, 0)
     end_b = jnp.where(has_valid, last_seg // tb, -1)
     # carry forward so all-pad blocks produce in-bounds, monotone i indices
     prev_end = jnp.maximum(jax.lax.cummax(end_b), 0)
@@ -226,8 +230,9 @@ def _segment_sum_mxu_impl(values: jax.Array, segments: jax.Array,
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
 def segment_sum_mxu(values: jax.Array, segments: jax.Array,
                     num_segments: int) -> jax.Array:
-    """values [K, D], segments [K] int32 NONDECREASING (−1 = drop)
-    → [num_segments, D]. See block-sparse notes above."""
+    """values [K, D], segments [K] int32 → [num_segments, D].
+    Contract: −1 entries are dropped (allowed anywhere); the NON-negative
+    entries must be nondecreasing in array order. See notes above."""
     return _segment_sum_mxu_impl(values, segments, num_segments)
 
 

@@ -3,7 +3,11 @@ from paddlebox_tpu.ps.table import (
     EmbeddingTable, TableState, PullIndex, pull_rows, expand_pull,
     apply_push, merge_push, push_stats, init_table_state,
 )
+from paddlebox_tpu.ps.host_store import HostStore
+from paddlebox_tpu.ps.pass_table import PassScopedTable
+from paddlebox_tpu.ps.box_helper import BoxPSHelper
 
 __all__ = ["SparseSGDConfig", "SparseAdamConfig", "EmbeddingTable",
            "TableState", "PullIndex", "pull_rows", "expand_pull",
-           "apply_push", "merge_push", "push_stats", "init_table_state"]
+           "apply_push", "merge_push", "push_stats", "init_table_state",
+           "HostStore", "PassScopedTable", "BoxPSHelper"]

@@ -1,0 +1,96 @@
+"""BoxPSHelper — the pass-pipeline driver (BoxHelper/``core.BoxPS`` role).
+
+Reference: fleet/box_wrapper.h:1043-1295 — ``ReadData2Memory`` (:1086),
+``PreLoadIntoMemory``/``WaitFeedPassDone`` (:1142,:1156) double-buffered
+pass pipelining, and the Python pass protocol in SURVEY.md §3.3:
+
+    ds.preload_into_memory()     # pass k+1 IO overlaps pass k training
+    ...train pass k...
+    ds.wait_feed_pass_done()
+    ds.begin_pass()              # working set → HBM
+    trainer.train_pass(ds)
+    ds.end_pass(save_delta)      # HBM → host store
+
+TPU-native split of work: dataset IO/parse/key-dedup runs on reader
+threads (overlappable); the host-store fetch + HBM promotion runs inside
+``begin_pass`` after the previous ``end_pass`` write-back so values are
+never stale (the reference's closed PS enforces the same order between
+EndPass and the next BeginPass).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from paddlebox_tpu.data.dataset import PaddleBoxDataset
+from paddlebox_tpu.ps.pass_table import PassScopedTable
+from paddlebox_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class BoxPSHelper:
+    """Couples a PassScopedTable (+ optional Trainer) to the pass protocol."""
+
+    def __init__(self, table: PassScopedTable, trainer=None) -> None:
+        self.table = table
+        self.trainer = trainer
+        self.pass_id = 0
+
+    # ---- dataset attachment (Paddle-style ds.begin_pass() hooks) ----
+    def attach(self, ds: PaddleBoxDataset) -> PaddleBoxDataset:
+        ds.on_begin_pass = lambda d: self.begin_pass(d)
+        ds.on_end_pass = lambda d, save_delta: self.end_pass(
+            d, need_save_delta=save_delta)
+        return ds
+
+    # ---- pass protocol ----
+    def read_data_to_memory(self, ds: PaddleBoxDataset) -> None:
+        """Synchronous load (ReadData2Memory, box_wrapper.h:1086)."""
+        ds.load_into_memory()
+
+    def preload_into_memory(self, ds: PaddleBoxDataset) -> None:
+        """Start pass k+1's IO while pass k trains (box_wrapper.h:1142)."""
+        ds.preload_into_memory()
+
+    def wait_feed_pass_done(self, ds: PaddleBoxDataset) -> None:
+        ds.wait_preload_done()
+
+    def begin_pass(self, ds: PaddleBoxDataset) -> int:
+        """Promote the pass working set into HBM and point the trainer's
+        jit state at it."""
+        self.pass_id += 1
+        n = self.table.begin_pass(ds.pass_keys())
+        if self.trainer is not None:
+            self.trainer.adopt_table()
+        return n
+
+    def train_pass(self, ds: PaddleBoxDataset, **kw) -> dict:
+        if self.trainer is None:
+            raise RuntimeError("no trainer bound")
+        return self.trainer.train_pass(ds, **kw)
+
+    def end_pass(self, ds: Optional[PaddleBoxDataset] = None,
+                 need_save_delta: bool = False,
+                 delta_path: Optional[str] = None) -> int:
+        """Write the working set back; optionally dump the xbox delta."""
+        if self.trainer is not None:
+            self.trainer.sync_table()
+        n = self.table.end_pass()
+        if need_save_delta:
+            path = delta_path or f"xbox_delta_pass{self.pass_id}.npz"
+            self.table.host.save_delta(path)
+        return n
+
+    # ---- model lifecycle (box_helper_py.cc:70-165) ----
+    def save_base(self, path: str) -> int:
+        return self.table.host.save_base(path)
+
+    def save_delta(self, path: str) -> int:
+        return self.table.host.save_delta(path)
+
+    def load_model(self, path: str, merge: bool = False) -> int:
+        return self.table.host.load(path, merge=merge)
+
+    def shrink_table(self, **kw) -> int:
+        return self.table.host.shrink(**kw)

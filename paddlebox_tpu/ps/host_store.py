@@ -1,0 +1,163 @@
+"""Host-RAM backing store for features beyond HBM capacity (Phase 5).
+
+Reference capability: the BoxPS closed core keeps the full table on
+host-mem+SSD and promotes each pass's working set into GPU HBM
+(``BeginFeedPass``/``BeginPass``/``EndPass``, fleet/box_wrapper.cc:129-186);
+the open HeterPS analogue is PSGPUWrapper's build pipeline — ``BuildPull``
+fetching values from the CPU PS and ``BuildGPUTask`` filling HBM pools
+(ps_gpu_wrapper.cc:337,684), with ``EndPass`` dumping updated values back
+(:983). PSCore's ``memory_sparse_table``/``ssd_sparse_table`` define the
+save/shrink semantics.
+
+TPU-native redesign: one numpy SoA per feature field, grown geometrically
+up to a hard capacity, fronted by the native C++ key→row index (ps/kv.py).
+Fetch/update are fully vectorized (no per-key python). The pass working
+set is fetched here and scattered into the statically-shaped device
+TableState by PassScopedTable; spill granularity is the pass, not the key.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.config import FLAGS
+from paddlebox_tpu.ps.kv import make_kv
+from paddlebox_tpu.ps.table import TWO_D_FIELDS, TableState
+from paddlebox_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+# host SoA fields — single source of truth is the device TableState
+# (FeatureValue layout, heter_ps/feature_value.h:570)
+FIELDS = TableState._fields
+_2D_FIELDS = TWO_D_FIELDS
+
+
+class HostStore:
+    """All-features host table; thread-safe for one writer at a time."""
+
+    def __init__(self, mf_dim: int, capacity: Optional[int] = None,
+                 init_rows: int = 1 << 16) -> None:
+        self.mf_dim = mf_dim
+        self.capacity = capacity or FLAGS.host_store_capacity
+        self.index = make_kv(self.capacity)
+        self._alloc = min(init_rows, self.capacity)
+        self._arr: Dict[str, np.ndarray] = {
+            f: np.zeros(self._shape(f, self._alloc), np.float32)
+            for f in FIELDS
+        }
+        self._touched = np.zeros(self._alloc, dtype=bool)
+        self._lock = threading.Lock()
+
+    def _shape(self, field: str, n: int) -> Tuple[int, ...]:
+        return (n, self.mf_dim) if field in _2D_FIELDS else (n,)
+
+    def _ensure(self, max_row: int) -> None:
+        if max_row < self._alloc:
+            return
+        new = self._alloc
+        while new <= max_row:
+            new *= 2
+        new = min(new, self.capacity)
+        for f in FIELDS:
+            a = np.zeros(self._shape(f, new), np.float32)
+            a[:self._alloc] = self._arr[f]
+            self._arr[f] = a
+        t = np.zeros(new, dtype=bool)
+        t[:self._alloc] = self._touched
+        self._touched = t
+        self._alloc = new
+
+    def __len__(self) -> int:
+        return len(self.index)
+
+    # ---- pass staging ----
+    def fetch(self, keys: np.ndarray) -> Dict[str, np.ndarray]:
+        """Values for ``keys``; unknown keys read as zero-initialized rows
+        (they materialize on update — lazy feature creation)."""
+        with self._lock:
+            rows = self.index.lookup(np.ascontiguousarray(keys, np.uint64))
+            known = rows >= 0
+            out = {}
+            for f in FIELDS:
+                a = np.zeros(self._shape(f, len(keys)), np.float32)
+                a[known] = self._arr[f][rows[known]]
+                out[f] = a
+            return out
+
+    def update(self, keys: np.ndarray, data: Dict[str, np.ndarray]) -> None:
+        """Write back a pass's updated rows (EndPass dump)."""
+        with self._lock:
+            rows = self.index.assign(np.ascontiguousarray(keys, np.uint64))
+            if len(rows):
+                self._ensure(int(rows.max()))
+            for f in FIELDS:
+                self._arr[f][rows] = data[f]
+            self._touched[rows] = True
+
+    # ---- checkpoint (SaveBase/SaveDelta, box_wrapper.cc:1383-1415) ----
+    def _dump(self, path: str, keys: np.ndarray, rows: np.ndarray) -> int:
+        np.savez_compressed(
+            path, keys=keys, mf_dim=np.int32(self.mf_dim),
+            **{f: self._arr[f][rows] for f in FIELDS})
+        return len(keys)
+
+    def save_base(self, path: str) -> int:
+        with self._lock:
+            keys, rows = self.index.items()
+            n = self._dump(path, keys, rows)
+            self._touched[:] = False
+        log.info("save_base: %d rows -> %s", n, path)
+        return n
+
+    def save_delta(self, path: str) -> int:
+        with self._lock:
+            keys, rows = self.index.items()
+            m = self._touched[rows]
+            n = self._dump(path, keys[m], rows[m])
+            self._touched[:] = False
+        log.info("save_delta: %d rows -> %s", n, path)
+        return n
+
+    def load(self, path: str, merge: bool = False) -> int:
+        blob = np.load(path)
+        keys = blob["keys"]
+        with self._lock:
+            if not merge:
+                self.index = make_kv(self.capacity)
+                for f in FIELDS:
+                    self._arr[f][:] = 0
+                self._touched[:] = False
+            rows = self.index.assign(keys)
+            if len(rows):
+                self._ensure(int(rows.max()))
+            for f in FIELDS:
+                self._arr[f][rows] = blob[f]
+        return len(keys)
+
+    # ---- feature aging (ShrinkTable, box_wrapper.h:638) ----
+    def shrink(self, delete_threshold: Optional[float] = None,
+               decay: Optional[float] = None,
+               nonclk_coeff: float = 0.1, clk_coeff: float = 1.0) -> int:
+        thr = (FLAGS.shrink_delete_threshold
+               if delete_threshold is None else delete_threshold)
+        dk = FLAGS.show_click_decay_rate if decay is None else decay
+        with self._lock:
+            keys, rows = self.index.items()
+            if len(keys) == 0:
+                return 0
+            self._arr["show"] *= dk
+            self._arr["clk"] *= dk
+            self._arr["delta_score"] *= dk
+            show, clk = self._arr["show"][rows], self._arr["clk"][rows]
+            score = nonclk_coeff * (show - clk) + clk_coeff * clk
+            drop = score < thr
+            freed = self.index.release(keys[drop])
+            for f in FIELDS:
+                self._arr[f][freed] = 0
+            self._touched[freed] = False
+        log.info("host shrink: freed %d/%d rows", len(freed), len(keys))
+        return int(len(freed))

@@ -1,0 +1,152 @@
+"""Pass-scoped HBM table: per-pass working set promoted from the HostStore.
+
+Reference lifecycle (SURVEY.md §3.3): ``BeginFeedPass`` schedules SSD→mem
+for the pass's key set, ``BeginPass`` buffers the pass embeddings into HBM,
+training pulls/pushes hit only that working set, ``EndPass`` writes back
+HBM→mem (box_wrapper.cc:129-186; open analogue BuildGPUTask/EndPass,
+ps_gpu_wrapper.cc:684,983).
+
+TPU-native: the device TableState stays statically shaped (pass_capacity
+rows); begin_pass assigns every pass key a fresh row, scatters host-fetched
+values in with one vectorized np write per field, and device_puts the SoA.
+The host fetch can run on a background thread (``stage()``) between
+end_pass and begin_pass (overlapping dataset columnarization); what
+overlaps the previous pass's *training* is the dataset IO/parse/dedup
+(PreLoadIntoMemory/WaitFeedPassDone), since staged values must reflect
+that pass's write-back.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from paddlebox_tpu.config import FLAGS
+from paddlebox_tpu.ps.host_store import FIELDS, HostStore
+from paddlebox_tpu.ps.kv import make_kv
+from paddlebox_tpu.ps.sgd import SparseSGDConfig
+from paddlebox_tpu.ps.table import EmbeddingTable, TableState
+from paddlebox_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class PassStage:
+    """Host-side staging of one pass (keys + fetched values)."""
+
+    def __init__(self, keys: np.ndarray, values: Dict[str, np.ndarray]):
+        self.keys = keys
+        self.values = values
+
+
+class PassScopedTable(EmbeddingTable):
+    """EmbeddingTable whose contents are one pass's working set."""
+
+    def __init__(self, host: HostStore, pass_capacity: Optional[int] = None,
+                 cfg: Optional[SparseSGDConfig] = None, seed: int = 0,
+                 unique_bucket_min: int = 1024) -> None:
+        super().__init__(mf_dim=host.mf_dim,
+                         capacity=pass_capacity or
+                         FLAGS.table_capacity_per_shard,
+                         cfg=cfg, seed=seed,
+                         unique_bucket_min=unique_bucket_min)
+        self.host = host
+        self._stage: Optional[PassStage] = None
+        self._stage_thread: Optional[threading.Thread] = None
+        self._stage_exc: Optional[BaseException] = None
+        self.in_pass = False
+
+    # ---- feed-pass staging (BeginFeedPass/EndFeedPass) ----
+    def stage(self, pass_keys: np.ndarray, background: bool = True) -> None:
+        """Fetch the pass working set from the host store. Only legal
+        between the previous end_pass and the next begin_pass: staging
+        while a pass is open would read host rows the open pass has not
+        written back yet (the reference's closed PS enforces the same
+        EndPass→BeginPass order). What overlaps training is the dataset
+        IO/parse/key-dedup (BoxPSHelper.preload_into_memory), not this."""
+        if self.in_pass:
+            raise RuntimeError(
+                "stage() while a pass is open — the open pass's updates "
+                "are not in the host store yet; end_pass first")
+        if self._stage_thread is not None:
+            raise RuntimeError("a feed pass is already staging")
+        if len(pass_keys) > self.capacity:
+            raise ValueError(
+                f"pass working set ({len(pass_keys)}) exceeds table "
+                f"capacity ({self.capacity})")
+        self._stage_exc = None
+
+        def run() -> None:
+            try:
+                self._stage = PassStage(pass_keys,
+                                        self.host.fetch(pass_keys))
+            except BaseException as e:
+                self._stage_exc = e
+
+        if background:
+            self._stage_thread = threading.Thread(target=run, daemon=True)
+            self._stage_thread.start()
+        else:
+            run()
+            if self._stage_exc is not None:
+                raise self._stage_exc
+
+    def wait_stage_done(self) -> None:
+        if self._stage_thread is not None:
+            self._stage_thread.join()
+            self._stage_thread = None
+        if self._stage_exc is not None:
+            exc, self._stage_exc = self._stage_exc, None
+            raise exc
+
+    # ---- pass window (BeginPass/EndPass) ----
+    def begin_pass(self, pass_keys: Optional[np.ndarray] = None) -> int:
+        """Promote the staged (or given) working set into the device table.
+        Returns the number of working-set rows."""
+        if self.in_pass:
+            raise RuntimeError("begin_pass while a pass is open")
+        if pass_keys is not None:
+            if self._stage_thread is not None or self._stage is not None:
+                # a stage exists: it must be for the same key set, else
+                # promoting it would corrupt rows for keys only in one set
+                self.wait_stage_done()
+                if (self._stage is None
+                        or not np.array_equal(self._stage.keys, pass_keys)):
+                    raise RuntimeError(
+                        "begin_pass keys differ from the staged key set")
+            else:
+                self.stage(pass_keys, background=False)
+        self.wait_stage_done()
+        st = self._stage
+        if st is None:
+            raise RuntimeError("begin_pass with nothing staged")
+        self._stage = None
+
+        self.index = make_kv(self.capacity)
+        rows = self.index.assign(st.keys)
+        c1 = self.capacity + 1
+        host_leaves = []
+        for f in FIELDS:
+            shape = (c1, self.mf_dim) if f == "embedx_w" else (c1,)
+            a = np.zeros(shape, np.float32)
+            a[rows] = st.values[f]
+            host_leaves.append(a)
+        self.state = TableState(*[jax.device_put(a) for a in host_leaves])
+        self._touched[:] = False
+        self.in_pass = True
+        log.info("begin_pass: %d working-set rows in HBM", len(st.keys))
+        return len(st.keys)
+
+    def end_pass(self) -> int:
+        """Write the (jit-updated) working set back to the host store."""
+        if not self.in_pass:
+            raise RuntimeError("end_pass without begin_pass")
+        keys, rows = self.index.items()
+        data = self._gather_host(rows)
+        self.host.update(keys, {f: data[f] for f in FIELDS})
+        self.in_pass = False
+        log.info("end_pass: %d rows written back to host store", len(keys))
+        return len(keys)

@@ -41,7 +41,9 @@ log = get_logger(__name__)
 
 class TableState(NamedTuple):
     """Device SoA, leaves shaped [C+1] / [C+1, mf_dim]; row C is the zero
-    sentinel (FeatureValue fields, feature_value.h:570)."""
+    sentinel (FeatureValue fields, feature_value.h:570). 2-D leaves are
+    listed in TWO_D_FIELDS below — host-side mirrors (HostStore) derive
+    their layouts from these two definitions only."""
 
     show: jax.Array
     clk: jax.Array
@@ -60,6 +62,9 @@ class TableState(NamedTuple):
     @property
     def mf_dim(self) -> int:
         return self.embedx_w.shape[1]
+
+
+TWO_D_FIELDS = ("embedx_w",)  # [C+1, mf_dim] leaves; all others are [C+1]
 
 
 class PullIndex(NamedTuple):

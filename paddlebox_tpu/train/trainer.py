@@ -121,6 +121,11 @@ class Trainer:
         facade (for save/shrink/load host ops)."""
         self.table.state = self.state.table
 
+    def adopt_table(self) -> None:
+        """Point the jit state at the table facade's (re)built state —
+        used by the pass lifecycle after begin_pass swaps the working set."""
+        self.state = self.state._replace(table=self.table.state)
+
     def reset_metrics(self) -> None:
         self.state = self.state._replace(auc=init_auc_state())
 

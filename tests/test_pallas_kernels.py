@@ -81,6 +81,16 @@ def test_segment_sum_mxu_drop_negative():
     np.testing.assert_allclose(np.asarray(got), np.ones((2, 3)))
 
 
+def test_segment_sum_mxu_leading_and_interleaved_drops():
+    vals = jnp.asarray(np.arange(20, dtype=np.float32).reshape(5, 4))
+    segs = jnp.asarray([-1, 0, -1, 0, 1], jnp.int32)
+    got = segment_sum_mxu(vals, segs, 2)
+    want = jax.ops.segment_sum(
+        jnp.where(jnp.asarray([0, 1, 0, 1, 1], bool)[:, None], vals, 0),
+        jnp.asarray([0, 0, 0, 0, 1], jnp.int32), num_segments=2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+
 def test_segment_sum_mxu_grad():
     rng = np.random.default_rng(6)
     vals = jnp.asarray(rng.normal(size=(50, 5)).astype(np.float32))

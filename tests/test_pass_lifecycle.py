@@ -1,0 +1,153 @@
+"""Phase-5 pass lifecycle: host backing store, working-set promotion,
+preload double-buffering, checkpoint deltas (SURVEY.md §3.3, §7 Phase 5)."""
+
+import os
+
+import numpy as np
+import optax
+import pytest
+
+from paddlebox_tpu.data import DataFeedDesc, DatasetFactory
+from paddlebox_tpu.data.criteo import generate_criteo_files
+from paddlebox_tpu.models import CtrDnn
+from paddlebox_tpu.ps import (BoxPSHelper, HostStore, PassScopedTable,
+                              SparseSGDConfig)
+from paddlebox_tpu.train import Trainer
+
+
+def test_host_store_fetch_update_roundtrip():
+    hs = HostStore(mf_dim=4, capacity=1 << 12, init_rows=8)
+    keys = np.array([10, 20, 30], np.uint64)
+    got = hs.fetch(keys)
+    assert got["embed_w"].shape == (3,) and got["embedx_w"].shape == (3, 4)
+    np.testing.assert_allclose(got["embed_w"], 0.0)  # unknown keys = zeros
+    data = {f: np.full_like(v, 2.0) for f, v in got.items()}
+    hs.update(keys, data)
+    # growth past init_rows
+    many = np.arange(100, 600, dtype=np.uint64)
+    hs.update(many, {f: np.ones((500, 4) if f == "embedx_w" else (500,),
+                                np.float32) for f in got})
+    back = hs.fetch(keys)
+    np.testing.assert_allclose(back["embed_w"], 2.0)
+    assert len(hs) == 503
+
+
+def test_host_store_save_delta_and_shrink(tmp_path):
+    hs = HostStore(mf_dim=2, capacity=1 << 10)
+    k1 = np.array([1, 2, 3], np.uint64)
+    d = lambda n, v: {f: np.full((n, 2) if f == "embedx_w" else (n,), v,
+                                 np.float32) for f in
+                      ("show", "clk", "delta_score", "slot", "embed_w",
+                       "embed_g2sum", "embedx_w", "embedx_g2sum", "mf_size")}
+    hs.update(k1, d(3, 1.0))
+    base = str(tmp_path / "base.npz")
+    assert hs.save_base(base) == 3
+    k2 = np.array([4, 5], np.uint64)
+    hs.update(k2, d(2, 2.0))
+    delta = str(tmp_path / "delta.npz")
+    assert hs.save_delta(delta) == 2   # only rows touched since save_base
+    # reload base then merge delta
+    hs2 = HostStore(mf_dim=2, capacity=1 << 10)
+    assert hs2.load(base) == 3
+    assert hs2.load(delta, merge=True) == 2
+    np.testing.assert_allclose(hs2.fetch(k2)["embed_w"], 2.0)
+    # shrink: decayed score below threshold drops never-shown rows
+    hs2.update(np.array([9], np.uint64), d(1, 0.0))
+    freed = hs2.shrink(delete_threshold=0.05, decay=1.0)
+    assert freed == 1 and len(hs2) == 5
+
+
+def test_pass_scoped_table_promote_and_writeback():
+    hs = HostStore(mf_dim=4, capacity=1 << 12)
+    t = PassScopedTable(hs, pass_capacity=64, cfg=SparseSGDConfig())
+    keys = np.array([7, 8, 9], np.uint64)
+    t.begin_pass(keys)
+    assert t.in_pass and t.feature_count == 3
+    # simulate a jit update: bump show on the working set rows
+    rows = t.index.lookup(keys)
+    st = t.state
+    t.state = st._replace(show=st.show.at[rows].set(5.0))
+    t.end_pass()
+    assert not t.in_pass
+    np.testing.assert_allclose(hs.fetch(keys)["show"], 5.0)
+    # second pass with overlapping keys sees the written-back values
+    t.begin_pass(np.array([8, 9, 11], np.uint64))
+    r = t.index.lookup(np.array([8], np.uint64))
+    assert float(np.asarray(t.state.show)[r[0]]) == 5.0
+    t.end_pass()
+
+
+def test_pass_capacity_guard():
+    hs = HostStore(mf_dim=2, capacity=1 << 12)
+    t = PassScopedTable(hs, pass_capacity=4)
+    with pytest.raises(ValueError):
+        t.begin_pass(np.arange(10, dtype=np.uint64))
+
+
+def test_stage_guards():
+    hs = HostStore(mf_dim=2, capacity=1 << 12)
+    t = PassScopedTable(hs, pass_capacity=64)
+    t.begin_pass(np.array([1, 2], np.uint64))
+    # staging while a pass is open would read stale host rows
+    with pytest.raises(RuntimeError, match="pass is open"):
+        t.stage(np.array([3], np.uint64))
+    t.end_pass()
+    # begin_pass with keys differing from the staged set must refuse
+    t.stage(np.array([1, 2], np.uint64), background=False)
+    with pytest.raises(RuntimeError, match="differ"):
+        t.begin_pass(np.array([1, 3], np.uint64))
+
+
+@pytest.fixture(scope="module")
+def criteo_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("criteo_pass")
+    return generate_criteo_files(str(d), num_files=4, rows_per_file=2500,
+                                 vocab_per_slot=40, seed=11)
+
+
+def test_boxps_helper_multi_pass_training(criteo_files, tmp_path):
+    """Two-day pipeline: preload day k+1 while day k trains; AUC improves
+    across passes; delta saved at end_pass."""
+    desc = DataFeedDesc.criteo(batch_size=128)
+    desc.key_bucket_min = 4096
+    cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=1e-3,
+                          learning_rate=0.1, mf_learning_rate=0.1)
+    hs = HostStore(mf_dim=8, capacity=1 << 16)
+    table = PassScopedTable(hs, pass_capacity=1 << 13, cfg=cfg,
+                            unique_bucket_min=4096)
+    tr = Trainer(CtrDnn(hidden=(32, 32)), table, desc, tx=optax.adam(2e-3))
+    helper = BoxPSHelper(table, trainer=tr)
+
+    def new_ds(files):
+        ds = DatasetFactory().create_dataset("PaddleBoxDataset", desc)
+        helper.attach(ds)
+        ds.set_filelist(files)
+        ds.set_thread(2)
+        return ds
+
+    ds1 = new_ds(criteo_files[:2])
+    helper.read_data_to_memory(ds1)
+    ds1.begin_pass()
+    n1 = table.feature_count
+    assert n1 > 50
+
+    ds2 = new_ds(criteo_files[2:])
+    helper.preload_into_memory(ds2)   # overlaps pass-1 training
+    r1 = helper.train_pass(ds1)
+    delta = str(tmp_path / "p1_delta.npz")
+    helper.end_pass(ds1, need_save_delta=True, delta_path=delta)
+    assert os.path.exists(delta)
+    assert len(hs) >= n1
+
+    helper.wait_feed_pass_done(ds2)
+    ds2.begin_pass()
+    tr.reset_metrics()
+    r2 = helper.train_pass(ds2)
+    ds2.end_pass()
+    assert np.isfinite(r1["last_loss"]) and np.isfinite(r2["last_loss"])
+    # same synthetic distribution → learned state (sparse rows written back
+    # through the host store + dense params) carries across passes
+    assert r2["auc"] > r1["auc"] > 0.5, (r1["auc"], r2["auc"])
+    # full model dump contains the union of both passes' features
+    base = str(tmp_path / "base.npz")
+    assert helper.save_base(base) == len(hs)
