@@ -132,22 +132,34 @@ class Metric:
 
 
 class MetricRegistry:
-    """init_metric/get_metric_msg surface (pybind box_helper_py.cc:99-160)."""
+    """init_metric/get_metric_msg surface (pybind box_helper_py.cc:99-160).
+
+    ``method`` selects the metric variant (metrics_ext.METRIC_METHODS):
+    auc | cmatch_rank_auc | mask_auc | cmatch_rank_mask_auc |
+    multi_task_auc | continue_value | nan_inf | wuauc."""
 
     def __init__(self) -> None:
-        self._metrics: Dict[str, Metric] = {}
+        self._metrics: Dict[str, object] = {}
         self.phase = 1  # 1=join, 0=update (FlipPhase semantics)
 
-    def init_metric(self, name: str, **kwargs) -> Metric:
-        m = Metric(name, **kwargs)
+    def init_metric(self, name: str, method: str = "auc", **kwargs):
+        from paddlebox_tpu.metrics_ext import METRIC_METHODS
+        try:
+            cls = METRIC_METHODS[method]
+        except KeyError:
+            raise ValueError(
+                f"unknown metric method {method!r}; "
+                f"one of {sorted(METRIC_METHODS)}") from None
+        m = cls(name, **kwargs)
         self._metrics[name] = m
         return m
 
-    def get(self, name: str) -> Metric:
+    def get(self, name: str):
         return self._metrics[name]
 
     def get_metric_msg(self, name: str) -> Dict[str, float]:
-        return self._metrics[name].compute().as_dict()
+        out = self._metrics[name].compute()
+        return out.as_dict() if isinstance(out, AucResult) else out
 
     def flip_phase(self) -> None:
         self.phase = 1 - self.phase

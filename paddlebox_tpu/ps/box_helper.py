@@ -93,4 +93,8 @@ class BoxPSHelper:
         return self.table.host.load(path, merge=merge)
 
     def shrink_table(self, **kw) -> int:
+        # score with the table's optimizer coefficients so host- and
+        # device-side shrink agree on what to drop
+        kw.setdefault("nonclk_coeff", self.table.cfg.nonclk_coeff)
+        kw.setdefault("clk_coeff", self.table.cfg.clk_coeff)
         return self.table.host.shrink(**kw)

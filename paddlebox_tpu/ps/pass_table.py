@@ -28,7 +28,8 @@ from paddlebox_tpu.config import FLAGS
 from paddlebox_tpu.ps.host_store import FIELDS, HostStore
 from paddlebox_tpu.ps.kv import make_kv
 from paddlebox_tpu.ps.sgd import SparseSGDConfig
-from paddlebox_tpu.ps.table import EmbeddingTable, TableState
+from paddlebox_tpu.ps.table import (TWO_D_FIELDS, EmbeddingTable,
+                                    TableState)
 from paddlebox_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -130,7 +131,7 @@ class PassScopedTable(EmbeddingTable):
         c1 = self.capacity + 1
         host_leaves = []
         for f in FIELDS:
-            shape = (c1, self.mf_dim) if f == "embedx_w" else (c1,)
+            shape = (c1, self.mf_dim) if f in TWO_D_FIELDS else (c1,)
             a = np.zeros(shape, np.float32)
             a[rows] = st.values[f]
             host_leaves.append(a)

@@ -206,10 +206,9 @@ class EmbeddingTable:
         self._touched = np.zeros(self.capacity + 1, dtype=bool)
 
     # ---- per-batch host prep (dedup + row assignment) ----
-    def prepare(self, batch: SlotBatch) -> PullIndex:
-        valid = batch.keys[:batch.num_keys]
-        uniq, inv = np.unique(valid, return_inverse=True)
-        rows = self.index.assign(uniq)
+    def _build_index(self, batch: SlotBatch, uniq: np.ndarray,
+                     inv: np.ndarray, rows: np.ndarray) -> PullIndex:
+        """Shared padding/bucketing tail of prepare/prepare_eval."""
         u = len(uniq)
         cap = self.unique_bucket_min
         while cap < u + 1:
@@ -221,8 +220,23 @@ class EmbeddingTable:
         gather_idx[:batch.num_keys] = inv.astype(np.int32)
         key_valid = np.zeros(k_pad, dtype=np.float32)
         key_valid[:batch.num_keys] = 1.0
-        self._touched[rows] = True
         return PullIndex(unique_rows, gather_idx, key_valid, u)
+
+    def prepare(self, batch: SlotBatch) -> PullIndex:
+        valid = batch.keys[:batch.num_keys]
+        uniq, inv = np.unique(valid, return_inverse=True)
+        rows = self.index.assign(uniq)
+        self._touched[rows] = True
+        return self._build_index(batch, uniq, inv, rows)
+
+    def prepare_eval(self, batch: SlotBatch) -> PullIndex:
+        """Read-only prepare: unknown keys map to the zero sentinel row
+        instead of allocating (inference path — no index mutation)."""
+        valid = batch.keys[:batch.num_keys]
+        uniq, inv = np.unique(valid, return_inverse=True)
+        rows = self.index.lookup(uniq)
+        rows = np.where(rows < 0, self.capacity, rows).astype(np.int32)
+        return self._build_index(batch, uniq, inv, rows)
 
     def next_rng(self) -> jax.Array:
         self._push_count += 1

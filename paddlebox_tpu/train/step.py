@@ -90,6 +90,7 @@ class TrainStep:
         self.quant_ratio = quant_ratio
         self.rng = jax.random.PRNGKey(rng_seed)
         self._jit = jax.jit(self._step, donate_argnums=(0,))
+        self._jit_eval = jax.jit(self._eval_step, donate_argnums=(2,))
 
     def init_params(self, mf_dim: int, dense_dim: int) -> Any:
         d = self.cvm_offset + 1 + mf_dim if self.use_cvm else 1 + mf_dim
@@ -155,6 +156,32 @@ class TrainStep:
                  "pred_mean": jnp.sum(pred * ins_w) /
                  jnp.maximum(jnp.sum(ins_w), 1.0)}
         return new_state, stats
+
+    def _forward(self, table: TableState, params: Any,
+                 batch: DeviceBatch) -> Tuple[jax.Array, jax.Array]:
+        """Shared inference path: pull → seqpool_cvm → model → pred."""
+        b, s = self.batch_size, self.num_slots
+        batch_show_clk = jnp.stack([batch.show, batch.clk], axis=1)
+        vals_u = pull_rows(table, batch.unique_rows)
+        values_k = expand_pull(vals_u, batch.gather_idx)
+        pooled = fused_seqpool_cvm(
+            values_k, batch.segments, batch_show_clk, b, s,
+            self.use_cvm, self.cvm_offset, 0.0, self.need_filter,
+            0.2, 1.0, 0.96, self.quant_ratio)
+        logits = self.model.apply(params, pooled, batch.dense)
+        ins_w = (batch.show > 0).astype(jnp.float32)
+        return jax.nn.sigmoid(logits), ins_w
+
+    def _eval_step(self, table: TableState, params: Any, auc: AucState,
+                   batch: DeviceBatch) -> AucState:
+        """Forward-only pass: metrics accumulate, nothing trains
+        (test_program / infer phase of the reference workers)."""
+        pred, ins_w = self._forward(table, params, batch)
+        return auc_add_batch(auc, pred, batch.label, ins_w)
+
+    def eval(self, table: TableState, params: Any, auc: AucState,
+             batch: DeviceBatch) -> AucState:
+        return self._jit_eval(table, params, auc, batch)
 
     def __call__(self, state: StepState, batch: DeviceBatch,
                  rng: jax.Array) -> Tuple[StepState, Dict[str, jax.Array]]:

@@ -74,10 +74,11 @@ class Trainer:
 
     # ---- host-side prefetch: batch build + dedup + row assign ----
     def _prefetch_iter(
-        self, batches: Iterable[SlotBatch]
+        self, batches: Iterable[SlotBatch], prepare=None,
     ) -> Iterator[Tuple[SlotBatch, PullIndex]]:
         from paddlebox_tpu.utils.prefetch import prefetch_iter
-        return prefetch_iter(batches, lambda b: (b, self.table.prepare(b)),
+        prep = prepare or self.table.prepare
+        return prefetch_iter(batches, lambda b: (b, prep(b)),
                              capacity=self.prefetch)
 
     def train_pass(self, dataset: Dataset,
@@ -114,6 +115,31 @@ class Trainer:
                    last_loss=last_loss)
         log.info("%spass done: %d batches, %.0f ex/s, auc=%.4f",
                  log_prefix, nb, out["examples_per_sec"], res.auc)
+        return out
+
+    def eval_pass(self, dataset: Dataset,
+                  log_prefix: str = "") -> Dict[str, float]:
+        """Forward-only pass: AUC on frozen params/table, no updates, no
+        index growth (reference test-phase / infer semantics)."""
+        auc = init_auc_state()
+        nb = 0
+        timer = Timer()
+        timer.start()
+        it = self._prefetch_iter(dataset.batches(),
+                                 prepare=self.table.prepare_eval)
+        for batch, idx in it:
+            dev = make_device_batch(batch, idx)
+            auc = self.step_fn.eval(self.state.table, self.state.params,
+                                    auc, dev)
+            nb += 1
+        timer.pause()
+        res = auc_compute(auc)
+        out = res.as_dict()
+        out.update(batches=nb, elapsed_sec=timer.elapsed_sec(),
+                   examples_per_sec=res.ins_num / max(timer.elapsed_sec(),
+                                                      1e-9))
+        log.info("%seval pass: %d batches, auc=%.4f", log_prefix, nb,
+                 res.auc)
         return out
 
     def sync_table(self) -> None:

@@ -1,0 +1,158 @@
+"""Metric variant semantics vs sklearn-style numpy references
+(fleet/metrics.h:198-567 behaviors)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddlebox_tpu.metrics import MetricRegistry
+from paddlebox_tpu.metrics_ext import (
+    CmatchRankAucMetric, CmatchRankMaskAucMetric, ContinueValueMetric,
+    MaskAucMetric, MultiTaskAucMetric, NanInfMetric, WuAucMetric,
+    _tie_averaged_user_auc, parse_cmatch_rank_group,
+)
+
+
+def ref_auc(label, pred):
+    """Exact Mann-Whitney AUC (tie-averaged)."""
+    order = np.argsort(pred, kind="stable")
+    p, l = pred[order], label[order]
+    ranks = np.empty(len(p))
+    i = 0
+    while i < len(p):
+        j = i
+        while j < len(p) and p[j] == p[i]:
+            j += 1
+        ranks[i:j] = (i + j + 1) / 2.0
+        i = j
+    n_pos, n_neg = l.sum(), (1 - l).sum()
+    return (ranks[l > 0].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+
+
+def test_parse_cmatch_rank_group():
+    assert parse_cmatch_rank_group("401:0,402:1") == [(401, 0), (402, 1)]
+    assert parse_cmatch_rank_group("7, 8") == [(7, 0), (8, 0)]
+
+
+def test_cmatch_rank_filter():
+    rng = np.random.default_rng(0)
+    n = 2000
+    pred = rng.random(n).astype(np.float32)
+    label = (rng.random(n) < pred).astype(np.float32)
+    cmatch = rng.choice([401, 402, 403], size=n).astype(np.int32)
+    rank = rng.integers(0, 3, size=n).astype(np.int32)
+
+    m = CmatchRankAucMetric("m", "401:0,402:1", nbins=100_000)
+    m.add(jnp.asarray(pred), jnp.asarray(label),
+          cmatch=jnp.asarray(cmatch), rank=jnp.asarray(rank))
+    sel = ((cmatch == 401) & (rank == 0)) | ((cmatch == 402) & (rank == 1))
+    got = m.compute()
+    assert got["ins_num"] == sel.sum()
+    assert abs(got["auc"] - ref_auc(label[sel], pred[sel])) < 2e-3
+
+    m2 = CmatchRankAucMetric("m2", "401", ignore_rank=True, nbins=100_000)
+    m2.add(jnp.asarray(pred), jnp.asarray(label),
+           cmatch=jnp.asarray(cmatch), rank=jnp.asarray(rank))
+    assert m2.compute()["ins_num"] == (cmatch == 401).sum()
+
+
+def test_mask_and_combined_filter():
+    rng = np.random.default_rng(1)
+    n = 1000
+    pred = rng.random(n).astype(np.float32)
+    label = (rng.random(n) < pred).astype(np.float32)
+    mask = rng.integers(0, 2, size=n).astype(np.int32)
+    cmatch = rng.choice([7, 9], size=n).astype(np.int32)
+
+    m = MaskAucMetric("m", nbins=100_000)
+    m.add(jnp.asarray(pred), jnp.asarray(label), mask=jnp.asarray(mask))
+    assert m.compute()["ins_num"] == mask.sum()
+
+    mc = CmatchRankMaskAucMetric("mc", "7", ignore_rank=True, nbins=100_000)
+    mc.add(jnp.asarray(pred), jnp.asarray(label),
+           cmatch=jnp.asarray(cmatch), mask=jnp.asarray(mask))
+    sel = (cmatch == 7) & (mask == 1)
+    got = mc.compute()
+    assert got["ins_num"] == sel.sum()
+    assert abs(got["auc"] - ref_auc(label[sel], pred[sel])) < 4e-3
+
+
+def test_multi_task_selects_head_by_cmatch():
+    rng = np.random.default_rng(2)
+    n, t = 1500, 3
+    preds = rng.random((n, t)).astype(np.float32)
+    cmatch = rng.choice([11, 12, 13, 99], size=n).astype(np.int32)
+    task = np.select([cmatch == 11, cmatch == 12, cmatch == 13],
+                     [0, 1, 2], default=-1)
+    sel = task >= 0
+    chosen = preds[np.arange(n), np.maximum(task, 0)]
+    label = (rng.random(n) < chosen).astype(np.float32)
+
+    m = MultiTaskAucMetric("mt", "11:0,12:1,13:2", nbins=100_000)
+    m.add(jnp.asarray(preds), jnp.asarray(label), cmatch=jnp.asarray(cmatch))
+    got = m.compute()
+    assert got["ins_num"] == sel.sum()
+    assert abs(got["auc"] - ref_auc(label[sel], chosen[sel])) < 2e-3
+
+
+def test_continue_value():
+    m = ContinueValueMetric("cv")
+    pred = jnp.asarray([1.0, 2.0, 3.0])
+    label = jnp.asarray([1.5, 2.0, 1.0])
+    m.add(pred, label)
+    got = m.compute()
+    np.testing.assert_allclose(got["mae"], (0.5 + 0 + 2.0) / 3)
+    np.testing.assert_allclose(got["rmse"], np.sqrt((0.25 + 4.0) / 3))
+
+
+def test_nan_inf_counter():
+    m = NanInfMetric("ni")
+    m.add(jnp.asarray([0.1, np.nan, np.inf, -np.inf, 0.5]))
+    got = m.compute()
+    assert got["nan"] == 1 and got["inf"] == 2 and got["ins_num"] == 5
+
+
+def test_wuauc_matches_per_user_reference():
+    rng = np.random.default_rng(3)
+    n = 3000
+    uid = rng.integers(0, 40, size=n).astype(np.int64)
+    pred = np.round(rng.random(n).astype(np.float64), 2)  # force ties
+    label = (rng.random(n) < pred).astype(np.float64)
+
+    wuauc, uauc, users = _tie_averaged_user_auc(uid, pred, label)
+    # python reference: loop users
+    aucs, weights = [], []
+    for u in np.unique(uid):
+        m = uid == u
+        l, p = label[m], pred[m]
+        if l.sum() in (0, len(l)):
+            continue
+        aucs.append(ref_auc(l, p))
+        weights.append(m.sum())
+    want_w = float(np.sum(np.array(aucs) * np.array(weights)) / np.sum(weights))
+    assert users == len(aucs)
+    np.testing.assert_allclose(wuauc, want_w, rtol=1e-10)
+    np.testing.assert_allclose(uauc, np.mean(aucs), rtol=1e-10)
+
+
+def test_wuauc_metric_batches():
+    m = WuAucMetric("wu")
+    m.add(np.array([0.9, 0.1]), np.array([1.0, 0.0]), uid=np.array([1, 1]))
+    m.add(np.array([0.2, 0.8]), np.array([1.0, 0.0]), uid=np.array([2, 2]))
+    got = m.compute()
+    assert got["user_count"] == 2
+    np.testing.assert_allclose(got["wuauc"], 0.5)  # user1 perfect, user2 inverted
+
+
+def test_registry_dispatch_and_phase():
+    reg = MetricRegistry()
+    reg.init_metric("join_auc", method="auc", phase=1, nbins=1000)
+    reg.init_metric("upd_auc", method="auc", phase=0, nbins=1000)
+    reg.init_metric("wu", method="wuauc")
+    assert set(reg.active()) == {"join_auc", "wu"}
+    reg.flip_phase()
+    assert set(reg.active()) == {"upd_auc", "wu"}
+    with pytest.raises(ValueError):
+        reg.init_metric("x", method="nope")
+    msg = reg.get_metric_msg("wu")
+    assert msg["ins_num"] == 0.0
