@@ -43,6 +43,8 @@ class SlotBatch:
     uid: Optional[np.ndarray] = None     # int64 [B]
     rank: Optional[np.ndarray] = None    # int32 [B]
     cmatch: Optional[np.ndarray] = None  # int32 [B]
+    # sample ids for the dump subsystem (None when no record carries one)
+    ins_ids: Optional[list] = None       # list[str], len == #real records
 
     @property
     def key_capacity(self) -> int:
@@ -105,10 +107,12 @@ class BatchBuilder:
             uid[i] = r.uid
             rank[i] = r.rank
             cmatch[i] = r.cmatch
+        ins_ids = ([r.ins_id for r in records]
+                   if any(r.ins_id for r in records) else None)
         # short batches (tail of a pass): instances [n, bs) have show=0 so
         # they contribute nothing to pooled sums, loss, or metrics.
         return SlotBatch(
             keys=keys_p, segments=segs_p, num_keys=nk, dense=dense,
             label=label, show=show, clk=clk, batch_size=bs, num_slots=S,
-            uid=uid, rank=rank, cmatch=cmatch,
+            uid=uid, rank=rank, cmatch=cmatch, ins_ids=ins_ids,
         )

@@ -13,6 +13,11 @@ from paddlebox_tpu.ops.cross_norm import (
     cross_norm_hadamard, cross_norm_update, init_cross_norm_summary,
 )
 from paddlebox_tpu.ops.scaled_fc import scaled_fc, scaled_int8fc
+from paddlebox_tpu.ops.seqpool_variants import (
+    fused_seqpool_cvm_with_diff_thres, fused_seqpool_cvm_tradew,
+    fused_seqpool_cvm_with_credit, fused_seqpool_cvm_with_pcoc,
+)
+from paddlebox_tpu.ops.seq_tensor import fused_seq_tensor
 
 __all__ = [
     "fused_seqpool_cvm", "fused_seqpool_cvm_with_conv",
@@ -21,4 +26,7 @@ __all__ = [
     "partial_sum", "DataNormSummary", "data_norm", "data_norm_update",
     "init_data_norm_summary", "cross_norm_hadamard", "cross_norm_update",
     "init_cross_norm_summary", "scaled_fc", "scaled_int8fc",
+    "fused_seqpool_cvm_with_diff_thres", "fused_seqpool_cvm_tradew",
+    "fused_seqpool_cvm_with_credit", "fused_seqpool_cvm_with_pcoc",
+    "fused_seq_tensor",
 ]

@@ -1,0 +1,63 @@
+"""fused_seq_tensor — DIN-style ad/user-sequence feature interaction.
+
+Reference: paddle/fluid/operators/fused/fused_seq_tensor_op.{cc,cu} —
+inputs ``Input`` (user behavior sequence embeddings,
+[ins, batch_count·slot_num·max_length·dim]) and ``ADInput``
+([ins, batch_count·ad_slot_num·dim]); outputs (op .cc:95-111):
+- DINOut: per sequence position, [in, ad, in−ad, in·ad] interaction
+  block over the ad slots (kernel cal_ad_slot_session_kernel, .cu:53-97);
+- MaskOut: position non-empty mask via sum-over-slots/dims ≠ 0
+  (reduce_sum_max_length, .cu:146-199);
+- SideInfoOut: side-info slot slice (cal_sideinfo_kernel);
+- ADSlotSessionOut: the ad-slot slice of the input sequence.
+
+TPU-native: four reshape/slice/broadcast expressions fused by XLA — the
+CUDA index juggling disappears entirely.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def fused_seq_tensor(
+    inputs: jax.Array,      # [ins, batch_count*slot_num*max_length*dim]
+    ad_input: jax.Array,    # [ins, batch_count*ad_slot_num*dim]
+    batch_count: int,
+    max_length: int,
+    slot_num: int,
+    fea_emb_dim: int,
+    ad_slot_num: int,
+    ad_slot_offset: int,
+    sideinfo_slot_num: int,
+    sideinfo_slot_offset: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Returns (din_out [bc, ins, L, 4·adS·dim], mask [bc, ins, L],
+    side_info [bc, ins, L, sideS·dim], ad_session [bc, ins, L, adS·dim])."""
+    ins = inputs.shape[0]
+    x = inputs.reshape(ins, batch_count, slot_num, max_length, fea_emb_dim)
+    ad = ad_input.reshape(ins, batch_count, ad_slot_num, fea_emb_dim)
+
+    seq = x[:, :, ad_slot_offset:ad_slot_offset + ad_slot_num]  # [ins,bc,adS,L,d]
+    seq = seq.transpose(1, 0, 3, 2, 4)                          # [bc,ins,L,adS,d]
+    adb = ad.transpose(1, 0, 2, 3)[:, :, None]                  # [bc,ins,1,adS,d]
+    adb = jnp.broadcast_to(adb, seq.shape)
+    din = jnp.stack([seq, adb, seq - adb, seq * adb], axis=3)   # [bc,ins,L,4,adS,d]
+    din_out = din.reshape(batch_count, ins, max_length,
+                          4 * ad_slot_num * fea_emb_dim)
+
+    pos_sum = x.sum(axis=(2, 4))                                # [ins,bc,L]
+    mask = (jnp.abs(pos_sum) > 1e-8).astype(inputs.dtype)
+    mask_out = mask.transpose(1, 0, 2)                          # [bc,ins,L]
+
+    side = x[:, :, sideinfo_slot_offset:
+             sideinfo_slot_offset + sideinfo_slot_num]
+    side_out = side.transpose(1, 0, 3, 2, 4).reshape(
+        batch_count, ins, max_length, sideinfo_slot_num * fea_emb_dim)
+
+    ad_session_out = seq.reshape(batch_count, ins, max_length,
+                                 ad_slot_num * fea_emb_dim)
+    return din_out, mask_out, side_out, ad_session_out

@@ -138,19 +138,29 @@ def fused_seqpool_cvm_with_conv(
 _CONV_OFFSET = 3
 
 
+def _pool_core(values, segments, batch_size, num_slots, keep=None,
+               pad_value=0.0):
+    """The one shared pooling body: mask → segment-sum → [B, S, D]
+    (+pad). Every seqpool op and variant goes through here."""
+    if keep is not None:
+        values = jnp.where(keep[:, None], values, 0.0)
+    num_segments = batch_size * num_slots + 1
+    pooled = segment_sum(values, segments, num_segments)
+    d = values.shape[1]
+    return pooled[:-1].reshape(batch_size, num_slots, d) + pad_value
+
+
 def _filtered_pool(values, segments, batch_size, num_slots, pad_value,
                    need_filter, show_coeff, clk_coeff, threshold):
     """Shared filter + segment-sum (both seqpool variants)."""
-    k, d = values.shape
+    k = values.shape[0]
     if need_filter:
         show, clk = values[:, 0], values[:, 1]
         keep = ((show - clk) * show_coeff + clk * clk_coeff) >= threshold
     else:
         keep = jnp.ones((k,), dtype=bool)
-    v = jnp.where(keep[:, None], values, 0.0)
-    num_segments = batch_size * num_slots + 1
-    pooled = segment_sum(v, segments, num_segments)
-    return pooled[:-1].reshape(batch_size, num_slots, d) + pad_value, keep
+    return _pool_core(values, segments, batch_size, num_slots, keep,
+                      pad_value), keep
 
 
 def _fwd_conv(values, segments, batch_cvm, batch_size, num_slots, use_cvm,

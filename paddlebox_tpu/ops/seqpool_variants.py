@@ -1,0 +1,314 @@
+"""fused_seqpool_cvm variant family.
+
+Reference ops (paddle/fluid/operators/fused/):
+- ``fused_seqpool_cvm_with_diff_thres_op.cu`` — per-slot filter thresholds
+  (kernel :100-140: threshold_vec_gpu[slot] replaces the scalar).
+- ``fused_seqpool_cvm_tradew_op.cu`` — value layout
+  [cvm | trade weights | embed]; normal mode skips the trade columns
+  (:37-60); trade_id mode scales embeds by the chosen trade weight
+  (:66-90); grads per :269-345 (normal: cvm←batch-cvm, trade←0,
+  embed←g; trade_id: cvm←0, chosen trade←Σ g·embed_in, embed←g·w).
+- ``fused_seqpool_cvm_with_credit_op.cu`` — cvm_offset=4
+  [show,click,conv,credit], CVM head = log1p of each cvm column
+  (:53-70); show_filter drops the show column (:75-92).
+- ``fused_seqpool_cvm_with_pcoc_op.cu`` — input cvm
+  [show,clk,show2,clk2,pclk_1..p]; output head (:122-157):
+  [log1p(show), log1p(clk)-log1p(show),
+   log1p(pclk_i)-log1p(show2) ∀i, log1p(pclk_i)-log1p(clk2) ∀i];
+  backward (:261-293): first 4 cvm cols ← batch cvm values, pclk cols ←
+  per-instance q_values, embeds broadcast.
+
+TPU-native: same single-segment-sum formulation as ops/seqpool_cvm.py —
+all slots of all instances pool in one fused op; the variant math is the
+elementwise epilogue/filter XLA fuses into it. custom_vjp replicates each
+reference backward contract exactly.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.ops.seqpool_cvm import _pool_core as _pool
+
+
+def _broadcast_grad(flat_g, segments, batch_size, num_slots):
+    """[B*S, E] per-segment grads → [K, E] per-item grads (pads → 0)."""
+    e = flat_g.shape[1]
+    flat_g = jnp.concatenate([flat_g, jnp.zeros((1, e), flat_g.dtype)],
+                             axis=0)
+    seg = jnp.minimum(segments, batch_size * num_slots)
+    return flat_g[seg]
+
+
+def _ins_of(segments, batch_size, num_slots):
+    return jnp.minimum(segments // num_slots, batch_size - 1)
+
+
+def _pad_mask(segments, batch_size, num_slots):
+    return segments >= batch_size * num_slots
+
+
+# ---------------------------------------------------------------------------
+# diff_thres
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
+def fused_seqpool_cvm_with_diff_thres(
+    values: jax.Array,           # [K, D]
+    segments: jax.Array,         # [K]
+    batch_show_clk: jax.Array,   # [B, 2]
+    threshold_vec: jax.Array,    # [S] per-slot thresholds
+    batch_size: int,
+    num_slots: int,
+    use_cvm: bool = True,
+    cvm_offset: int = 2,
+    pad_value: float = 0.0,
+    show_coeff: float = 0.2,
+    clk_coeff: float = 1.0,
+    xbox_diff_thres_filter: bool = True,
+) -> jax.Array:
+    out, _ = _fwd_dt(values, segments, batch_show_clk, threshold_vec,
+                     batch_size, num_slots, use_cvm, cvm_offset, pad_value,
+                     show_coeff, clk_coeff, xbox_diff_thres_filter)
+    return out
+
+
+def _fwd_dt(values, segments, batch_show_clk, threshold_vec, batch_size,
+            num_slots, use_cvm, cvm_offset, pad_value, show_coeff,
+            clk_coeff, xbox):
+    slot = jnp.minimum(segments % num_slots, num_slots - 1)
+    thr = threshold_vec[slot]
+    score = ((values[:, 0] - values[:, 1]) * show_coeff
+             + values[:, 1] * clk_coeff)
+    keep = score >= thr
+    pooled = _pool(values, segments, batch_size, num_slots, keep, pad_value)
+    if use_cvm:
+        show_l = jnp.log1p(pooled[..., 0:1])
+        ctr = jnp.log1p(pooled[..., 1:2]) - show_l
+        out = jnp.concatenate([show_l, ctr, pooled[..., cvm_offset:]], -1)
+    else:
+        out = pooled[..., cvm_offset:]
+    vtoken = jnp.zeros((0, values.shape[1]), values.dtype)
+    return out, (segments, keep, vtoken, batch_show_clk)
+
+
+def _bwd_dt(batch_size, num_slots, use_cvm, cvm_offset, pad_value,
+            show_coeff, clk_coeff, xbox, res, g):
+    segments, keep, vtoken, batch_show_clk = res
+    d = vtoken.shape[1]
+    embedx_g = g[..., cvm_offset:] if use_cvm else g
+    g_embedx = _broadcast_grad(
+        embedx_g.reshape(batch_size * num_slots, d - cvm_offset),
+        segments, batch_size, num_slots)
+    g_cvm = batch_show_clk[_ins_of(segments, batch_size, num_slots)]
+    live = (keep & ~_pad_mask(segments, batch_size, num_slots))[:, None]
+    g_values = jnp.where(
+        live, jnp.concatenate([g_cvm.astype(g_embedx.dtype), g_embedx], -1),
+        0.0).astype(vtoken.dtype)
+    return (g_values, None, None, None)
+
+
+fused_seqpool_cvm_with_diff_thres.defvjp(_fwd_dt, _bwd_dt)
+
+
+# ---------------------------------------------------------------------------
+# tradew
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def fused_seqpool_cvm_tradew(
+    values: jax.Array,          # [K, cvm_offset + trade_num + E]
+    segments: jax.Array,
+    batch_show_clk: jax.Array,  # [B, cvm_offset]
+    batch_size: int,
+    num_slots: int,
+    trade_num: int,
+    trade_id: int = -1,         # ≥0: scale embeds by that trade weight
+    use_cvm: bool = True,
+    cvm_offset: int = 2,
+) -> jax.Array:
+    out, _ = _fwd_tw(values, segments, batch_show_clk, batch_size, num_slots,
+                     trade_num, trade_id, use_cvm, cvm_offset)
+    return out
+
+
+def _fwd_tw(values, segments, batch_show_clk, batch_size, num_slots,
+            trade_num, trade_id, use_cvm, cvm_offset):
+    co, tn = cvm_offset, trade_num
+    cvm_cols = values[:, :co]
+    embed_cols = values[:, co + tn:]
+    if trade_id >= 0:
+        w = values[:, co + trade_id:co + trade_id + 1]
+        embed_cols = embed_cols * w
+    v = jnp.concatenate([cvm_cols, embed_cols], axis=1)
+    pooled = _pool(v, segments, batch_size, num_slots)
+    if use_cvm:
+        show_l = jnp.log1p(pooled[..., 0:1])
+        ctr = jnp.log1p(pooled[..., 1:2]) - show_l
+        out = jnp.concatenate([show_l, ctr, pooled[..., co:]], -1)
+    else:
+        out = pooled[..., co:]
+    vtoken = jnp.zeros((0, values.shape[1]), values.dtype)
+    # normal mode's backward never reads the inputs — keep only the token
+    # so the [K, D] activations don't live until backward for nothing
+    saved = values if trade_id >= 0 else None
+    return out, (segments, saved, vtoken, batch_show_clk)
+
+
+def _bwd_tw(batch_size, num_slots, trade_num, trade_id, use_cvm, cvm_offset,
+            res, g):
+    segments, values, vtoken, batch_show_clk = res
+    co, tn = cvm_offset, trade_num
+    e = values.shape[1] - co - tn
+    embedx_g = g[..., co:] if use_cvm else g
+    g_embed_seg = _broadcast_grad(
+        embedx_g.reshape(batch_size * num_slots, e),
+        segments, batch_size, num_slots)                   # [K, E]
+    live = ~_pad_mask(segments, batch_size, num_slots)
+    g_trade = jnp.zeros((values.shape[0], tn), g_embed_seg.dtype)
+    if trade_id >= 0:
+        # product rule (FusedSeqpoolCVMTradeWGradKernel :295-345):
+        # cvm←0, chosen trade col ← Σ_j g_j·embed_in_j, embed ← g·w
+        g_cvm = jnp.zeros((values.shape[0], co), g_embed_seg.dtype)
+        embed_in = values[:, co + tn:]
+        g_trade = g_trade.at[:, trade_id].set(
+            jnp.sum(g_embed_seg * embed_in, axis=1))
+        w = values[:, co + trade_id:co + trade_id + 1]
+        g_embed = g_embed_seg * w
+    else:
+        g_cvm = batch_show_clk[
+            _ins_of(segments, batch_size, num_slots)].astype(
+                g_embed_seg.dtype)
+        g_embed = g_embed_seg
+    g_values = jnp.where(
+        live[:, None], jnp.concatenate([g_cvm, g_trade, g_embed], -1),
+        0.0).astype(vtoken.dtype)
+    return (g_values, None, None)
+
+
+fused_seqpool_cvm_tradew.defvjp(_fwd_tw, _bwd_tw)
+
+
+# ---------------------------------------------------------------------------
+# credit
+# ---------------------------------------------------------------------------
+
+_CREDIT_OFFSET = 4  # show, click, conv, credit
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def fused_seqpool_cvm_with_credit(
+    values: jax.Array,       # [K, 4 + E]
+    segments: jax.Array,
+    batch_cvm: jax.Array,    # [B, 4]
+    batch_size: int,
+    num_slots: int,
+    use_cvm: bool = True,
+    show_filter: bool = False,
+) -> jax.Array:
+    out, _ = _fwd_cr(values, segments, batch_cvm, batch_size, num_slots,
+                     use_cvm, show_filter)
+    return out
+
+
+def _fwd_cr(values, segments, batch_cvm, batch_size, num_slots, use_cvm,
+            show_filter):
+    co = _CREDIT_OFFSET
+    pooled = _pool(values, segments, batch_size, num_slots)
+    if use_cvm:
+        head = jnp.log1p(pooled[..., :co])
+        if show_filter:
+            head = head[..., 1:]
+        out = jnp.concatenate([head, pooled[..., co:]], -1)
+    else:
+        out = pooled[..., co:]
+    vtoken = jnp.zeros((0, values.shape[1]), values.dtype)
+    return out, (segments, vtoken, batch_cvm)
+
+
+def _bwd_cr(batch_size, num_slots, use_cvm, show_filter, res, g):
+    segments, vtoken, batch_cvm = res
+    co = _CREDIT_OFFSET
+    d = vtoken.shape[1]
+    n_head = (co - 1 if show_filter else co) if use_cvm else 0
+    embedx_g = g[..., n_head:]
+    g_embedx = _broadcast_grad(
+        embedx_g.reshape(batch_size * num_slots, d - co),
+        segments, batch_size, num_slots)
+    g_cvm = batch_cvm[_ins_of(segments, batch_size, num_slots)]
+    live = ~_pad_mask(segments, batch_size, num_slots)
+    g_values = jnp.where(
+        live[:, None],
+        jnp.concatenate([g_cvm.astype(g_embedx.dtype), g_embedx], -1),
+        0.0).astype(vtoken.dtype)
+    return (g_values, None, None)
+
+
+fused_seqpool_cvm_with_credit.defvjp(_fwd_cr, _bwd_cr)
+
+
+# ---------------------------------------------------------------------------
+# pcoc
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def fused_seqpool_cvm_with_pcoc(
+    values: jax.Array,       # [K, 4 + pclk_num + E]
+    segments: jax.Array,
+    batch_cvm: jax.Array,    # [B, 4 + pclk_num] (show,clk,show2,clk2,pclk…)
+    q_values: jax.Array,     # [B, pclk_num]
+    batch_size: int,
+    num_slots: int,
+    use_cvm: bool = True,
+) -> jax.Array:
+    """Output head (use_cvm): [log1p(show), log1p(clk)-log1p(show),
+    {log1p(pclk_i)-log1p(show2)}, {log1p(pclk_i)-log1p(clk2)}] + embeds."""
+    out, _ = _fwd_pc(values, segments, batch_cvm, q_values, batch_size,
+                     num_slots, use_cvm)
+    return out
+
+
+def _fwd_pc(values, segments, batch_cvm, q_values, batch_size, num_slots,
+            use_cvm):
+    p = batch_cvm.shape[1] - 4
+    used = 4 + p
+    pooled = _pool(values, segments, batch_size, num_slots)
+    if use_cvm:
+        lg = jnp.log1p(pooled[..., :used])
+        show_l, clk_l = lg[..., 0:1], lg[..., 1:2]
+        show2_l, clk2_l = lg[..., 2:3], lg[..., 3:4]
+        pclk_l = lg[..., 4:used]
+        out = jnp.concatenate(
+            [show_l, clk_l - show_l, pclk_l - show2_l, pclk_l - clk2_l,
+             pooled[..., used:]], -1)
+    else:
+        out = pooled[..., used:]
+    vtoken = jnp.zeros((0, values.shape[1]), values.dtype)
+    return out, (segments, vtoken, batch_cvm, q_values)
+
+
+def _bwd_pc(batch_size, num_slots, use_cvm, res, g):
+    segments, vtoken, batch_cvm, q_values = res
+    p = batch_cvm.shape[1] - 4
+    used = 4 + p
+    d = vtoken.shape[1]
+    n_head = (2 + 2 * p) if use_cvm else 0
+    embedx_g = g[..., n_head:]
+    g_embedx = _broadcast_grad(
+        embedx_g.reshape(batch_size * num_slots, d - used),
+        segments, batch_size, num_slots)
+    ins = _ins_of(segments, batch_size, num_slots)
+    # first 4 cvm cols carry batch cvm; pclk cols carry q_values (:261-293)
+    g_cvm = jnp.concatenate([batch_cvm[:, :4], q_values], axis=1)[ins]
+    live = ~_pad_mask(segments, batch_size, num_slots)
+    g_values = jnp.where(
+        live[:, None],
+        jnp.concatenate([g_cvm.astype(g_embedx.dtype), g_embedx], -1),
+        0.0).astype(vtoken.dtype)
+    return (g_values, None, None, None)
+
+
+fused_seqpool_cvm_with_pcoc.defvjp(_fwd_pc, _bwd_pc)

@@ -6,8 +6,11 @@ from paddlebox_tpu.ps.table import (
 from paddlebox_tpu.ps.host_store import HostStore
 from paddlebox_tpu.ps.pass_table import PassScopedTable
 from paddlebox_tpu.ps.box_helper import BoxPSHelper
+from paddlebox_tpu.ps.extended import ExtendedEmbeddingTable
+from paddlebox_tpu.ps.replica_cache import InputTable, ReplicaCache
 
 __all__ = ["SparseSGDConfig", "SparseAdamConfig", "EmbeddingTable",
            "TableState", "PullIndex", "pull_rows", "expand_pull",
            "apply_push", "merge_push", "push_stats", "init_table_state",
-           "HostStore", "PassScopedTable", "BoxPSHelper"]
+           "HostStore", "PassScopedTable", "BoxPSHelper",
+           "ExtendedEmbeddingTable", "InputTable", "ReplicaCache"]

@@ -1,0 +1,95 @@
+"""Extended (expand) embedding pulls — pull_box_extended_sparse.
+
+Reference: paddle/fluid/operators/pull_box_extended_sparse_op.{cc,cu,h} —
+one lookup returns TWO embeddings per key: the base ``emb_size`` vector
+and an ``emb_extended_size`` "expand" vector from a second value space
+(Python surface ``_pull_box_extended_sparse``, contrib/layers/nn.py:1678);
+slots listed in ``skip_extend_slots`` only produce the base output (their
+expand values read zero and train nothing — see ``prepare``).
+
+TPU-native: the expand space is a second EmbeddingTable over the same
+keys (the BoxPS core versions them inside one FeatureValue; two SoA
+tables give identical math with independent mf dims and optimizers, and
+both pulls land in the same jit step).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from paddlebox_tpu.data.batch import SlotBatch
+from paddlebox_tpu.ps.sgd import SparseSGDConfig
+from paddlebox_tpu.ps.table import EmbeddingTable, PullIndex
+
+
+class ExtendedEmbeddingTable:
+    """Base + expand table pair sharing key traffic.
+
+    ``skip_extend_slots`` (attr `skip_extend_slots` of the reference op):
+    keys in those slots pull zeros from the expand space and push no
+    expand grads — only the base embedding trains for them."""
+
+    def __init__(self, mf_dim: int, extend_mf_dim: int,
+                 capacity: Optional[int] = None,
+                 cfg: Optional[SparseSGDConfig] = None,
+                 extend_cfg: Optional[SparseSGDConfig] = None,
+                 seed: int = 0, unique_bucket_min: int = 1024,
+                 skip_extend_slots: Sequence[int] = ()) -> None:
+        self.base = EmbeddingTable(mf_dim, capacity, cfg, seed,
+                                   unique_bucket_min)
+        self.extend = EmbeddingTable(extend_mf_dim, capacity,
+                                     extend_cfg or cfg, seed + 1,
+                                     unique_bucket_min)
+        self.skip_extend_slots = frozenset(skip_extend_slots)
+
+    def prepare(self, batch: SlotBatch) -> Tuple[PullIndex, PullIndex]:
+        # dedup once; both tables share the unique set (the reference's
+        # single dedup feeding both value spaces)
+        valid = batch.keys[:batch.num_keys]
+        uniq, inv = np.unique(valid, return_inverse=True)
+        rows_b = self.base.index.assign(uniq)
+        self.base._touched[rows_b] = True
+        idx_b = self.base._build_index(batch, uniq, inv, rows_b)
+        if not self.skip_extend_slots:
+            rows_e = self.extend.index.assign(uniq)
+            self.extend._touched[rows_e] = True
+            idx_e = self.extend._build_index(batch, uniq, inv, rows_e)
+        else:
+            slot_k = batch.segments[:batch.num_keys] % batch.num_slots
+            keep = ~np.isin(slot_k, list(self.skip_extend_slots))
+            uniq_e, inv_e = np.unique(valid[keep], return_inverse=True)
+            rows_e = self.extend.index.assign(uniq_e)
+            self.extend._touched[rows_e] = True
+            u = len(uniq_e)
+            cap = self.extend.unique_bucket_min
+            while cap < u + 1:
+                cap *= 2
+            unique_rows = np.full(cap, self.extend.capacity, np.int32)
+            unique_rows[:u] = rows_e
+            k_pad = batch.keys.shape[0]
+            # skipped keys point at the sentinel slot: zero pulls, and
+            # key_valid=0 drops their expand grads in merge_push
+            gather_idx = np.full(k_pad, u, dtype=np.int32)
+            gather_idx[:batch.num_keys][keep] = inv_e.astype(np.int32)
+            key_valid = np.zeros(k_pad, dtype=np.float32)
+            key_valid[:batch.num_keys][keep] = 1.0
+            idx_e = PullIndex(unique_rows, gather_idx, key_valid, u)
+        return idx_b, idx_e
+
+    def pull(self, idx: Tuple[PullIndex, PullIndex]
+             ) -> Tuple[jax.Array, jax.Array]:
+        """→ (values [K, 3+mf], expand_values [K, 3+extend_mf])."""
+        return self.base.pull(idx[0]), self.extend.pull(idx[1])
+
+    def push(self, idx: Tuple[PullIndex, PullIndex],
+             key_grads: jax.Array, extend_key_grads: jax.Array,
+             slot_of_key=None) -> None:
+        self.base.push(idx[0], key_grads, slot_of_key)
+        self.extend.push(idx[1], extend_key_grads, slot_of_key)
+
+    @property
+    def feature_count(self) -> int:
+        return self.base.feature_count

@@ -1,0 +1,99 @@
+"""Replicated small dense-embedding caches + host side-input table.
+
+Reference:
+- ``GpuReplicaCache`` (fleet/box_wrapper.h:63-122 + box_wrapper.cu:1210):
+  a small dense embedding table built on host (``AddItems``), replicated
+  into every GPU's HBM (``ToHBM``) and looked up in-kernel by row id
+  (``pull_cache_value_kernel``) — used for tiny high-traffic vocabularies
+  that would waste PS round-trips.
+- ``InputTable`` (fleet/box_wrapper.h:124-197): string-keyed dense
+  side-input rows on host, batch-looked-up and copied to device
+  (``LookupInput``), feeding the ``InputTableDataFeed`` variant.
+
+TPU-native: the replica cache is one jnp array — under pjit it is
+replicated to every chip by giving it a fully-replicated sharding, and
+lookups are jit gathers; the input table keeps a host string→row dict
+and materializes per-batch rows as a device array.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ReplicaCache:
+    """GpuReplicaCache analogue: build rows on host, freeze to device."""
+
+    def __init__(self, emb_dim: int) -> None:
+        self.emb_dim = emb_dim
+        self._rows: List[np.ndarray] = []
+        self._dev: Optional[jax.Array] = None
+
+    def add_items(self, rows: np.ndarray) -> int:
+        """Append [n, emb_dim] rows; returns the first new row id."""
+        rows = np.asarray(rows, np.float32).reshape(-1, self.emb_dim)
+        first = self.size
+        self._rows.append(rows)
+        self._dev = None
+        return first
+
+    @property
+    def size(self) -> int:
+        return sum(r.shape[0] for r in self._rows)
+
+    def to_hbm(self) -> jax.Array:
+        """Freeze to a device array (ToHBM). Under pjit, pass this array
+        with a replicated PartitionSpec to mirror the per-GPU copies."""
+        if self._dev is None:
+            host = (np.concatenate(self._rows, axis=0) if self._rows
+                    else np.zeros((0, self.emb_dim), np.float32))
+            self._dev = jnp.asarray(host)
+        return self._dev
+
+    def pull(self, ids: jax.Array) -> jax.Array:
+        """Row lookup (pull_cache_value_kernel): [.., ] ids → [.., dim].
+        Ids are clamped into range (the CUDA kernel does no bounds check
+        either); an empty cache is a caller bug and raises at trace time."""
+        table = self.to_hbm()
+        if table.shape[0] == 0:
+            raise ValueError("ReplicaCache.pull on an empty cache — "
+                             "add_items first")
+        return table[jnp.clip(ids, 0, table.shape[0] - 1)]
+
+
+class InputTable:
+    """Host string-keyed dense side-input (InputTable, box_wrapper.h:124)."""
+
+    def __init__(self, dim: int) -> None:
+        self.dim = dim
+        self._map: Dict[str, int] = {}
+        self._rows: List[np.ndarray] = []
+
+    def add_input(self, key: str, values: Sequence[float]) -> int:
+        v = np.asarray(values, np.float32)
+        if v.shape != (self.dim,):
+            raise ValueError(f"row for {key!r} has shape {v.shape}, "
+                             f"want ({self.dim},)")
+        if key in self._map:
+            self._rows[self._map[key]] = v
+            return self._map[key]
+        self._map[key] = len(self._rows)
+        self._rows.append(v)
+        return self._map[key]
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    def lookup(self, keys: Sequence[str]) -> jax.Array:
+        """Batch lookup → [n, dim] device array; misses read zeros
+        (LookupInput H2D copy)."""
+        out = np.zeros((len(keys), self.dim), np.float32)
+        for i, k in enumerate(keys):
+            r = self._map.get(k)
+            if r is not None:
+                out[i] = self._rows[r]
+        return jnp.asarray(out)

@@ -154,7 +154,10 @@ class TrainStep:
                               step=state.step + 1)
         stats = {"loss": loss,
                  "pred_mean": jnp.sum(pred * ins_w) /
-                 jnp.maximum(jnp.sum(ins_w), 1.0)}
+                 jnp.maximum(jnp.sum(ins_w), 1.0),
+                 # per-instance preds for the dump subsystem; stays on
+                 # device unless a DumpWriter fetches it
+                 "pred": pred}
         return new_state, stats
 
     def _forward(self, table: TableState, params: Any,

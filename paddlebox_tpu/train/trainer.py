@@ -71,6 +71,7 @@ class Trainer:
         self.prefetch = prefetch
         self._rng = jax.random.PRNGKey(seed + 1)
         self.global_step = 0
+        self._dump_cfg = None
 
     # ---- host-side prefetch: batch build + dedup + row assign ----
     def _prefetch_iter(
@@ -81,6 +82,16 @@ class Trainer:
         return prefetch_iter(batches, lambda b: (b, prep(b)),
                              capacity=self.prefetch)
 
+    def set_dump(self, cfg) -> None:
+        """Enable per-sample prediction dump for subsequent passes
+        (dump_fields, boxps_worker.cc:1595; pass None to disable)."""
+        self._dump_cfg = cfg
+
+    def dump_param(self, path: str) -> int:
+        """Named dense-parameter dump (DumpParam, boxps_worker.cc:1633)."""
+        from paddlebox_tpu.utils.dump import dump_param
+        return dump_param(self.state.params, path)
+
     def train_pass(self, dataset: Dataset,
                    log_prefix: str = "") -> Dict[str, float]:
         """One pass over the dataset — train_from_dataset analogue."""
@@ -88,12 +99,22 @@ class Trainer:
         timer.start()
         nb = 0
         stats = None
+        dump_writer = None
+        if self._dump_cfg is not None:
+            from paddlebox_tpu.utils.dump import DumpWriter
+            dump_writer = DumpWriter(self._dump_cfg)
         for batch, idx in self._prefetch_iter(dataset.batches()):
             dev = make_device_batch(batch, idx)
             self.global_step += 1
             rng = jax.random.fold_in(self._rng, self.global_step)
             self.state, stats = self.step_fn(self.state, dev, rng)
             nb += 1
+            if dump_writer is not None and nb % self._dump_cfg.interval == 0:
+                dump_writer.add_batch(
+                    batch.ins_ids,
+                    {"pred": stats["pred"], "label": batch.label,
+                     "show": batch.show, "clk": batch.clk},
+                    int((batch.show > 0).sum()))
             # loss fetch forces a device sync — only on guard/log steps
             if FLAGS.check_nan_inf or nb % FLAGS.log_period_steps == 0:
                 loss = float(stats["loss"])
@@ -105,6 +126,8 @@ class Trainer:
                     log.info("%spass step %d loss=%.5f", log_prefix,
                              self.global_step, loss)
         last_loss = float(stats["loss"]) if stats is not None else float("nan")
+        if dump_writer is not None:
+            dump_writer.close()
         timer.pause()
         self.sync_table()
         res = auc_compute(self.state.auc)

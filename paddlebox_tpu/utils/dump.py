@@ -1,0 +1,118 @@
+"""Per-sample prediction dump + parameter dump.
+
+Reference: BoxPSWorker::DumpField/DumpParam (framework/boxps_worker.cc:
+1595-1858) — each worker writes sample-level lines (ins_id + named
+field values, used for offline eval/debug) through a channel to sharded
+files, uploaded to AFS via BoxFileMgr; param dump writes named parameter
+tensors. Trainer wires it via dump_fields/dump_param in TrainerDesc
+(boxps_trainer.cc:112-156 dump env).
+
+TPU-native: the trainer enqueues (ins_ids, device pred, host label) per
+batch on a bounded channel; a background writer thread does the
+device_get and formatting, so the jit stream never blocks on IO. Files
+are local paths (the AFS tier is out of scope; any fsspec-style mount
+works the same way).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddlebox_tpu.utils.channel import Channel, ChannelClosed
+from paddlebox_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class DumpConfig:
+    """dump_fields semantics (trainer_desc dump_fields/dump_interval)."""
+
+    def __init__(self, path: str, fields: Sequence[str] = ("pred", "label"),
+                 interval: int = 1, rank: int = 0) -> None:
+        self.path = path
+        self.fields = list(fields)
+        self.interval = interval
+        self.rank = rank
+
+
+class DumpWriter:
+    """Channel-buffered sharded line writer (DumpField role)."""
+
+    def __init__(self, cfg: DumpConfig) -> None:
+        self.cfg = cfg
+        os.makedirs(os.path.dirname(cfg.path) or ".", exist_ok=True)
+        self._file = open(f"{cfg.path}.part-{cfg.rank:05d}", "w")
+        self._ch: Channel = Channel(capacity=64)
+        self._exc: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        self.lines = 0
+
+    def add_batch(self, ins_ids: Optional[List[str]],
+                  fields: Dict[str, object], num_real: int) -> None:
+        """fields: name → array-like [B] (device arrays fine — fetched on
+        the writer thread)."""
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise exc
+        try:
+            self._ch.put((ins_ids, fields, num_real))
+        except ChannelClosed:
+            # writer thread died and closed the channel; surface its error
+            if self._exc is not None:
+                exc, self._exc = self._exc, None
+                raise exc
+            raise
+
+    def _run(self) -> None:
+        try:
+            while True:
+                item = self._ch.get()
+                if item is None:
+                    break
+                ins_ids, fields, n = item
+                cols = {k: np.asarray(v) for k, v in fields.items()}
+                for i in range(n):
+                    ins = ins_ids[i] if ins_ids else str(self.lines)
+                    vals = "\t".join(
+                        f"{k}:{float(cols[k][i]):.6g}" for k in
+                        self.cfg.fields if k in cols)
+                    self._file.write(f"{ins}\t{vals}\n")
+                    self.lines += 1
+        except BaseException as e:
+            self._exc = e
+            # close the channel so blocked/future producers fail fast
+            # instead of deadlocking on a full channel
+            self._ch.close()
+
+    def close(self) -> int:
+        try:
+            self._ch.put(None)
+        except ChannelClosed:
+            pass
+        self._thread.join()
+        self._file.close()
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise exc
+        log.info("dump: %d lines -> %s", self.lines, self._file.name)
+        return self.lines
+
+
+def dump_param(params, path: str) -> int:
+    """Write named parameter tensors (DumpParam, boxps_worker.cc:1633).
+    Returns the number of tensors written."""
+    import jax
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    out = {}
+    for keypath, leaf in flat:
+        name = "/".join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in keypath)
+        out[name] = np.asarray(jax.device_get(leaf))
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    np.savez_compressed(path, **out)
+    return len(out)

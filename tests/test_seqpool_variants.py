@@ -1,0 +1,245 @@
+"""seqpool variant semantics vs numpy references (reference CUDA kernels:
+fused_seqpool_cvm_{with_diff_thres,tradew,with_credit,with_pcoc}_op.cu)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.ops import (
+    fused_seq_tensor, fused_seqpool_cvm_tradew,
+    fused_seqpool_cvm_with_credit, fused_seqpool_cvm_with_diff_thres,
+    fused_seqpool_cvm_with_pcoc,
+)
+
+
+def make_inputs(k=60, b=4, s=3, e=5, extra=0, seed=0):
+    rng = np.random.default_rng(seed)
+    vals = np.abs(rng.normal(size=(k, 2 + extra + e))).astype(np.float32)
+    segs = np.sort(rng.integers(0, b * s, size=k)).astype(np.int32)
+    return vals, segs, rng
+
+
+def np_pool(vals, segs, n_seg, keep=None):
+    out = np.zeros((n_seg, vals.shape[1]), np.float64)
+    for i, sg in enumerate(segs):
+        if keep is None or keep[i]:
+            out[sg] += vals[i]
+    return out
+
+
+def test_diff_thres_per_slot_threshold():
+    b, s, e = 4, 3, 5
+    vals, segs, rng = make_inputs(b=b, s=s, e=e)
+    thr = np.array([0.3, 5.0, 0.0], np.float32)  # slot1 filters everything
+    sc = np.abs(rng.normal(size=(b, 2))).astype(np.float32)
+    out = fused_seqpool_cvm_with_diff_thres(
+        jnp.asarray(vals), jnp.asarray(segs), jnp.asarray(sc),
+        jnp.asarray(thr), b, s, show_coeff=0.2, clk_coeff=1.0)
+    slot = segs % s
+    score = (vals[:, 0] - vals[:, 1]) * 0.2 + vals[:, 1] * 1.0
+    keep = score >= thr[slot]
+    pooled = np_pool(vals, segs, b * s, keep).reshape(b, s, -1)
+    want_show = np.log1p(pooled[..., 0])
+    np.testing.assert_allclose(np.asarray(out)[..., 0], want_show, rtol=1e-4, atol=1e-6)
+    ctr = np.log1p(pooled[..., 1]) - np.log1p(pooled[..., 0])
+    np.testing.assert_allclose(np.asarray(out)[..., 1], ctr, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out)[..., 2:], pooled[..., 2:],
+                               rtol=1e-4, atol=1e-6)
+    # slot 1 fully filtered → zero pools → log1p(0)=0 head
+    np.testing.assert_allclose(np.asarray(out)[:, 1, :], 0.0, atol=1e-6)
+
+
+def test_tradew_normal_and_trade_id():
+    b, s, e, tn = 3, 2, 4, 2
+    vals, segs, rng = make_inputs(k=40, b=b, s=s, e=e, extra=tn, seed=1)
+    sc = np.abs(rng.normal(size=(b, 2))).astype(np.float32)
+
+    out = fused_seqpool_cvm_tradew(
+        jnp.asarray(vals), jnp.asarray(segs), jnp.asarray(sc), b, s, tn)
+    v_sel = np.concatenate([vals[:, :2], vals[:, 2 + tn:]], 1)
+    pooled = np_pool(v_sel, segs, b * s).reshape(b, s, -1)
+    np.testing.assert_allclose(np.asarray(out)[..., 2:], pooled[..., 2:],
+                               rtol=1e-4, atol=1e-6)
+
+    out_t = fused_seqpool_cvm_tradew(
+        jnp.asarray(vals), jnp.asarray(segs), jnp.asarray(sc), b, s, tn,
+        trade_id=1)
+    v_w = np.concatenate(
+        [vals[:, :2], vals[:, 2 + tn:] * vals[:, 3:4]], 1)
+    pooled_w = np_pool(v_w, segs, b * s).reshape(b, s, -1)
+    np.testing.assert_allclose(np.asarray(out_t)[..., 2:], pooled_w[..., 2:],
+                               rtol=1e-4, atol=1e-6)
+
+    # trade_id backward: cvm cols 0, chosen trade col gets Σ g·embed_in,
+    # embeds scaled by the trade weight (kernel :295-345)
+    g = jax.grad(lambda v: fused_seqpool_cvm_tradew(
+        v, jnp.asarray(segs), jnp.asarray(sc), b, s, tn, trade_id=1
+    ).sum())(jnp.asarray(vals))
+    g = np.asarray(g)
+    np.testing.assert_allclose(g[:, :2], 0.0)
+    np.testing.assert_allclose(g[:, 2], 0.0)  # non-chosen trade col
+    np.testing.assert_allclose(g[:, 3], vals[:, 2 + tn:].sum(1), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(g[:, 2 + tn:],
+                               np.repeat(vals[:, 3:4], e, 1), rtol=1e-4, atol=1e-6)
+
+
+def test_credit_heads():
+    b, s, e = 3, 2, 4
+    vals, segs, rng = make_inputs(k=30, b=b, s=s, e=e, extra=2, seed=2)
+    cvm4 = np.abs(rng.normal(size=(b, 4))).astype(np.float32)
+    pooled = np_pool(vals, segs, b * s).reshape(b, s, -1)
+
+    out = fused_seqpool_cvm_with_credit(
+        jnp.asarray(vals), jnp.asarray(segs), jnp.asarray(cvm4), b, s)
+    np.testing.assert_allclose(np.asarray(out)[..., :4],
+                               np.log1p(pooled[..., :4]), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out)[..., 4:], pooled[..., 4:],
+                               rtol=1e-4, atol=1e-6)
+
+    out_ns = fused_seqpool_cvm_with_credit(
+        jnp.asarray(vals), jnp.asarray(segs), jnp.asarray(cvm4), b, s,
+        show_filter=True)
+    assert out_ns.shape[-1] == out.shape[-1] - 1
+    np.testing.assert_allclose(np.asarray(out_ns)[..., :3],
+                               np.log1p(pooled[..., 1:4]), rtol=1e-4, atol=1e-6)
+
+    # backward: cvm cols carry batch cvm, embeds broadcast
+    g = jax.grad(lambda v: fused_seqpool_cvm_with_credit(
+        v, jnp.asarray(segs), jnp.asarray(cvm4), b, s).sum()
+    )(jnp.asarray(vals))
+    ins = np.minimum(segs // s, b - 1)
+    np.testing.assert_allclose(np.asarray(g)[:, :4], cvm4[ins], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(g)[:, 4:], 1.0)
+
+
+def test_pcoc_head_and_backward():
+    b, s, e, p = 2, 2, 3, 2
+    used = 4 + p
+    vals, segs, rng = make_inputs(k=24, b=b, s=s, e=e, extra=used - 2, seed=3)
+    cvm = np.abs(rng.normal(size=(b, used))).astype(np.float32)
+    q = np.abs(rng.normal(size=(b, p))).astype(np.float32)
+    pooled = np_pool(vals, segs, b * s).reshape(b, s, -1)
+    lg = np.log1p(pooled[..., :used])
+
+    out = np.asarray(fused_seqpool_cvm_with_pcoc(
+        jnp.asarray(vals), jnp.asarray(segs), jnp.asarray(cvm),
+        jnp.asarray(q), b, s))
+    assert out.shape[-1] == 2 + 2 * p + e
+    np.testing.assert_allclose(out[..., 0], lg[..., 0], rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(out[..., 1], lg[..., 1] - lg[..., 0],
+                               rtol=1e-4, atol=1e-6)
+    for i in range(p):
+        np.testing.assert_allclose(out[..., 2 + i],
+                                   lg[..., 4 + i] - lg[..., 2], rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(out[..., 2 + p + i],
+                                   lg[..., 4 + i] - lg[..., 3], rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(out[..., 2 + 2 * p:], pooled[..., used:],
+                               rtol=1e-4, atol=1e-6)
+
+    g = np.asarray(jax.grad(lambda v: fused_seqpool_cvm_with_pcoc(
+        v, jnp.asarray(segs), jnp.asarray(cvm), jnp.asarray(q), b, s).sum()
+    )(jnp.asarray(vals)))
+    ins = np.minimum(segs // s, b - 1)
+    np.testing.assert_allclose(g[:, :4], cvm[ins, :4], rtol=1e-6)
+    np.testing.assert_allclose(g[:, 4:used], q[ins], rtol=1e-6)
+    np.testing.assert_allclose(g[:, used:], 1.0)
+
+
+def test_fused_seq_tensor_shapes_and_din():
+    rng = np.random.default_rng(4)
+    ins, bc, S, L, d = 3, 2, 5, 4, 2
+    adS, adOff = 2, 1
+    sideS, sideOff = 1, 3
+    x = rng.normal(size=(ins, bc * S * L * d)).astype(np.float32)
+    ad = rng.normal(size=(ins, bc * adS * d)).astype(np.float32)
+    din, mask, side, sess = fused_seq_tensor(
+        jnp.asarray(x), jnp.asarray(ad), bc, L, S, d, adS, adOff,
+        sideS, sideOff)
+    assert din.shape == (bc, ins, L, 4 * adS * d)
+    assert mask.shape == (bc, ins, L)
+    assert side.shape == (bc, ins, L, sideS * d)
+    assert sess.shape == (bc, ins, L, adS * d)
+    # check one din element: [in, ad, in-ad, in*ad] layout
+    x5 = x.reshape(ins, bc, S, L, d)
+    ad4 = ad.reshape(ins, bc, adS, d)
+    i, b_, l, sl = 1, 0, 2, 1
+    inv = x5[i, b_, adOff + sl, l]
+    adv = ad4[i, b_, sl]
+    got = np.asarray(din)[b_, i, l].reshape(4, adS, d)
+    np.testing.assert_allclose(got[0, sl], inv, rtol=1e-6)
+    np.testing.assert_allclose(got[1, sl], adv, rtol=1e-6)
+    np.testing.assert_allclose(got[2, sl], inv - adv, rtol=1e-6)
+    np.testing.assert_allclose(got[3, sl], inv * adv, rtol=1e-6)
+    # mask: zero out one position entirely
+    x5z = x5.copy()
+    x5z[:, :, :, 3, :] = 0.0
+    _, mask2, _, _ = fused_seq_tensor(
+        jnp.asarray(x5z.reshape(ins, -1)), jnp.asarray(ad), bc, L, S, d,
+        adS, adOff, sideS, sideOff)
+    np.testing.assert_allclose(np.asarray(mask2)[:, :, 3], 0.0)
+
+
+def test_replica_cache_and_input_table():
+    from paddlebox_tpu.ps import InputTable, ReplicaCache
+    rc = ReplicaCache(emb_dim=4)
+    first = rc.add_items(np.ones((3, 4)))
+    assert first == 0 and rc.size == 3
+    rc.add_items(np.full((2, 4), 2.0))
+    out = np.asarray(rc.pull(jnp.asarray([0, 3, 4])))
+    np.testing.assert_allclose(out[0], 1.0)
+    np.testing.assert_allclose(out[1], 2.0)
+
+    it = InputTable(dim=3)
+    it.add_input("adv_1", [1.0, 2.0, 3.0])
+    it.add_input("adv_2", [4.0, 5.0, 6.0])
+    got = np.asarray(it.lookup(["adv_2", "missing", "adv_1"]))
+    np.testing.assert_allclose(got[0], [4, 5, 6])
+    np.testing.assert_allclose(got[1], 0.0)
+    np.testing.assert_allclose(got[2], [1, 2, 3])
+
+
+def test_extended_embedding_table():
+    from paddlebox_tpu.data.batch import SlotBatch
+    from paddlebox_tpu.ps import ExtendedEmbeddingTable, SparseSGDConfig
+    t = ExtendedEmbeddingTable(mf_dim=4, extend_mf_dim=8, capacity=128,
+                               cfg=SparseSGDConfig(mf_create_thresholds=0.0),
+                               unique_bucket_min=64)
+    keys = np.array([5, 9, 5, 33], np.uint64)
+    batch = SlotBatch(
+        keys=keys, num_keys=4, segments=np.arange(4, dtype=np.int32),
+        dense=np.zeros((2, 1), np.float32), label=np.zeros(2, np.float32),
+        show=np.ones(2, np.float32), clk=np.zeros(2, np.float32),
+        batch_size=2, num_slots=2)
+    idx = t.prepare(batch)
+    v, ve = t.pull(idx)
+    assert v.shape == (4, 3 + 4) and ve.shape == (4, 3 + 8)
+    t.push(idx, jnp.ones((4, 7)) * 0.1, jnp.ones((4, 11)) * 0.1)
+    v2, ve2 = t.pull(idx)
+    assert not np.allclose(np.asarray(v), np.asarray(v2))
+    assert not np.allclose(np.asarray(ve), np.asarray(ve2))
+    assert t.feature_count == 3
+
+
+def test_extended_table_skip_slots():
+    from paddlebox_tpu.data.batch import SlotBatch
+    from paddlebox_tpu.ps import ExtendedEmbeddingTable, SparseSGDConfig
+    t = ExtendedEmbeddingTable(mf_dim=4, extend_mf_dim=4, capacity=128,
+                               cfg=SparseSGDConfig(mf_create_thresholds=0.0),
+                               unique_bucket_min=64, skip_extend_slots=[1])
+    keys = np.array([5, 9, 7, 33], np.uint64)
+    # segments: ins0 slots 0,1; ins1 slots 0,1 → keys 9 and 33 in slot 1
+    batch = SlotBatch(
+        keys=keys, num_keys=4,
+        segments=np.array([0, 1, 2, 3], np.int32),
+        dense=np.zeros((2, 1), np.float32), label=np.zeros(2, np.float32),
+        show=np.ones(2, np.float32), clk=np.zeros(2, np.float32),
+        batch_size=2, num_slots=2)
+    idx_b, idx_e = t.prepare(batch)
+    _, ve = t.pull((idx_b, idx_e))
+    # slot-1 keys pull zero expand values
+    np.testing.assert_allclose(np.asarray(ve)[[1, 3]], 0.0)
+    assert idx_e.key_valid[1] == 0.0 and idx_e.key_valid[3] == 0.0
+    # pushes for skipped keys train nothing in the expand space
+    t.push((idx_b, idx_e), jnp.ones((4, 7)) * 0.1, jnp.ones((4, 7)) * 0.1)
+    assert t.extend.feature_count == 2  # only slot-0 keys allocated
+    assert t.base.feature_count == 4
