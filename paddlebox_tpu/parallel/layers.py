@@ -1,0 +1,111 @@
+"""Tensor- and pipeline-parallel building blocks.
+
+Reference: python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+— ``VocabParallelEmbedding``, ``ColumnParallelLinear``,
+``RowParallelLinear`` (mp_layers.py) and the pipeline engine
+(``PipelineLayer`` + framework/section_worker.cc scope queues between
+program sections).
+
+TPU-native redesign: the layers are plain functions meant to run INSIDE
+``shard_map`` over a model axis — each device holds its weight shard and
+the reference's explicit c_allreduce/c_concat ops become ``psum``/
+``all_gather`` collectives that XLA schedules on ICI. The pipeline is a
+GPipe schedule expressed as one ``lax.fori_loop`` with a ``ppermute``
+ring between stage devices — no section workers, no scope queues, one
+compiled program.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+MODEL_AXIS = "mp"
+PIPE_AXIS = "pp"
+
+
+def vocab_parallel_embedding(ids: jax.Array, weight_shard: jax.Array,
+                             axis: str = MODEL_AXIS) -> jax.Array:
+    """Vocab-sharded embedding lookup (VocabParallelEmbedding).
+
+    weight_shard: [vocab/P, dim] — this device's contiguous vocab range.
+    Out-of-range ids contribute zero locally; a psum assembles the full
+    lookup (replaces the reference's c_allreduce after masked lookup)."""
+    idx = jax.lax.axis_index(axis)
+    per = weight_shard.shape[0]
+    local = ids.astype(jnp.int32) - idx * per
+    ok = (local >= 0) & (local < per)
+    rows = weight_shard[jnp.clip(local, 0, per - 1)]
+    rows = rows * ok[..., None].astype(rows.dtype)
+    return jax.lax.psum(rows, axis)
+
+
+def column_parallel_linear(x: jax.Array, weight_shard: jax.Array,
+                           bias_shard: Optional[jax.Array] = None,
+                           gather_output: bool = True,
+                           axis: str = MODEL_AXIS) -> jax.Array:
+    """Column-split linear (ColumnParallelLinear): weight [in, out/P];
+    each device computes its output columns. gather_output=True
+    all-gathers to the full [.., out] (c_concat), else the result stays
+    column-sharded for a following row-parallel layer."""
+    y = x @ weight_shard
+    if bias_shard is not None:
+        y = y + bias_shard
+    if gather_output:
+        y = jax.lax.all_gather(y, axis, axis=-1, tiled=True)
+    return y
+
+
+def row_parallel_linear(x_shard: jax.Array, weight_shard: jax.Array,
+                        bias: Optional[jax.Array] = None,
+                        axis: str = MODEL_AXIS) -> jax.Array:
+    """Row-split linear (RowParallelLinear): weight [in/P, out]; input
+    arrives column-sharded (from a gather_output=False column layer);
+    partial products reduce with psum (c_allreduce_sum). Bias is full
+    [out], added once after the reduce."""
+    y = jax.lax.psum(x_shard @ weight_shard, axis)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def pipeline_run(stage_fn: Callable, stage_params, x_micros: jax.Array,
+                 axis: str = PIPE_AXIS) -> jax.Array:
+    """GPipe schedule inside shard_map over the pipeline axis.
+
+    stage_fn(params, act) -> act: one stage's compute (shape-preserving
+    across stages). stage_params: this device's stage weights.
+    x_micros: [M, mb, d] microbatched input (meaningful on stage 0).
+    Returns [M, mb, d] — the last stage's outputs (zeros elsewhere; a
+    caller using out_specs=P(axis) takes shard [-1], or psum-collects).
+
+    Tick t: stage i computes microbatch m = t − i (when 0 ≤ m < M), then
+    activations ppermute one hop down the ring — the scope-queue handoff
+    of section_worker.cc as a single traced collective."""
+    s = jax.lax.psum(1, axis)
+    i = jax.lax.axis_index(axis)
+    m_count = x_micros.shape[0]
+    ticks = m_count + s - 1
+
+    def tick(t, carry):
+        act, out = carry
+        inp = jnp.where(i == 0, x_micros[jnp.clip(t, 0, m_count - 1)], act)
+        y = stage_fn(stage_params, inp)
+        m = t - (s - 1)
+        valid = (i == s - 1) & (m >= 0) & (m < m_count)
+        out = jnp.where(valid,
+                        out.at[jnp.clip(m, 0, m_count - 1)].set(y), out)
+        perm = [(j, (j + 1) % s) for j in range(s)]
+        act = jax.lax.ppermute(y, axis, perm)
+        return act, out
+
+    # the loop body makes the carry vary over the pipe axis (ppermute /
+    # per-stage writes); mark the zero-init carry as varying to match
+    pvary = getattr(jax.lax, "pvary", lambda x, names: x)
+    act0 = pvary(jnp.zeros_like(x_micros[0]), (axis,))
+    out0 = pvary(jnp.zeros_like(x_micros), (axis,))
+    _, out = jax.lax.fori_loop(0, ticks, tick, (act0, out0))
+    # only the last stage holds real outputs; mask so callers can psum
+    return out * (i == s - 1).astype(out.dtype)

@@ -1,0 +1,81 @@
+"""Profiling tiers: stage timers, per-pass sync report, XPlane tracing.
+
+Reference (SURVEY.md §5.1): (a) per-stage ``platform::Timer`` aggregation
+printed after each pass (``PrintSyncTimer``, fleet/box_wrapper.cc:1182;
+``DeviceBoxData`` timers box_wrapper.h:394-403); (b) worker profile mode
+timing every op by name (``TrainFilesWithProfiler``,
+boxps_worker.cc:1358-1387); (c) the full chrome-trace profiler
+(platform/profiler/ + chrometracing_logger.cc).
+
+TPU-native mapping: (a) → ``StageTimers`` (named pause/resume timers +
+one-line pass report); (b) → per-step timing happens at jit-step
+granularity (XLA fuses the "ops"; finer slicing comes from tier c);
+(c) → ``trace()``: jax.profiler XPlane/TensorBoard traces, which include
+per-HLO device timing — the chrome-trace equivalent."""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Iterator, Optional
+
+from paddlebox_tpu.config import FLAGS
+from paddlebox_tpu.utils.logging import get_logger
+from paddlebox_tpu.utils.timer import Timer
+
+log = get_logger(__name__)
+
+
+class StageTimers:
+    """Named stage timers with a PrintSyncTimer-style report."""
+
+    def __init__(self) -> None:
+        self._timers: Dict[str, Timer] = {}
+
+    def __getitem__(self, name: str) -> Timer:
+        t = self._timers.get(name)
+        if t is None:
+            t = self._timers[name] = Timer()
+        return t
+
+    @contextlib.contextmanager
+    def stage(self, name: str) -> Iterator[Timer]:
+        t = self[name]
+        t.resume()
+        try:
+            yield t
+        finally:
+            t.pause()
+
+    def report(self, prefix: str = "") -> str:
+        """One line per pass: 'stage=1.23s(xN)' (box_wrapper.cc:1182)."""
+        parts = [
+            f"{k}={t.elapsed_sec():.3f}s(x{t.count()})"
+            for k, t in sorted(self._timers.items())
+        ]
+        line = f"{prefix}timers: " + " ".join(parts) if parts else "timers: -"
+        log.info("%s", line)
+        return line
+
+    def reset(self) -> None:
+        for t in self._timers.values():
+            t.reset()
+
+    def as_dict(self) -> Dict[str, float]:
+        return {k: t.elapsed_sec() for k, t in self._timers.items()}
+
+
+@contextlib.contextmanager
+def trace(logdir: Optional[str] = None) -> Iterator[None]:
+    """XPlane/TensorBoard trace window (tier c). No-op unless
+    FLAGS.profile or an explicit logdir is given."""
+    import jax
+    target = logdir or ("/tmp/paddlebox_tpu_trace" if FLAGS.profile else None)
+    if target is None:
+        yield
+        return
+    jax.profiler.start_trace(target)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        log.info("profiler trace written to %s", target)
