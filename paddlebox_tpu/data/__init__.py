@@ -7,10 +7,14 @@ from paddlebox_tpu.data.parser import (
 from paddlebox_tpu.data.dataset import (
     DatasetFactory, InMemoryDataset, QueueDataset, PaddleBoxDataset,
 )
+from paddlebox_tpu.data.pv import (
+    PvBatchBuilder, build_rank_offset, group_by_search_id, group_by_uid,
+)
 
 __all__ = [
     "SlotDef", "DataFeedDesc", "SlotRecord", "SlotRecordPool", "SlotBatch",
     "BatchBuilder", "SlotTextParser", "CriteoParser", "register_parser",
     "get_parser", "DatasetFactory", "InMemoryDataset", "QueueDataset",
-    "PaddleBoxDataset",
+    "PaddleBoxDataset", "PvBatchBuilder", "build_rank_offset",
+    "group_by_search_id", "group_by_uid",
 ]

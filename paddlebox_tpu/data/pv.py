@@ -1,0 +1,122 @@
+"""Page-view (PV) merge batching + rank_offset construction.
+
+Reference: PadBoxSlotDataset::PreprocessInstance (data_set.cc:2825) sorts
+records by search_id and merges consecutive equal-sid records into one
+PvInstance; PaddleBoxDataFeed::GetRankOffset (data_feed.cc:1855) /
+CopyRankOffsetKernel (data_feed.cu:1319) then build the ``rank_offset``
+int matrix [ins_num, 2*max_rank+1] consumed by the rank_attention op:
+
+- col 0: the ad's own 1-based rank, valid only when cmatch ∈ {222, 223}
+  and 0 < rank <= max_rank; else -1.
+- for every co-shown ad k in the same PV with valid rank r, cols
+  (2*(r-1)+1, 2*(r-1)+2) hold (r, global-row-index-of-k). Rows whose own
+  rank is invalid keep -1 everywhere past col 0.
+
+TPU-native: the matrix is built host-side in numpy (it is pure data prep,
+shape [B, 7] for max_rank=3) and padded to the static batch size so the
+jit step never sees ragged shapes; padding rows are all -1 which
+rank_attention treats as "contribute nothing".
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.data.batch import BatchBuilder, SlotBatch
+from paddlebox_tpu.data.record import SlotRecord
+from paddlebox_tpu.data.schema import DataFeedDesc
+
+VALID_CMATCH = (222, 223)
+
+
+def group_by_search_id(records: Sequence[SlotRecord]) -> List[List[SlotRecord]]:
+    """Stable sort by search_id, then merge consecutive equal sids into one
+    PV (mirrors PreprocessInstance's merge_by_sid path)."""
+    order = sorted(range(len(records)), key=lambda i: records[i].search_id)
+    pvs: List[List[SlotRecord]] = []
+    last_sid = None
+    for i in order:
+        r = records[i]
+        if last_sid is None or r.search_id != last_sid:
+            pvs.append([r])
+            last_sid = r.search_id
+        else:
+            pvs[-1].append(r)
+    return pvs
+
+
+def group_by_uid(records: Sequence[SlotRecord]) -> List[List[SlotRecord]]:
+    """Group records by uid (merge_by_uid path: user timeline grouping)."""
+    buckets: Dict[int, List[SlotRecord]] = {}
+    for r in records:
+        buckets.setdefault(r.uid, []).append(r)
+    return list(buckets.values())
+
+
+def _valid_rank(rank: int, cmatch: int, max_rank: int) -> int:
+    if cmatch in VALID_CMATCH and 0 < rank <= max_rank:
+        return rank
+    return -1
+
+
+def build_rank_offset(pvs: Sequence[Sequence[SlotRecord]],
+                      max_rank: int = 3,
+                      pad_to: int = 0) -> np.ndarray:
+    """int32 [max(ins_num, pad_to), 2*max_rank+1], padding rows all -1."""
+    ins_num = sum(len(pv) for pv in pvs)
+    rows = max(ins_num, pad_to)
+    cols = 2 * max_rank + 1
+    mat = np.full((rows, cols), -1, dtype=np.int32)
+
+    base = 0
+    for pv in pvs:
+        vr = np.array([_valid_rank(r.rank, r.cmatch, max_rank) for r in pv],
+                      dtype=np.int32)
+        mat[base:base + len(pv), 0] = vr
+        valid_k = np.nonzero(vr > 0)[0]
+        for j in range(len(pv)):
+            if vr[j] <= 0:
+                continue
+            for k in valid_k:
+                m = vr[k] - 1
+                mat[base + j, 2 * m + 1] = vr[k]
+                mat[base + j, 2 * m + 2] = base + k
+        base += len(pv)
+    return mat
+
+
+class PvBatchBuilder:
+    """PV-merged minibatches: ``pv_batch_size`` PVs per batch, flattened ads
+    padded to ``desc.batch_size`` rows, plus the rank_offset matrix.
+
+    Reference flow: PaddleBoxDataFeed::PutToFeedVec(pv_vec)
+    (data_feed.cc:1915) = GetRankOffset + flatten ads into the normal
+    instance batch path.
+    """
+
+    def __init__(self, desc: DataFeedDesc, max_rank: int = 3) -> None:
+        if desc.pv_batch_size <= 0:
+            raise ValueError("desc.pv_batch_size must be > 0 for PV batching")
+        self.desc = desc
+        self.max_rank = max_rank
+        self._builder = BatchBuilder(desc)
+
+    def batches(self, records: Sequence[SlotRecord]
+                ) -> List[Tuple[SlotBatch, np.ndarray]]:
+        pvs = group_by_search_id(records)
+        out: List[Tuple[SlotBatch, np.ndarray]] = []
+        pvb = self.desc.pv_batch_size
+        for i in range(0, len(pvs), pvb):
+            chunk = pvs[i:i + pvb]
+            flat = [r for pv in chunk for r in pv]
+            if len(flat) > self.desc.batch_size:
+                raise ValueError(
+                    f"PV chunk flattens to {len(flat)} ads > batch_size "
+                    f"{self.desc.batch_size}; lower pv_batch_size")
+            batch = self._builder.build(flat)
+            ro = build_rank_offset(chunk, self.max_rank,
+                                   pad_to=self.desc.batch_size)
+            out.append((batch, ro))
+        return out

@@ -1,0 +1,11 @@
+from paddlebox_tpu.distributed.elastic import (
+    ElasticLevel, ElasticManager, FileKVStore, KVStore,
+)
+from paddlebox_tpu.distributed.launch import (
+    LaunchConfig, init_runtime_env, launch_local, main,
+)
+
+__all__ = [
+    "ElasticLevel", "ElasticManager", "FileKVStore", "KVStore",
+    "LaunchConfig", "init_runtime_env", "launch_local", "main",
+]

@@ -1,0 +1,207 @@
+"""Elastic membership / fault detection.
+
+Reference: python/paddle/distributed/fleet/elastic/manager.py:131
+(ElasticManager) — etcd-backed: each node registers under a job prefix
+with a TTL lease refreshed by a heartbeat thread; a watch on the node
+prefix fires scale events; np (node count) may float in [min_np, max_np]
+(ELASTIC level) or must stay fixed (FAULT_TOLERANCE level, restart only).
+
+TPU-native redesign: no etcd dependency — membership rides a pluggable
+``KVStore``. The default ``FileKVStore`` uses a shared directory (works
+for multi-process single host and for multi-host over NFS/GCS-fuse; the
+JAX distributed coordinator handles the device runtime itself, this layer
+only decides *when to restart and with how many hosts*). Leases are
+mtime-based: a key is alive while its last heartbeat is younger than the
+TTL.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from paddlebox_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class ElasticLevel:
+    FAULT_TOLERANCE = 1  # fixed np; dead node ⇒ wait for it to come back
+    ELASTIC = 2          # np floats in [min_np, max_np]
+
+
+class KVStore:
+    """Minimal KV interface the manager needs (etcd analogue)."""
+
+    def put(self, key: str, value: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+    def list_prefix(self, prefix: str) -> Dict[str, bytes]:
+        raise NotImplementedError
+
+    def mtime(self, key: str) -> float:
+        raise NotImplementedError
+
+
+class FileKVStore(KVStore):
+    """Shared-directory KV store; key = relative path, one file per key."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        safe = key.strip("/").replace("/", "__")
+        return os.path.join(self.root, safe)
+
+    def put(self, key: str, value: bytes) -> None:
+        path = self._path(key)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(value)
+        os.replace(tmp, path)  # atomic publish
+
+    def get(self, key: str) -> Optional[bytes]:
+        try:
+            with open(self._path(key), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def delete(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    def list_prefix(self, prefix: str) -> Dict[str, bytes]:
+        pfx = prefix.strip("/").replace("/", "__")
+        out: Dict[str, bytes] = {}
+        for name in os.listdir(self.root):
+            if name.startswith(pfx) and not name.endswith(".tmp"):
+                try:
+                    with open(os.path.join(self.root, name), "rb") as f:
+                        out[name.replace("__", "/")] = f.read()
+                except FileNotFoundError:
+                    continue
+        return out
+
+    def mtime(self, key: str) -> float:
+        try:
+            return os.stat(self._path(key)).st_mtime
+        except FileNotFoundError:
+            return 0.0
+
+
+class ElasticManager:
+    """Per-node membership agent.
+
+    Usage: ``register()`` once, keep the heartbeat alive; the launcher
+    polls ``scale_event()`` and, on a change, stops workers, waits for
+    ``wait_for_np()``, and restarts them from the latest checkpoint.
+    """
+
+    def __init__(self, store: KVStore, job_id: str, host: str,
+                 np: int, min_np: int = 0, max_np: int = 0,
+                 ttl: float = 10.0, heartbeat_period: Optional[float] = None
+                 ) -> None:
+        self.store = store
+        self.prefix = f"paddlebox/{job_id}"
+        self.node_prefix = f"{self.prefix}/nodes"
+        self.host = host
+        self.np = np
+        self.min_np = min_np or np
+        self.max_np = max_np or np
+        self.ttl = ttl
+        self.heartbeat_period = heartbeat_period or ttl / 3.0
+        self.level = (ElasticLevel.ELASTIC if self.max_np > self.min_np
+                      else ElasticLevel.FAULT_TOLERANCE)
+        self._hb_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._key = f"{self.node_prefix}/{host}"
+        self._last_hosts: Optional[List[str]] = None
+
+    # -- membership ---------------------------------------------------------
+
+    def register(self, payload: Optional[dict] = None) -> None:
+        body = dict(payload or {})
+        body["host"] = self.host
+        self.store.put(self._key, json.dumps(body).encode())
+        self._stop.clear()
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True)
+        self._hb_thread.start()
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_period):
+            raw = self.store.get(self._key) or b"{}"
+            self.store.put(self._key, raw)  # refresh lease mtime
+
+    def deregister(self) -> None:
+        self._stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2 * self.heartbeat_period)
+            self._hb_thread = None
+        self.store.delete(self._key)
+
+    def alive_hosts(self) -> List[str]:
+        now = time.time()
+        hosts = []
+        for key in self.store.list_prefix(self.node_prefix):
+            if now - self.store.mtime(key) <= self.ttl:
+                hosts.append(key.rsplit("/", 1)[-1])
+        return sorted(hosts)
+
+    # -- events -------------------------------------------------------------
+
+    def scale_event(self) -> Optional[List[str]]:
+        """Returns the new alive-host list when membership changed since the
+        last call (the etcd watch-callback analogue), else None."""
+        hosts = self.alive_hosts()
+        if self._last_hosts is None:
+            self._last_hosts = hosts
+            return None
+        if hosts != self._last_hosts:
+            log.info("scale event: %s -> %s", self._last_hosts, hosts)
+            self._last_hosts = hosts
+            return hosts
+        return None
+
+    def world_ok(self) -> bool:
+        n = len(self.alive_hosts())
+        if self.level == ElasticLevel.FAULT_TOLERANCE:
+            return n == self.np
+        return self.min_np <= n <= self.max_np
+
+    def wait_for_np(self, timeout: float = 60.0) -> List[str]:
+        """Block until the alive set satisfies the level constraints
+        (= the rendezvous barrier before a restart)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.world_ok():
+                hosts = self.alive_hosts()
+                self._last_hosts = hosts
+                return hosts
+            time.sleep(self.heartbeat_period)
+        raise TimeoutError(
+            f"elastic rendezvous: alive={self.alive_hosts()} does not "
+            f"satisfy np∈[{self.min_np},{self.max_np}] within {timeout}s")
+
+    # -- checkpoint pointer (restart resume source) -------------------------
+
+    def publish_checkpoint(self, path: str, pass_id: int) -> None:
+        self.store.put(f"{self.prefix}/ckpt",
+                       json.dumps({"path": path, "pass_id": pass_id}).encode())
+
+    def latest_checkpoint(self) -> Optional[dict]:
+        raw = self.store.get(f"{self.prefix}/ckpt")
+        return json.loads(raw) if raw else None

@@ -1,3 +1,16 @@
 from paddlebox_tpu.parallel.mesh import make_mesh, data_axis_size
+from paddlebox_tpu.parallel.layers import (
+    vocab_parallel_embedding, column_parallel_linear, row_parallel_linear,
+    pipeline_run,
+)
+from paddlebox_tpu.parallel.moe import (
+    moe_forward_local, moe_forward_sharded, naive_gating, top1_gating,
+    top2_gating,
+)
 
-__all__ = ["make_mesh", "data_axis_size"]
+__all__ = [
+    "make_mesh", "data_axis_size", "vocab_parallel_embedding",
+    "column_parallel_linear", "row_parallel_linear", "pipeline_run",
+    "moe_forward_local", "moe_forward_sharded", "naive_gating",
+    "top1_gating", "top2_gating",
+]

@@ -1,4 +1,6 @@
 from paddlebox_tpu.train.step import TrainStep, DeviceBatch, make_device_batch
 from paddlebox_tpu.train.trainer import Trainer
+from paddlebox_tpu.train.dense_modes import AsyncDenseTable, KStepParamSync
 
-__all__ = ["TrainStep", "DeviceBatch", "make_device_batch", "Trainer"]
+__all__ = ["TrainStep", "DeviceBatch", "make_device_batch", "Trainer",
+           "AsyncDenseTable", "KStepParamSync"]

@@ -1,0 +1,215 @@
+"""Dense-parameter handling modes beyond per-step in-jit sync.
+
+Reference (boxps_worker.cc):
+
+- **sync mode** ``SyncParam`` (:1191): workers train on local replicas and
+  every K steps allreduce the flattened param buffer, scaling by
+  1/(ndev*nnode) — i.e. periodic parameter *averaging*, not per-step grad
+  allreduce.
+- **async mode** ``BoxPSAsynDenseTable`` (:61-370): a host-side flattened
+  param table with Adam state; worker threads PullDense (copy latest
+  params) and PushDense (enqueue grads) through a buffer queue while a
+  background thread drains the queue and applies Adam on CPU. DataNorm
+  "summary" params (batch_size/batch_sum/batch_square_sum) are
+  accumulated directly instead of Adam-updated (:93-98).
+
+TPU-native redesign: the per-step psum inside the jit step
+(train/sharded.py) is the default; these modes exist for parity and for
+host-offloaded experimentation. K-step averaging runs as one tiny jitted
+pmean over the mesh (or a stacked-axis mean in the single-process
+emulation); the async table is numpy + a Channel, with pull/push crossing
+host↔device only at pass boundaries the caller chooses.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.utils.channel import Channel
+from paddlebox_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# K-step periodic parameter averaging (SyncParam analogue)
+# ---------------------------------------------------------------------------
+
+class KStepParamSync:
+    """Average param replicas every ``k`` steps.
+
+    Replicas are a pytree whose leaves carry a leading replica axis
+    (the single-process stand-in for one param copy per device/host; under
+    a mesh the same pytree is sharded over ``axis`` and the mean lowers to
+    one psum over ICI).
+    """
+
+    def __init__(self, k: int, mesh: Optional[Any] = None,
+                 axis: str = "dp") -> None:
+        if k <= 0:
+            raise ValueError("k must be positive")
+        self.k = k
+        self._step = 0
+
+        if mesh is None:
+            def _avg(params):
+                return jax.tree.map(
+                    lambda x: jnp.broadcast_to(
+                        jnp.mean(x, axis=0, keepdims=True), x.shape),
+                    params)
+            self._avg = jax.jit(_avg)
+        else:
+            from jax.sharding import PartitionSpec as P
+
+            try:
+                from jax import shard_map as _shard_map
+                shard_map = _shard_map
+            except ImportError:  # older jax
+                from jax.experimental.shard_map import shard_map
+
+            def _avg(params):
+                def body(p):
+                    return jax.tree.map(
+                        lambda x: jax.lax.pmean(x, axis), p)
+                spec = jax.tree.map(lambda _: P(axis), params)
+                return shard_map(body, mesh=mesh, in_specs=(spec,),
+                                 out_specs=spec)(params)
+            self._avg = jax.jit(_avg)
+
+    def maybe_sync(self, params: Any) -> Tuple[Any, bool]:
+        """Call once per train step; returns (params, did_sync)."""
+        self._step += 1
+        if self._step % self.k != 0:
+            return params, False
+        return self._avg(params), True
+
+
+# ---------------------------------------------------------------------------
+# Async host-side dense table (BoxPSAsynDenseTable analogue)
+# ---------------------------------------------------------------------------
+
+class _HostAdam:
+    def __init__(self, n: int, lr: float, beta1: float, beta2: float,
+                 eps: float) -> None:
+        self.m = np.zeros(n, np.float32)
+        self.v = np.zeros(n, np.float32)
+        self.t = 0
+        self.lr, self.b1, self.b2, self.eps = lr, beta1, beta2, eps
+
+    def update(self, p: np.ndarray, g: np.ndarray) -> None:
+        self.t += 1
+        self.m = self.b1 * self.m + (1 - self.b1) * g
+        self.v = self.b2 * self.v + (1 - self.b2) * g * g
+        mhat = self.m / (1 - self.b1 ** self.t)
+        vhat = self.v / (1 - self.b2 ** self.t)
+        p -= self.lr * mhat / (np.sqrt(vhat) + self.eps)
+
+
+class AsyncDenseTable:
+    """Host-resident dense params updated by a background Adam thread.
+
+    ``pull()`` returns the latest params as a pytree (device transfer is
+    the caller's jnp.asarray); ``push(grads)`` enqueues a gradient pytree
+    and returns immediately. Leaves whose path matches ``is_summary``
+    (DataNorm batch_size/batch_sum/batch_square_sum) are accumulated
+    (ps += grad) instead of Adam-updated, mirroring boxps_worker.cc:93-98.
+    """
+
+    def __init__(self, params: Any, lr: float = 1e-3, beta1: float = 0.9,
+                 beta2: float = 0.999, eps: float = 1e-8,
+                 queue_capacity: int = 64,
+                 is_summary: Optional[Callable[[str], bool]] = None) -> None:
+        from jax.flatten_util import ravel_pytree
+
+        host = jax.tree.map(lambda x: np.asarray(x, np.float32), params)
+        flat, self._unravel = ravel_pytree(host)
+        self._ps = np.array(flat, np.float32)
+
+        # summary mask over the flat vector
+        leaves_with_path = jax.tree_util.tree_leaves_with_path(host)
+        mask = np.zeros(self._ps.size, bool)
+        off = 0
+        pred = is_summary or (lambda name: "summary" in name.lower())
+        for path, leaf in leaves_with_path:
+            n = int(np.size(leaf))
+            if pred(jax.tree_util.keystr(path)):
+                mask[off:off + n] = True
+            off += n
+        self._summary_mask = mask
+
+        self._adam = _HostAdam(self._ps.size, lr, beta1, beta2, eps)
+        self._q: Channel = Channel(capacity=queue_capacity)
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._applied = 0
+        self._pushed = 0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._q.close()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._q.get_batch(max_items=1)
+            if not batch:  # channel closed and drained
+                return
+            g = batch[0]
+            with self._lock:
+                s = self._summary_mask
+                if s.any():
+                    self._ps[s] += g[s]
+                    self._adam_masked(~s, g)
+                else:
+                    self._adam.update(self._ps, g)
+                self._applied += 1
+
+    def _adam_masked(self, sel: np.ndarray, g: np.ndarray) -> None:
+        a = self._adam
+        a.t += 1
+        a.m[sel] = a.b1 * a.m[sel] + (1 - a.b1) * g[sel]
+        a.v[sel] = a.b2 * a.v[sel] + (1 - a.b2) * g[sel] ** 2
+        mhat = a.m[sel] / (1 - a.b1 ** a.t)
+        vhat = a.v[sel] / (1 - a.b2 ** a.t)
+        self._ps[sel] -= a.lr * mhat / (np.sqrt(vhat) + a.eps)
+
+    # -- worker API ---------------------------------------------------------
+
+    def pull(self) -> Any:
+        with self._lock:
+            snap = self._ps.copy()
+        return self._unravel(snap)
+
+    def push(self, grads: Any) -> None:
+        from jax.flatten_util import ravel_pytree
+
+        host = jax.tree.map(lambda x: np.asarray(x, np.float32), grads)
+        flat, _ = ravel_pytree(host)
+        with self._lock:
+            self._pushed += 1
+        self._q.put(np.asarray(flat, np.float32))
+
+    def drain(self) -> int:
+        """Block until every pushed grad has been applied (pass barrier);
+        returns how many updates have been applied in total. Compares
+        applied vs pushed counters — queue emptiness alone would race with
+        the in-flight grad the worker has popped but not yet applied."""
+        import time
+
+        while True:
+            with self._lock:
+                if self._applied >= self._pushed:
+                    return self._applied
+            time.sleep(0.001)

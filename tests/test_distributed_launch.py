@@ -1,0 +1,124 @@
+"""Launcher + elastic manager tests (reference: fleet/elastic/manager.py,
+distributed/launch.py — here exercised multi-process on localhost, the
+same strategy the reference uses for its distributed tests, SURVEY §4)."""
+
+import os
+import sys
+import time
+
+import pytest
+
+from paddlebox_tpu.distributed import (ElasticLevel, ElasticManager,
+                                       FileKVStore, LaunchConfig,
+                                       launch_local)
+
+
+def test_file_kv_store_roundtrip(tmp_path):
+    kv = FileKVStore(str(tmp_path))
+    kv.put("a/b", b"1")
+    kv.put("a/c", b"2")
+    assert kv.get("a/b") == b"1"
+    assert kv.get("missing") is None
+    assert set(kv.list_prefix("a").values()) == {b"1", b"2"}
+    kv.delete("a/b")
+    assert kv.get("a/b") is None
+    assert kv.mtime("a/c") > 0
+
+
+def test_elastic_membership_and_scale_down(tmp_path):
+    kv = FileKVStore(str(tmp_path))
+    m1 = ElasticManager(kv, "job", "hostA", np=2, ttl=0.5,
+                        heartbeat_period=0.1)
+    m2 = ElasticManager(kv, "job", "hostB", np=2, ttl=0.5,
+                        heartbeat_period=0.1)
+    m1.register()
+    m2.register()
+    assert m1.wait_for_np(timeout=5.0) == ["hostA", "hostB"]
+    assert m1.world_ok()
+    assert m1.scale_event() is None  # no change yet
+
+    # hostB dies: heartbeat stops, lease expires
+    m2.deregister()
+    time.sleep(0.7)
+    ev = m1.scale_event()
+    assert ev == ["hostA"]
+    assert not m1.world_ok()  # FAULT_TOLERANCE needs np==2
+    m1.deregister()
+
+
+def test_elastic_level_window(tmp_path):
+    kv = FileKVStore(str(tmp_path))
+    m = ElasticManager(kv, "job2", "h0", np=4, min_np=2, max_np=4,
+                       ttl=0.5, heartbeat_period=0.1)
+    assert m.level == ElasticLevel.ELASTIC
+    m.register()
+    # only one host alive: below min_np
+    assert not m.world_ok()
+    with pytest.raises(TimeoutError):
+        m.wait_for_np(timeout=0.4)
+    # second host joins: inside [2,4] window
+    m2 = ElasticManager(kv, "job2", "h1", np=4, min_np=2, max_np=4,
+                        ttl=0.5, heartbeat_period=0.1)
+    m2.register()
+    assert m.wait_for_np(timeout=5.0) == ["h0", "h1"]
+    m.deregister()
+    m2.deregister()
+
+
+def test_checkpoint_pointer(tmp_path):
+    kv = FileKVStore(str(tmp_path))
+    m = ElasticManager(kv, "job3", "h0", np=1)
+    assert m.latest_checkpoint() is None
+    m.publish_checkpoint("/models/delta_7", pass_id=7)
+    ckpt = m.latest_checkpoint()
+    assert ckpt == {"path": "/models/delta_7", "pass_id": 7}
+
+
+def test_launch_local_ranks(tmp_path):
+    out = tmp_path / "ranks"
+    out.mkdir()
+    code = (
+        "import os, pathlib; "
+        "pathlib.Path(os.environ['OUT'], os.environ['PBOX_RANK'])"
+        ".write_text(os.environ['PBOX_WORLD_SIZE'])"
+    )
+    os.environ["OUT"] = str(out)
+    try:
+        rc = launch_local([sys.executable, "-c", code],
+                          LaunchConfig(nproc=3))
+    finally:
+        del os.environ["OUT"]
+    assert rc == 0
+    got = sorted(os.listdir(out))
+    assert got == ["0", "1", "2"]
+    assert (out / "0").read_text() == "3"
+
+
+def test_launch_elastic_restart_resumes_from_checkpoint(tmp_path):
+    """First gang run fails; launcher restarts it with the published
+    checkpoint path in PBOX_RESUME_CKPT; second run succeeds."""
+    kvroot = tmp_path / "kv"
+    marker = tmp_path / "attempts"
+    marker.mkdir()
+    kv = FileKVStore(str(kvroot))
+    boot = ElasticManager(kv, "jobL", "seed", np=1)
+    boot.publish_checkpoint(str(tmp_path / "ckpt_pass3"), pass_id=3)
+
+    code = (
+        "import os, pathlib, sys\n"
+        "d = pathlib.Path(os.environ['MARK'])\n"
+        "n = len(list(d.iterdir()))\n"
+        "(d / str(n)).write_text(os.environ.get('PBOX_RESUME_CKPT', ''))\n"
+        "sys.exit(1 if n == 0 else 0)\n"
+    )
+    os.environ["MARK"] = str(marker)
+    try:
+        rc = launch_local(
+            [sys.executable, "-c", code],
+            LaunchConfig(nproc=1, job_id="jobL",
+                         elastic_root=str(kvroot), max_restarts=2))
+    finally:
+        del os.environ["MARK"]
+    assert rc == 0
+    # two attempts, both saw the checkpoint pointer
+    assert (marker / "1").read_text().endswith("ckpt_pass3")

@@ -1,0 +1,118 @@
+"""PV merge + rank_offset tests (reference: data_feed.cc:1855 GetRankOffset,
+data_set.cc:2825 PreprocessInstance)."""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.data import DataFeedDesc, SlotDef
+from paddlebox_tpu.data.pv import (PvBatchBuilder, build_rank_offset,
+                                   group_by_search_id, group_by_uid)
+from paddlebox_tpu.data.record import SlotRecord
+
+
+def rec(sid, rank, cmatch, uid=0, nslots=2):
+    # one key per sparse slot
+    return SlotRecord(
+        keys=np.arange(nslots, dtype=np.uint64),
+        slot_offsets=np.arange(nslots + 1, dtype=np.int32),
+        dense=np.zeros(0, np.float32), label=1.0, show=1.0, clk=0.0,
+        search_id=sid, rank=rank, cmatch=cmatch, uid=uid)
+
+
+def reference_rank_offset(pvs, max_rank=3):
+    """Direct transliteration of the reference CPU loop semantics."""
+    ins_num = sum(len(p) for p in pvs)
+    col = 2 * max_rank + 1
+    mat = np.full((ins_num, col), -1, dtype=np.int32)
+    index = 0
+    for pv in pvs:
+        start = index
+        for j, ins in enumerate(pv):
+            rank = -1
+            if ins.cmatch in (222, 223) and 0 < ins.rank <= max_rank:
+                rank = ins.rank
+            mat[index, 0] = rank
+            if rank > 0:
+                for k, cur in enumerate(pv):
+                    fr = -1
+                    if cur.cmatch in (222, 223) and 0 < cur.rank <= max_rank:
+                        fr = cur.rank
+                    if fr > 0:
+                        m = fr - 1
+                        mat[index, 2 * m + 1] = cur.rank
+                        mat[index, 2 * m + 2] = start + k
+            index += 1
+    return mat
+
+
+def test_group_by_search_id_merges_consecutive():
+    rs = [rec(7, 1, 222), rec(3, 2, 222), rec(7, 3, 223), rec(3, 1, 0)]
+    pvs = group_by_search_id(rs)
+    assert [len(p) for p in pvs] == [2, 2]
+    assert {p[0].search_id for p in pvs} == {3, 7}
+
+
+def test_group_by_uid():
+    rs = [rec(1, 1, 222, uid=5), rec(2, 1, 222, uid=6), rec(3, 1, 222, uid=5)]
+    groups = group_by_uid(rs)
+    sizes = sorted(len(g) for g in groups)
+    assert sizes == [1, 2]
+
+
+def test_rank_offset_matches_reference_semantics():
+    rng = np.random.default_rng(0)
+    pvs = []
+    for sid in range(6):
+        n = int(rng.integers(1, 5))
+        pvs.append([
+            rec(sid, int(rng.integers(0, 5)),
+                int(rng.choice([0, 111, 222, 223])))
+            for _ in range(n)
+        ])
+    got = build_rank_offset(pvs)
+    want = reference_rank_offset(pvs)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_rank_offset_pads_with_minus_one():
+    pvs = [[rec(1, 1, 222), rec(1, 2, 222)]]
+    mat = build_rank_offset(pvs, max_rank=3, pad_to=5)
+    assert mat.shape == (5, 7)
+    assert (mat[2:] == -1).all()
+    # row 0: own rank 1; co-shown ranks 1,2 at cols (1,2) and (3,4)
+    assert mat[0, 0] == 1 and mat[0, 1] == 1 and mat[0, 2] == 0
+    assert mat[0, 3] == 2 and mat[0, 4] == 1
+
+
+def test_pv_batch_builder_feeds_rank_attention():
+    import jax.numpy as jnp
+
+    from paddlebox_tpu.ops import rank_attention
+
+    S, B = 2, 8
+    slots = [SlotDef("label", "float", 1)]
+    slots += [SlotDef(f"C{i}", "uint64") for i in range(S)]
+    desc = DataFeedDesc(slots=slots, batch_size=B, label_slot="label",
+                        pv_batch_size=2, key_bucket_min=32)
+    rs = [rec(sid, r + 1, 222, nslots=S) for sid in range(4) for r in range(2)]
+    pairs = PvBatchBuilder(desc, max_rank=3).batches(rs)
+    assert len(pairs) == 2
+    batch, ro = pairs[0]
+    assert ro.shape == (B, 7)
+    x = jnp.ones((B, 4))
+    param = jnp.ones((3 * 3 * 4, 5))
+    out = rank_attention(x, jnp.asarray(ro), param, max_rank=3)
+    assert out.shape == (B, 5)
+    # padding rows (own rank -1) contribute zero
+    valid_ads = sum(len(p) for p in group_by_search_id(rs[:4]))
+    np.testing.assert_allclose(np.asarray(out[valid_ads:]), 0.0)
+
+
+def test_pv_chunk_overflow_raises():
+    slots = [SlotDef("label", "float", 1), SlotDef("C0", "uint64")]
+    desc = DataFeedDesc(slots=slots, batch_size=2, label_slot="label",
+                        pv_batch_size=2, key_bucket_min=32)
+    rs = [rec(0, 1, 222, nslots=1), rec(0, 2, 222, nslots=1),
+          rec(1, 1, 222, nslots=1), rec(1, 2, 222, nslots=1)]
+    with pytest.raises(ValueError):
+        PvBatchBuilder(desc).batches(rs)
