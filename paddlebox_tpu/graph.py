@@ -1,0 +1,124 @@
+"""Graph store + neighbor sampling (GNN training support).
+
+Reference: the GPU graph PS in heter_ps — ``GpuPsCommGraph`` (CSR
+neighbor lists per shard, gpu_graph_node.h:35), ``GpuPsGraphTable``
+(graph_neighbor_sample/_v2/_v3, graph_gpu_ps_table.h:128-140),
+``graph_sampler`` walk generation, and ``GraphDataGenerator``
+(data_feed.h:908) which feeds sampled walks into the training pipeline.
+
+TPU-native redesign: the graph lives as two device arrays (CSR
+``indptr``/``indices``) — node ids are compacted to dense row ids the
+same way the embedding PS compacts feature keys. Sampling is one jitted
+gather: uniform neighbor draws are ``indptr[n] + floor(u * deg)`` with
+isolated nodes padded to -1 (static shapes, no host sync), so a sampling
+step fuses into the surrounding training step instead of being a
+separate RPC to a graph server. Walks are ``lax.scan`` over hops.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class GraphStore:
+    """CSR graph with dense node ids [0, n_nodes)."""
+
+    def __init__(self, indptr: np.ndarray, indices: np.ndarray) -> None:
+        self.indptr = np.asarray(indptr, np.int32)
+        self.indices = np.asarray(indices, np.int32)
+        self.n_nodes = self.indptr.size - 1
+        self._dev: Optional[Tuple[jax.Array, jax.Array]] = None
+
+    @classmethod
+    def from_edges(cls, src: np.ndarray, dst: np.ndarray,
+                   n_nodes: Optional[int] = None,
+                   symmetric: bool = False) -> "GraphStore":
+        src = np.asarray(src, np.int64)
+        dst = np.asarray(dst, np.int64)
+        if symmetric:
+            src, dst = (np.concatenate([src, dst]),
+                        np.concatenate([dst, src]))
+        n = int(n_nodes if n_nodes is not None
+                else (max(src.max(), dst.max()) + 1 if src.size else 0))
+        if src.size and (src.min() < 0 or dst.min() < 0
+                         or src.max() >= n or dst.max() >= n):
+            raise ValueError(
+                f"edge node ids must lie in [0, {n}); got src range "
+                f"[{src.min()}, {src.max()}], dst range "
+                f"[{dst.min()}, {dst.max()}]")
+        order = np.argsort(src, kind="stable")
+        src, dst = src[order], dst[order]
+        counts = np.bincount(src, minlength=n)
+        indptr = np.zeros(n + 1, np.int64)
+        np.cumsum(counts, out=indptr[1:])
+        return cls(indptr, dst)
+
+    def degree(self, nodes: Optional[np.ndarray] = None) -> np.ndarray:
+        deg = np.diff(self.indptr)
+        return deg if nodes is None else deg[np.asarray(nodes)]
+
+    def to_device(self) -> Tuple[jax.Array, jax.Array]:
+        if self._dev is None:
+            self._dev = (jnp.asarray(self.indptr), jnp.asarray(self.indices))
+        return self._dev
+
+
+def sample_neighbors(indptr: jax.Array, indices: jax.Array,
+                     nodes: jax.Array, k: int,
+                     rng: jax.Array) -> jax.Array:
+    """Uniform with-replacement k-neighbor sample per node → int32 [N, k];
+    isolated nodes yield -1 (the reference pads its sample results the
+    same way: default_value in graph_neighbor_sample)."""
+    start = indptr[nodes]                                    # [N]
+    deg = indptr[nodes + 1] - start                          # [N]
+    u = jax.random.uniform(rng, (nodes.shape[0], k))
+    off = jnp.floor(u * jnp.maximum(deg, 1)[:, None]).astype(jnp.int32)
+    neigh = indices[start[:, None] + off]                    # [N, k]
+    return jnp.where(deg[:, None] > 0, neigh, -1)
+
+
+def random_walk(indptr: jax.Array, indices: jax.Array,
+                starts: jax.Array, length: int,
+                rng: jax.Array) -> jax.Array:
+    """DeepWalk-style uniform walks → int32 [N, length+1] (first column =
+    starts). A walk stalls (repeats its node) at isolated nodes."""
+
+    def hop(cur, r):
+        nxt = sample_neighbors(indptr, indices, cur, 1, r)[:, 0]
+        nxt = jnp.where(nxt < 0, cur, nxt)
+        return nxt, nxt
+
+    keys = jax.random.split(rng, length)
+    _, steps = jax.lax.scan(hop, starts, keys)
+    return jnp.concatenate([starts[None, :], steps], axis=0).T
+
+
+class GraphDataGenerator:
+    """Walk-batch generator feeding skip-gram style training (reference:
+    GraphDataGenerator data_feed.h:908 — sample walks, emit id batches)."""
+
+    def __init__(self, store: GraphStore, walk_len: int = 5,
+                 batch_size: int = 256, seed: int = 0) -> None:
+        self.store = store
+        self.walk_len = walk_len
+        self.batch_size = batch_size
+        self._rng = jax.random.PRNGKey(seed)
+
+    def batches(self, epochs: int = 1):
+        indptr, indices = self.store.to_device()
+        n = self.store.n_nodes
+        for _ in range(epochs):
+            self._rng, sub = jax.random.split(self._rng)
+            perm = np.asarray(jax.random.permutation(sub, n))
+            for i in range(0, n, self.batch_size):
+                chunk = perm[i:i + self.batch_size]
+                if chunk.size < self.batch_size:  # static shapes: pad
+                    chunk = np.pad(chunk, (0, self.batch_size - chunk.size),
+                                   mode="edge")
+                self._rng, sub = jax.random.split(self._rng)
+                yield random_walk(indptr, indices, jnp.asarray(chunk),
+                                  self.walk_len, sub)

@@ -104,6 +104,7 @@ class ShardedTrainStep:
         num_slots: int,
         use_cvm: bool = True,
         cvm_offset: int = 2,
+        zero1: bool = False,
     ) -> None:
         self.model = model
         self.tx = tx
@@ -114,12 +115,19 @@ class ShardedTrainStep:
         self.num_slots = num_slots
         self.use_cvm = use_cvm
         self.cvm_offset = cvm_offset
+        # ZeRO-1 dense sharding (reference: BoxPSWorker sharding stage,
+        # boxps_worker.cc:601 BuildShardingDepends — params partitioned
+        # across devices): each device owns a flat param chunk + its opt
+        # state; grads reduce-scatter in, params all-gather out.
+        self.zero1 = zero1
+        self._chunk = 0           # set at init_state
+        self._unravel = None
 
         shard0 = P(DATA_AXIS)
         rep = P()
         state_spec = ShardedStepState(
             table=TableState(*([shard0] * len(TableState._fields))),
-            params=rep, opt_state=rep,
+            params=rep, opt_state=(shard0 if zero1 else rep),
             auc=AucState(*([shard0] * len(AucState._fields))),
             step=rep)
         batch_spec = GlobalBatch(*([shard0] * len(GlobalBatch._fields)))
@@ -138,8 +146,19 @@ class ShardedTrainStep:
         return self.model.init(jax.random.PRNGKey(0), pooled, dense)
 
     def init_state(self, table: ShardedEmbeddingTable, params: Any) -> ShardedStepState:
+        if self.zero1:
+            from jax.flatten_util import ravel_pytree
+
+            flat, self._unravel = ravel_pytree(params)
+            self._psize = int(flat.size)
+            self._chunk = -(-self._psize // self.n)  # ceil
+            pad = self.n * self._chunk - self._psize
+            chunks = jnp.pad(flat, (0, pad)).reshape(self.n, self._chunk)
+            opt_state = jax.vmap(self.tx.init)(chunks)
+        else:
+            opt_state = self.tx.init(params)
         return ShardedStepState(
-            table=table.state, params=params, opt_state=self.tx.init(params),
+            table=table.state, params=params, opt_state=opt_state,
             auc=init_sharded_auc(self.n), step=jnp.zeros((), jnp.int32))
 
     # ---- per-device block program (runs under shard_map) ----
@@ -201,11 +220,32 @@ class ShardedTrainStep:
         table = apply_push(table, serve_rows, gb, touched, serve_slot,
                            self.sgd_cfg, jax.random.fold_in(rng, me))
 
-        # ---- dense sync: psum == SyncParam's allreduce ----
-        g_params = jax.lax.psum(g_params, DATA_AXIS)
-        updates, opt_state = self.tx.update(g_params, state.opt_state,
-                                            state.params)
-        params = optax.apply_updates(state.params, updates)
+        # ---- dense sync ----
+        if self.zero1:
+            # ZeRO-1: reduce-scatter grads, update the owned flat chunk
+            # with per-device opt state, all-gather fresh params
+            from jax.flatten_util import ravel_pytree
+
+            g_flat, _ = ravel_pytree(g_params)
+            pad = self.n * self._chunk - self._psize
+            g_mine = jax.lax.psum_scatter(
+                jnp.pad(g_flat, (0, pad)).reshape(self.n, self._chunk),
+                DATA_AXIS, scatter_dimension=0, tiled=True)[0]
+            p_flat, _ = ravel_pytree(state.params)
+            p_mine = jnp.pad(p_flat, (0, pad)).reshape(
+                self.n, self._chunk)[me]
+            opt_mine = jax.tree.map(lambda l: l[0], state.opt_state)
+            updates, opt_mine = self.tx.update(g_mine, opt_mine, p_mine)
+            p_mine = optax.apply_updates(p_mine, updates)
+            p_all = jax.lax.all_gather(p_mine, DATA_AXIS, tiled=True)
+            params = self._unravel(p_all[:self._psize])
+            opt_state = jax.tree.map(lambda l: l[None], opt_mine)
+        else:
+            # psum == SyncParam's allreduce
+            g_params = jax.lax.psum(g_params, DATA_AXIS)
+            updates, opt_state = self.tx.update(g_params, state.opt_state,
+                                                state.params)
+            params = optax.apply_updates(state.params, updates)
 
         pred = jax.nn.sigmoid(logits)
         auc = auc_add_batch(auc, pred, label, ins_w)
@@ -230,7 +270,8 @@ class ShardedTrainer:
 
     def __init__(self, model, table: ShardedEmbeddingTable, desc, mesh: Mesh,
                  tx: Optional[optax.GradientTransformation] = None,
-                 use_cvm: bool = True, prefetch: int = 4, seed: int = 0) -> None:
+                 use_cvm: bool = True, prefetch: int = 4, seed: int = 0,
+                 zero1: bool = False) -> None:
         import threading as _threading
         self.model = model
         self.table = table
@@ -240,7 +281,7 @@ class ShardedTrainer:
         self.tx = tx or optax.adam(1e-3)
         self.step_fn = ShardedTrainStep(
             model, self.tx, table.cfg, mesh, desc.batch_size,
-            len(desc.sparse_slots), use_cvm=use_cvm)
+            len(desc.sparse_slots), use_cvm=use_cvm, zero1=zero1)
         params = self.step_fn.init_params(table.mf_dim, desc.dense_dim)
         self.state = self.step_fn.init_state(table, params)
         self._rng = jax.random.PRNGKey(seed + 1)

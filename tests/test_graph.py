@@ -1,0 +1,78 @@
+"""Graph store / sampling tests (reference: heter_ps graph PS —
+gpu_graph_node.h:35, graph_gpu_ps_table.h:128, test_graph.cu)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.graph import (GraphDataGenerator, GraphStore,
+                                 random_walk, sample_neighbors)
+
+
+def star_graph():
+    # 0 -> {1,2,3}; 1 -> {0}; 2 -> {0}; 3 -> {0}; 4 isolated
+    src = np.array([0, 0, 0, 1, 2, 3])
+    dst = np.array([1, 2, 3, 0, 0, 0])
+    return GraphStore.from_edges(src, dst, n_nodes=5)
+
+
+def test_from_edges_csr():
+    g = star_graph()
+    assert g.n_nodes == 5
+    np.testing.assert_array_equal(g.degree(), [3, 1, 1, 1, 0])
+    np.testing.assert_array_equal(
+        sorted(g.indices[g.indptr[0]:g.indptr[1]]), [1, 2, 3])
+
+
+def test_symmetric_edges():
+    g = GraphStore.from_edges(np.array([0]), np.array([1]), n_nodes=2,
+                              symmetric=True)
+    np.testing.assert_array_equal(g.degree(), [1, 1])
+
+
+def test_sample_neighbors_valid_and_padded():
+    g = star_graph()
+    indptr, indices = g.to_device()
+    nodes = jnp.array([0, 1, 4], dtype=jnp.int32)
+    out = np.asarray(sample_neighbors(indptr, indices, nodes, 8,
+                                      jax.random.PRNGKey(0)))
+    assert out.shape == (3, 8)
+    assert set(out[0]).issubset({1, 2, 3})   # node 0's neighbors
+    assert (out[1] == 0).all()               # node 1 -> only 0
+    assert (out[2] == -1).all()              # isolated -> padded
+
+
+def test_sample_neighbors_jits():
+    g = star_graph()
+    indptr, indices = g.to_device()
+    f = jax.jit(sample_neighbors, static_argnums=(3,))
+    out = f(indptr, indices, jnp.array([0, 1]), 4, jax.random.PRNGKey(1))
+    assert out.shape == (2, 4)
+
+
+def test_random_walk_follows_edges():
+    g = star_graph()
+    indptr, indices = g.to_device()
+    walks = np.asarray(random_walk(indptr, indices,
+                                   jnp.array([0, 4], dtype=jnp.int32), 6,
+                                   jax.random.PRNGKey(2)))
+    assert walks.shape == (2, 7)
+    # star graph: walk from 0 alternates 0 <-> leaf
+    w = walks[0]
+    for t in range(6):
+        if w[t] == 0:
+            assert w[t + 1] in (1, 2, 3)
+        else:
+            assert w[t + 1] == 0
+    # isolated node stalls
+    assert (walks[1] == 4).all()
+
+
+def test_generator_batches_static_shapes():
+    g = star_graph()
+    gen = GraphDataGenerator(g, walk_len=3, batch_size=4, seed=0)
+    batches = list(gen.batches(epochs=1))
+    assert len(batches) == 2  # ceil(5/4)
+    for b in batches:
+        assert b.shape == (4, 4)
+        assert (np.asarray(b) >= 0).all()
