@@ -39,6 +39,11 @@ class SlotBatch:
     clk: np.ndarray         # float32 [B]
     batch_size: int
     num_slots: int          # S (sparse slots)
+    # True when segments[i] == i for every valid key (each record has
+    # exactly one key per slot — the one-hot CTR layout): the device side
+    # can then derive segments from the key position and the H2D copy
+    # skips the segments array entirely.
+    segments_trivial: bool = False
     # metric side-channels (WuAUC / cmatch_rank variants)
     uid: Optional[np.ndarray] = None     # int64 [B]
     rank: Optional[np.ndarray] = None    # int32 [B]
@@ -111,8 +116,11 @@ class BatchBuilder:
                    if any(r.ins_id for r in records) else None)
         # short batches (tail of a pass): instances [n, bs) have show=0 so
         # they contribute nothing to pooled sums, loss, or metrics.
+        trivial = (nk == n * S
+                   and bool(np.array_equal(segs, np.arange(nk, dtype=np.int32))))
         return SlotBatch(
             keys=keys_p, segments=segs_p, num_keys=nk, dense=dense,
             label=label, show=show, clk=clk, batch_size=bs, num_slots=S,
+            segments_trivial=trivial,
             uid=uid, rank=rank, cmatch=cmatch, ins_ids=ins_ids,
         )

@@ -77,5 +77,13 @@ def load_native() -> ctypes.CDLL | None:
                                    ctypes.c_int64, ctypes.c_void_p]
         lib.kv_items.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                  ctypes.c_void_p]
+        lib.kv_assign_unique.restype = ctypes.c_int64
+        lib.kv_assign_unique.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                         ctypes.c_int64, ctypes.c_void_p,
+                                         ctypes.c_void_p]
+        lib.kv_lookup_unique.restype = ctypes.c_int64
+        lib.kv_lookup_unique.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                         ctypes.c_int64, ctypes.c_int32,
+                                         ctypes.c_void_p, ctypes.c_void_p]
         _LIB = lib
         return _LIB

@@ -42,6 +42,26 @@ struct KvIndex {
   int32_t next_row = 0;
   int32_t max_rows = 0;
 
+  // per-call dedup scratch, keyed by row (rows are unique per key):
+  // seen_epoch[row] == cur_epoch marks "already emitted this call";
+  // seen_pos[row] is its position in the call's unique list. Lazily sized
+  // max_rows+1 so the lookup sentinel row can participate too.
+  std::vector<uint32_t> seen_epoch;
+  std::vector<int32_t> seen_pos;
+  uint32_t cur_epoch = 0;
+
+  uint32_t next_epoch() {
+    if (seen_epoch.empty()) {
+      seen_epoch.assign(static_cast<size_t>(max_rows) + 1, 0);
+      seen_pos.assign(static_cast<size_t>(max_rows) + 1, 0);
+    }
+    if (++cur_epoch == 0) {  // wrapped: stale marks could alias — clear
+      std::fill(seen_epoch.begin(), seen_epoch.end(), 0);
+      cur_epoch = 1;
+    }
+    return cur_epoch;
+  }
+
   explicit KvIndex(int64_t capacity_hint, int32_t max_rows_) {
     uint64_t cap = 64;
     while (cap < static_cast<uint64_t>(capacity_hint) * 2) cap <<= 1;
@@ -176,6 +196,62 @@ int64_t kv_release(void* p, const uint64_t* in, int64_t n, int32_t* rows_out) {
     if (rows_out[i] >= 0) ++freed;
   }
   return freed;
+}
+
+// Fused DedupKeysAndFillIdx + assign (box_wrapper_impl.h:129 done host-side
+// in ONE pass): dedup n keys in first-occurrence order, assign a row to each
+// unique key, write the unique rows to uniq_rows_out (buffer sized n) and
+// the key→unique-position inverse map to inverse_out (sized n). Returns the
+// unique count, or -1 if the table filled. Replaces np.unique's O(n log n)
+// sort with O(n) hashing — the prepare-thread hot path.
+int64_t kv_assign_unique(void* p, const uint64_t* in, int64_t n,
+                         int32_t* uniq_rows_out, int32_t* inverse_out) {
+  KvIndex* kv = static_cast<KvIndex*>(p);
+  uint32_t epoch = kv->next_epoch();
+  int64_t u = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t row = kv->assign_one(in[i]);
+    if (row == -2) return -1;
+    if (kv->seen_epoch[row] != epoch) {
+      kv->seen_epoch[row] = epoch;
+      kv->seen_pos[row] = static_cast<int32_t>(u);
+      uniq_rows_out[u] = row;
+      ++u;
+    }
+    inverse_out[i] = kv->seen_pos[row];
+  }
+  return u;
+}
+
+// Read-only variant (eval/inference): unknown keys all share ONE unique
+// entry holding sentinel_row (the zero row), so no index mutation happens.
+int64_t kv_lookup_unique(void* p, const uint64_t* in, int64_t n,
+                         int32_t sentinel_row, int32_t* uniq_rows_out,
+                         int32_t* inverse_out) {
+  KvIndex* kv = static_cast<KvIndex*>(p);
+  uint32_t epoch = kv->next_epoch();
+  int64_t u = 0;
+  int32_t miss_pos = -1;
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t row = kv->lookup_one(in[i]);
+    if (row < 0) {
+      if (miss_pos < 0) {
+        miss_pos = static_cast<int32_t>(u);
+        uniq_rows_out[u] = sentinel_row;
+        ++u;
+      }
+      inverse_out[i] = miss_pos;
+      continue;
+    }
+    if (kv->seen_epoch[row] != epoch) {
+      kv->seen_epoch[row] = epoch;
+      kv->seen_pos[row] = static_cast<int32_t>(u);
+      uniq_rows_out[u] = row;
+      ++u;
+    }
+    inverse_out[i] = kv->seen_pos[row];
+  }
+  return u;
 }
 
 // dump all live (key,row) pairs; buffers must hold kv_size entries.

@@ -52,11 +52,12 @@ class ExtendedEmbeddingTable:
         uniq, inv = np.unique(valid, return_inverse=True)
         rows_b = self.base.index.assign(uniq)
         self.base._touched[rows_b] = True
-        idx_b = self.base._build_index(batch, uniq, inv, rows_b)
+        idx_b = self.base._build_index(batch, rows_b, inv.astype(np.int32))
         if not self.skip_extend_slots:
             rows_e = self.extend.index.assign(uniq)
             self.extend._touched[rows_e] = True
-            idx_e = self.extend._build_index(batch, uniq, inv, rows_e)
+            idx_e = self.extend._build_index(batch, rows_e,
+                                             inv.astype(np.int32))
         else:
             slot_k = batch.segments[:batch.num_keys] % batch.num_slots
             keep = ~np.isin(slot_k, list(self.skip_extend_slots))

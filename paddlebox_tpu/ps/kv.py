@@ -75,6 +75,19 @@ class PyKV:
                          count=len(self._map))
         return ks, rs
 
+    def assign_unique(self, keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """(unique rows, inverse): dedup keys and assign rows to the uniques."""
+        uniq, inv = np.unique(keys, return_inverse=True)
+        return self.assign(uniq), inv.astype(np.int32, copy=False)
+
+    def lookup_unique(self, keys: np.ndarray,
+                      sentinel: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Read-only dedup: unknown uniques map to the sentinel row."""
+        uniq, inv = np.unique(keys, return_inverse=True)
+        rows = self.lookup(uniq)
+        rows = np.where(rows < 0, sentinel, rows).astype(np.int32)
+        return rows, inv.astype(np.int32, copy=False)
+
 
 class NativeKV:
     """ctypes wrapper over native/kv_index.cpp."""
@@ -127,6 +140,30 @@ class NativeKV:
         if n:
             self._lib.kv_items(self._h, self._buf(ks), self._buf(rs))
         return ks, rs
+
+    def assign_unique(self, keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """One-pass hash dedup + row assign (O(n), no sort); uniques come in
+        first-occurrence order. Contract matches PyKV.assign_unique."""
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        n = len(keys)
+        uniq_rows = np.empty(n, dtype=np.int32)
+        inv = np.empty(n, dtype=np.int32)
+        u = self._lib.kv_assign_unique(self._h, self._buf(keys), n,
+                                       self._buf(uniq_rows), self._buf(inv))
+        if u < 0:
+            raise _full_error(self.capacity)
+        return uniq_rows[:u].copy(), inv
+
+    def lookup_unique(self, keys: np.ndarray,
+                      sentinel: int) -> Tuple[np.ndarray, np.ndarray]:
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        n = len(keys)
+        uniq_rows = np.empty(max(n, 1), dtype=np.int32)
+        inv = np.empty(n, dtype=np.int32)
+        u = self._lib.kv_lookup_unique(self._h, self._buf(keys), n,
+                                       sentinel, self._buf(uniq_rows),
+                                       self._buf(inv))
+        return uniq_rows[:u].copy(), inv
 
 
 def make_kv(capacity: int):

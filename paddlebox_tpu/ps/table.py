@@ -206,10 +206,10 @@ class EmbeddingTable:
         self._touched = np.zeros(self.capacity + 1, dtype=bool)
 
     # ---- per-batch host prep (dedup + row assignment) ----
-    def _build_index(self, batch: SlotBatch, uniq: np.ndarray,
-                     inv: np.ndarray, rows: np.ndarray) -> PullIndex:
+    def _build_index(self, batch: SlotBatch, rows: np.ndarray,
+                     inv: np.ndarray) -> PullIndex:
         """Shared padding/bucketing tail of prepare/prepare_eval."""
-        u = len(uniq)
+        u = len(rows)
         cap = self.unique_bucket_min
         while cap < u + 1:
             cap *= 2
@@ -217,26 +217,23 @@ class EmbeddingTable:
         unique_rows[:u] = rows
         k_pad = batch.keys.shape[0]
         gather_idx = np.full(k_pad, u, dtype=np.int32)  # pads → sentinel slot
-        gather_idx[:batch.num_keys] = inv.astype(np.int32)
+        gather_idx[:batch.num_keys] = inv
         key_valid = np.zeros(k_pad, dtype=np.float32)
         key_valid[:batch.num_keys] = 1.0
         return PullIndex(unique_rows, gather_idx, key_valid, u)
 
     def prepare(self, batch: SlotBatch) -> PullIndex:
         valid = batch.keys[:batch.num_keys]
-        uniq, inv = np.unique(valid, return_inverse=True)
-        rows = self.index.assign(uniq)
+        rows, inv = self.index.assign_unique(valid)
         self._touched[rows] = True
-        return self._build_index(batch, uniq, inv, rows)
+        return self._build_index(batch, rows, inv)
 
     def prepare_eval(self, batch: SlotBatch) -> PullIndex:
         """Read-only prepare: unknown keys map to the zero sentinel row
         instead of allocating (inference path — no index mutation)."""
         valid = batch.keys[:batch.num_keys]
-        uniq, inv = np.unique(valid, return_inverse=True)
-        rows = self.index.lookup(uniq)
-        rows = np.where(rows < 0, self.capacity, rows).astype(np.int32)
-        return self._build_index(batch, uniq, inv, rows)
+        rows, inv = self.index.lookup_unique(valid, self.capacity)
+        return self._build_index(batch, rows, inv)
 
     def next_rng(self) -> jax.Array:
         self._push_count += 1

@@ -119,6 +119,10 @@ class ShardedTrainStep:
         # boxps_worker.cc:601 BuildShardingDepends — params partitioned
         # across devices): each device owns a flat param chunk + its opt
         # state; grads reduce-scatter in, params all-gather out.
+        # CONSTRAINT: tx must be an ELEMENTWISE transform (adam/adagrad/
+        # sgd/…) — it is applied per flat per-device chunk, so transforms
+        # needing a global reduction over the whole param tree (e.g.
+        # clip_by_global_norm) would compute per-chunk statistics instead.
         self.zero1 = zero1
         self._chunk = 0           # set at init_state
         self._unravel = None

@@ -29,29 +29,80 @@ from paddlebox_tpu.ps.table import (PullIndex, TableState, apply_push,
 
 
 class DeviceBatch(NamedTuple):
-    """Everything the device step consumes for one batch."""
+    """Everything the device step consumes for one batch, packed into THREE
+    host→device transfers (the tunnel/PCIe round-trip is the real cost, not
+    bytes — the reference packs per-slot tensors into single copies for the
+    same reason, MiniBatchGpuPack data_feed.cu:1210). ``key_valid`` is not
+    shipped at all: it's derived on device from the real-key count carried
+    in ``ints_u``'s last element. Accessors below unpack inside the traced
+    step, where slices are free."""
 
-    unique_rows: jax.Array  # int32 [U_pad]
-    gather_idx: jax.Array   # int32 [K_pad]
-    key_valid: jax.Array    # f32 [K_pad]
-    segments: jax.Array     # int32 [K_pad]
-    dense: jax.Array        # f32 [B, Dd]
-    label: jax.Array        # f32 [B]
-    show: jax.Array         # f32 [B]
-    clk: jax.Array          # f32 [B]
+    ints_u: jax.Array   # int32 [U_pad + 2] = unique_rows ++ [num_keys, pad_segment]
+    ints_k: jax.Array   # int32 [2, K_pad] = [gather_idx; segments], or
+                        #       [1, K_pad] when segments are derivable
+    floats: jax.Array   # f32 [B, Dd + 3] = [dense | label | show | clk]
+
+    @property
+    def unique_rows(self) -> jax.Array:
+        return self.ints_u[:-2]
+
+    @property
+    def num_keys(self) -> jax.Array:
+        return self.ints_u[-2]
+
+    @property
+    def gather_idx(self) -> jax.Array:
+        return self.ints_k[0]
+
+    @property
+    def segments(self) -> jax.Array:
+        if self.ints_k.shape[0] == 2:
+            return self.ints_k[1]
+        # trivial layout (one key per slot per record): segment i == i for
+        # real keys, pad bin for the tail
+        k_pad = self.ints_k.shape[1]
+        i = jnp.arange(k_pad, dtype=jnp.int32)
+        return jnp.where(i < self.num_keys, i, self.ints_u[-1])
+
+    @property
+    def key_valid(self) -> jax.Array:
+        k_pad = self.ints_k.shape[1]
+        return (jnp.arange(k_pad, dtype=jnp.int32)
+                < self.num_keys).astype(jnp.float32)
+
+    @property
+    def dense(self) -> jax.Array:
+        return self.floats[:, :-3]
+
+    @property
+    def label(self) -> jax.Array:
+        return self.floats[:, -3]
+
+    @property
+    def show(self) -> jax.Array:
+        return self.floats[:, -2]
+
+    @property
+    def clk(self) -> jax.Array:
+        return self.floats[:, -1]
 
 
 def make_device_batch(batch: SlotBatch, idx: PullIndex) -> DeviceBatch:
-    return DeviceBatch(
-        unique_rows=jnp.asarray(idx.unique_rows),
-        gather_idx=jnp.asarray(idx.gather_idx),
-        key_valid=jnp.asarray(idx.key_valid),
-        segments=jnp.asarray(batch.segments),
-        dense=jnp.asarray(batch.dense),
-        label=jnp.asarray(batch.label),
-        show=jnp.asarray(batch.show),
-        clk=jnp.asarray(batch.clk),
-    )
+    u_pad = idx.unique_rows.shape[0]
+    ints_u = np.empty(u_pad + 2, np.int32)
+    ints_u[:u_pad] = idx.unique_rows
+    ints_u[u_pad] = batch.num_keys
+    ints_u[u_pad + 1] = batch.pad_segment
+    if getattr(batch, "segments_trivial", False):
+        ints_k = np.ascontiguousarray(idx.gather_idx[None, :])
+    else:
+        ints_k = np.stack([idx.gather_idx, batch.segments.astype(np.int32)])
+    floats = np.concatenate(
+        [batch.dense.astype(np.float32, copy=False),
+         np.stack([batch.label, batch.show, batch.clk], axis=1)], axis=1)
+    return DeviceBatch(ints_u=jnp.asarray(ints_u),
+                       ints_k=jnp.asarray(ints_k),
+                       floats=jnp.asarray(floats))
 
 
 class StepState(NamedTuple):

@@ -73,14 +73,23 @@ class Trainer:
         self.global_step = 0
         self._dump_cfg = None
 
-    # ---- host-side prefetch: batch build + dedup + row assign ----
+    # ---- host-side prefetch: batch build + dedup + row assign + H2D ----
     def _prefetch_iter(
         self, batches: Iterable[SlotBatch], prepare=None,
-    ) -> Iterator[Tuple[SlotBatch, PullIndex]]:
+    ) -> Iterator[Tuple[SlotBatch, DeviceBatch]]:
+        """Two chained producer threads — stage 1 does dedup + row assign
+        (mutates the host index, so single-threaded), stage 2 does the
+        device transfer — so the main thread only dispatches jit steps.
+        This is the role split of the reference's DataFeed read thread +
+        MiniBatchGpuPack H2D stage, with both overlapped against device
+        compute through bounded channels."""
         from paddlebox_tpu.utils.prefetch import prefetch_iter
         prep = prepare or self.table.prepare
-        return prefetch_iter(batches, lambda b: (b, prep(b)),
-                             capacity=self.prefetch)
+        prepared = prefetch_iter(batches, lambda b: (b, prep(b)),
+                                 capacity=self.prefetch)
+        return prefetch_iter(
+            prepared, lambda t: (t[0], make_device_batch(t[0], t[1])),
+            capacity=self.prefetch)
 
     def set_dump(self, cfg) -> None:
         """Enable per-sample prediction dump for subsequent passes
@@ -103,8 +112,7 @@ class Trainer:
         if self._dump_cfg is not None:
             from paddlebox_tpu.utils.dump import DumpWriter
             dump_writer = DumpWriter(self._dump_cfg)
-        for batch, idx in self._prefetch_iter(dataset.batches()):
-            dev = make_device_batch(batch, idx)
+        for batch, dev in self._prefetch_iter(dataset.batches()):
             self.global_step += 1
             rng = jax.random.fold_in(self._rng, self.global_step)
             self.state, stats = self.step_fn(self.state, dev, rng)
@@ -150,8 +158,7 @@ class Trainer:
         timer.start()
         it = self._prefetch_iter(dataset.batches(),
                                  prepare=self.table.prepare_eval)
-        for batch, idx in it:
-            dev = make_device_batch(batch, idx)
+        for batch, dev in it:
             auc = self.step_fn.eval(self.state.table, self.state.params,
                                     auc, dev)
             nb += 1
