@@ -48,21 +48,27 @@ def main() -> None:
     from paddlebox_tpu.data import DataFeedDesc, InMemoryDataset, SlotDef
     from paddlebox_tpu.models import DeepFM
     from paddlebox_tpu.ps import EmbeddingTable, SparseSGDConfig
-    from paddlebox_tpu.train import Trainer
+    from paddlebox_tpu.train import PassPreloader, Trainer
 
     bs = int(os.environ.get("BENCH_BATCH_SIZE", 8192))
     num_records = int(os.environ.get("BENCH_RECORDS", 262_144))
     mf_dim = int(os.environ.get("BENCH_MF_DIM", 8))
+    num_passes = int(os.environ.get("BENCH_PASSES", 3))
+    mode = os.environ.get("BENCH_MODE", "resident")
     FLAGS.log_period_steps = 10 ** 9
 
     slots = [SlotDef("label", "float", 1), SlotDef("dense", "float", 13)]
     slots += [SlotDef(f"C{i}", "uint64") for i in range(1, 27)]
+    # one key per slot → exact key bucket (bs*26): zero padding waste and
+    # a single compile variant
     desc = DataFeedDesc(slots=slots, batch_size=bs, label_slot="label",
-                        key_bucket_min=1 << 10)
+                        key_bucket_min=bs * 26)
 
-    ds = InMemoryDataset(desc)
-    ds.records = build_records(num_records)
-    ds.columnarize()
+    def make_ds(seed: int) -> InMemoryDataset:
+        d = InMemoryDataset(desc)
+        d.records = build_records(num_records, seed=seed)
+        d.columnarize()
+        return d
 
     cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=1e-3)
     table = EmbeddingTable(mf_dim=mf_dim, capacity=1 << 23, cfg=cfg,
@@ -70,14 +76,39 @@ def main() -> None:
     tr = Trainer(DeepFM(hidden=(512, 256, 128)), table, desc,
                  tx=optax.adam(1e-3), prefetch=8)
 
-    # warmup: compile all key-bucket variants on a slice of the data
-    warm = InMemoryDataset(desc)
-    warm.records = build_records(bs * 3, seed=1)
-    warm.columnarize()
-    tr.train_pass(warm)
-
-    res = tr.train_pass(ds)
-    value = res["examples_per_sec"]
+    if mode == "streaming":
+        ds = make_ds(0)
+        warm = InMemoryDataset(desc)
+        warm.records = build_records(bs * 3, seed=99)
+        warm.columnarize()
+        tr.train_pass(warm)
+        res = tr.train_pass(ds)
+        value = res["examples_per_sec"]
+    else:
+        # Device-resident passes with double-buffered preload — the
+        # reference's steady state (preload_into_memory overlaps training,
+        # BeginPass stages the pass in HBM; SURVEY.md §3.3). Pass 0 pays
+        # compile+upload; measurement covers passes 1..num_passes wall
+        # clock, preloads overlapped. Datasets are materialized up front:
+        # synthetic data GENERATION is the data source, not the system
+        # under test (the measured pipeline still includes batch build,
+        # row assign and upload via the preloader).
+        import jax.numpy as jnp
+        datasets = iter([make_ds(s) for s in range(num_passes + 1)])
+        pre = PassPreloader(datasets, table, floats_dtype=jnp.bfloat16)
+        pre.start_next()
+        rp = pre.wait()
+        pre.start_next()
+        tr.train_pass_resident(rp)          # warmup/compile pass
+        total_ex = 0
+        t0 = time.perf_counter()
+        for _ in range(num_passes):
+            rp = pre.wait()
+            pre.start_next()
+            res = tr.train_pass_resident(rp)
+            total_ex += rp.num_records
+        elapsed = time.perf_counter() - t0
+        value = total_ex / elapsed
     baseline_per_chip = 1_000_000 / 16  # v5p-32 north-star / chips
     print(json.dumps({
         "metric": "deepfm_ctr_examples_per_sec_per_chip",

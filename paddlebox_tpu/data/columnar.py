@@ -123,12 +123,15 @@ class ColumnarRecords:
             out[:n] = a[start:end]
             return out
 
+        trivial = (nk == n * num_slots
+                   and bool(np.array_equal(segs,
+                                           np.arange(nk, dtype=np.int32))))
         opt = lambda a: None if a is None else padrow(a)
         return SlotBatch(
             keys=keys_p, segments=segs_p, num_keys=nk,
             dense=padrow(self.dense), label=padrow(self.label),
             show=padrow(self.show), clk=padrow(self.clk),
-            batch_size=bs, num_slots=num_slots,
+            batch_size=bs, num_slots=num_slots, segments_trivial=trivial,
             uid=opt(self.uid), rank=opt(self.rank), cmatch=opt(self.cmatch),
         )
 

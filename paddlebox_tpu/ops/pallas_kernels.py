@@ -17,14 +17,27 @@ Reference hot kernels being replaced (SURVEY.md §2.1-2.2, §2.4):
 All kernels auto-fall back to interpret mode off-TPU so the whole suite is
 testable on the CPU mesh (SURVEY.md §4 implication).
 
-Status (measured on one v5p chip, DeepFM/criteo bench, mf_dim=8):
-- XLA's native gather/scatter-add is FASTER at small embedding dims (the
-  lane padding 11→128 and per-row DMA granularity dominate), so all three
-  flags default to False and the jnp paths are the production defaults.
+Status (measured on one TPU chip, DeepFM/criteo bench, AoS table
+[8M+1, 16] f32, 213k rows/batch):
+- XLA's native gather/scatter lowers to PER-ELEMENT access: scatter
+  [213k, 16] rows = 26 ms (~7.6 ns/element), gather = 8 ms. The hints
+  (unique_indices / indices_are_sorted / mode) change nothing. This is
+  the single largest cost in the train step.
+- ``gather_rows_dma``/``scatter_rows_dma`` below implement the obvious
+  fix — one 64-byte row DMA per index, _NSEM in flight — but current
+  Mosaic CANNOT compile them: every memref (HBM included) is laid out
+  with a 128-lane minor tile, so a 16-wide row slice is "unaligned"
+  regardless of memory space (error: "Slice shape along dimension 1
+  must be aligned to tiling (128)"). They are correct in interpret mode
+  and kept as the reference implementation.
+- The workable TPU design (next round): treat 8 consecutive 16-wide
+  rows as one (8, 128)-aligned super-row, gather/scatter super-rows via
+  DMA, and merge scattered rows into gathered super-rows with masked
+  vector selects (rows arrive sorted, so each touched super-row's rows
+  are a contiguous range). ~1.6 GB of aligned RMW traffic ≈ 2-4 ms vs
+  26 ms.
 - ``segment_sum_mxu`` is the right shape for wide-D, high-slot-count
   configs (1000-slot fused pipelines, D≥128); re-evaluate there.
-- ``gather_rows`` needs a batched-DMA redesign (8 rows/step via manual
-  async copies) before it can compete with XLA's gather.
 """
 
 from __future__ import annotations
@@ -56,6 +69,9 @@ def gather_rows(table: jax.Array, rows: jax.Array) -> jax.Array:
 
     One grid step per row; the row index is scalar-prefetched so the
     pipeline issues the HBM→VMEM DMA for step i+1 while step i copies out.
+    Out-of-bounds pad rows (> C-1, the OOB-pad contract of
+    table._build_index / device_unique.dedup_rows) clamp to the sentinel
+    row C-1, matching XLA's clamped-gather semantics.
     """
     c, d = table.shape
     u = rows.shape[0]
@@ -67,7 +83,8 @@ def gather_rows(table: jax.Array, rows: jax.Array) -> jax.Array:
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(u,),
-        in_specs=[pl.BlockSpec((1, d), lambda i, rows_ref: (rows_ref[i], 0))],
+        in_specs=[pl.BlockSpec(
+            (1, d), lambda i, rows_ref: (jnp.minimum(rows_ref[i], c - 1), 0))],
         out_specs=pl.BlockSpec((1, d), lambda i, rows_ref: (i, 0)),
     )
     return pl.pallas_call(
@@ -86,9 +103,9 @@ def scatter_rows(table: jax.Array, rows: jax.Array,
                  values: jax.Array) -> jax.Array:
     """Write values[i] into table[rows[i]] in place (buffer aliased).
 
-    Rows must be unique except for a designated pad/sentinel row, which may
-    be written multiple times (last-write-wins nondeterminism is confined to
-    that row; callers reset it — see table.apply_push).
+    In-bounds rows must be duplicate-free (the unique-scatter contract);
+    out-of-bounds pad rows clamp to the sentinel row C-1, whose racy
+    last-write-wins content the callers reset (table.apply_push).
     """
     c, d = table.shape
     u = rows.shape[0]
@@ -104,7 +121,8 @@ def scatter_rows(table: jax.Array, rows: jax.Array,
             pl.BlockSpec(memory_space=pl.ANY),  # aliased table, untouched
             pl.BlockSpec((1, d), lambda i, rows_ref: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((1, d), lambda i, rows_ref: (rows_ref[i], 0)),
+        out_specs=pl.BlockSpec(
+            (1, d), lambda i, rows_ref: (jnp.minimum(rows_ref[i], c - 1), 0)),
     )
     return pl.pallas_call(
         kernel,
@@ -113,6 +131,117 @@ def scatter_rows(table: jax.Array, rows: jax.Array,
         input_output_aliases={1: 0},  # tensor input 0 (table) → output 0
         interpret=_interpret(),
     )(rows, table, values)
+
+
+# ---------------------------------------------------------------------------
+# Manual-DMA row gather/scatter — per-row 64B copies, semaphore ring
+# ---------------------------------------------------------------------------
+#
+# XLA lowers irregular gather/scatter to per-ELEMENT access on TPU; these
+# kernels issue one DMA per ROW instead. Rows stream through VMEM in blocks
+# of _TR (the pallas pipeline double-buffers the block transfer), and inside
+# each block a scalar loop issues per-row DMAs, keeping _NSEM in flight.
+# Out-of-bounds row ids (the OOB padding contract of table._build_index /
+# device_unique.dedup_rows) are clamped to the sentinel row C — reads there
+# return zeros, racy pad writes land on C which apply_push resets.
+
+_TR = 2048    # rows per grid block (VMEM: _TR * D * 4B)
+_NSEM = 16    # DMAs in flight
+
+
+def _dma_body(rows_ref, tbl_ref, io_ref, sem, base, scatter: bool) -> None:
+    """Issue one 64B-row DMA per index with a _NSEM-deep in-flight ring.
+    rows_ref: SMEM [tr] block-local row ids; io_ref: the full [K, d]
+    values/out array in HBM (row base+r ↔ table row); tbl_ref: the whole
+    table in HBM. DMAs are HBM→HBM (row slices are contiguous, so no VMEM
+    tiling constraint applies)."""
+    tr = rows_ref.shape[0]
+    c = tbl_ref.shape[0] - 1
+
+    def issue(r):
+        row = jnp.minimum(rows_ref[r], c)  # OOB pads clamp to sentinel
+        if scatter:
+            return pltpu.make_async_copy(
+                io_ref.at[base + r], tbl_ref.at[row], sem.at[r % _NSEM])
+        return pltpu.make_async_copy(
+            tbl_ref.at[row], io_ref.at[base + r], sem.at[r % _NSEM])
+
+    def body(r, carry):
+        # reuse slot r%_NSEM: drain the DMA issued _NSEM rows ago
+        @pl.when(r >= _NSEM)
+        def _():
+            issue(r - _NSEM).wait()
+        issue(r).start()
+        return carry
+
+    jax.lax.fori_loop(0, tr, body, 0)
+    start = max(0, tr - _NSEM)
+
+    def drain(i, carry):
+        issue(start + i).wait()
+        return carry
+
+    jax.lax.fori_loop(0, tr - start, drain, 0)
+
+
+def scatter_rows_dma(table: jax.Array, rows: jax.Array,
+                     values: jax.Array) -> jax.Array:
+    """table[rows[i]] = values[i] via per-row DMAs, table aliased in place.
+
+    rows must be duplicate-free among in-bounds ids (the unique-scatter
+    contract of table._build_index / device_unique.dedup_rows); OOB pads
+    clamp to the sentinel row — racy pad writes land there and the caller
+    resets it (apply_push)."""
+    c1, d = table.shape
+    k = rows.shape[0]
+    tr = min(_TR, k)
+    assert k % tr == 0, f"pad rows to a multiple of {tr}"
+
+    def kernel(rows_ref, tbl_ref, val_ref, out_ref, sem):
+        del tbl_ref  # out_ref is its alias — write through the output
+        _dma_body(rows_ref, out_ref, val_ref, sem,
+                  pl.program_id(0) * tr, scatter=True)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(k // tr,),
+        in_specs=[
+            pl.BlockSpec((tr,), lambda b: (b,), memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.HBM),  # table (aliased)
+            pl.BlockSpec(memory_space=pltpu.HBM),  # values, stays in HBM
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.HBM),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((_NSEM,))],
+        out_shape=jax.ShapeDtypeStruct((c1, d), table.dtype),
+        input_output_aliases={1: 0},  # table input → output
+        interpret=_interpret(),
+    )(rows, table, values)
+
+
+def gather_rows_dma(table: jax.Array, rows: jax.Array) -> jax.Array:
+    """out[i] = table[min(rows[i], C)] via per-row DMAs (OOB ids clamp to
+    the zero sentinel row — same semantics as XLA's clamped gather)."""
+    c1, d = table.shape
+    k = rows.shape[0]
+    tr = min(_TR, k)
+    assert k % tr == 0, f"pad rows to a multiple of {tr}"
+
+    def kernel(rows_ref, tbl_ref, out_ref, sem):
+        _dma_body(rows_ref, tbl_ref, out_ref, sem,
+                  pl.program_id(0) * tr, scatter=False)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(k // tr,),
+        in_specs=[
+            pl.BlockSpec((tr,), lambda b: (b,), memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.HBM),  # table
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.HBM),  # written via DMA
+        scratch_shapes=[pltpu.SemaphoreType.DMA((_NSEM,))],
+        out_shape=jax.ShapeDtypeStruct((k, d), table.dtype),
+        interpret=_interpret(),
+    )(rows, table)
 
 
 # ---------------------------------------------------------------------------

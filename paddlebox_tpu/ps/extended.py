@@ -22,7 +22,8 @@ import numpy as np
 
 from paddlebox_tpu.data.batch import SlotBatch
 from paddlebox_tpu.ps.sgd import SparseSGDConfig
-from paddlebox_tpu.ps.table import EmbeddingTable, PullIndex
+from paddlebox_tpu.ps.table import (EmbeddingTable, PullIndex,
+                                    fill_oob_pads)
 
 
 class ExtendedEmbeddingTable:
@@ -68,8 +69,9 @@ class ExtendedEmbeddingTable:
             cap = self.extend.unique_bucket_min
             while cap < u + 1:
                 cap *= 2
-            unique_rows = np.full(cap, self.extend.capacity, np.int32)
+            unique_rows = np.empty(cap, np.int32)
             unique_rows[:u] = rows_e
+            fill_oob_pads(unique_rows, u, self.extend.capacity)
             k_pad = batch.keys.shape[0]
             # skipped keys point at the sentinel slot: zero pulls, and
             # key_valid=0 drops their expand grads in merge_push

@@ -25,14 +25,13 @@ import numpy as np
 
 from paddlebox_tpu.config import FLAGS
 from paddlebox_tpu.ps.kv import make_kv
-from paddlebox_tpu.ps.table import TWO_D_FIELDS, TableState
+from paddlebox_tpu.ps.table import TWO_D_FIELDS, FIELDS
 from paddlebox_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
 
 # host SoA fields — single source of truth is the device TableState
 # (FeatureValue layout, heter_ps/feature_value.h:570)
-FIELDS = TableState._fields
 _2D_FIELDS = TWO_D_FIELDS
 
 

@@ -82,11 +82,24 @@ class PyKV:
 
     def lookup_unique(self, keys: np.ndarray,
                       sentinel: int) -> Tuple[np.ndarray, np.ndarray]:
-        """Read-only dedup: unknown uniques map to the sentinel row."""
+        """Read-only dedup: ALL unknown keys collapse into ONE unique
+        entry holding the sentinel row (same contract as the native
+        kv_lookup_unique — keeps unique_rows duplicate-free)."""
         uniq, inv = np.unique(keys, return_inverse=True)
         rows = self.lookup(uniq)
-        rows = np.where(rows < 0, sentinel, rows).astype(np.int32)
-        return rows, inv.astype(np.int32, copy=False)
+        miss = rows < 0
+        if not miss.any():
+            return rows.astype(np.int32, copy=False), \
+                inv.astype(np.int32, copy=False)
+        # renumber: known uniques keep relative order, misses share one slot
+        remap = np.empty(len(uniq), np.int32)
+        known_idx = np.nonzero(~miss)[0]
+        remap[known_idx] = np.arange(len(known_idx), dtype=np.int32)
+        remap[np.nonzero(miss)[0]] = len(known_idx)
+        out_rows = np.empty(len(known_idx) + 1, np.int32)
+        out_rows[:len(known_idx)] = rows[known_idx]
+        out_rows[len(known_idx)] = sentinel
+        return out_rows, remap[inv].astype(np.int32, copy=False)
 
 
 class NativeKV:

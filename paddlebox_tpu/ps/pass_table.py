@@ -28,7 +28,7 @@ from paddlebox_tpu.config import FLAGS
 from paddlebox_tpu.ps.host_store import FIELDS, HostStore
 from paddlebox_tpu.ps.kv import make_kv
 from paddlebox_tpu.ps.sgd import SparseSGDConfig
-from paddlebox_tpu.ps.table import (TWO_D_FIELDS, EmbeddingTable,
+from paddlebox_tpu.ps.table import (FIELD_COL, NUM_FIXED, EmbeddingTable,
                                     TableState)
 from paddlebox_tpu.utils.logging import get_logger
 
@@ -129,13 +129,13 @@ class PassScopedTable(EmbeddingTable):
         self.index = make_kv(self.capacity)
         rows = self.index.assign(st.keys)
         c1 = self.capacity + 1
-        host_leaves = []
+        data = np.zeros((c1, NUM_FIXED + self.mf_dim), np.float32)
         for f in FIELDS:
-            shape = (c1, self.mf_dim) if f in TWO_D_FIELDS else (c1,)
-            a = np.zeros(shape, np.float32)
-            a[rows] = st.values[f]
-            host_leaves.append(a)
-        self.state = TableState(*[jax.device_put(a) for a in host_leaves])
+            if f == "embedx_w":
+                data[rows, NUM_FIXED:] = st.values[f]
+            else:
+                data[rows, FIELD_COL[f]] = st.values[f]
+        self.state = TableState(jax.device_put(data))
         self._touched[:] = False
         self.in_pass = True
         log.info("begin_pass: %d working-set rows in HBM", len(st.keys))

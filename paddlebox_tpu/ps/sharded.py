@@ -46,7 +46,9 @@ import numpy as np
 from paddlebox_tpu.config import FLAGS
 from paddlebox_tpu.data.batch import SlotBatch
 from paddlebox_tpu.ps.sgd import SparseSGDConfig
-from paddlebox_tpu.ps.table import HostKV, TableState, init_table_state
+from paddlebox_tpu.ps.table import (FIELD_COL, FIELDS, NUM_FIXED, HostKV,
+                                    TableState, field_slice,
+                                    fill_oob_pads, init_table_state)
 from paddlebox_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -171,12 +173,13 @@ class ShardedEmbeddingTable:
                 off += cnt
         A2 = _bucket(a2_max, self.serve_bucket_min)
 
-        serve_rows = np.full((n, A2), C, dtype=np.int32)
+        serve_rows = np.empty((n, A2), dtype=np.int32)
         serve_valid = np.zeros((n, A2), dtype=np.float32)
         serve_slot = np.zeros((n, A2), dtype=np.float32)
         for s in range(n):
             u = len(serve_rows_l[s])
             serve_rows[s, :u] = serve_rows_l[s]
+            fill_oob_pads(serve_rows[s], u, C)
             serve_valid[s, :u] = 1.0
             serve_slot[s, :u] = serve_slot_l[s]
             # pad requests point at the sentinel slot (zero row)
@@ -202,15 +205,15 @@ class ShardedEmbeddingTable:
         return sum(len(ix) for ix in self.indexes)
 
     def _dump(self, path: str, row_filter) -> int:
-        st = jax.device_get(self.state)
+        data = np.asarray(jax.device_get(self.state.data))
         blobs = {}
         total = 0
         for s in range(self.n):
             keys, rows = self.indexes[s].items()
             keys, rows = row_filter(s, keys, rows)
             blobs[f"keys_{s}"] = keys
-            for f, leaf in zip(TableState._fields, st):
-                blobs[f"{f}_{s}"] = np.asarray(leaf)[s][rows]
+            for f in FIELDS:
+                blobs[f"{f}_{s}"] = field_slice(data[s][rows], f)
             total += len(keys)
         np.savez_compressed(path, n=self.n, **blobs)
         self._touched[:] = False
@@ -234,21 +237,22 @@ class ShardedEmbeddingTable:
         blob = np.load(path)
         assert int(blob["n"]) == self.n, "shard count mismatch"
         if merge:
-            leaves = [np.asarray(l).copy()
-                      for l in jax.device_get(self.state)]
+            data = np.asarray(jax.device_get(self.state.data)).copy()
         else:
-            single = init_table_state(self.capacity, self.mf_dim)
-            leaves = [np.broadcast_to(np.asarray(l)[None],
-                                      (self.n,) + l.shape).copy()
-                      for l in single]
+            data = np.zeros(
+                (self.n, self.capacity + 1, NUM_FIXED + self.mf_dim),
+                np.float32)
             self.indexes = [HostKV(self.capacity) for _ in range(self.n)]
             self._touched[:] = False
         total = 0
         for s in range(self.n):
             keys = blob[f"keys_{s}"]
             rows = self.indexes[s].assign(keys)
-            for i, f in enumerate(TableState._fields):
-                leaves[i][s][rows] = blob[f"{f}_{s}"]
+            for f in FIELDS:
+                if f == "embedx_w":
+                    data[s][rows, NUM_FIXED:] = blob[f"{f}_{s}"]
+                else:
+                    data[s][rows, FIELD_COL[f]] = blob[f"{f}_{s}"]
             total += len(keys)
-        self.state = TableState(*[jnp.asarray(l) for l in leaves])
+        self.state = TableState(jnp.asarray(data))
         return total

@@ -1,6 +1,9 @@
 from paddlebox_tpu.train.step import TrainStep, DeviceBatch, make_device_batch
 from paddlebox_tpu.train.trainer import Trainer
 from paddlebox_tpu.train.dense_modes import AsyncDenseTable, KStepParamSync
+from paddlebox_tpu.train.device_pass import (PassPreloader, ResidentPass,
+                                             ResidentPassRunner)
 
 __all__ = ["TrainStep", "DeviceBatch", "make_device_batch", "Trainer",
-           "AsyncDenseTable", "KStepParamSync"]
+           "AsyncDenseTable", "KStepParamSync",
+           "PassPreloader", "ResidentPass", "ResidentPassRunner"]

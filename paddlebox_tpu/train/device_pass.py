@@ -1,0 +1,241 @@
+"""Device-resident pass mode — the pass's batches live in HBM.
+
+Reference architecture: BoxPS stages the PASS into device memory up front
+(``BeginPass`` buffers the pass's embeddings into HBM, box_wrapper.cc:171;
+``PreLoadIntoMemory``/``WaitFeedPassDone`` double-buffer pass k+1's data
+against pass k's training, box_wrapper.h:1142-1156). The per-batch work in
+the CUDA path is then only key-copy + PS lookup.
+
+TPU-native redesign: the same pass-window contract, but the staged object
+is the pass's BATCH DATA — per-key row ids + dense features for every
+batch, uploaded in three bulk transfers — because on TPU the per-batch
+host→device hop is the scarce resource (PCIe/tunnel latency), not HBM.
+The train loop then runs as a ``lax.fori_loop`` ON DEVICE: batch slicing,
+key dedup (ops/device_unique.py), pull, fwd/bwd, push, dense update and
+AUC all inside one XLA program, zero host round-trips per step. The host's
+only per-pass jobs are row assignment (native hash index) and the bulk
+upload — both overlappable with the previous pass via ``PassPreloader``.
+
+Falls back gracefully: anything this mode can't express (per-step dump
+hooks, dynamic NaN aborts mid-pass) still runs via Trainer.train_pass.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.data.dataset import Dataset
+from paddlebox_tpu.ops.device_unique import dedup_rows
+from paddlebox_tpu.train.step import pack_floats, unpack_floats
+from paddlebox_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class ResidentPass:
+    """One pass's batches, packed host-side then staged to HBM.
+
+    Arrays (nb = #batches, K = uniform per-batch key capacity):
+      rows:   int32 [nb, K]      per-key table row; padding → sentinel row
+      floats: f32   [nb, B, D+3] [dense | label | show | clk]
+      meta:   int32 [nb, 2]      [num_keys, pad_segment]
+      segs:   int32 [nb, K] | None   None when every batch has the trivial
+              one-key-per-slot layout (segments derived on device)
+    """
+
+    def __init__(self, rows: np.ndarray, floats: np.ndarray,
+                 meta: np.ndarray, segs: Optional[np.ndarray],
+                 num_records: int) -> None:
+        self.rows = rows
+        self.floats = floats
+        self.meta = meta
+        self.segs = segs
+        self.num_records = num_records
+        self.dev: Optional[Tuple[jax.Array, ...]] = None
+
+    @property
+    def num_batches(self) -> int:
+        return self.rows.shape[0]
+
+    @property
+    def key_capacity(self) -> int:
+        return self.rows.shape[1]
+
+    @classmethod
+    def build(cls, dataset: Dataset, table,
+              floats_dtype=np.float32) -> "ResidentPass":
+        """Pack a dataset's batches; assigns table rows for every key
+        (the FeedPass key registration step, done by the native index).
+
+        ``floats_dtype=jnp.bfloat16`` halves the float block on the wire
+        (dense features, label/show/clk — the latter are small integers,
+        exact in bf16); the step casts back to f32 on device."""
+        rows_l, floats_l, meta_l, segs_l = [], [], [], []
+        trivial = True
+        nrec = 0
+        cap = table.capacity
+        for b in dataset.batches():
+            nk = b.num_keys
+            rk = np.full(b.key_capacity, cap, np.int32)
+            r = table.index.assign(b.keys[:nk])
+            table._touched[r] = True
+            rk[:nk] = r
+            rows_l.append(rk)
+            floats_l.append(pack_floats(b.dense, b.label, b.show, b.clk,
+                                        dtype=floats_dtype))
+            meta_l.append((nk, b.pad_segment))
+            segs_l.append(b.segments.astype(np.int32, copy=False))
+            trivial = trivial and getattr(b, "segments_trivial", False)
+            nrec += int((b.show > 0).sum())
+        if not rows_l:
+            raise ValueError("empty pass")
+        k_max = max(r.shape[0] for r in rows_l)
+        nb = len(rows_l)
+        rows = np.full((nb, k_max), cap, np.int32)
+        segs = np.empty((nb, k_max), np.int32)
+        for i, (r, s, (nk, pad)) in enumerate(zip(rows_l, segs_l, meta_l)):
+            rows[i, :r.shape[0]] = r
+            segs[i, :s.shape[0]] = s
+            segs[i, s.shape[0]:] = pad
+        return cls(rows, np.stack(floats_l), np.asarray(meta_l, np.int32),
+                   None if trivial else segs, nrec)
+
+    def upload(self) -> None:
+        """Stage to HBM — three (four with segs) bulk transfers."""
+        if self.dev is not None:
+            return
+        segs = (jnp.zeros((1, 1), jnp.int32) if self.segs is None
+                else jnp.asarray(self.segs))
+        self.dev = (jnp.asarray(self.rows), jnp.asarray(self.floats),
+                    jnp.asarray(self.meta), segs)
+
+    def nbytes(self) -> int:
+        n = self.rows.nbytes + self.floats.nbytes + self.meta.nbytes
+        return n + (self.segs.nbytes if self.segs is not None else 0)
+
+
+class _BatchView:
+    """Duck-typed DeviceBatch built inside the trace from pass slices."""
+
+    def __init__(self, unique_rows, gather_idx, key_valid, segments,
+                 dense, label, show, clk) -> None:
+        self.unique_rows = unique_rows
+        self.gather_idx = gather_idx
+        self.key_valid = key_valid
+        self.segments = segments
+        self.dense = dense
+        self.label = label
+        self.show = show
+        self.clk = clk
+
+
+class ResidentPassRunner:
+    """jits `chunk` steps of a resident pass as ONE device program
+    (lax.fori_loop over the staged batches)."""
+
+    def __init__(self, step, capacity: int, trivial_segments: bool,
+                 chunk: int = 0) -> None:
+        self.step = step            # TrainStep
+        self.capacity = capacity
+        self.trivial = trivial_segments
+        self.chunk = chunk
+        self._jit: Dict[int, object] = {}  # n_steps → compiled runner
+
+    def _make_view(self, rows, floats, meta, segs) -> _BatchView:
+        k = rows.shape[0]
+        unique_rows, gather_idx = dedup_rows(rows, self.capacity)
+        num_keys, pad_seg = meta[0], meta[1]
+        pos = jnp.arange(k, dtype=jnp.int32)
+        key_valid = (pos < num_keys).astype(jnp.float32)
+        if self.trivial:
+            segments = jnp.where(pos < num_keys, pos, pad_seg)
+        else:
+            segments = segs
+        dense, label, show, clk = unpack_floats(floats)
+        return _BatchView(
+            unique_rows, gather_idx, key_valid, segments,
+            dense=dense, label=label, show=show, clk=clk)
+
+    def _run(self, n_steps: int):
+        if n_steps not in self._jit:
+            def run(state, rows_p, floats_p, meta_p, segs_p, start, rng):
+                def body(i, carry):
+                    state, rng = carry
+                    view = self._make_view(
+                        rows_p[i], floats_p[i], meta_p[i],
+                        segs_p[i % segs_p.shape[0]])
+                    rng_i = jax.random.fold_in(rng, state.step)
+                    state, _ = self.step._step(state, view, rng_i)
+                    return state, rng
+
+                state, _ = jax.lax.fori_loop(
+                    start, start + n_steps, body, (state, rng))
+                return state
+
+            self._jit[n_steps] = jax.jit(run, donate_argnums=(0,))
+        return self._jit[n_steps]
+
+    def run_pass(self, state, rp: ResidentPass, rng: jax.Array,
+                 chunk: Optional[int] = None):
+        """Run every batch of the staged pass; returns the new state."""
+        rp.upload()
+        nb = rp.num_batches
+        c = chunk if chunk is not None else (self.chunk or nb)
+        i = 0
+        while i < nb:
+            n = min(c, nb - i)
+            state = self._run(n)(state, *rp.dev,
+                                 jnp.asarray(i, jnp.int32), rng)
+            i += n
+        return state
+
+
+class PassPreloader:
+    """Double-buffered pass pipeline — preload_into_memory /
+    wait_feed_pass_done (box_wrapper.h:1142-1156) for resident passes:
+    builds + uploads pass k+1 in a background thread while pass k trains."""
+
+    def __init__(self, datasets: Iterator[Dataset], table,
+                 floats_dtype=np.float32) -> None:
+        self._it = iter(datasets)
+        self._table = table
+        self._floats_dtype = floats_dtype
+        self._next: Optional[ResidentPass] = None
+        self._thread: Optional[threading.Thread] = None
+        self._err: Optional[BaseException] = None
+
+    def _load(self, ds: Dataset) -> None:
+        try:
+            rp = ResidentPass.build(ds, self._table,
+                                    floats_dtype=self._floats_dtype)
+            rp.upload()
+            self._next = rp
+        except BaseException as e:  # surfaces on next()
+            self._err = e
+
+    def start_next(self) -> bool:
+        """Kick off background build+upload of the next dataset."""
+        ds = next(self._it, None)
+        if ds is None:
+            return False
+        self._next = None
+        self._thread = threading.Thread(target=self._load, args=(ds,),
+                                        daemon=True)
+        self._thread.start()
+        return True
+
+    def wait(self) -> Optional[ResidentPass]:
+        """Block until the preloaded pass is staged (WaitFeedPassDone)."""
+        if self._thread is None:
+            return None
+        self._thread.join()
+        self._thread = None
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+        return self._next

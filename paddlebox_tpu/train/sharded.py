@@ -27,7 +27,8 @@ from paddlebox_tpu.ops import fused_seqpool_cvm
 from paddlebox_tpu.parallel.mesh import DATA_AXIS
 from paddlebox_tpu.ps.sgd import SparseSGDConfig
 from paddlebox_tpu.ps.sharded import ShardedEmbeddingTable, ShardedPullIndex
-from paddlebox_tpu.ps.table import TableState, apply_push, pull_rows
+from paddlebox_tpu.ps.table import (TableState, apply_push,
+                                    gather_full_rows, pull_values)
 
 
 class GlobalBatch(NamedTuple):
@@ -130,7 +131,7 @@ class ShardedTrainStep:
         shard0 = P(DATA_AXIS)
         rep = P()
         state_spec = ShardedStepState(
-            table=TableState(*([shard0] * len(TableState._fields))),
+            table=TableState(shard0),  # one AoS leaf [N, C+1, F]
             params=rep, opt_state=(shard0 if zero1 else rep),
             auc=AucState(*([shard0] * len(AucState._fields))),
             step=rep)
@@ -188,7 +189,9 @@ class ShardedTrainStep:
         d = 3 + table.mf_dim
 
         # ---- pull: serve my rows, exchange, reassemble ----
-        serve_vals = pull_rows(table, serve_rows)          # [A2, D]
+        # one AoS gather serves the pull AND the push optimizer state
+        rows_full = gather_full_rows(table, serve_rows)    # [A2, F]
+        serve_vals = pull_values(rows_full)                # [A2, D]
         resp = serve_vals[resp_idx]                        # [N, A, D]
         recv = jax.lax.all_to_all(resp, DATA_AXIS, 0, 0, tiled=True)
         vals_flat = recv.reshape(n * a, d)
@@ -222,7 +225,8 @@ class ShardedTrainStep:
             [g_serve[:, :2], g_serve[:, 2:] * (-1.0 * b * n)], axis=1)
         touched = serve_valid > 0
         table = apply_push(table, serve_rows, gb, touched, serve_slot,
-                           self.sgd_cfg, jax.random.fold_in(rng, me))
+                           self.sgd_cfg, jax.random.fold_in(rng, me),
+                           rows_full=rows_full)
 
         # ---- dense sync ----
         if self.zero1:

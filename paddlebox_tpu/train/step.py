@@ -25,7 +25,25 @@ from paddlebox_tpu.metrics import AucState, auc_add_batch
 from paddlebox_tpu.ops import fused_seqpool_cvm
 from paddlebox_tpu.ps.sgd import SparseSGDConfig
 from paddlebox_tpu.ps.table import (PullIndex, TableState, apply_push,
-                                    expand_pull, pull_rows, push_stats)
+                                    expand_pull, gather_full_rows,
+                                    pull_values, push_stats)
+
+
+def pack_floats(dense: np.ndarray, label: np.ndarray, show: np.ndarray,
+                clk: np.ndarray, dtype=np.float32) -> np.ndarray:
+    """THE float-block wire layout, [B, Dd+3] = [dense | label, show, clk].
+    Single definition shared by the streaming path (make_device_batch) and
+    the resident-pass packer; unpacked only by ``unpack_floats``."""
+    return np.concatenate(
+        [dense.astype(np.float32, copy=False),
+         np.stack([label, show, clk], axis=1)],
+        axis=1).astype(dtype, copy=False)
+
+
+def unpack_floats(floats: jax.Array):
+    """(dense, label, show, clk) views of a pack_floats block (traced)."""
+    floats = floats.astype(jnp.float32)  # no-op for f32, upcast bf16 wire
+    return floats[:, :-3], floats[:, -3], floats[:, -2], floats[:, -1]
 
 
 class DeviceBatch(NamedTuple):
@@ -72,19 +90,19 @@ class DeviceBatch(NamedTuple):
 
     @property
     def dense(self) -> jax.Array:
-        return self.floats[:, :-3]
+        return unpack_floats(self.floats)[0]
 
     @property
     def label(self) -> jax.Array:
-        return self.floats[:, -3]
+        return unpack_floats(self.floats)[1]
 
     @property
     def show(self) -> jax.Array:
-        return self.floats[:, -2]
+        return unpack_floats(self.floats)[2]
 
     @property
     def clk(self) -> jax.Array:
-        return self.floats[:, -1]
+        return unpack_floats(self.floats)[3]
 
 
 def make_device_batch(batch: SlotBatch, idx: PullIndex) -> DeviceBatch:
@@ -97,9 +115,7 @@ def make_device_batch(batch: SlotBatch, idx: PullIndex) -> DeviceBatch:
         ints_k = np.ascontiguousarray(idx.gather_idx[None, :])
     else:
         ints_k = np.stack([idx.gather_idx, batch.segments.astype(np.int32)])
-    floats = np.concatenate(
-        [batch.dense.astype(np.float32, copy=False),
-         np.stack([batch.label, batch.show, batch.clk], axis=1)], axis=1)
+    floats = pack_floats(batch.dense, batch.label, batch.show, batch.clk)
     return DeviceBatch(ints_u=jnp.asarray(ints_u),
                        ints_k=jnp.asarray(ints_k),
                        floats=jnp.asarray(floats))
@@ -162,7 +178,10 @@ class TrainStep:
         batch_show_clk = jnp.stack([batch.show, batch.clk], axis=1)
         ins_w = (batch.show > 0).astype(jnp.float32)  # mask tail padding
 
-        vals_u = pull_rows(state.table, batch.unique_rows)
+        # ONE gather serves both the pull values and the push optimizer
+        # state (AoS rows — see TableState)
+        rows_full = gather_full_rows(state.table, batch.unique_rows)
+        vals_u = pull_values(rows_full)
 
         def loss_fn(params, vals_u):
             values_k = expand_pull(vals_u, batch.gather_idx)
@@ -191,7 +210,8 @@ class TrainStep:
             batch.gather_idx, batch.key_valid, slot_of_key,
             batch.unique_rows.shape[0])
         table = apply_push(state.table, batch.unique_rows, g_vals_u,
-                           touched, slot_val, self.sgd_cfg, rng)
+                           touched, slot_val, self.sgd_cfg, rng,
+                           rows_full=rows_full)
 
         updates, opt_state = self.tx.update(g_params, state.opt_state,
                                             state.params)
@@ -216,7 +236,7 @@ class TrainStep:
         """Shared inference path: pull → seqpool_cvm → model → pred."""
         b, s = self.batch_size, self.num_slots
         batch_show_clk = jnp.stack([batch.show, batch.clk], axis=1)
-        vals_u = pull_rows(table, batch.unique_rows)
+        vals_u = pull_values(gather_full_rows(table, batch.unique_rows))
         values_k = expand_pull(vals_u, batch.gather_idx)
         pooled = fused_seqpool_cvm(
             values_k, batch.segments, batch_show_clk, b, s,

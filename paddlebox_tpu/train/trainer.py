@@ -72,6 +72,7 @@ class Trainer:
         self._rng = jax.random.PRNGKey(seed + 1)
         self.global_step = 0
         self._dump_cfg = None
+        self._resident_runners: Dict[Any, Any] = {}
 
     # ---- host-side prefetch: batch build + dedup + row assign + H2D ----
     def _prefetch_iter(
@@ -112,7 +113,9 @@ class Trainer:
         if self._dump_cfg is not None:
             from paddlebox_tpu.utils.dump import DumpWriter
             dump_writer = DumpWriter(self._dump_cfg)
+        n_ex = 0
         for batch, dev in self._prefetch_iter(dataset.batches()):
+            n_ex += int((batch.show > 0).sum())
             self.global_step += 1
             rng = jax.random.fold_in(self._rng, self.global_step)
             self.state, stats = self.step_fn(self.state, dev, rng)
@@ -139,13 +142,52 @@ class Trainer:
         timer.pause()
         self.sync_table()
         res = auc_compute(self.state.auc)
-        ex = res.ins_num
         out = res.as_dict()
+        # ex/s counts THIS pass's instances (res.ins_num is cumulative
+        # across passes until reset_metrics, like the reference registry)
         out.update(batches=nb, elapsed_sec=timer.elapsed_sec(),
-                   examples_per_sec=ex / max(timer.elapsed_sec(), 1e-9),
+                   examples_per_sec=n_ex / max(timer.elapsed_sec(), 1e-9),
                    last_loss=last_loss)
         log.info("%spass done: %d batches, %.0f ex/s, auc=%.4f",
                  log_prefix, nb, out["examples_per_sec"], res.auc)
+        return out
+
+    def train_pass_resident(self, pass_or_dataset,
+                            log_prefix: str = "") -> Dict[str, float]:
+        """One pass in device-resident mode (train/device_pass.py): the
+        pass's batches are staged to HBM in bulk and the whole loop runs
+        on device via lax.fori_loop — zero per-batch host→device hops.
+        Accepts a Dataset (built+uploaded inline) or a prebuilt
+        ResidentPass (e.g. from PassPreloader double-buffering)."""
+        from paddlebox_tpu.train.device_pass import (ResidentPass,
+                                                     ResidentPassRunner)
+        timer = Timer()
+        timer.start()
+        rp = (pass_or_dataset if isinstance(pass_or_dataset, ResidentPass)
+              else ResidentPass.build(pass_or_dataset, self.table))
+        trivial = rp.segs is None
+        key = (rp.key_capacity, trivial)
+        runner = self._resident_runners.get(key)
+        if runner is None:
+            runner = ResidentPassRunner(self.step_fn, self.table.capacity,
+                                        trivial)
+            self._resident_runners[key] = runner
+        self.state = runner.run_pass(self.state, rp, self._rng)
+        jax.block_until_ready(self.state.step)
+        self.global_step += rp.num_batches
+        timer.pause()
+        self.sync_table()
+        res = auc_compute(self.state.auc)
+        out = res.as_dict()
+        out.update(batches=rp.num_batches, elapsed_sec=timer.elapsed_sec(),
+                   examples_per_sec=rp.num_records /
+                   max(timer.elapsed_sec(), 1e-9))
+        if FLAGS.check_nan_inf and math.isnan(out.get("auc", 0.0)):
+            raise NanInfError(f"nan metrics after resident pass "
+                              f"at step {self.global_step}")
+        log.info("%sresident pass done: %d batches, %.0f ex/s, auc=%.4f",
+                 log_prefix, rp.num_batches, out["examples_per_sec"],
+                 res.auc)
         return out
 
     def eval_pass(self, dataset: Dataset,

@@ -1,0 +1,136 @@
+"""Device-resident pass mode: on-device dedup correctness and equivalence
+with the streaming (per-batch H2D) trainer path."""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.data import DataFeedDesc, DatasetFactory
+from paddlebox_tpu.data.criteo import generate_criteo_files
+from paddlebox_tpu.models import DeepFM
+from paddlebox_tpu.ops.device_unique import dedup_rows
+from paddlebox_tpu.ps import EmbeddingTable, SparseSGDConfig
+from paddlebox_tpu.train import PassPreloader, ResidentPass, Trainer
+
+
+def test_dedup_rows_matches_numpy():
+    rng = np.random.default_rng(0)
+    cap = 500
+    for trial in range(5):
+        rows = rng.integers(0, cap, size=300).astype(np.int32)
+        rows[rng.random(300) < 0.1] = cap  # sentinel (invalid keys)
+        uniq, gidx = jax.jit(dedup_rows, static_argnums=1)(
+            jnp.asarray(rows), cap)
+        uniq, gidx = np.asarray(uniq), np.asarray(gidx)
+        # expansion reconstructs every key's row
+        np.testing.assert_array_equal(uniq[gidx], rows)
+        ref = np.unique(rows)
+        u = len(ref)
+        np.testing.assert_array_equal(uniq[:u], ref)  # ascending, compact
+        assert (uniq[u:] > cap).all()         # OOB pads (gathers clamp,
+        assert len(set(uniq.tolist())) == len(uniq)  # scatters drop, unique
+
+
+def test_dedup_rows_all_sentinel():
+    cap = 64
+    rows = jnp.full(16, cap, jnp.int32)
+    uniq, gidx = dedup_rows(rows, cap)
+    assert int(uniq[0]) == cap and (np.asarray(gidx) == 0).all()
+
+
+@pytest.fixture(scope="module")
+def criteo_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("criteo_dp")
+    return generate_criteo_files(str(d), num_files=2, rows_per_file=1500,
+                                 vocab_per_slot=40, seed=11)
+
+
+def _make(files, bs=128):
+    desc = DataFeedDesc.criteo(batch_size=bs)
+    desc.key_bucket_min = 4096
+    ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+    ds.set_filelist(files)
+    ds.set_thread(2)
+    ds.load_into_memory()
+    # mf_initial_range=0 → no rng in lazy-mf init, so the streaming and
+    # resident paths are numerically comparable
+    cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=0.0,
+                          learning_rate=0.05, mf_learning_rate=0.05)
+    table = EmbeddingTable(mf_dim=4, capacity=1 << 13, cfg=cfg,
+                           unique_bucket_min=4096)
+    tr = Trainer(DeepFM(hidden=(16, 8)), table, desc, tx=optax.adam(1e-2),
+                 seed=3)
+    return tr, ds
+
+
+def test_resident_matches_streaming(criteo_files):
+    tr_a, ds = _make(criteo_files)
+    tr_b, _ = _make(criteo_files)
+    ra = [tr_a.train_pass(ds) for _ in range(2)][-1]
+    rb = [tr_b.train_pass_resident(ds) for _ in range(2)][-1]
+    assert rb["batches"] == ra["batches"]
+    assert tr_b.table.feature_count == tr_a.table.feature_count
+    assert np.isclose(rb["auc"], ra["auc"], atol=2e-3)
+    # dense params track closely (order-of-reduction float drift only)
+    pa = jax.tree.leaves(tr_a.state.params)
+    pb = jax.tree.leaves(tr_b.state.params)
+    for a, b in zip(pa, pb):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-2, atol=2e-3)
+    # sparse table rows agree for the keys both saw
+    keys, rows_a = tr_a.table.index.items()
+    rows_b = tr_b.table.index.lookup(keys)
+    st_a = jax.device_get(tr_a.state.table)
+    st_b = jax.device_get(tr_b.state.table)
+    np.testing.assert_allclose(np.asarray(st_a.embed_w)[rows_a],
+                               np.asarray(st_b.embed_w)[rows_b],
+                               rtol=2e-2, atol=2e-3)
+
+
+def test_resident_learns(criteo_files):
+    tr, ds = _make(criteo_files)
+    first = tr.train_pass_resident(ds)
+    tr.reset_metrics()
+    for _ in range(3):
+        last = tr.train_pass_resident(ds)
+    assert last["auc"] > max(first["auc"], 0.55)
+    assert np.isfinite(last["auc"])
+
+
+def test_resident_chunked_equals_whole(criteo_files):
+    tr_a, ds = _make(criteo_files)
+    tr_b, _ = _make(criteo_files)
+    rp_a = ResidentPass.build(ds, tr_a.table)
+    tr_a.train_pass_resident(rp_a)
+    from paddlebox_tpu.train.device_pass import ResidentPassRunner
+    rp_b = ResidentPass.build(ds, tr_b.table)
+    runner = ResidentPassRunner(tr_b.step_fn, tr_b.table.capacity,
+                                rp_b.segs is None, chunk=3)
+    tr_b.state = runner.run_pass(tr_b.state, rp_b, tr_b._rng)
+    tr_b.sync_table()
+    pa = jax.tree.leaves(tr_a.state.params)
+    pb = jax.tree.leaves(tr_b.state.params)
+    for a, b in zip(pa, pb):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_pass_preloader(criteo_files):
+    tr, ds = _make(criteo_files)
+    datasets = iter([ds, ds, ds])
+    pre = PassPreloader(datasets, tr.table)
+    assert pre.start_next()
+    results = []
+    while True:
+        rp = pre.wait()
+        if rp is None:
+            break
+        has_more = pre.start_next()  # overlap next build with training
+        results.append(tr.train_pass_resident(rp))
+        if not has_more:
+            break
+    assert len(results) == 3
+    assert all(np.isfinite(r["auc"]) for r in results)

@@ -147,3 +147,21 @@ def test_lookup_unique_miss_collapse():
     # all-miss batch
     r3, inv3 = nat.lookup_unique(np.array([777, 888], np.uint64), sent)
     assert len(r3) == 1 and r3[0] == sent and (inv3 == 0).all()
+
+
+def test_pykv_lookup_unique_miss_collapse():
+    """Python fallback must honor the same miss-collapse contract as the
+    native index (duplicate-free unique rows for the scatter promise)."""
+    py = PyKV(64)
+    py.assign(np.array([10, 20, 30], np.uint64))
+    probe = np.array([20, 555, 10, 666, 20, 555], np.uint64)
+    r, inv = py.lookup_unique(probe, 9999)
+    assert (r == 9999).sum() == 1            # one shared sentinel entry
+    assert len(set(r.tolist())) == len(r)    # duplicate-free
+    got = r[inv]
+    assert (got[[1, 3, 5]] == 9999).all()
+    np.testing.assert_array_equal(
+        got[[0, 2, 4]], py.lookup(np.array([20, 10, 20], np.uint64)))
+    # all-miss batch
+    r2, inv2 = py.lookup_unique(np.array([777, 888], np.uint64), 9999)
+    assert len(r2) == 1 and r2[0] == 9999 and (inv2 == 0).all()

@@ -157,3 +157,27 @@ def test_table_pull_push_with_pallas_flags():
     v1, p1 = run(use_pallas_gather=True, use_pallas_scatter=True)
     np.testing.assert_allclose(v0, v1, rtol=1e-6)
     np.testing.assert_allclose(p0, p1, rtol=1e-6)
+
+
+def test_dma_kernels_interpret_semantics():
+    """gather_rows_dma / scatter_rows_dma (interpret mode off-TPU):
+    OOB rows clamp to the sentinel; scatter is in-place on unique rows."""
+    import jax.numpy as jnp
+    from paddlebox_tpu.ops.pallas_kernels import (gather_rows_dma,
+                                                  scatter_rows_dma)
+    C, D, K = 64, 16, 32
+    rng = np.random.default_rng(0)
+    table = jnp.zeros((C + 1, D), jnp.float32)
+    uq = np.unique(rng.integers(0, C, size=K).astype(np.int32))
+    rows = np.concatenate([uq, C + 1 + np.arange(K - len(uq),
+                                                 dtype=np.int32)])
+    vals = rng.normal(size=(K, D)).astype(np.float32)
+    out = np.asarray(scatter_rows_dma(table, jnp.asarray(rows),
+                                      jnp.asarray(vals)))
+    ref = np.zeros((C + 1, D), np.float32)
+    ref[uq] = vals[:len(uq)]
+    np.testing.assert_allclose(out[:C], ref[:C])  # row C is the racy pad bin
+    got = np.asarray(gather_rows_dma(jnp.asarray(out).at[C].set(0.0),
+                                     jnp.asarray(rows)))
+    np.testing.assert_allclose(got[:len(uq)], vals[:len(uq)])
+    np.testing.assert_allclose(got[len(uq):], 0.0)  # OOB → sentinel zeros

@@ -78,13 +78,13 @@ def test_sharded_pull_matches_single_table(mesh):
                                   serve_bucket_min=8)
     batches = make_batches(N, seed=3)
     idx = table.prepare_global(batches)
-    # plant distinctive embed_w = key value into each shard
-    st = [np.asarray(l).copy() for l in jax.device_get(table.state)]
-    fieldi = list(type(table.state)._fields).index("embed_w")
+    # plant distinctive embed_w = key value into each shard (AoS col 4)
+    from paddlebox_tpu.ps.table import FIELD_COL
+    data = np.asarray(jax.device_get(table.state.data)).copy()
     for s in range(N):
         keys, rows = table.indexes[s].items()
-        st[fieldi][s][rows] = keys.astype(np.float32)
-    table.state = type(table.state)(*[jnp.asarray(l) for l in st])
+        data[s][rows, FIELD_COL["embed_w"]] = keys.astype(np.float32)
+    table.state = type(table.state)(jnp.asarray(data))
 
     gb = make_global_batch(batches, idx)
     from jax.sharding import PartitionSpec as P
@@ -101,7 +101,7 @@ def test_sharded_pull_matches_single_table(mesh):
 
     f = jax.jit(jax.shard_map(
         pull_blk, mesh=mesh,
-        in_specs=(TableState(*([P(DATA_AXIS)] * 9)), P(DATA_AXIS),
+        in_specs=(TableState(P(DATA_AXIS)), P(DATA_AXIS),
                   P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=P(DATA_AXIS), check_vma=False))
     got = np.asarray(f(table.state, gb.resp_idx, gb.serve_rows,
@@ -147,12 +147,12 @@ def test_sharded_save_load_roundtrip(mesh, tmp_path):
                                   serve_bucket_min=8)
     batches = make_batches(N, seed=5)
     table.prepare_global(batches)
-    st = [np.asarray(l).copy() for l in jax.device_get(table.state)]
-    fieldi = list(type(table.state)._fields).index("embed_w")
+    from paddlebox_tpu.ps.table import FIELD_COL
+    data = np.asarray(jax.device_get(table.state.data)).copy()
     for s in range(N):
         keys, rows = table.indexes[s].items()
-        st[fieldi][s][rows] = keys.astype(np.float32) * 2
-    table.state = type(table.state)(*[jnp.asarray(l) for l in st])
+        data[s][rows, FIELD_COL["embed_w"]] = keys.astype(np.float32) * 2
+    table.state = type(table.state)(jnp.asarray(data))
     path = str(tmp_path / "sharded.npz")
     n_saved = table.save_base(path)
     assert n_saved == table.feature_count() > 0
@@ -184,10 +184,10 @@ def test_sharded_save_delta_and_reset_load(mesh, tmp_path):
     nd = table.save_delta(delta)
     assert 0 < nd <= table.feature_count()
     # plant junk in a row, then reset-load the base: junk must be gone
-    st = [np.asarray(l).copy() for l in jax.device_get(table.state)]
-    fi = list(type(table.state)._fields).index("embed_w")
-    st[fi][0][:] = 99.0
-    table.state = type(table.state)(*[jnp.asarray(l) for l in st])
+    from paddlebox_tpu.ps.table import FIELD_COL
+    data = np.asarray(jax.device_get(table.state.data)).copy()
+    data[0][:, FIELD_COL["embed_w"]] = 99.0
+    table.state = type(table.state)(jnp.asarray(data))
     got = table.load(base)  # merge=False resets everything first
     assert got == n1
     w0 = np.asarray(table.state.embed_w)[0]
