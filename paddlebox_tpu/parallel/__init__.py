@@ -7,10 +7,16 @@ from paddlebox_tpu.parallel.moe import (
     moe_forward_local, moe_forward_sharded, naive_gating, top1_gating,
     top2_gating,
 )
+from paddlebox_tpu.parallel.ring_attention import (
+    make_context_parallel_attention, reference_attention, ring_attention,
+    ulysses_attention,
+)
 
 __all__ = [
     "make_mesh", "data_axis_size", "vocab_parallel_embedding",
     "column_parallel_linear", "row_parallel_linear", "pipeline_run",
     "moe_forward_local", "moe_forward_sharded", "naive_gating",
     "top1_gating", "top2_gating",
+    "make_context_parallel_attention", "reference_attention",
+    "ring_attention", "ulysses_attention",
 ]

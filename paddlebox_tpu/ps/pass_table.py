@@ -28,8 +28,8 @@ from paddlebox_tpu.config import FLAGS
 from paddlebox_tpu.ps.host_store import FIELDS, HostStore
 from paddlebox_tpu.ps.kv import make_kv
 from paddlebox_tpu.ps.sgd import SparseSGDConfig
-from paddlebox_tpu.ps.table import (FIELD_COL, NUM_FIXED, EmbeddingTable,
-                                    TableState)
+from paddlebox_tpu.ps.table import (NUM_FIXED, EmbeddingTable, TableState,
+                                    field_assign)
 from paddlebox_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -131,10 +131,7 @@ class PassScopedTable(EmbeddingTable):
         c1 = self.capacity + 1
         data = np.zeros((c1, NUM_FIXED + self.mf_dim), np.float32)
         for f in FIELDS:
-            if f == "embedx_w":
-                data[rows, NUM_FIXED:] = st.values[f]
-            else:
-                data[rows, FIELD_COL[f]] = st.values[f]
+            field_assign(data, rows, f, st.values[f])
         self.state = TableState(jax.device_put(data))
         self._touched[:] = False
         self.in_pass = True

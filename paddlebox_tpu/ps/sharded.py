@@ -46,8 +46,8 @@ import numpy as np
 from paddlebox_tpu.config import FLAGS
 from paddlebox_tpu.data.batch import SlotBatch
 from paddlebox_tpu.ps.sgd import SparseSGDConfig
-from paddlebox_tpu.ps.table import (FIELD_COL, FIELDS, NUM_FIXED, HostKV,
-                                    TableState, field_slice,
+from paddlebox_tpu.ps.table import (FIELDS, NUM_FIXED, HostKV, TableState,
+                                    field_assign, field_slice,
                                     fill_oob_pads, init_table_state)
 from paddlebox_tpu.utils.logging import get_logger
 
@@ -249,10 +249,7 @@ class ShardedEmbeddingTable:
             keys = blob[f"keys_{s}"]
             rows = self.indexes[s].assign(keys)
             for f in FIELDS:
-                if f == "embedx_w":
-                    data[s][rows, NUM_FIXED:] = blob[f"{f}_{s}"]
-                else:
-                    data[s][rows, FIELD_COL[f]] = blob[f"{f}_{s}"]
+                field_assign(data[s], rows, f, blob[f"{f}_{s}"])
             total += len(keys)
         self.state = TableState(jnp.asarray(data))
         return total

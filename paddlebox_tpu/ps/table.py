@@ -24,6 +24,7 @@ dynamic hashtable) maps to host-index growth + static device capacity
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Dict, NamedTuple, Optional, Tuple
 
 import jax
@@ -129,6 +130,16 @@ def field_slice(data, name: str):
     if name == "embedx_w":
         return data[..., NUM_FIXED:]
     return data[..., FIELD_COL[name]]
+
+
+def field_assign(data: np.ndarray, rows: np.ndarray, name: str,
+                 values: np.ndarray) -> None:
+    """Write counterpart of field_slice: data[rows, <field cols>] = values.
+    The single place that knows which fields are the embedx block."""
+    if name == "embedx_w":
+        data[rows, NUM_FIXED:] = values
+    else:
+        data[rows, FIELD_COL[name]] = values
 
 
 def fill_oob_pads(unique_rows: np.ndarray, u: int, capacity: int) -> None:
@@ -282,6 +293,9 @@ class EmbeddingTable:
         self._push_count = 0
         self.unique_bucket_min = unique_bucket_min
         self._touched = np.zeros(self.capacity + 1, dtype=bool)
+        # serializes host-side index/touched mutation across threads
+        # (prefetch prepare, ResidentPass.build preload, shrink/save/load)
+        self.host_lock = threading.Lock()
 
     # ---- per-batch host prep (dedup + row assignment) ----
     def _build_index(self, batch: SlotBatch, rows: np.ndarray,
@@ -308,15 +322,17 @@ class EmbeddingTable:
 
     def prepare(self, batch: SlotBatch) -> PullIndex:
         valid = batch.keys[:batch.num_keys]
-        rows, inv = self.index.assign_unique(valid)
-        self._touched[rows] = True
+        with self.host_lock:
+            rows, inv = self.index.assign_unique(valid)
+            self._touched[rows] = True
         return self._build_index(batch, rows, inv)
 
     def prepare_eval(self, batch: SlotBatch) -> PullIndex:
         """Read-only prepare: unknown keys map to the zero sentinel row
         instead of allocating (inference path — no index mutation)."""
         valid = batch.keys[:batch.num_keys]
-        rows, inv = self.index.lookup_unique(valid, self.capacity)
+        with self.host_lock:
+            rows, inv = self.index.lookup_unique(valid, self.capacity)
         return self._build_index(batch, rows, inv)
 
     def next_rng(self) -> jax.Array:
@@ -350,7 +366,8 @@ class EmbeddingTable:
 
     def save_base(self, path: str) -> int:
         """Full model dump (day-level batch model). Returns rows saved."""
-        keys, rows = self.index.items()
+        with self.host_lock:
+            keys, rows = self.index.items()
         data = self._gather_host(rows)
         np.savez_compressed(path, keys=keys, **data)
         self._touched[:] = False
@@ -358,8 +375,9 @@ class EmbeddingTable:
 
     def save_delta(self, path: str) -> int:
         """Incremental dump of rows touched since last save ("xbox delta")."""
-        keys, rows = self.index.items()
-        mask = self._touched[rows]
+        with self.host_lock:
+            keys, rows = self.index.items()
+            mask = self._touched[rows]
         keys, rows = keys[mask], rows[mask]
         data = self._gather_host(rows)
         np.savez_compressed(path, keys=keys, **data)
@@ -371,17 +389,15 @@ class EmbeddingTable:
         (delta apply), else resets the table first."""
         blob = np.load(path)
         keys = blob["keys"]
-        if not merge:
-            self.index = HostKV(self.capacity)
-            self.state = init_table_state(self.capacity, self.mf_dim)
-            self._touched[:] = False
-        rows = self.index.assign(keys)
+        with self.host_lock:
+            if not merge:
+                self.index = HostKV(self.capacity)
+                self.state = init_table_state(self.capacity, self.mf_dim)
+                self._touched[:] = False
+            rows = self.index.assign(keys)
         data = np.asarray(jax.device_get(self.state.data)).copy()
         for f in FIELDS:
-            if f == "embedx_w":
-                data[rows, NUM_FIXED:] = blob[f]
-            else:
-                data[rows, FIELD_COL[f]] = blob[f]
+            field_assign(data, rows, f, blob[f])
         self.state = TableState(jnp.asarray(data))
         return len(keys)
 
@@ -393,20 +409,21 @@ class EmbeddingTable:
         thr = (FLAGS.shrink_delete_threshold
                if delete_threshold is None else delete_threshold)
         dk = FLAGS.show_click_decay_rate if decay is None else decay
-        keys, rows = self.index.items()
-        if len(keys) == 0:
-            return 0
-        data = np.asarray(jax.device_get(self.state.data)).copy()
-        data[:, 0:3] *= dk  # decay show/clk/delta_score
-        show, clk = data[rows, 0], data[rows, 1]
-        score = (self.cfg.nonclk_coeff * (show - clk)
-                 + self.cfg.clk_coeff * clk)
-        drop = score < thr
-        drop_keys = keys[drop]
-        freed_rows = self.index.release(drop_keys)
-        data[freed_rows] = 0.0
-        self.state = TableState(jnp.asarray(data))
-        self._touched[freed_rows] = False
+        with self.host_lock:
+            keys, rows = self.index.items()
+            if len(keys) == 0:
+                return 0
+            data = np.asarray(jax.device_get(self.state.data)).copy()
+            data[:, 0:3] *= dk  # decay show/clk/delta_score
+            show, clk = data[rows, 0], data[rows, 1]
+            score = (self.cfg.nonclk_coeff * (show - clk)
+                     + self.cfg.clk_coeff * clk)
+            drop = score < thr
+            drop_keys = keys[drop]
+            freed_rows = self.index.release(drop_keys)
+            data[freed_rows] = 0.0
+            self.state = TableState(jnp.asarray(data))
+            self._touched[freed_rows] = False
         log.info("shrink: freed %d/%d rows", len(freed_rows), len(keys))
         return int(len(freed_rows))
 

@@ -82,8 +82,9 @@ class ResidentPass:
         for b in dataset.batches():
             nk = b.num_keys
             rk = np.full(b.key_capacity, cap, np.int32)
-            r = table.index.assign(b.keys[:nk])
-            table._touched[r] = True
+            with table.host_lock:  # vs shrink/save on the main thread
+                r = table.index.assign(b.keys[:nk])
+                table._touched[r] = True
             rk[:nk] = r
             rows_l.append(rk)
             floats_l.append(pack_floats(b.dense, b.label, b.show, b.clk,
@@ -97,13 +98,17 @@ class ResidentPass:
         k_max = max(r.shape[0] for r in rows_l)
         nb = len(rows_l)
         rows = np.full((nb, k_max), cap, np.int32)
-        segs = np.empty((nb, k_max), np.int32)
-        for i, (r, s, (nk, pad)) in enumerate(zip(rows_l, segs_l, meta_l)):
+        for i, r in enumerate(rows_l):
             rows[i, :r.shape[0]] = r
-            segs[i, :s.shape[0]] = s
-            segs[i, s.shape[0]:] = pad
+        if trivial:
+            segs = None  # derived on device — skip the [nb, k_max] copy
+        else:
+            segs = np.empty((nb, k_max), np.int32)
+            for i, (s, (nk, pad)) in enumerate(zip(segs_l, meta_l)):
+                segs[i, :s.shape[0]] = s
+                segs[i, s.shape[0]:] = pad
         return cls(rows, np.stack(floats_l), np.asarray(meta_l, np.int32),
-                   None if trivial else segs, nrec)
+                   segs, nrec)
 
     def upload(self) -> None:
         """Stage to HBM — three (four with segs) bulk transfers."""

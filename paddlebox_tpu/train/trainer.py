@@ -158,9 +158,23 @@ class Trainer:
         pass's batches are staged to HBM in bulk and the whole loop runs
         on device via lax.fori_loop — zero per-batch host→device hops.
         Accepts a Dataset (built+uploaded inline) or a prebuilt
-        ResidentPass (e.g. from PassPreloader double-buffering)."""
+        ResidentPass (e.g. from PassPreloader double-buffering).
+
+        Per-sample dumps need host visibility of every batch, which this
+        mode gives up by design — with a dump configured, fall back to
+        the streaming pass (for a prebuilt ResidentPass that is
+        impossible, so raise instead of silently writing no dump)."""
         from paddlebox_tpu.train.device_pass import (ResidentPass,
                                                      ResidentPassRunner)
+        if self._dump_cfg is not None:
+            if isinstance(pass_or_dataset, ResidentPass):
+                raise ValueError(
+                    "dump is configured (set_dump) but a prebuilt "
+                    "ResidentPass has no host-side batches to dump — "
+                    "pass the Dataset, or set_dump(None)")
+            log.warning("dump configured: falling back to streaming "
+                        "train_pass for this pass")
+            return self.train_pass(pass_or_dataset, log_prefix)
         timer = Timer()
         timer.start()
         rp = (pass_or_dataset if isinstance(pass_or_dataset, ResidentPass)
