@@ -43,6 +43,9 @@ class Flags:
     shuffle_thread_num: int = 8
     read_thread_num: int = 8
     channel_capacity: int = 65536
+    # native C++ file→columnar parse fast path (data/parser.py,
+    # native/slot_parser.cpp); falls back to per-line python parsing
+    native_parse: bool = True
 
     # --- trainer (reference: boxps_worker.cc) ---
     check_nan_inf: bool = False

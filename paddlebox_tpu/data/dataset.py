@@ -133,6 +133,12 @@ class InMemoryDataset(Dataset):
     def load_into_memory(self) -> None:
         if not self.filelist:
             raise ValueError("set_filelist first")
+        # native columnar fast path: only for the plain in-memory dataset —
+        # subclasses (PaddleBoxDataset) run record-level pass protocols
+        # (global shuffle / key merge) that need SlotRecord objects
+        if (FLAGS.native_parse and type(self) is InMemoryDataset
+                and self._load_columnar_native()):
+            return
         ch: Channel[SlotRecord] = Channel(capacity=FLAGS.channel_capacity)
         group = self._read_files_into(self.filelist, ch, self.thread_num)
 
@@ -148,9 +154,48 @@ class InMemoryDataset(Dataset):
         log.info("loaded %d records from %d files",
                  len(self.records), len(self.filelist))
 
+    def _load_columnar_native(self) -> bool:
+        """Native bulk parse: file bytes → columnar arrays per file (C++,
+        GIL released during the ctypes call so files parse in parallel),
+        concatenated straight into the ColumnarRecords store — the whole
+        per-record python layer is skipped. Returns False when the parser
+        has no native fast path (per-line fallback runs instead)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from paddlebox_tpu.data.columnar import ColumnarRecords
+        parser = get_parser(self.desc)
+        probe = parser.parse_file_columnar(self.filelist[0])
+        if probe is None:
+            return False
+        rest = self.filelist[1:]
+        with ThreadPoolExecutor(max(1, self.thread_num)) as ex:
+            chunks = [probe] + list(ex.map(parser.parse_file_columnar, rest))
+        n_rec = sum(len(c["label"]) for c in chunks)
+        offsets = np.zeros(n_rec + 1, np.int64)
+        pos, kpos = 0, 0
+        for c in chunks:
+            m = len(c["label"])
+            offsets[pos + 1:pos + m + 1] = c["offsets"][1:] + kpos
+            pos += m
+            kpos += int(c["offsets"][-1])
+        cat = lambda f: (np.concatenate([c[f] for c in chunks]) if chunks
+                         else np.empty(0))
+        self.columnar = ColumnarRecords(
+            keys=cat("keys"), key_slot=cat("key_slot"), offsets=offsets,
+            dense=cat("dense"), label=cat("label"), show=cat("show"),
+            clk=cat("clk"))
+        self.records = []
+        self._pass_keys = None
+        stat_add("records_parsed", n_rec)
+        log.info("native-parsed %d records from %d files (columnar)",
+                 n_rec, len(self.filelist))
+        return True
+
     def columnarize(self, release_records: bool = True) -> None:
         """Convert the loaded pass to the columnar store (data/columnar.py)
         for vectorized batch building; amortized once per pass."""
+        if self.columnar is not None and not self.records:
+            return  # already columnar (native load path)
         from paddlebox_tpu.data.columnar import ColumnarRecords
         self.columnar = ColumnarRecords.from_records(
             self.records, self.desc.dense_dim)

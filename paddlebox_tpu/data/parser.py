@@ -28,8 +28,111 @@ class BaseParser:
     def parse(self, line: str) -> Optional[SlotRecord]:
         raise NotImplementedError
 
+    def parse_file_columnar(self, path: str) -> Optional[dict]:
+        """Bulk fast path: parse a whole file straight into columnar
+        arrays (native/slot_parser.cpp), bypassing per-line python and
+        SlotRecord objects. Returns a dict with keys / key_slot /
+        offsets / dense / label / show / clk, or None when no native
+        fast path exists (caller falls back to per-line parse)."""
+        return None
 
-class SlotTextParser(BaseParser):
+
+def _slot_text_spec(desc: DataFeedDesc) -> np.ndarray:
+    """Compact slot spec for native slot_text_parse: per slot (kind, dim);
+    kinds: 0 sparse, 1 dense, 2 label, 3 show, 4 clk, 5 skip."""
+    spec = np.zeros((len(desc.slots), 2), np.int32)
+    for i, slot in enumerate(desc.slots):
+        if slot.type == "uint64":
+            spec[i, 0] = 0 if slot.is_used else 5
+        elif slot.name == desc.label_slot:
+            spec[i, 0] = 2
+        elif slot.name == desc.show_slot:
+            spec[i, 0] = 3
+        elif slot.name == desc.clk_slot:
+            spec[i, 0] = 4
+        elif slot.is_used:
+            spec[i, 0] = 1
+            spec[i, 1] = slot.dim
+        else:
+            spec[i, 0] = 5
+    return spec
+
+
+def _native_lib():
+    from paddlebox_tpu.native import load_native
+    return load_native()
+
+
+def _read_bytes(path: str) -> bytes:
+    with open(path, "rb") as fh:
+        return fh.read()
+
+
+class _NativeSlotTextMixin:
+    """parse_file_columnar via native slot_text_parse."""
+
+    def parse_file_columnar(self, path: str) -> Optional[dict]:
+        import ctypes
+        lib = _native_lib()
+        if lib is None:
+            return None
+        buf = _read_bytes(path)
+        desc = self.desc
+        max_rec = buf.count(b"\n") + 1
+        spec = _slot_text_spec(desc)
+        dense_dim = desc.dense_dim
+        key_cap = max(1024, max_rec * max(1, len(desc.sparse_slots)))
+        while True:
+            keys = np.empty(key_cap, np.uint64)
+            key_slot = np.empty(key_cap, np.int32)
+            offs = np.empty(max_rec + 1, np.int64)
+            dense = np.empty((max_rec, dense_dim), np.float32)
+            label = np.empty(max_rec, np.float32)
+            show = np.empty(max_rec, np.float32)
+            clk = np.empty(max_rec, np.float32)
+            ptr = lambda a: a.ctypes.data_as(ctypes.c_void_p)
+            n = lib.slot_text_parse(
+                buf, len(buf), ptr(spec), len(desc.slots), dense_dim,
+                max_rec, key_cap, ptr(keys), ptr(key_slot), ptr(offs),
+                ptr(dense), ptr(label), ptr(show), ptr(clk))
+            if n == -1:  # key arena overflowed: double and retry
+                key_cap *= 2
+                continue
+            nk = int(offs[n])
+            return dict(keys=keys[:nk].copy(),
+                        key_slot=key_slot[:nk].copy(),
+                        offsets=offs[:n + 1].copy(),
+                        dense=dense[:n].copy(), label=label[:n].copy(),
+                        show=show[:n].copy(), clk=clk[:n].copy())
+
+
+class _NativeCriteoMixin:
+    """parse_file_columnar via native criteo_parse."""
+
+    def parse_file_columnar(self, path: str) -> Optional[dict]:
+        import ctypes
+        lib = _native_lib()
+        if lib is None:
+            return None
+        buf = _read_bytes(path)
+        max_rec = buf.count(b"\n") + 1
+        keys = np.empty((max_rec, 26), np.uint64)
+        dense = np.empty((max_rec, 13), np.float32)
+        label = np.empty(max_rec, np.float32)
+        ptr = lambda a: a.ctypes.data_as(ctypes.c_void_p)
+        n = int(lib.criteo_parse(buf, len(buf), max_rec, ptr(keys),
+                                 ptr(dense), ptr(label)))
+        label = label[:n].copy()
+        return dict(
+            keys=keys[:n].reshape(-1).copy(),
+            key_slot=np.tile(np.arange(26, dtype=np.int32), n),
+            offsets=np.arange(n + 1, dtype=np.int64) * 26,
+            dense=dense[:n].copy(), label=label,
+            show=np.ones(n, np.float32), clk=label.copy())
+
+
+
+class SlotTextParser(_NativeSlotTextMixin, BaseParser):
     """Generic multi-slot text format, one record per line:
 
         <num> v0 v1 ... <num> v0 ...        (one group per slot, schema order)
@@ -85,7 +188,7 @@ class SlotTextParser(BaseParser):
         )
 
 
-class CriteoParser(BaseParser):
+class CriteoParser(_NativeCriteoMixin, BaseParser):
     """Criteo display-ads TSV: label \\t I1..I13 \\t C1..C26 (hex).
 
     Dense ints get the standard log(x+1) transform; missing dense → 0;
@@ -117,7 +220,13 @@ class CriteoParser(BaseParser):
         mask = (np.uint64(1) << np.uint64(self._SLOT_SHIFT)) - np.uint64(1)
         for i in range(26):
             v = f[14 + i]
-            h = np.uint64(int(v, 16)) if v else np.uint64(0xFFFFFFFF)
+            # invalid hex → missing-value sentinel; overlong hex wraps
+            # mod 2^64 — both matching the native criteo_parse exactly
+            try:
+                h = (np.uint64(int(v, 16) & 0xFFFFFFFFFFFFFFFF) if v
+                     else np.uint64(0xFFFFFFFF))
+            except ValueError:
+                h = np.uint64(0xFFFFFFFF)
             keys[i] = (np.uint64(i + 1) << np.uint64(self._SLOT_SHIFT)) | (h & mask)
         offsets = np.arange(27, dtype=np.int32)  # one key per slot
         return SlotRecord(keys=keys, slot_offsets=offsets, dense=dense,

@@ -23,16 +23,19 @@ _LIB: ctypes.CDLL | None = None
 _TRIED = False
 
 
+_SRCS = ("kv_index.cpp", "slot_parser.cpp")
+
+
 def _build() -> bool:
     """Compile to a temp file then atomically rename, so concurrent importers
     never CDLL a half-written .so. Honors CXX/CXXFLAGS like the Makefile."""
-    src = os.path.join(_DIR, "kv_index.cpp")
+    srcs = [os.path.join(_DIR, s) for s in _SRCS]
     cxx = os.environ.get("CXX", "g++")
     flags = os.environ.get(
         "CXXFLAGS", "-O3 -march=native -std=c++17 -fPIC").split()
     tmp = _SO + f".tmp{os.getpid()}"
     try:
-        subprocess.run([cxx, *flags, "-shared", src, "-o", tmp],
+        subprocess.run([cxx, *flags, "-shared", *srcs, "-o", tmp],
                        check=True, capture_output=True, timeout=120)
         os.replace(tmp, _SO)
         return True
@@ -52,9 +55,9 @@ def load_native() -> ctypes.CDLL | None:
         if _LIB is not None or _TRIED:
             return _LIB
         _TRIED = True
-        if not os.path.exists(_SO) or (
+        if not os.path.exists(_SO) or any(
                 os.path.getmtime(_SO) <
-                os.path.getmtime(os.path.join(_DIR, "kv_index.cpp"))):
+                os.path.getmtime(os.path.join(_DIR, s)) for s in _SRCS):
             if not _build():
                 return None
         try:
@@ -85,5 +88,16 @@ def load_native() -> ctypes.CDLL | None:
         lib.kv_lookup_unique.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                          ctypes.c_int64, ctypes.c_int32,
                                          ctypes.c_void_p, ctypes.c_void_p]
+        lib.criteo_parse.restype = ctypes.c_int64
+        lib.criteo_parse.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                     ctypes.c_int64, ctypes.c_void_p,
+                                     ctypes.c_void_p, ctypes.c_void_p]
+        lib.slot_text_parse.restype = ctypes.c_int64
+        lib.slot_text_parse.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p]
         _LIB = lib
         return _LIB
