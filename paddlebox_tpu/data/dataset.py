@@ -171,6 +171,7 @@ class InMemoryDataset(Dataset):
         with ThreadPoolExecutor(max(1, self.thread_num)) as ex:
             chunks = [probe] + list(ex.map(parser.parse_file_columnar, rest))
         n_rec = sum(len(c["label"]) for c in chunks)
+        n_drop = sum(int(c.get("dropped", 0)) for c in chunks)
         offsets = np.zeros(n_rec + 1, np.int64)
         pos, kpos = 0, 0
         for c in chunks:
@@ -187,8 +188,9 @@ class InMemoryDataset(Dataset):
         self.records = []
         self._pass_keys = None
         stat_add("records_parsed", n_rec)
-        log.info("native-parsed %d records from %d files (columnar)",
-                 n_rec, len(self.filelist))
+        stat_add("records_dropped", n_drop)
+        log.info("native-parsed %d records from %d files (columnar, "
+                 "%d lines dropped)", n_rec, len(self.filelist), n_drop)
         return True
 
     def columnarize(self, release_records: bool = True) -> None:
@@ -223,8 +225,11 @@ class InMemoryDataset(Dataset):
         if shuffler is not None:
             if self.columnar is not None:
                 raise RuntimeError(
-                    "global_shuffle(shuffler) must run before columnarize() "
-                    "— the columnar store cannot be exchanged")
+                    "global_shuffle(shuffler) needs record objects, but "
+                    "this dataset is already columnar (columnarize() was "
+                    "called, or the native parse fast path loaded it "
+                    "columnar directly — set FLAGS.native_parse=False "
+                    "before load_into_memory for cross-host exchange)")
             self.records = shuffler.exchange(self.records)
             self._pass_keys = None
         self.local_shuffle(seed)

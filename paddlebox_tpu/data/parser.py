@@ -18,6 +18,8 @@ import numpy as np
 from paddlebox_tpu.data.record import SlotRecord
 from paddlebox_tpu.data.schema import DataFeedDesc
 
+_HEXDIGITS = set("0123456789abcdefABCDEF")
+
 
 class BaseParser:
     """Parse one text line into a SlotRecord (None = drop the line)."""
@@ -68,6 +70,11 @@ def _read_bytes(path: str) -> bytes:
         return fh.read()
 
 
+def _line_count(buf: bytes) -> int:
+    n = buf.count(b"\n")
+    return n + (1 if buf and not buf.endswith(b"\n") else 0)
+
+
 class _NativeSlotTextMixin:
     """parse_file_columnar via native slot_text_parse."""
 
@@ -103,7 +110,8 @@ class _NativeSlotTextMixin:
                         key_slot=key_slot[:nk].copy(),
                         offsets=offs[:n + 1].copy(),
                         dense=dense[:n].copy(), label=label[:n].copy(),
-                        show=show[:n].copy(), clk=clk[:n].copy())
+                        show=show[:n].copy(), clk=clk[:n].copy(),
+                        dropped=_line_count(buf) - int(n))
 
 
 class _NativeCriteoMixin:
@@ -128,7 +136,8 @@ class _NativeCriteoMixin:
             key_slot=np.tile(np.arange(26, dtype=np.int32), n),
             offsets=np.arange(n + 1, dtype=np.int64) * 26,
             dense=dense[:n].copy(), label=label,
-            show=np.ones(n, np.float32), clk=label.copy())
+            show=np.ones(n, np.float32), clk=label.copy(),
+            dropped=_line_count(buf) - n)
 
 
 
@@ -218,14 +227,15 @@ class CriteoParser(_NativeCriteoMixin, BaseParser):
                     pass
         keys = np.empty(26, dtype=np.uint64)
         mask = (np.uint64(1) << np.uint64(self._SLOT_SHIFT)) - np.uint64(1)
+        hexdigits = _HEXDIGITS
         for i in range(26):
             v = f[14 + i]
-            # invalid hex → missing-value sentinel; overlong hex wraps
-            # mod 2^64 — both matching the native criteo_parse exactly
-            try:
-                h = (np.uint64(int(v, 16) & 0xFFFFFFFFFFFFFFFF) if v
-                     else np.uint64(0xFFFFFFFF))
-            except ValueError:
+            # strict bare-hex only (no 0x/+/_ forms int() would take),
+            # invalid → missing-value sentinel, overlong wraps mod 2^64 —
+            # all matching native parse_hex64 exactly
+            if v and not (set(v) - hexdigits):
+                h = np.uint64(int(v, 16) & 0xFFFFFFFFFFFFFFFF)
+            else:
                 h = np.uint64(0xFFFFFFFF)
             keys[i] = (np.uint64(i + 1) << np.uint64(self._SLOT_SHIFT)) | (h & mask)
         offsets = np.arange(27, dtype=np.int32)  # one key per slot

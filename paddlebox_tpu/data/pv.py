@@ -55,6 +55,86 @@ def group_by_uid(records: Sequence[SlotRecord]) -> List[List[SlotRecord]]:
     return list(buckets.values())
 
 
+def compute_split_num_and_mask(ins_count: int, seq_length: int,
+                               train_length: int
+                               ) -> Tuple[List[Tuple[int, int]], List[int]]:
+    """Sliding test-train windows over a user timeline — direct port of
+    ``compute_split_num_and_mask`` (data_set.cc:2783). Returns per-window
+    [start, end) offsets and the window's zero-mask prefix length (the
+    leading ``seq_length - train_length`` context records that do NOT
+    train). Invariant (asserted, as the reference PADDLE_ENFORCEs): every
+    record trains in exactly one window."""
+    window_num = (ins_count - seq_length) // train_length + 1
+    offsets: List[Tuple[int, int]] = [(0, ins_count - window_num * train_length)]
+    zero_mask: List[int] = [0]
+    s = offsets[0][1] - (seq_length - train_length)
+    e = offsets[0][1] + train_length
+    while e <= ins_count:
+        offsets.append((s, e))
+        zero_mask.append(seq_length - train_length)
+        s += train_length
+        e += train_length
+    train_num = sum((b - a) - z for (a, b), z in zip(offsets, zero_mask))
+    assert train_num == ins_count, "window split lost/duplicated train rows"
+    return offsets, zero_mask
+
+
+def split_uid_groups(groups: Sequence[Sequence[SlotRecord]], method: int,
+                     split_size: int = 0, train_size: int = 0
+                     ) -> List[Tuple[List[SlotRecord], int]]:
+    """Split uid-merged timelines into PV chunks with a zero-mask count —
+    ``merge_by_uid_split_method`` (data_feed.h:624, data_set.cc:2871-2927):
+
+    - 0: whole timeline as one chunk, mask 0.
+    - 1: direct split into ``split_size`` chunks aligned to the END of the
+      timeline (the reference opens a new chunk when
+      ``(count - j) % split_size == 0``), all records train.
+    - 2: sliding test-train windows (``compute_split_num_and_mask``): each
+      window's first ``split_size - train_size`` records are frozen
+      context (zero mask), the rest train; a record trains exactly once.
+
+    Returns [(records, zero_mask_num)] — feed to ``build_train_mask``.
+    """
+    if method == 2 and split_size > 0 and train_size > split_size:
+        raise ValueError(
+            f"train_size ({train_size}) must be <= split_size "
+            f"({split_size}) — the window's context prefix would be "
+            "negative")
+    out: List[Tuple[List[SlotRecord], int]] = []
+    for g in groups:
+        n = len(g)
+        if method == 1 and split_size > 0:
+            chunk: List[SlotRecord] = []
+            for j, r in enumerate(g):
+                if j > 0 and (n - j) % split_size == 0:
+                    out.append((chunk, 0))
+                    chunk = []
+                chunk.append(r)
+            out.append((chunk, 0))
+        elif method == 2 and 0 < split_size < n and train_size > 0:
+            offsets, zmask = compute_split_num_and_mask(
+                n, split_size, train_size)
+            for (a, b), z in zip(offsets, zmask):
+                out.append((list(g[a:b]), z))
+        else:
+            out.append((list(g), 0))
+    return out
+
+
+def build_train_mask(chunks: Sequence[Tuple[Sequence[SlotRecord], int]],
+                     pad_to: int = 0) -> np.ndarray:
+    """Flattened per-record ``ads_train_mask`` (data_feed.proto:57,
+    MiniBatchGpuPack::pack_pvinstance data_feed.cc:4787-4791): per chunk,
+    ``zero_mask_num`` zeros then ones; batch padding rows are 0."""
+    ins = sum(len(c) for c, _ in chunks)
+    mask = np.zeros(max(ins, pad_to), dtype=np.int64)
+    pos = 0
+    for recs, z in chunks:
+        mask[pos + z:pos + len(recs)] = 1
+        pos += len(recs)
+    return mask
+
+
 def _valid_rank(rank: int, cmatch: int, max_rank: int) -> int:
     if cmatch in VALID_CMATCH and 0 < rank <= max_rank:
         return rank

@@ -2,12 +2,15 @@ from paddlebox_tpu.models.ctr_dnn import CtrDnn
 from paddlebox_tpu.models.deepfm import DeepFM
 from paddlebox_tpu.models.wide_deep import WideDeep
 from paddlebox_tpu.models.dcn import DCNv2
+from paddlebox_tpu.models.ads_rank import AdsRank
 
 MODEL_REGISTRY = {
     "ctr_dnn": CtrDnn,
     "deepfm": DeepFM,
     "wide_deep": WideDeep,
     "dcn_v2": DCNv2,
+    "ads_rank": AdsRank,
 }
 
-__all__ = ["CtrDnn", "DeepFM", "WideDeep", "DCNv2", "MODEL_REGISTRY"]
+__all__ = ["CtrDnn", "DeepFM", "WideDeep", "DCNv2", "AdsRank",
+           "MODEL_REGISTRY"]

@@ -1,0 +1,56 @@
+"""AdsRank — PV (page-view) ads ranking model with rank attention.
+
+The production BoxPS pattern this mirrors: PV-merged batches flatten each
+search result page's ads into instances with a ``rank_offset`` matrix
+(PaddleBoxDataFeed::GetRankOffset, data_feed.cu:1319), and the net mixes
+per-ad features with a per-(own-rank, other-rank) attention over co-shown
+ads (``rank_attention`` op, operators/rank_attention_op.*) plus slot-wise
+``batch_fc`` towers (operators/batch_fc_op.*). This module is the model
+half; paddlebox_tpu/data/pv.py builds the batches.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.ops.rank_attention import rank_attention
+
+
+class AdsRank(nn.Module):
+    """pooled [B, S, D] + dense [B, Dd] + rank_offset [B, 1+2K] → logits [B].
+
+    d_model: per-ad projection width fed to rank attention.
+    max_rank: K, max co-shown ads attended per ad (must match the
+      PvBatchBuilder's max_rank).
+    """
+
+    d_model: int = 64
+    max_rank: int = 3
+    hidden: Sequence[int] = (128, 64)
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, pooled: jax.Array, dense: jax.Array,
+                 rank_offset: jax.Array) -> jax.Array:
+        b, s, d = pooled.shape
+        feats = jnp.concatenate(
+            [pooled.reshape(b, s * d), dense], axis=1)
+        proj = nn.Dense(self.d_model, dtype=self.compute_dtype,
+                        name="ad_proj")(feats).astype(jnp.float32)
+
+        # per-(own-rank, co-rank) attention parameter blocks
+        rank_param = self.param(
+            "rank_param", nn.initializers.normal(0.02),
+            (self.max_rank * self.max_rank, self.d_model, self.d_model))
+        ra = rank_attention(proj, rank_offset, rank_param,
+                            max_rank=self.max_rank, enable_input_bp=True)
+
+        h = jnp.concatenate([proj, ra], axis=1)
+        for i, w in enumerate(self.hidden):
+            h = nn.relu(nn.Dense(w, dtype=self.compute_dtype,
+                                 name=f"mlp_{i}")(h).astype(jnp.float32))
+        return nn.Dense(1, dtype=jnp.float32, name="head")(h)[:, 0]

@@ -30,19 +30,26 @@ inline const char* next_line(const char* p, const char* end) {
   return nl ? nl + 1 : end;
 }
 
-// strtof-like over a [p, q) field; returns false on empty/garbage.
+// strtof over a [p, q) field; the WHOLE field must parse (python
+// float("1x") raises → the native path must reject "1x" identically).
 inline bool parse_float(const char* p, const char* q, float* out) {
   if (p >= q) return false;
   char tmp[64];
   size_t n = static_cast<size_t>(q - p);
-  if (n >= sizeof(tmp)) n = sizeof(tmp) - 1;
+  if (n >= sizeof(tmp)) return false;  // longer than any real number
   memcpy(tmp, p, n);
   tmp[n] = 0;
   char* endp = nullptr;
   float v = strtof(tmp, &endp);
-  if (endp == tmp) return false;
+  if (endp != tmp + n) return false;
   *out = v;
   return true;
+}
+
+// a token must END at whitespace/line end — otherwise strtol("2.5")
+// would accept what python's int("2.5") rejects
+inline bool at_token_end(const char* c, const char* line_end) {
+  return c >= line_end || isspace(static_cast<unsigned char>(*c));
 }
 
 inline bool parse_hex64(const char* p, const char* q, uint64_t* out) {
@@ -157,9 +164,16 @@ int64_t slot_text_parse(const char* buf, int64_t len, const int32_t* spec,
       if (c >= line_end) { ok = false; break; }
       char* endp = nullptr;
       long cnt = strtol(c, &endp, 10);
-      if (endp == c || cnt < 0) { ok = false; break; }
+      if (endp == c || cnt < 0 || !at_token_end(endp, line_end)) {
+        ok = false;
+        break;
+      }
       c = endp;
       if (kind == 1 && cnt != dim) { ok = false; break; }
+      // group presence sets python's defaults even for empty groups
+      // (label/clk = 0.0 when the group exists with zero values)
+      if (kind == 2) { has_label = true; label = 0.f; }
+      if (kind == 4) { has_clk = true; clk = 0.f; }
       for (long i = 0; ok && i < cnt; ++i) {
         while (c < line_end && isspace(static_cast<unsigned char>(*c))) ++c;
         if (c >= line_end) { ok = false; break; }
@@ -169,7 +183,7 @@ int64_t slot_text_parse(const char* buf, int64_t len, const int32_t* spec,
         } else if (kind == 0) {
           char* ep = nullptr;
           uint64_t v = strtoull(c, &ep, 10);
-          if (ep == c) { ok = false; break; }
+          if (ep == c || !at_token_end(ep, line_end)) { ok = false; break; }
           c = ep;
           if (nkeys >= key_cap) return -1;
           keys_out[nkeys] = v;
@@ -178,16 +192,16 @@ int64_t slot_text_parse(const char* buf, int64_t len, const int32_t* spec,
         } else {
           char* ep = nullptr;
           float v = strtof(c, &ep);
-          if (ep == c) { ok = false; break; }
+          if (ep == c || !at_token_end(ep, line_end)) { ok = false; break; }
           c = ep;
           if (kind == 1) {
             if (dpos < dense_dim) dd[dpos++] = v;
           } else if (kind == 2 && i == 0) {
-            label = v; has_label = true;
+            label = v;
           } else if (kind == 3 && i == 0) {
             show = v;
           } else if (kind == 4 && i == 0) {
-            clk = v; has_clk = true;
+            clk = v;
           }
         }
       }

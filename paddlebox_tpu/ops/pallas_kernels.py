@@ -24,18 +24,17 @@ Status (measured on one TPU chip, DeepFM/criteo bench, AoS table
   (unique_indices / indices_are_sorted / mode) change nothing. This is
   the single largest cost in the train step.
 - ``gather_rows_dma``/``scatter_rows_dma`` below implement the obvious
-  fix — one 64-byte row DMA per index, _NSEM in flight — but current
-  Mosaic CANNOT compile them: every memref (HBM included) is laid out
-  with a 128-lane minor tile, so a 16-wide row slice is "unaligned"
-  regardless of memory space (error: "Slice shape along dimension 1
-  must be aligned to tiling (128)"). They are correct in interpret mode
-  and kept as the reference implementation.
-- The workable TPU design (next round): treat 8 consecutive 16-wide
-  rows as one (8, 128)-aligned super-row, gather/scatter super-rows via
-  DMA, and merge scattered rows into gathered super-rows with masked
-  vector selects (rows arrive sorted, so each touched super-row's rows
-  are a contiguous range). ~1.6 GB of aligned RMW traffic ≈ 2-4 ms vs
-  26 ms.
+  fix — one row DMA per index, _NSEM in flight. Measured verdict:
+  (a) D=16 rows cannot compile — every Mosaic memref (HBM included) is
+  laid out with a 128-lane minor tile, so a 16-wide row slice is
+  "unaligned" regardless of memory space; (b) at D=128 (lane-aligned
+  rows) they compile and are CORRECT but the scalar-core loop issues
+  DMAs at ~320 µs each (2048 rows = 656 ms) — ~1000x off, so manual
+  per-row DMA is not viable on current Mosaic at any width. Kept as
+  interpret-mode reference implementations only.
+- Conclusion: XLA's native per-element scatter (26 ms/batch) stands as
+  the table-update cost on this toolchain; revisit if Mosaic grows a
+  batched gather/scatter DMA primitive or SparseCore access.
 - ``segment_sum_mxu`` is the right shape for wide-D, high-slot-count
   configs (1000-slot fused pipelines, D≥128); re-evaluate there.
 """

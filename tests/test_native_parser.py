@@ -156,3 +156,61 @@ def test_slot_text_truncated_line_no_bleed(tmp_path):
     assert len(got["label"]) == ref.num_records == 2
     np.testing.assert_array_equal(got["keys"], ref.keys)
     np.testing.assert_array_equal(got["offsets"], ref.offsets)
+
+
+@requires_native
+def test_token_garbage_parity(tmp_path):
+    """Trailing-garbage tokens ('1x' label, '2.5' count) must be rejected
+    by the native path exactly like the python parsers; empty clk group
+    must yield clk=0.0 on both paths."""
+    good = "1\t" + "\t".join(str(i) for i in range(1, 14)) + "\t" + \
+        "\t".join(f"{i:x}" for i in range(26))
+    bad_label = good.replace("1\t", "1x\t", 1)
+    bad_dense = good.replace("\t3\t", "\t3x\t", 1)
+    f = tmp_path / "garb.txt"
+    f.write_text("\n".join([good, bad_label, bad_dense]) + "\n")
+    desc = DataFeedDesc.criteo(batch_size=4)
+    p = CriteoParser(desc)
+    got = p.parse_file_columnar(str(f))
+    ref = _columnar_from_python(p, str(f), desc.dense_dim)
+    assert len(got["label"]) == ref.num_records
+    np.testing.assert_allclose(got["dense"], ref.dense, rtol=1e-6)
+    assert got["dropped"] == 3 - ref.num_records
+
+    slots = [SlotDef("label", "float", 1), SlotDef("clk", "float", 1),
+             SlotDef("s1", "uint64")]
+    desc2 = DataFeedDesc(slots=slots, batch_size=4, label_slot="label",
+                         clk_slot="clk")
+    lines = [
+        "1 1 1 0.0 1 5",      # normal
+        "1 1 0 1 5",          # clk group PRESENT but empty → clk must be 0
+        "2.5 1 1 1 1 5",      # float count → dropped
+        "1 1 1 1 1 5x",       # trailing-garbage key → dropped
+    ]
+    f2 = tmp_path / "slots.txt"
+    f2.write_text("\n".join(lines) + "\n")
+    p2 = SlotTextParser(desc2)
+    got2 = p2.parse_file_columnar(str(f2))
+    ref2 = _columnar_from_python(p2, str(f2), desc2.dense_dim)
+    assert len(got2["label"]) == ref2.num_records == 2
+    np.testing.assert_array_equal(got2["clk"], ref2.clk)
+    assert got2["clk"][1] == 0.0
+
+
+@requires_native
+def test_criteo_hex_form_parity(tmp_path):
+    """Hex forms int(v,16) would take but parse_hex64 rejects ('0x..',
+    '+1a') must map to the sentinel on BOTH paths."""
+    base = "1\t" + "\t".join(str(i) for i in range(1, 14)) + "\t"
+    cats = [f"{i:x}" for i in range(26)]
+    cats[0] = "0x1a"
+    cats[1] = "+1a"
+    f = tmp_path / "hexforms.txt"
+    f.write_text(base + "\t".join(cats) + "\n")
+    desc = DataFeedDesc.criteo(batch_size=2)
+    p = CriteoParser(desc)
+    got = p.parse_file_columnar(str(f))
+    ref = _columnar_from_python(p, str(f), desc.dense_dim)
+    np.testing.assert_array_equal(got["keys"], ref.keys)
+    sent = (np.uint64(1) << np.uint64(52)) | np.uint64(0xFFFFFFFF)
+    assert got["keys"][0] == sent

@@ -116,3 +116,47 @@ def test_pv_chunk_overflow_raises():
           rec(1, 1, 222, nslots=1), rec(1, 2, 222, nslots=1)]
     with pytest.raises(ValueError):
         PvBatchBuilder(desc).batches(rs)
+
+
+def test_compute_split_num_and_mask_invariant():
+    """Port-parity with data_set.cc:2783: windows tile the timeline, every
+    record trains exactly once, context prefixes are seq-train long."""
+    from paddlebox_tpu.data.pv import compute_split_num_and_mask
+    for n, seq, train in [(10, 4, 2), (17, 6, 3), (9, 4, 4), (25, 8, 2)]:
+        offs, zmask = compute_split_num_and_mask(n, seq, train)
+        assert offs[0][0] == 0 and offs[-1][1] == n
+        assert zmask[0] == 0
+        assert all(z == seq - train for z in zmask[1:])
+        # each window after the first is seq long
+        assert all(b - a == seq for (a, b) in offs[1:])
+        trained = sum((b - a) - z for (a, b), z in zip(offs, zmask))
+        assert trained == n
+
+
+def test_split_uid_groups_methods():
+    from paddlebox_tpu.data.pv import build_train_mask, split_uid_groups
+    g = [rec(1, 1, 222, uid=5) for _ in range(10)]
+
+    whole = split_uid_groups([g], method=0)
+    assert len(whole) == 1 and len(whole[0][0]) == 10 and whole[0][1] == 0
+
+    # direct split, chunks aligned to the END (reference j>0 &&
+    # (count-j)%size==0): 10 into size-4 → [2, 4, 4]
+    direct = split_uid_groups([g], method=1, split_size=4)
+    assert [len(c) for c, _ in direct] == [2, 4, 4]
+    assert all(z == 0 for _, z in direct)
+
+    # windowed split with train mask: seq=4, train=2 over 10 records
+    win = split_uid_groups([g], method=2, split_size=4, train_size=2)
+    sizes = [len(c) for c, _ in win]
+    zmask = [z for _, z in win]
+    assert zmask[0] == 0 and all(z == 2 for z in zmask[1:])
+    assert sum(s - z for s, z in zip(sizes, zmask)) == 10
+    mask = build_train_mask(win, pad_to=32)
+    assert mask.shape == (32,)
+    assert int(mask.sum()) == 10          # every record trains exactly once
+    assert (mask[sum(sizes):] == 0).all()  # padding rows masked out
+
+    # short timelines fall back to whole-chunk
+    short = split_uid_groups([g[:3]], method=2, split_size=4, train_size=2)
+    assert len(short) == 1 and short[0][1] == 0
