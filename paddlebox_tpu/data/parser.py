@@ -183,7 +183,9 @@ class SlotTextParser(_NativeSlotTextMixin, BaseParser):
                         if len(fvals) != slot.dim:
                             return None
                         dense_parts.extend(fvals)
-        except (ValueError, IndexError):
+        except (ValueError, IndexError, OverflowError):
+            # OverflowError: negative/oversized tokens in a uint64 slot —
+            # drop the line (the native parser rejects them the same way)
             return None
         keys = (np.concatenate(sparse_chunks) if sparse_chunks
                 else np.empty(0, dtype=np.uint64))

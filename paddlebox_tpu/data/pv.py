@@ -115,7 +115,8 @@ def split_uid_groups(groups: Sequence[Sequence[SlotRecord]], method: int,
             offsets, zmask = compute_split_num_and_mask(
                 n, split_size, train_size)
             for (a, b), z in zip(offsets, zmask):
-                out.append((list(g[a:b]), z))
+                if b > a:  # the first window can be empty when the
+                    out.append((list(g[a:b]), z))  # timeline tiles exactly
         else:
             out.append((list(g), 0))
     return out

@@ -30,13 +30,20 @@ inline const char* next_line(const char* p, const char* end) {
   return nl ? nl + 1 : end;
 }
 
-// strtof over a [p, q) field; the WHOLE field must parse (python
-// float("1x") raises → the native path must reject "1x" identically).
+// strtof over a [p, q) field with python-float() semantics: surrounding
+// whitespace tolerated (python strips it), the remaining token must parse
+// COMPLETELY (float("1x") raises), and C99 hex-float forms are rejected
+// (float("0x1p1") raises).
 inline bool parse_float(const char* p, const char* q, float* out) {
+  while (p < q && isspace(static_cast<unsigned char>(*p))) ++p;
+  while (q > p && isspace(static_cast<unsigned char>(*(q - 1)))) --q;
   if (p >= q) return false;
   char tmp[64];
   size_t n = static_cast<size_t>(q - p);
   if (n >= sizeof(tmp)) return false;  // longer than any real number
+  for (size_t i = 0; i < n; ++i) {
+    if (p[i] == 'x' || p[i] == 'X') return false;  // hex-float form
+  }
   memcpy(tmp, p, n);
   tmp[n] = 0;
   char* endp = nullptr;
@@ -181,6 +188,9 @@ int64_t slot_text_parse(const char* buf, int64_t len, const int32_t* spec,
           while (c < line_end && !isspace(static_cast<unsigned char>(*c)))
             ++c;
         } else if (kind == 0) {
+          // negatives wrap in strtoull but overflow python's uint64 cast
+          // (both paths must DROP the line); '+5' parses as 5 on both
+          if (*c == '-') { ok = false; break; }
           char* ep = nullptr;
           uint64_t v = strtoull(c, &ep, 10);
           if (ep == c || !at_token_end(ep, line_end)) { ok = false; break; }

@@ -3,7 +3,9 @@ from paddlebox_tpu.train.trainer import Trainer
 from paddlebox_tpu.train.dense_modes import AsyncDenseTable, KStepParamSync
 from paddlebox_tpu.train.device_pass import (PassPreloader, ResidentPass,
                                              ResidentPassRunner)
+from paddlebox_tpu.train.checkpoint import CheckpointManager
 
 __all__ = ["TrainStep", "DeviceBatch", "make_device_batch", "Trainer",
            "AsyncDenseTable", "KStepParamSync",
-           "PassPreloader", "ResidentPass", "ResidentPassRunner"]
+           "PassPreloader", "ResidentPass", "ResidentPassRunner",
+           "CheckpointManager"]

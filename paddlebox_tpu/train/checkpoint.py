@@ -1,0 +1,195 @@
+"""Unified checkpoint manager — sparse base/delta + dense state, atomic.
+
+Reference surface (SURVEY.md §3.4/§5.4): day-level ``SaveBase`` (full
+batch model), incremental ``SaveDelta`` ("xbox delta" for online serving),
+dense ``io.save_persistables``, and resume =
+``InitializeGPUAndLoadModel(model_path)`` (box_wrapper.cc:1298,1383,1406).
+
+TPU-native packaging: one directory per checkpoint —
+
+    <root>/ckpt-<step>/
+        sparse.npz | sparse_delta.npz   (EmbeddingTable save_base/save_delta)
+        dense.pkl                       (params + optimizer state + auc)
+        meta.json                       (step, kind, base_step)
+    <root>/LATEST                       (atomic pointer file)
+
+Writes land in a temp dir then ``os.replace`` — a crash mid-save never
+corrupts the latest restorable state (the property the reference gets from
+day-level directory convention + AFS rename). ``restore`` replays base +
+the delta chain up to the requested step. Retention keeps the last
+``keep`` checkpoints but never drops a base an alive delta depends on.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from paddlebox_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class CheckpointManager:
+    def __init__(self, root: str, keep: int = 3) -> None:
+        self.root = root
+        self.keep = keep
+        os.makedirs(root, exist_ok=True)
+
+    # ---- paths ----
+    def _dir(self, step: int) -> str:
+        return os.path.join(self.root, f"ckpt-{step:012d}")
+
+    def steps(self) -> List[int]:
+        out = []
+        for name in os.listdir(self.root):
+            if name.startswith("ckpt-"):
+                try:
+                    out.append(int(name[5:]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        p = os.path.join(self.root, "LATEST")
+        try:
+            with open(p) as fh:
+                s = int(fh.read().strip())
+            if os.path.isdir(self._dir(s)):
+                return s
+        except (OSError, ValueError):
+            pass
+        # stale/missing pointer: fall back to newest dir on disk
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def _meta(self, step: int) -> dict:
+        with open(os.path.join(self._dir(step), "meta.json")) as fh:
+            return json.load(fh)
+
+    # ---- save ----
+    def save(self, trainer, step: Optional[int] = None,
+             delta: bool = False) -> str:
+        """Snapshot the trainer. ``delta=True`` = save_delta (rows touched
+        since the previous save) referencing the most recent base."""
+        step = trainer.global_step if step is None else step
+        base_step = None
+        if delta:
+            base_step = self._latest_base()
+            if base_step is None:
+                raise ValueError("delta save with no base checkpoint yet")
+        tmp = os.path.join(self.root, f".tmp-{os.getpid()}-{step}")
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        trainer.sync_table()
+        if delta:
+            n = trainer.table.save_delta(os.path.join(tmp, "sparse_delta.npz"))
+        else:
+            n = trainer.table.save_base(os.path.join(tmp, "sparse.npz"))
+        with open(os.path.join(tmp, "dense.pkl"), "wb") as fh:
+            pickle.dump(jax.device_get(
+                (trainer.state.params, trainer.state.opt_state,
+                 trainer.state.auc)), fh)
+        with open(os.path.join(tmp, "meta.json"), "w") as fh:
+            json.dump({"step": step, "kind": "delta" if delta else "base",
+                       "base_step": base_step, "sparse_rows": n}, fh)
+        final = self._dir(step)
+        if os.path.isdir(final):
+            # move the old dir aside BEFORE the swap — a crash between the
+            # two renames leaves either the old or the new dir in place,
+            # never neither (latest_step falls back to dirs on disk)
+            aside = final + f".old-{os.getpid()}"
+            os.replace(final, aside)
+            os.replace(tmp, final)
+            shutil.rmtree(aside, ignore_errors=True)
+        else:
+            os.replace(tmp, final)
+        self._write_latest(step)
+        self._retain()
+        log.info("checkpoint %s saved at step %d (%d sparse rows)",
+                 "delta" if delta else "base", step, n)
+        return final
+
+    def _write_latest(self, step: int) -> None:
+        tmp = os.path.join(self.root, ".LATEST.tmp")
+        with open(tmp, "w") as fh:
+            fh.write(str(step))
+        os.replace(tmp, os.path.join(self.root, "LATEST"))
+
+    def _latest_base(self) -> Optional[int]:
+        for s in reversed(self.steps()):
+            if self._meta(s)["kind"] == "base":
+                return s
+        return None
+
+    def _retain(self) -> None:
+        steps = self.steps()
+        if len(steps) <= self.keep:
+            return
+        kept = set(steps[-self.keep:])
+        # a delta restores by replaying its base + EVERY intermediate
+        # delta (each delta covers only rows touched since the previous
+        # save) — the whole chain of every kept checkpoint must survive
+        for s in kept.copy():
+            try:
+                kept.update(self._chain(s))
+            except (FileNotFoundError, OSError):
+                pass
+        for s in steps:
+            if s not in kept:
+                shutil.rmtree(self._dir(s), ignore_errors=True)
+
+    # ---- restore ----
+    def restore(self, trainer, step: Optional[int] = None) -> Optional[int]:
+        """Restore to ``step`` (default: latest). Replays the base + delta
+        chain for sparse state; returns the restored step or None if no
+        checkpoint exists."""
+        target = self.latest_step() if step is None else step
+        if target is None:
+            return None
+        chain = self._chain(target)
+        first = True
+        for s in chain:
+            d = self._dir(s)
+            meta = self._meta(s)
+            if meta["kind"] == "base":
+                trainer.table.load(os.path.join(d, "sparse.npz"),
+                                   merge=not first)
+            else:
+                trainer.table.load(os.path.join(d, "sparse_delta.npz"),
+                                   merge=True)
+            first = False
+        with open(os.path.join(self._dir(target), "dense.pkl"), "rb") as fh:
+            params, opt_state, auc = pickle.load(fh)
+        from paddlebox_tpu.train.step import StepState
+        import jax.numpy as jnp
+        trainer.state = StepState(
+            table=trainer.table.state,
+            params=jax.device_put(params),
+            opt_state=jax.device_put(opt_state),
+            auc=jax.device_put(auc),
+            step=jnp.asarray(target, jnp.int32))
+        trainer.global_step = target
+        log.info("restored step %d (chain: %s)", target, chain)
+        return target
+
+    def _chain(self, target: int) -> List[int]:
+        """base → …deltas… → target, following meta base_step links."""
+        meta = self._meta(target)
+        if meta["kind"] == "base":
+            return [target]
+        base = meta["base_step"]
+        if base is None or not os.path.isdir(self._dir(base)):
+            raise FileNotFoundError(
+                f"delta checkpoint {target} references missing base {base}")
+        # every delta between base and target (sorted) applies in order
+        mids = [s for s in self.steps()
+                if base < s <= target and self._meta(s)["kind"] == "delta"
+                and self._meta(s)["base_step"] == base]
+        return [base] + mids

@@ -79,7 +79,7 @@ def test_ads_rank_trains_on_pv_batches(pv_setup):
     opt = tx.init(params)
 
     @jax.jit
-    def step(params, opt, values_k, gi, kv, segments, show_clk, dense,
+    def step(params, opt, values_k, segments, show_clk, dense,
              label, ro, ins_w):
         def loss_fn(params, values_k):
             pooled = fused_seqpool_cvm(values_k, segments, show_clk, bs, S)
@@ -100,8 +100,7 @@ def test_ads_rank_trains_on_pv_batches(pv_setup):
                                   jnp.asarray(batch.clk)], axis=1)
             ins_w = (batch.show > 0).astype(np.float32)
             params, opt, loss, pred, gk = step(
-                params, opt, values_k, jnp.asarray(idx.gather_idx),
-                jnp.asarray(idx.key_valid), jnp.asarray(batch.segments),
+                params, opt, values_k, jnp.asarray(batch.segments),
                 show_clk, jnp.asarray(batch.dense),
                 jnp.asarray(batch.label), jnp.asarray(ro),
                 jnp.asarray(ins_w))
