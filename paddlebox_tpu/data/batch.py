@@ -48,6 +48,8 @@ class SlotBatch:
     uid: Optional[np.ndarray] = None     # int64 [B]
     rank: Optional[np.ndarray] = None    # int32 [B]
     cmatch: Optional[np.ndarray] = None  # int32 [B]
+    # ads timestamp tensor (need_time_info, GetTimestampGPU)
+    timestamp: Optional[np.ndarray] = None  # int64 [B]
     # sample ids for the dump subsystem (None when no record carries one)
     ins_ids: Optional[list] = None       # list[str], len == #real records
 
@@ -103,6 +105,7 @@ class BatchBuilder:
         uid = np.zeros(bs, dtype=np.int64)
         rank = np.zeros(bs, dtype=np.int32)
         cmatch = np.zeros(bs, dtype=np.int32)
+        ts = np.zeros(bs, dtype=np.int64)
         for i, r in enumerate(records):
             if r.dense.size:
                 dense[i, :r.dense.size] = r.dense
@@ -112,6 +115,7 @@ class BatchBuilder:
             uid[i] = r.uid
             rank[i] = r.rank
             cmatch[i] = r.cmatch
+            ts[i] = r.timestamp
         ins_ids = ([r.ins_id for r in records]
                    if any(r.ins_id for r in records) else None)
         # short batches (tail of a pass): instances [n, bs) have show=0 so
@@ -122,5 +126,6 @@ class BatchBuilder:
             keys=keys_p, segments=segs_p, num_keys=nk, dense=dense,
             label=label, show=show, clk=clk, batch_size=bs, num_slots=S,
             segments_trivial=trivial,
-            uid=uid, rank=rank, cmatch=cmatch, ins_ids=ins_ids,
+            uid=uid, rank=rank, cmatch=cmatch, timestamp=ts,
+            ins_ids=ins_ids,
         )

@@ -36,6 +36,7 @@ class ColumnarRecords:
     uid: Optional[np.ndarray] = None     # int64 [R]
     rank: Optional[np.ndarray] = None    # int32 [R]
     cmatch: Optional[np.ndarray] = None  # int32 [R]
+    timestamp: Optional[np.ndarray] = None  # int64 [R] (need_time_info)
 
     @property
     def num_records(self) -> int:
@@ -66,6 +67,7 @@ class ColumnarRecords:
         uid = np.empty(r, np.int64)
         rank = np.empty(r, np.int32)
         cmatch = np.empty(r, np.int32)
+        ts = np.empty(r, np.int64)
         for i, rec in enumerate(records):
             if rec.dense.size:
                 dense[i, :rec.dense.size] = rec.dense
@@ -75,9 +77,10 @@ class ColumnarRecords:
             uid[i] = rec.uid
             rank[i] = rec.rank
             cmatch[i] = rec.cmatch
+            ts[i] = rec.timestamp
         return cls(keys=keys, key_slot=key_slot, offsets=offsets,
                    dense=dense, label=label, show=show, clk=clk, uid=uid,
-                   rank=rank, cmatch=cmatch)
+                   rank=rank, cmatch=cmatch, timestamp=ts)
 
     def shuffle(self, seed: int = 0) -> "ColumnarRecords":
         """Record-order permutation (one gather per pass, amortized)."""
@@ -94,7 +97,8 @@ class ColumnarRecords:
             keys=self.keys[src_idx], key_slot=self.key_slot[src_idx],
             offsets=new_off, dense=self.dense[perm], label=self.label[perm],
             show=self.show[perm], clk=self.clk[perm],
-            uid=opt(self.uid), rank=opt(self.rank), cmatch=opt(self.cmatch))
+            uid=opt(self.uid), rank=opt(self.rank), cmatch=opt(self.cmatch),
+            timestamp=opt(self.timestamp))
 
     def batch(self, start: int, end: int, desc: DataFeedDesc,
               num_slots: int) -> SlotBatch:
@@ -133,6 +137,7 @@ class ColumnarRecords:
             show=padrow(self.show), clk=padrow(self.clk),
             batch_size=bs, num_slots=num_slots, segments_trivial=trivial,
             uid=opt(self.uid), rank=opt(self.rank), cmatch=opt(self.cmatch),
+            timestamp=opt(self.timestamp),
         )
 
     def batches(self, desc: DataFeedDesc, num_slots: int,

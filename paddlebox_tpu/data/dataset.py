@@ -35,6 +35,22 @@ from paddlebox_tpu.utils.logging import get_logger
 log = get_logger(__name__)
 
 
+def shard_filelist(files: Sequence[str], rank: Optional[int] = None,
+                   world: Optional[int] = None) -> List[str]:
+    """This host's round-robin slice of a file list; rank/world default to
+    the launcher env (PBOX_RANK / PBOX_WORLD_SIZE)."""
+    import os
+    if rank is None:
+        rank = int(os.environ.get("PBOX_RANK", "0"))
+    if world is None:
+        world = int(os.environ.get("PBOX_WORLD_SIZE", "1"))
+    if world <= 1:
+        return list(files)
+    if rank >= world or rank < 0:
+        raise ValueError(f"rank {rank} out of range for world {world}")
+    return list(files[rank::world])
+
+
 class Dataset:
     """Base: file list + schema + threaded readers."""
 
@@ -49,11 +65,21 @@ class Dataset:
         self.desc = desc
         self._builder = None
 
-    def set_filelist(self, files: Sequence[str]) -> None:
-        self.filelist = list(files)
+    def set_filelist(self, files: Sequence[str],
+                     shard_by_rank: bool = False) -> None:
+        """``shard_by_rank=True`` keeps only this host's round-robin slice
+        of the file list (multi-host input sharding — each reference MPI
+        rank reads its own file subset before the cross-rank global
+        shuffle, SURVEY.md §7 Phase 4). Rank/world come from the
+        launcher's env (distributed/launch.py)."""
+        files = list(files)
+        if shard_by_rank:
+            files = shard_filelist(files)
+        self.filelist = files
 
-    def set_glob(self, pattern: str) -> None:
-        self.filelist = sorted(globlib.glob(pattern))
+    def set_glob(self, pattern: str, shard_by_rank: bool = False) -> None:
+        self.set_filelist(sorted(globlib.glob(pattern)),
+                          shard_by_rank=shard_by_rank)
 
     def set_batch_size(self, bs: int) -> None:
         self.desc.batch_size = bs
@@ -184,7 +210,12 @@ class InMemoryDataset(Dataset):
         self.columnar = ColumnarRecords(
             keys=cat("keys"), key_slot=cat("key_slot"), offsets=offsets,
             dense=cat("dense"), label=cat("label"), show=cat("show"),
-            clk=cat("clk"))
+            clk=cat("clk"),
+            # text formats carry no metadata columns — default like the
+            # record path does (SlotRecord field defaults)
+            uid=np.zeros(n_rec, np.int64), rank=np.zeros(n_rec, np.int32),
+            cmatch=np.zeros(n_rec, np.int32),
+            timestamp=np.zeros(n_rec, np.int64))
         self.records = []
         self._pass_keys = None
         stat_add("records_parsed", n_rec)

@@ -47,12 +47,19 @@ def group_by_search_id(records: Sequence[SlotRecord]) -> List[List[SlotRecord]]:
     return pvs
 
 
-def group_by_uid(records: Sequence[SlotRecord]) -> List[List[SlotRecord]]:
-    """Group records by uid (merge_by_uid path: user timeline grouping)."""
+def group_by_uid(records: Sequence[SlotRecord],
+                 sort_by_time: bool = True) -> List[List[SlotRecord]]:
+    """Group records by uid (merge_by_uid path: user timeline grouping),
+    each timeline time-ordered (cur_timestamp_) so the window split
+    (split_uid_groups) sees a temporal sequence."""
     buckets: Dict[int, List[SlotRecord]] = {}
     for r in records:
         buckets.setdefault(r.uid, []).append(r)
-    return list(buckets.values())
+    groups = list(buckets.values())
+    if sort_by_time:
+        for g in groups:
+            g.sort(key=lambda r: r.timestamp)
+    return groups
 
 
 def compute_split_num_and_mask(ins_count: int, seq_length: int,
@@ -134,6 +141,16 @@ def build_train_mask(chunks: Sequence[Tuple[Sequence[SlotRecord], int]],
         mask[pos + z:pos + len(recs)] = 1
         pos += len(recs)
     return mask
+
+
+def timestamp_range_mask(timestamp: np.ndarray, lo: int,
+                         hi: int) -> np.ndarray:
+    """1.0 where timestamp ∈ [lo, hi) — the test-phase timestamp window
+    (SetTestTimestampRange, data_feed.h:2038: eval restricted to a time
+    range of the uid timeline). Combine multiplicatively with ins_w /
+    ads_train_mask."""
+    ts = np.asarray(timestamp)
+    return ((ts >= lo) & (ts < hi)).astype(np.float32)
 
 
 def _valid_rank(rank: int, cmatch: int, max_rank: int) -> int:

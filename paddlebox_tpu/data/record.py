@@ -39,6 +39,7 @@ class SlotRecord:
     rank: int = 0
     cmatch: int = 0
     uid: int = 0                     # user id for WuAUC / uid-merge
+    timestamp: int = 0               # cur_timestamp_ (need_time_info path)
 
     def slot_keys(self, slot_idx: int) -> np.ndarray:
         return self.keys[self.slot_offsets[slot_idx]:self.slot_offsets[slot_idx + 1]]

@@ -17,6 +17,7 @@
 //     slot-spec array (see slot_text_parse docs below).
 
 #include <cctype>
+#include <cerrno>
 #include <cmath>
 #include <cstdint>
 #include <cstdlib>
@@ -57,6 +58,14 @@ inline bool parse_float(const char* p, const char* q, float* out) {
 // would accept what python's int("2.5") rejects
 inline bool at_token_end(const char* c, const char* line_end) {
   return c >= line_end || isspace(static_cast<unsigned char>(*c));
+}
+
+// python float() rejects C99 hex-float forms ("0x1p1") that strtof takes
+inline bool token_has_hex_marker(const char* c, const char* line_end) {
+  for (; c < line_end && !isspace(static_cast<unsigned char>(*c)); ++c) {
+    if (*c == 'x' || *c == 'X') return true;
+  }
+  return false;
 }
 
 inline bool parse_hex64(const char* p, const char* q, uint64_t* out) {
@@ -188,18 +197,22 @@ int64_t slot_text_parse(const char* buf, int64_t len, const int32_t* spec,
           while (c < line_end && !isspace(static_cast<unsigned char>(*c)))
             ++c;
         } else if (kind == 0) {
-          // negatives wrap in strtoull but overflow python's uint64 cast
-          // (both paths must DROP the line); '+5' parses as 5 on both
+          // negatives wrap and over-range saturates in strtoull, but both
+          // overflow python's uint64 cast → DROP the line on both paths
+          // ('+5' parses as 5 on both)
           if (*c == '-') { ok = false; break; }
           char* ep = nullptr;
+          errno = 0;
           uint64_t v = strtoull(c, &ep, 10);
-          if (ep == c || !at_token_end(ep, line_end)) { ok = false; break; }
+          if (ep == c || errno == ERANGE
+              || !at_token_end(ep, line_end)) { ok = false; break; }
           c = ep;
           if (nkeys >= key_cap) return -1;
           keys_out[nkeys] = v;
           key_slot_out[nkeys] = sparse_slot_id;
           ++nkeys;
         } else {
+          if (token_has_hex_marker(c, line_end)) { ok = false; break; }
           char* ep = nullptr;
           float v = strtof(c, &ep);
           if (ep == c || !at_token_end(ep, line_end)) { ok = false; break; }

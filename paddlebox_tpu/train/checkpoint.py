@@ -41,6 +41,22 @@ class CheckpointManager:
         self.root = root
         self.keep = keep
         os.makedirs(root, exist_ok=True)
+        self._recover()
+
+    def _recover(self) -> None:
+        """Finish interrupted re-saves: a crash between the two renames in
+        save() leaves 'ckpt-N.old-<pid>' with no 'ckpt-N' — restore the
+        aside copy; if both exist the save completed, drop the aside."""
+        for name in os.listdir(self.root):
+            if ".old-" not in name or not name.startswith("ckpt-"):
+                continue
+            aside = os.path.join(self.root, name)
+            final = os.path.join(self.root, name.split(".old-")[0])
+            if os.path.isdir(final):
+                shutil.rmtree(aside, ignore_errors=True)
+            else:
+                os.replace(aside, final)
+                log.warning("recovered interrupted checkpoint %s", final)
 
     # ---- paths ----
     def _dir(self, step: int) -> str:
@@ -80,6 +96,7 @@ class CheckpointManager:
         since the previous save) referencing the most recent base."""
         step = trainer.global_step if step is None else step
         base_step = None
+        prev_step = self.latest_step()  # chain link for gap detection
         if delta:
             base_step = self._latest_base()
             if base_step is None:
@@ -98,7 +115,9 @@ class CheckpointManager:
                  trainer.state.auc)), fh)
         with open(os.path.join(tmp, "meta.json"), "w") as fh:
             json.dump({"step": step, "kind": "delta" if delta else "base",
-                       "base_step": base_step, "sparse_rows": n}, fh)
+                       "base_step": base_step,
+                       "prev_step": prev_step if delta else None,
+                       "sparse_rows": n}, fh)
         final = self._dir(step)
         if os.path.isdir(final):
             # move the old dir aside BEFORE the swap — a crash between the
@@ -180,16 +199,22 @@ class CheckpointManager:
         return target
 
     def _chain(self, target: int) -> List[int]:
-        """base → …deltas… → target, following meta base_step links."""
-        meta = self._meta(target)
-        if meta["kind"] == "base":
-            return [target]
-        base = meta["base_step"]
-        if base is None or not os.path.isdir(self._dir(base)):
-            raise FileNotFoundError(
-                f"delta checkpoint {target} references missing base {base}")
-        # every delta between base and target (sorted) applies in order
-        mids = [s for s in self.steps()
-                if base < s <= target and self._meta(s)["kind"] == "delta"
-                and self._meta(s)["base_step"] == base]
-        return [base] + mids
+        """base → …deltas… → target, walking each delta's prev_step link
+        backwards. A MISSING link raises (each delta covers only rows
+        touched since the previous save — a gap would restore silently
+        stale rows)."""
+        chain = [target]
+        cur = target
+        while True:
+            meta = self._meta(cur)
+            if meta["kind"] == "base":
+                return chain
+            prev = meta.get("prev_step")
+            if prev is None:
+                prev = meta["base_step"]  # first delta links to its base
+            if prev is None or not os.path.isdir(self._dir(prev)):
+                raise FileNotFoundError(
+                    f"checkpoint chain broken: {cur} needs {prev} "
+                    "(deleted or lost) — restore an older base or resave")
+            chain.insert(0, prev)
+            cur = prev

@@ -131,3 +131,33 @@ def test_restore_empty_returns_none(setup):
     cm = CheckpointManager(root)
     assert cm.restore(mk()) is None
     assert cm.latest_step() is None
+
+
+def test_chain_gap_detected(setup):
+    ds, mk, root = setup
+    tr = mk()
+    cm = CheckpointManager(root, keep=10)
+    tr.train_pass(ds); cm.save(tr)
+    tr.train_pass(ds); cm.save(tr, delta=True)
+    mid_step = tr.global_step
+    tr.train_pass(ds); cm.save(tr, delta=True)
+    # simulate the lost-intermediate-delta scenario
+    import shutil
+    shutil.rmtree(cm._dir(mid_step))
+    with pytest.raises(FileNotFoundError):
+        cm.restore(mk())
+
+
+def test_interrupted_resave_recovers(setup):
+    ds, mk, root = setup
+    tr = mk()
+    cm = CheckpointManager(root)
+    tr.train_pass(ds)
+    cm.save(tr)
+    step = tr.global_step
+    # simulate a crash between the two renames of a re-save at the same
+    # step: only the aside dir remains
+    os.replace(cm._dir(step), cm._dir(step) + ".old-999")
+    cm2 = CheckpointManager(root)           # init runs recovery
+    assert cm2.latest_step() == step
+    assert cm2.restore(mk()) == step

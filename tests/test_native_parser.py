@@ -214,3 +214,24 @@ def test_criteo_hex_form_parity(tmp_path):
     np.testing.assert_array_equal(got["keys"], ref.keys)
     sent = (np.uint64(1) << np.uint64(52)) | np.uint64(0xFFFFFFFF)
     assert got["keys"][0] == sent
+
+
+@requires_native
+def test_uint64_overflow_and_hexfloat_parity(tmp_path):
+    """Over-range uint64 tokens and hex-float labels must be DROPPED by
+    both paths (python raises OverflowError/ValueError; native checks
+    ERANGE / hex markers)."""
+    slots = [SlotDef("label", "float", 1), SlotDef("s1", "uint64")]
+    desc = DataFeedDesc(slots=slots, batch_size=4, label_slot="label")
+    lines = [
+        "1 1 1 5",                          # ok
+        "1 1 1 18446744073709551616",       # 2^64: over-range → drop
+        "1 0x1p1 1 5",                      # hex-float label → drop
+    ]
+    f = tmp_path / "ovf.txt"
+    f.write_text("\n".join(lines) + "\n")
+    p = SlotTextParser(desc)
+    got = p.parse_file_columnar(str(f))
+    ref = _columnar_from_python(p, str(f), desc.dense_dim)
+    assert len(got["label"]) == ref.num_records == 1
+    np.testing.assert_array_equal(got["keys"], ref.keys)

@@ -160,3 +160,46 @@ def test_split_uid_groups_methods():
     # short timelines fall back to whole-chunk
     short = split_uid_groups([g[:3]], method=2, split_size=4, train_size=2)
     assert len(short) == 1 and short[0][1] == 0
+
+
+def test_timestamp_plumbing_and_range_mask():
+    """timestamp flows record → batch/columnar; uid timelines sort by it;
+    the test-phase range mask selects [lo, hi)."""
+    from paddlebox_tpu.data import DataFeedDesc, SlotDef
+    from paddlebox_tpu.data.batch import BatchBuilder
+    from paddlebox_tpu.data.columnar import ColumnarRecords
+    from paddlebox_tpu.data.pv import timestamp_range_mask
+
+    recs = [rec(1, 1, 222, uid=5) for _ in range(4)]
+    for i, r in enumerate(recs):
+        r.timestamp = 100 - i * 10    # out of order on purpose
+    groups = group_by_uid(recs)
+    assert [r.timestamp for r in groups[0]] == [70, 80, 90, 100]
+
+    desc = DataFeedDesc(
+        slots=[SlotDef("label", "float", 1)]
+        + [SlotDef(f"C{i}", "uint64") for i in range(2)],
+        batch_size=8, label_slot="label")
+    b = BatchBuilder(desc).build(recs)
+    np.testing.assert_array_equal(b.timestamp[:4], [100, 90, 80, 70])
+    col = ColumnarRecords.from_records(recs, 0)
+    np.testing.assert_array_equal(col.timestamp, [100, 90, 80, 70])
+    cb = col.batch(0, 4, desc, 2)
+    np.testing.assert_array_equal(cb.timestamp[:4], [100, 90, 80, 70])
+
+    m = timestamp_range_mask(b.timestamp, 75, 95)
+    np.testing.assert_array_equal(m[:4], [0, 1, 1, 0])
+
+
+def test_shard_filelist_round_robin():
+    from paddlebox_tpu.data.dataset import shard_filelist
+    files = [f"f{i}" for i in range(10)]
+    assert shard_filelist(files, rank=0, world=4) == ["f0", "f4", "f8"]
+    assert shard_filelist(files, rank=3, world=4) == ["f3", "f7"]
+    # union over ranks covers everything exactly once
+    got = sum((shard_filelist(files, r, 4) for r in range(4)), [])
+    assert sorted(got) == files
+    assert shard_filelist(files, rank=0, world=1) == files
+    import pytest as _pt
+    with _pt.raises(ValueError):
+        shard_filelist(files, rank=5, world=4)
