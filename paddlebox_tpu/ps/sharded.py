@@ -105,9 +105,16 @@ class ShardedEmbeddingTable:
         self._touched = np.zeros((num_shards, self.capacity + 1), dtype=bool)
 
     # ------------------------------------------------------------------
-    def prepare_global(self, batches: List[SlotBatch]) -> ShardedPullIndex:
+    def prepare_global(self, batches: List[SlotBatch],
+                       req_capacity: Optional[int] = None,
+                       serve_capacity: Optional[int] = None
+                       ) -> ShardedPullIndex:
         """Build the routing plan for N per-device batches (one global
-        batch). All batches must share K_pad/batch_size/num_slots."""
+        batch). All batches must share K_pad/batch_size/num_slots.
+        ``req_capacity``/``serve_capacity`` force the A/A2 buckets — the
+        resident-pass builder uses this to give every batch in a pass
+        identical shapes (gather_idx encodes positions as owner*A + j, so
+        A must be uniform across the staged pass)."""
         n = self.n
         assert len(batches) == n, f"need {n} local batches, got {len(batches)}"
         k_pad = max(b.keys.shape[0] for b in batches)
@@ -147,6 +154,11 @@ class ShardedEmbeddingTable:
                 a_max = max(a_max, len(sel))
             req_pos_of_uniq.append(pos)
         A = _bucket(a_max, self.req_bucket_min)
+        if req_capacity is not None:
+            if req_capacity < a_max:
+                raise ValueError(
+                    f"forced req_capacity {req_capacity} < needed {a_max}")
+            A = req_capacity
 
         # owner-side dedup: all (dst, j) requests to owner s → serve slots
         resp_idx = np.zeros((n, n, A), dtype=np.int32)
@@ -172,6 +184,11 @@ class ShardedEmbeddingTable:
                 resp_idx[s, d, cnt:] = len(su)
                 off += cnt
         A2 = _bucket(a2_max, self.serve_bucket_min)
+        if serve_capacity is not None:
+            if serve_capacity < a2_max:
+                raise ValueError(
+                    f"forced serve_capacity {serve_capacity} < {a2_max}")
+            A2 = serve_capacity
 
         serve_rows = np.empty((n, A2), dtype=np.int32)
         serve_valid = np.zeros((n, A2), dtype=np.float32)

@@ -97,6 +97,13 @@ class CheckpointManager:
         step = trainer.global_step if step is None else step
         base_step = None
         prev_step = self.latest_step()  # chain link for gap detection
+        if prev_step == step:
+            # re-save at the same step: the predecessor is whatever the
+            # existing checkpoint pointed at (never itself — _chain loops)
+            try:
+                prev_step = self._meta(step).get("prev_step")
+            except (OSError, ValueError, KeyError):
+                prev_step = None
         if delta:
             base_step = self._latest_base()
             if base_step is None:
@@ -211,8 +218,14 @@ class CheckpointManager:
                 return chain
             prev = meta.get("prev_step")
             if prev is None:
-                prev = meta["base_step"]  # first delta links to its base
-            if prev is None or not os.path.isdir(self._dir(prev)):
+                # every delta written by this manager records prev_step
+                # (the base for the first delta); a missing link means a
+                # foreign/corrupt meta — refuse rather than restore with
+                # intermediate deltas silently skipped
+                raise ValueError(
+                    f"delta checkpoint {cur} has no prev_step link — "
+                    "unsupported checkpoint format")
+            if prev == cur or not os.path.isdir(self._dir(prev)):
                 raise FileNotFoundError(
                     f"checkpoint chain broken: {cur} needs {prev} "
                     "(deleted or lost) — restore an older base or resave")

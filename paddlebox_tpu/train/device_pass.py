@@ -174,7 +174,9 @@ class ResidentPassRunner:
                     view = self._make_view(
                         rows_p[i], floats_p[i], meta_p[i],
                         segs_p[i % segs_p.shape[0]])
-                    rng_i = jax.random.fold_in(rng, state.step)
+                    # 1-based like Trainer.train_pass's fold of the
+                    # pre-incremented global_step
+                    rng_i = jax.random.fold_in(rng, state.step + 1)
                     state, _ = self.step._step(state, view, rng_i)
                     return state, rng
 

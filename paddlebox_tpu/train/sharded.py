@@ -270,6 +270,65 @@ class ShardedTrainStep:
                  rng: jax.Array):
         return self._sharded(state, batch, rng)
 
+    # ---- resident pass: the whole loop inside one shard_map program ----
+    def _resident_runner(self, n_steps: int):
+        key = ("resident", n_steps)
+        cached = getattr(self, "_resident_cache", None)
+        if cached is None:
+            cached = self._resident_cache = {}
+        if key not in cached:
+            shard0 = P(DATA_AXIS)
+            rep = P()
+            state_spec = ShardedStepState(
+                table=TableState(shard0), params=rep,
+                opt_state=(shard0 if self.zero1 else rep),
+                auc=AucState(*([shard0] * len(AucState._fields))),
+                step=rep)
+
+            def pass_spec(name):
+                nd = {"resp_idx": 4, "serve_rows": 3, "serve_valid": 3,
+                      "serve_slot": 3, "gather_idx": 3, "segments": 3,
+                      "dense": 4, "label": 3, "show": 3, "clk": 3}[name]
+                return P(*([None, DATA_AXIS] + [None] * (nd - 2)))
+
+            batch_spec = GlobalBatch(
+                *[pass_spec(f) for f in GlobalBatch._fields])
+
+            def run(state, pass_gb, start, rng):
+                def body(i, carry):
+                    st, r = carry
+                    gb = GlobalBatch(*[leaf[i] for leaf in pass_gb])
+                    # per-step rng matching the streaming trainer exactly:
+                    # it folds the PRE-incremented global_step (1-based)
+                    st, _ = self._device_step(
+                        st, gb, jax.random.fold_in(r, st.step + 1))
+                    return st, r
+
+                state, _ = jax.lax.fori_loop(
+                    start, start + n_steps, body, (state, rng))
+                return state
+
+            cached[key] = jax.jit(
+                jax.shard_map(run, mesh=self.mesh,
+                              in_specs=(state_spec, batch_spec, rep, rep),
+                              out_specs=state_spec, check_vma=False),
+                donate_argnums=(0,))
+        return cached[key]
+
+    def run_resident(self, state: ShardedStepState, rp, rng: jax.Array,
+                     chunk: int = 0):
+        """Run every staged global batch of a ShardedResidentPass."""
+        rp.upload()
+        nb = rp.num_batches
+        c = chunk or nb
+        i = 0
+        while i < nb:
+            n = min(c, nb - i)
+            state = self._resident_runner(n)(
+                state, rp.dev, jnp.asarray(i, jnp.int32), rng)
+            i += n
+        return state
+
 
 class ShardedTrainer:
     """Multi-chip trainer: groups the batch stream into N-device global
@@ -355,3 +414,106 @@ class ShardedTrainer:
 
     def reset_metrics(self) -> None:
         self.state = self.state._replace(auc=init_sharded_auc(self.n))
+
+    # ---- device-resident passes over the mesh ----
+    def build_resident_pass(self, dataset) -> "ShardedResidentPass":
+        return ShardedResidentPass.build(dataset, self)
+
+    def train_pass_resident(self, pass_or_dataset,
+                            log_prefix: str = "") -> Dict[str, float]:
+        """Mesh analogue of Trainer.train_pass_resident: the whole pass's
+        global batches (routing plans + features) are staged to HBM,
+        sharded over the device axis, and the pass runs as ONE
+        lax.fori_loop inside the shard_map program — per-step host work
+        and H2D hops are zero; embedding all_to_all / dense psum happen
+        inside the loop body exactly as in the streaming step."""
+        from paddlebox_tpu.metrics import auc_compute
+        from paddlebox_tpu.utils import Timer
+        from paddlebox_tpu.utils.logging import get_logger
+        log = get_logger(__name__)
+        timer = Timer()
+        timer.start()
+        rp = (pass_or_dataset
+              if isinstance(pass_or_dataset, ShardedResidentPass)
+              else self.build_resident_pass(pass_or_dataset))
+        rp.upload()
+        self.state = self.step_fn.run_resident(self.state, rp, self._rng)
+        jax.block_until_ready(self.state.step)
+        self.global_step += rp.num_batches
+        timer.pause()
+        self.table.state = self.state.table
+        auc_host = AucState(*[jnp.sum(l, axis=0) for l in self.state.auc])
+        res = auc_compute(auc_host)
+        out = res.as_dict()
+        out.update(batches=rp.num_batches, elapsed_sec=timer.elapsed_sec(),
+                   examples_per_sec=rp.num_records /
+                   max(timer.elapsed_sec(), 1e-9))
+        log.info("%ssharded resident pass: %d global batches, %.0f ex/s, "
+                 "auc=%.4f", log_prefix, rp.num_batches,
+                 out["examples_per_sec"], res.auc)
+        return out
+
+
+class ShardedResidentPass:
+    """A pass's global batches stacked on a leading step axis: every
+    GlobalBatch field becomes [nb, ...] (device dim sharded over the mesh
+    at upload). Routing plans are rebuilt with forced uniform A/A2/K
+    buckets when batches landed in different ones (gather_idx encodes
+    owner*A + j, so A must match across the staged pass)."""
+
+    def __init__(self, arrays: Dict[str, np.ndarray], num_records: int,
+                 mesh: Mesh) -> None:
+        self.arrays = arrays
+        self.num_records = num_records
+        self.mesh = mesh
+        self.dev: Optional[GlobalBatch] = None
+
+    @property
+    def num_batches(self) -> int:
+        return self.arrays["label"].shape[0]
+
+    @classmethod
+    def build(cls, dataset, trainer: "ShardedTrainer"
+              ) -> "ShardedResidentPass":
+        table = trainer.table
+        groups = list(trainer._group_iter(dataset.batches()))
+        plans = [table.prepare_global(g) for g in groups]
+        a = max(p.req_capacity for p in plans)
+        a2 = max(p.serve_capacity for p in plans)
+        # rebuild ONLY mismatched plans with forced buckets (typically
+        # just the tail group; row assignment is idempotent)
+        plans = [p if p.req_capacity == a and p.serve_capacity == a2
+                 else table.prepare_global(g, req_capacity=a,
+                                           serve_capacity=a2)
+                 for g, p in zip(groups, plans)]
+        gbs = [make_global_batch(g, p) for g, p in zip(groups, plans)]
+        k = max(gb.gather_idx.shape[1] for gb in gbs)
+        # pad values that stay inert: gather_idx pads → the recv sentinel
+        # slot (n*A - 1, zero values), segments pads → the discarded
+        # pooling bin (bs * num_slots)
+        pad_of = {"gather_idx": trainer.n * a - 1,
+                  "segments": trainer.desc.batch_size *
+                  len(trainer.desc.sparse_slots)}
+        arrays: Dict[str, np.ndarray] = {}
+        for f in GlobalBatch._fields:
+            parts = []
+            for gb in gbs:
+                arr = np.asarray(getattr(gb, f))
+                if f in pad_of and arr.shape[1] < k:
+                    arr = np.pad(arr, ((0, 0), (0, k - arr.shape[1])),
+                                 constant_values=pad_of[f])
+                parts.append(arr)
+            arrays[f] = np.stack(parts)
+        n_rec = sum(int((b.show > 0).sum()) for g in groups for b in g)
+        return cls(arrays, n_rec, trainer.mesh)
+
+    def upload(self) -> None:
+        """Stage to HBM with the device dim sharded over the mesh axis."""
+        if self.dev is not None:
+            return
+        put = {}
+        for f, arr in self.arrays.items():
+            spec = P(*([None, DATA_AXIS] + [None] * (arr.ndim - 2)))
+            put[f] = jax.device_put(
+                jnp.asarray(arr), NamedSharding(self.mesh, spec))
+        self.dev = GlobalBatch(**put)

@@ -161,3 +161,19 @@ def test_interrupted_resave_recovers(setup):
     cm2 = CheckpointManager(root)           # init runs recovery
     assert cm2.latest_step() == step
     assert cm2.restore(mk()) == step
+
+
+def test_delta_resave_same_step_no_loop(setup):
+    """Re-saving a delta at the same step must inherit the OLD dir's
+    predecessor link, not point at itself (infinite _chain loop)."""
+    ds, mk, root = setup
+    tr = mk()
+    cm = CheckpointManager(root, keep=10)
+    tr.train_pass(ds); cm.save(tr)
+    tr.train_pass(ds)
+    cm.save(tr, delta=True)
+    cm.save(tr, delta=True)     # retry at the SAME step
+    meta = cm._meta(tr.global_step)
+    assert meta["prev_step"] != tr.global_step
+    tr2 = mk()
+    assert cm.restore(tr2) == tr.global_step  # terminates, correct chain
