@@ -103,6 +103,11 @@ class ShardedEmbeddingTable:
             for leaf in single
         ])
         self._touched = np.zeros((num_shards, self.capacity + 1), dtype=bool)
+        # serializes host index/touched mutation across threads (resident
+        # pass preloading vs save/shrink — same discipline as
+        # EmbeddingTable.host_lock)
+        import threading
+        self.host_lock = threading.Lock()
 
     # ------------------------------------------------------------------
     def prepare_global(self, batches: List[SlotBatch],
@@ -145,8 +150,9 @@ class ShardedEmbeddingTable:
             for s in range(n):
                 sel = np.nonzero(owners == s)[0]
                 keys_s = uniq[sel]
-                rows_s = self.indexes[s].assign(keys_s)
-                self._touched[s][rows_s] = True
+                with self.host_lock:
+                    rows_s = self.indexes[s].assign(keys_s)
+                    self._touched[s][rows_s] = True
                 req_rows[d][s] = rows_s
                 req_slots[d][s] = dev_uniq_slot[d][sel]
                 pos[sel, 0] = s
@@ -226,8 +232,9 @@ class ShardedEmbeddingTable:
         blobs = {}
         total = 0
         for s in range(self.n):
-            keys, rows = self.indexes[s].items()
-            keys, rows = row_filter(s, keys, rows)
+            with self.host_lock:
+                keys, rows = self.indexes[s].items()
+                keys, rows = row_filter(s, keys, rows)
             blobs[f"keys_{s}"] = keys
             for f in FIELDS:
                 blobs[f"{f}_{s}"] = field_slice(data[s][rows], f)

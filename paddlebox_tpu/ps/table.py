@@ -228,6 +228,21 @@ def push_stats(gather_idx: jax.Array, key_valid: jax.Array,
     return touched, slot_val
 
 
+def push_stats_fast(unique_rows: jax.Array, gather_idx: jax.Array,
+                    key_valid: jax.Array, slot_of_key: jax.Array,
+                    capacity: int) -> Tuple[jax.Array, jax.Array]:
+    """Cheaper push_stats for the dup-free unique_rows contract: every
+    in-bounds unique row was hit by ≥1 valid key (pads are OOB), so
+    ``touched`` is a vector compare instead of a segment count; the slot
+    id comes from one segment_max over valid keys (the reference stores
+    THE slot of the feasign — keys live in one slot — so max ≡ it)."""
+    touched = unique_rows <= capacity  # sentinel counts; OOB pads don't
+    slot_val = jax.ops.segment_max(
+        jnp.where(key_valid > 0, slot_of_key, -1.0), gather_idx,
+        num_segments=unique_rows.shape[0])
+    return touched, jnp.maximum(slot_val, 0.0)
+
+
 def apply_push(
     state: TableState,
     unique_rows: jax.Array,   # int32 [U_pad]

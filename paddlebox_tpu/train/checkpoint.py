@@ -101,9 +101,15 @@ class CheckpointManager:
             # re-save at the same step: the predecessor is whatever the
             # existing checkpoint pointed at (never itself — _chain loops)
             try:
-                prev_step = self._meta(step).get("prev_step")
+                old = self._meta(step)
             except (OSError, ValueError, KeyError):
-                prev_step = None
+                old = {}
+            if delta and old.get("kind") == "base":
+                raise ValueError(
+                    f"step {step} holds a BASE checkpoint; a delta re-save "
+                    "would destroy it and leave an unrestorable chain — "
+                    "save a base instead")
+            prev_step = old.get("prev_step")
         if delta:
             base_step = self._latest_base()
             if base_step is None:

@@ -207,19 +207,29 @@ class PassPreloader:
     wait_feed_pass_done (box_wrapper.h:1142-1156) for resident passes:
     builds + uploads pass k+1 in a background thread while pass k trains."""
 
-    def __init__(self, datasets: Iterator[Dataset], table,
-                 floats_dtype=np.float32) -> None:
+    def __init__(self, datasets: Iterator[Dataset], table=None,
+                 floats_dtype=np.float32, build_fn=None) -> None:
+        """``build_fn(dataset) -> pass`` overrides the default single-chip
+        ResidentPass builder — e.g.
+        ``build_fn=sharded_trainer.build_resident_pass`` double-buffers
+        mesh passes the same way."""
+        if table is None and build_fn is None:
+            raise ValueError("need a table or a build_fn")
         self._it = iter(datasets)
         self._table = table
         self._floats_dtype = floats_dtype
-        self._next: Optional[ResidentPass] = None
+        self._build_fn = build_fn
+        self._next = None
         self._thread: Optional[threading.Thread] = None
         self._err: Optional[BaseException] = None
 
     def _load(self, ds: Dataset) -> None:
         try:
-            rp = ResidentPass.build(ds, self._table,
-                                    floats_dtype=self._floats_dtype)
+            if self._build_fn is not None:
+                rp = self._build_fn(ds)
+            else:
+                rp = ResidentPass.build(ds, self._table,
+                                        floats_dtype=self._floats_dtype)
             rp.upload()
             self._next = rp
         except BaseException as e:  # surfaces on next()

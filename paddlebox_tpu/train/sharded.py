@@ -135,6 +135,7 @@ class ShardedTrainStep:
             params=rep, opt_state=(shard0 if zero1 else rep),
             auc=AucState(*([shard0] * len(AucState._fields))),
             step=rep)
+        self._state_spec = state_spec  # shared with _resident_runner
         batch_spec = GlobalBatch(*([shard0] * len(GlobalBatch._fields)))
         self._sharded = jax.jit(
             jax.shard_map(
@@ -277,13 +278,8 @@ class ShardedTrainStep:
         if cached is None:
             cached = self._resident_cache = {}
         if key not in cached:
-            shard0 = P(DATA_AXIS)
             rep = P()
-            state_spec = ShardedStepState(
-                table=TableState(shard0), params=rep,
-                opt_state=(shard0 if self.zero1 else rep),
-                auc=AucState(*([shard0] * len(AucState._fields))),
-                step=rep)
+            state_spec = self._state_spec
 
             def pass_spec(name):
                 nd = {"resp_idx": 4, "serve_rows": 3, "serve_valid": 3,
@@ -477,6 +473,8 @@ class ShardedResidentPass:
               ) -> "ShardedResidentPass":
         table = trainer.table
         groups = list(trainer._group_iter(dataset.batches()))
+        if not groups:
+            raise ValueError("empty pass")
         plans = [table.prepare_global(g) for g in groups]
         a = max(p.req_capacity for p in plans)
         a2 = max(p.serve_capacity for p in plans)

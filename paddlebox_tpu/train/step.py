@@ -26,7 +26,7 @@ from paddlebox_tpu.ops import fused_seqpool_cvm
 from paddlebox_tpu.ps.sgd import SparseSGDConfig
 from paddlebox_tpu.ps.table import (PullIndex, TableState, apply_push,
                                     expand_pull, gather_full_rows,
-                                    pull_values, push_stats)
+                                    pull_values, push_stats_fast)
 
 
 def pack_floats(dense: np.ndarray, label: np.ndarray, show: np.ndarray,
@@ -206,9 +206,9 @@ class TrainStep:
         g_vals_u = jnp.concatenate(
             [g_vals_u[:, :2], g_vals_u[:, 2:] * (-1.0 * b)], axis=1)
         slot_of_key = (batch.segments % s).astype(jnp.float32)
-        touched, slot_val = push_stats(
-            batch.gather_idx, batch.key_valid, slot_of_key,
-            batch.unique_rows.shape[0])
+        touched, slot_val = push_stats_fast(
+            batch.unique_rows, batch.gather_idx, batch.key_valid,
+            slot_of_key, state.table.capacity)
         table = apply_push(state.table, batch.unique_rows, g_vals_u,
                            touched, slot_val, self.sgd_cfg, rng,
                            rows_full=rows_full)

@@ -263,3 +263,37 @@ def test_sharded_resident_matches_streaming(mesh, tmp_path):
     tr_b.reset_metrics()
     rb2 = tr_b.train_pass_resident(ds)
     assert rb2["auc"] > rb["auc"] - 0.02
+
+
+def test_sharded_pass_preloader(mesh, tmp_path):
+    """PassPreloader double-buffers mesh resident passes via build_fn."""
+    from paddlebox_tpu.train import PassPreloader
+    files = generate_criteo_files(str(tmp_path), num_files=1,
+                                  rows_per_file=600, vocab_per_slot=30,
+                                  seed=17)
+    desc = DataFeedDesc.criteo(batch_size=32)
+    desc.key_bucket_min = 1024
+    ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=0.0)
+    table = ShardedEmbeddingTable(N, mf_dim=2, capacity_per_shard=2048,
+                                  cfg=cfg, req_bucket_min=128,
+                                  serve_bucket_min=128)
+    with flags_scope(log_period_steps=10000):
+        tr = ShardedTrainer(DeepFM(hidden=(16,)), table, desc, mesh,
+                            tx=optax.adam(1e-3))
+        pre = PassPreloader(iter([ds, ds]),
+                            build_fn=tr.build_resident_pass)
+        pre.start_next()
+        results = []
+        while True:
+            rp = pre.wait()
+            if rp is None:
+                break
+            more = pre.start_next()
+            results.append(tr.train_pass_resident(rp))
+            if not more:
+                break
+    assert len(results) == 2
+    assert all(np.isfinite(r["auc"]) for r in results)
