@@ -23,6 +23,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddlebox_tpu.config import FLAGS
+from paddlebox_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
 
 
 class AucState(NamedTuple):
@@ -141,6 +144,7 @@ class MetricRegistry:
     def __init__(self) -> None:
         self._metrics: Dict[str, object] = {}
         self.phase = 1  # 1=join, 0=update (FlipPhase semantics)
+        self._warned_missing: set = set()
 
     def init_metric(self, name: str, method: str = "auc", **kwargs):
         from paddlebox_tpu.metrics_ext import METRIC_METHODS
@@ -161,8 +165,31 @@ class MetricRegistry:
         out = self._metrics[name].compute()
         return out.as_dict() if isinstance(out, AucResult) else out
 
+    def add_batch(self, pred, label, weight=None, **inputs) -> None:
+        """Feed every phase-active metric from one batch — the per-batch
+        AddAucMonitor hook (boxps_worker.cc:1267). ``inputs`` carries the
+        side channels (uid/rank/cmatch/mask…); None values are dropped so
+        metrics that don't need them never see them. A metric whose
+        REQUIRED side channels are absent from this feed is skipped (with
+        a one-time warning) instead of crashing the pass."""
+        kw = {k: v for k, v in inputs.items() if v is not None}
+        for name, m in self.active().items():
+            missing = [r for r in getattr(m, "REQUIRED", ()) if r not in kw]
+            if missing:
+                if name not in self._warned_missing:
+                    self._warned_missing.add(name)
+                    log.warning(
+                        "metric %r skipped: feed lacks required side "
+                        "channel(s) %s", name, missing)
+                continue
+            # keywords throughout: some variants take only (pred, **_)
+            m.add(pred, label=label, weight=weight, **kw)
+
     def flip_phase(self) -> None:
         self.phase = 1 - self.phase
+
+    def __len__(self) -> int:
+        return len(self._metrics)
 
     def active(self) -> Dict[str, Metric]:
         return {k: m for k, m in self._metrics.items()

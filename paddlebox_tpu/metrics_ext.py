@@ -80,6 +80,7 @@ class CmatchRankAucMetric(AucMetric):
     (CmatchRankMetricMsg, metrics.h:279; ignore_rank ⇒ match cmatch only)."""
 
     method = "cmatch_rank_auc"
+    REQUIRED = ("cmatch",)
 
     def __init__(self, name: str, cmatch_rank_group: str,
                  ignore_rank: bool = False, **kw) -> None:
@@ -101,6 +102,7 @@ class MaskAucMetric(AucMetric):
     """AUC over instances with mask==1 (MaskMetricMsg, metrics.h:369)."""
 
     method = "mask_auc"
+    REQUIRED = ("mask",)
 
     def selection_weight(self, weight, *, mask, **_):
         return weight * (mask > 0).astype(weight.dtype)
@@ -108,6 +110,8 @@ class MaskAucMetric(AucMetric):
 
 class CmatchRankMaskAucMetric(CmatchRankAucMetric):
     """Both filters (CmatchRankMaskMetricMsg, metrics.h:414)."""
+
+    REQUIRED = ("cmatch", "mask")
 
     method = "cmatch_rank_mask_auc"
 
@@ -119,6 +123,7 @@ class CmatchRankMaskAucMetric(CmatchRankAucMetric):
 
 
 class MultiTaskAucMetric(AucMetric):
+    REQUIRED = ("cmatch",)
     """Per-instance task head selected by cmatch (MultiTaskMetricMsg,
     metrics.h:198): pred[i] = preds[i, task_of(cmatch[i])]."""
 
@@ -246,9 +251,11 @@ def _tie_averaged_user_auc(uid: np.ndarray, pred: np.ndarray,
 class WuAucMetric:
     """Per-user (weighted-user) AUC (WuAucMetricMsg, metrics.h:497).
     Collects (uid, pred, label) host-side per batch, like the reference's
-    record-based WuAucCalculator."""
+    record-based WuAucCalculator. NOTE: host-side accumulate — adding it
+    to a trainer registry forces a device sync per batch."""
 
     method = "wuauc"
+    REQUIRED = ("uid",)
 
     def __init__(self, name: str, label: str = "label", pred: str = "pred",
                  uid: str = "uid", phase: int = -1) -> None:

@@ -37,6 +37,7 @@ ICI-friendly schedule.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import List, NamedTuple, Optional, Tuple
 
 import jax
@@ -106,7 +107,6 @@ class ShardedEmbeddingTable:
         # serializes host index/touched mutation across threads (resident
         # pass preloading vs save/shrink — same discipline as
         # EmbeddingTable.host_lock)
-        import threading
         self.host_lock = threading.Lock()
 
     # ------------------------------------------------------------------
@@ -235,12 +235,15 @@ class ShardedEmbeddingTable:
             with self.host_lock:
                 keys, rows = self.indexes[s].items()
                 keys, rows = row_filter(s, keys, rows)
+                # clear only the SNAPSHOTTED rows, inside the lock — rows
+                # touched concurrently (preload thread) keep their flag
+                # for the next delta
+                self._touched[s][rows] = False
             blobs[f"keys_{s}"] = keys
             for f in FIELDS:
                 blobs[f"{f}_{s}"] = field_slice(data[s][rows], f)
             total += len(keys)
         np.savez_compressed(path, n=self.n, **blobs)
-        self._touched[:] = False
         return total
 
     def save_base(self, path: str) -> int:

@@ -383,9 +383,11 @@ class EmbeddingTable:
         """Full model dump (day-level batch model). Returns rows saved."""
         with self.host_lock:
             keys, rows = self.index.items()
+            # clear only snapshotted rows under the lock (rows touched by
+            # a concurrent preload keep their delta flag)
+            self._touched[rows] = False
         data = self._gather_host(rows)
         np.savez_compressed(path, keys=keys, **data)
-        self._touched[:] = False
         return len(keys)
 
     def save_delta(self, path: str) -> int:
@@ -393,10 +395,10 @@ class EmbeddingTable:
         with self.host_lock:
             keys, rows = self.index.items()
             mask = self._touched[rows]
-        keys, rows = keys[mask], rows[mask]
+            keys, rows = keys[mask], rows[mask]
+            self._touched[rows] = False
         data = self._gather_host(rows)
         np.savez_compressed(path, keys=keys, **data)
-        self._touched[:] = False
         return len(keys)
 
     def load(self, path: str, merge: bool = False) -> int:

@@ -120,6 +120,15 @@ class Trainer:
             rng = jax.random.fold_in(self._rng, self.global_step)
             self.state, stats = self.step_fn(self.state, dev, rng)
             nb += 1
+            if len(self.metrics):
+                # AddAucMonitor hook: feed registered metric variants.
+                # Side channels stay HOST numpy — device metrics convert
+                # on device, host metrics (wuauc) avoid a round trip;
+                # pred stays the device array (host metrics sync on it).
+                ins_w = (batch.show > 0).astype(np.float32)
+                self.metrics.add_batch(
+                    stats["pred"], batch.label, ins_w,
+                    uid=batch.uid, rank=batch.rank, cmatch=batch.cmatch)
             if dump_writer is not None and nb % self._dump_cfg.interval == 0:
                 dump_writer.add_batch(
                     batch.ins_ids,
@@ -175,6 +184,11 @@ class Trainer:
             log.warning("dump configured: falling back to streaming "
                         "train_pass for this pass")
             return self.train_pass(pass_or_dataset, log_prefix)
+        if len(self.metrics):
+            log.warning(
+                "registry metrics do not accumulate in resident mode "
+                "(no per-batch host hook) — use train_pass for metric "
+                "variants; the built-in AUC still accumulates in-state")
         timer = Timer()
         timer.start()
         rp = (pass_or_dataset if isinstance(pass_or_dataset, ResidentPass)

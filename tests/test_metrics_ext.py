@@ -156,3 +156,66 @@ def test_registry_dispatch_and_phase():
         reg.init_metric("x", method="nope")
     msg = reg.get_metric_msg("wu")
     assert msg["ins_num"] == 0.0
+
+
+def test_registry_auto_feed_through_trainer():
+    """Registered metric variants accumulate automatically during
+    train_pass (AddAucMonitor semantics) with batch side channels."""
+    import optax
+    from paddlebox_tpu.data import DataFeedDesc, InMemoryDataset, SlotDef
+    from paddlebox_tpu.data.record import SlotRecord
+    from paddlebox_tpu.models import CtrDnn
+    from paddlebox_tpu.ps import EmbeddingTable, SparseSGDConfig
+    from paddlebox_tpu.train import Trainer
+
+    rng = np.random.default_rng(0)
+    S = 3
+    recs = []
+    for i in range(512):
+        keys = (rng.integers(0, 40, S) + np.arange(S) * 40).astype(np.uint64)
+        lbl = float(rng.random() < 0.3)
+        recs.append(SlotRecord(
+            keys=keys, slot_offsets=np.arange(S + 1, dtype=np.int32),
+            dense=rng.normal(size=2).astype(np.float32), label=lbl,
+            show=1.0, clk=lbl, uid=int(i % 17),
+            rank=int(rng.integers(1, 4)),
+            cmatch=int(rng.choice([222, 223, 0]))))
+    slots = [SlotDef("label", "float", 1), SlotDef("dense", "float", 2)]
+    slots += [SlotDef(f"C{i}", "uint64") for i in range(S)]
+    desc = DataFeedDesc(slots=slots, batch_size=64, label_slot="label")
+    ds = InMemoryDataset(desc)
+    ds.records = recs
+    ds.columnarize()
+
+    t = EmbeddingTable(mf_dim=2, capacity=1 << 12,
+                       cfg=SparseSGDConfig(mf_create_thresholds=0.0))
+    tr = Trainer(CtrDnn(hidden=(8,)), t, desc, tx=optax.adam(1e-2))
+    tr.metrics.init_metric("all", method="auc")
+    tr.metrics.init_metric("cm222", method="cmatch_rank_auc",
+                           cmatch_rank_group="222:1,222:2,222:3")
+    tr.metrics.init_metric("wu", method="wuauc")
+    tr.train_pass(ds)
+
+    msg_all = tr.metrics.get_metric_msg("all")
+    msg_cm = tr.metrics.get_metric_msg("cm222")
+    msg_wu = tr.metrics.get_metric_msg("wu")
+    assert msg_all["ins_num"] == 512
+    # cmatch 222 subset only
+    n222 = sum(1 for r in recs if r.cmatch == 222)
+    assert msg_cm["ins_num"] == n222 > 0
+    assert np.isfinite(msg_wu["wuauc"])
+    assert msg_wu["user_count"] == 17
+
+
+def test_registry_skips_metric_missing_side_channel():
+    """A registered metric whose REQUIRED side channel is absent from the
+    feed is skipped with a warning, not a crash."""
+    from paddlebox_tpu.metrics import MetricRegistry
+    reg = MetricRegistry()
+    reg.init_metric("m", method="mask_auc")      # needs mask — never fed
+    reg.init_metric("a", method="auc")
+    pred = jnp.asarray(np.array([0.2, 0.8], np.float32))
+    label = np.array([0.0, 1.0], np.float32)
+    reg.add_batch(pred, label, np.ones(2, np.float32))  # must not raise
+    assert reg.get_metric_msg("a")["ins_num"] == 2
+    assert reg.get_metric_msg("m")["ins_num"] == 0
