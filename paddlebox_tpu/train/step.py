@@ -247,14 +247,15 @@ class TrainStep:
         return jax.nn.sigmoid(logits), ins_w
 
     def _eval_step(self, table: TableState, params: Any, auc: AucState,
-                   batch: DeviceBatch) -> AucState:
+                   batch: DeviceBatch) -> Tuple[AucState, jax.Array]:
         """Forward-only pass: metrics accumulate, nothing trains
-        (test_program / infer phase of the reference workers)."""
+        (test_program / infer phase of the reference workers). Returns
+        (auc, pred) — pred feeds the metric registry."""
         pred, ins_w = self._forward(table, params, batch)
-        return auc_add_batch(auc, pred, batch.label, ins_w)
+        return auc_add_batch(auc, pred, batch.label, ins_w), pred
 
     def eval(self, table: TableState, params: Any, auc: AucState,
-             batch: DeviceBatch) -> AucState:
+             batch: DeviceBatch) -> Tuple[AucState, jax.Array]:
         return self._jit_eval(table, params, auc, batch)
 
     def __call__(self, state: StepState, batch: DeviceBatch,

@@ -229,8 +229,14 @@ class Trainer:
         it = self._prefetch_iter(dataset.batches(),
                                  prepare=self.table.prepare_eval)
         for batch, dev in it:
-            auc = self.step_fn.eval(self.state.table, self.state.params,
-                                    auc, dev)
+            auc, pred = self.step_fn.eval(self.state.table,
+                                          self.state.params, auc, dev)
+            if len(self.metrics):
+                # test-phase metric feed (same hook as train_pass)
+                self.metrics.add_batch(
+                    pred, batch.label,
+                    (batch.show > 0).astype(np.float32),
+                    uid=batch.uid, rank=batch.rank, cmatch=batch.cmatch)
             nb += 1
         timer.pause()
         res = auc_compute(auc)
