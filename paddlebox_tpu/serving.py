@@ -1,0 +1,137 @@
+"""Online-serving loader — the consumer of base/delta model exports.
+
+Reference flow (SURVEY.md §3.4): ``SaveBase`` writes the day-level batch
+model plus the "xbox" serving model, ``SaveDelta`` ships incremental row
+updates to the online serving fleet; serving processes load base + apply
+deltas and answer embedding lookups / CTR predictions
+(box_wrapper.cc:1383,1406; the closed xbox server consumed these files).
+
+TPU-native equivalent: the same ``.npz`` artifacts written by
+``EmbeddingTable.save_base/save_delta`` (or the CheckpointManager) load
+into a read-only ``ServingModel`` that answers:
+
+- ``embed_lookup(keys)`` — raw feature rows for feature-store style use;
+- ``predict(batch)``     — full CTR forward (pull → fused_seqpool_cvm →
+  dense net), eval semantics: unknown keys read as zeros, nothing trains.
+
+Kept deliberately dependency-light: one table + a flax module + params,
+jit-compiled per batch bucket; suitable for a CPU host or a TPU chip.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.data.batch import SlotBatch
+from paddlebox_tpu.data.schema import DataFeedDesc
+from paddlebox_tpu.ops import fused_seqpool_cvm
+from paddlebox_tpu.ps.sgd import SparseSGDConfig
+from paddlebox_tpu.ps.table import EmbeddingTable
+from paddlebox_tpu.train.step import (DeviceBatch, make_device_batch,
+                                      unpack_floats)
+from paddlebox_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class ServingModel:
+    """Read-only base+delta consumer (the xbox-server role)."""
+
+    def __init__(self, model, desc: DataFeedDesc, mf_dim: int,
+                 capacity: int = 1 << 20, use_cvm: bool = True,
+                 cvm_offset: int = 2, need_filter: bool = False,
+                 quant_ratio: int = 0) -> None:
+        """The seqpool knobs (cvm_offset/need_filter/quant_ratio) must
+        match the TrainStep that produced the dense params, exactly as in
+        TrainStep._step — they change the pooled features."""
+        self.model = model
+        self.desc = desc
+        self.use_cvm = use_cvm
+        self.cvm_offset = cvm_offset
+        self.need_filter = need_filter
+        self.quant_ratio = quant_ratio
+        self.table = EmbeddingTable(mf_dim=mf_dim, capacity=capacity,
+                                    cfg=SparseSGDConfig())
+        self.params = None
+        self._host_data: Optional[np.ndarray] = None  # lookup cache
+        b = self.desc.batch_size
+        s = len(self.desc.sparse_slots)
+
+        @jax.jit
+        def _fwd(table_data, params, dev: DeviceBatch):
+            from paddlebox_tpu.ps.table import (TableState,
+                                                gather_full_rows,
+                                                pull_values)
+            table = TableState(table_data)
+            vals_u = pull_values(gather_full_rows(table, dev.unique_rows))
+            values_k = vals_u[dev.gather_idx]
+            dense, label, show, clk = unpack_floats(dev.floats)
+            show_clk = jnp.stack([show, clk], axis=1)
+            # knob order mirrors TrainStep._step's fused_seqpool_cvm call
+            pooled = fused_seqpool_cvm(
+                values_k, dev.segments, show_clk, b, s,
+                self.use_cvm, self.cvm_offset, 0.0, self.need_filter,
+                0.2, 1.0, 0.96, self.quant_ratio)
+            logits = self.model.apply(params, pooled, dense)
+            return jax.nn.sigmoid(logits)
+
+        self._fwd = _fwd  # jit retraces per batch-bucket shape itself
+
+    # ---- artifact loading ----
+    def load_base(self, path: str) -> int:
+        """Replace the table with a save_base artifact."""
+        n = self.table.load(path, merge=False)
+        self._host_data = None
+        log.info("serving: loaded base %s (%d rows)", path, n)
+        return n
+
+    def apply_delta(self, path: str) -> int:
+        """Apply a save_delta artifact on top (incremental row updates)."""
+        n = self.table.load(path, merge=True)
+        self._host_data = None
+        log.info("serving: applied delta %s (%d rows)", path, n)
+        return n
+
+    def load_dense(self, path: str) -> None:
+        """Load dense params — accepts the trainer's ``.dense.pkl``
+        (params, opt_state) or a CheckpointManager ``dense.pkl``
+        (params, opt_state, auc); only params are used."""
+        with open(path, "rb") as fh:
+            blob = pickle.load(fh)
+        self.params = jax.device_put(
+            blob[0] if isinstance(blob, tuple) else blob)
+
+    # ---- queries ----
+    def embed_lookup(self, keys: np.ndarray) -> np.ndarray:
+        """[n] uint64 → [n, 3+mf] pull values (show, clk, w, embedx…);
+        unknown keys → zeros. Serves from a cached host mirror of the
+        table (invalidated by load_base/apply_delta)."""
+        from paddlebox_tpu.ps.table import FIELD_COL, NUM_FIXED
+        keys = np.ascontiguousarray(keys, np.uint64)
+        rows, inv = self.table.index.lookup_unique(keys, self.table.capacity)
+        if self._host_data is None:
+            self._host_data = np.asarray(
+                jax.device_get(self.table.state.data))
+        data = self._host_data
+        rows_c = np.minimum(rows, self.table.capacity)  # OOB pads clamp
+        vals = data[rows_c]
+        gate = (vals[:, FIELD_COL["mf_size"]:FIELD_COL["mf_size"] + 1] > 0)
+        out = np.concatenate(
+            [vals[:, FIELD_COL["show"]:FIELD_COL["clk"] + 1],
+             vals[:, FIELD_COL["embed_w"]:FIELD_COL["embed_w"] + 1],
+             vals[:, NUM_FIXED:] * gate], axis=1)
+        return out[inv]
+
+    def predict(self, batch: SlotBatch) -> np.ndarray:
+        """CTR predictions for one batch (unknown keys pull zeros)."""
+        if self.params is None:
+            raise RuntimeError("load_dense first")
+        idx = self.table.prepare_eval(batch)
+        dev = make_device_batch(batch, idx)
+        return np.asarray(self._fwd(self.table.state.data, self.params,
+                                    dev))

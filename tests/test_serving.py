@@ -1,0 +1,83 @@
+"""ServingModel: base+delta consumption and prediction parity with the
+trainer's eval path (the xbox-server role)."""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+
+from paddlebox_tpu.data import DataFeedDesc, DatasetFactory
+from paddlebox_tpu.data.criteo import generate_criteo_files
+from paddlebox_tpu.models import CtrDnn
+from paddlebox_tpu.ps import EmbeddingTable, SparseSGDConfig
+from paddlebox_tpu.serving import ServingModel
+from paddlebox_tpu.train import Trainer
+
+
+@pytest.fixture()
+def trained(tmp_path):
+    files = generate_criteo_files(str(tmp_path / "d"), num_files=1,
+                                  rows_per_file=600, vocab_per_slot=40,
+                                  seed=4)
+    desc = DataFeedDesc.criteo(batch_size=64)
+    ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=0.0)
+    table = EmbeddingTable(mf_dim=4, capacity=1 << 13, cfg=cfg)
+    tr = Trainer(CtrDnn(hidden=(16,)), table, desc, tx=optax.adam(1e-2))
+    tr.train_pass(ds)
+    base = str(tmp_path / "base.npz")
+    tr.sync_table()
+    table.save_base(base)
+    tr.train_pass(ds)
+    delta = str(tmp_path / "delta.npz")
+    tr.sync_table()
+    table.save_delta(delta)
+    dense = str(tmp_path / "m")
+    tr.save(dense)   # writes m.dense.pkl + m.sparse.npz
+    return tr, ds, desc, base, delta, dense + ".dense.pkl"
+
+
+def test_serving_predicts_like_trainer(trained):
+    tr, ds, desc, base, delta, dense = trained
+    srv = ServingModel(CtrDnn(hidden=(16,)), desc, mf_dim=4,
+                       capacity=1 << 13)
+    n_base = srv.load_base(base)
+    n_delta = srv.apply_delta(delta)
+    assert n_base > 0 and n_delta > 0
+    srv.load_dense(dense)
+
+    batch = next(ds.batches())
+    preds = srv.predict(batch)
+    assert preds.shape == (desc.batch_size,)
+    assert np.isfinite(preds).all()
+
+    # oracle: the trainer's own eval forward on the same batch
+    from paddlebox_tpu.metrics import init_auc_state
+    from paddlebox_tpu.train.step import make_device_batch
+    idx = tr.table.prepare_eval(batch)
+    dev = make_device_batch(batch, idx)
+    _, pred_ref = tr.step_fn.eval(tr.state.table, tr.state.params,
+                                  init_auc_state(), dev)
+    np.testing.assert_allclose(preds, np.asarray(pred_ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_embed_lookup_known_and_unknown(trained):
+    tr, ds, desc, base, delta, dense = trained
+    srv = ServingModel(CtrDnn(hidden=(16,)), desc, mf_dim=4,
+                       capacity=1 << 13)
+    srv.load_base(base)
+    srv.apply_delta(delta)
+    keys, rows = srv.table.index.items()
+    some = keys[:7]
+    vals = srv.embed_lookup(np.concatenate(
+        [some, np.array([0xDEAD_BEEF_0001], np.uint64)]))
+    assert vals.shape == (8, 3 + 4)
+    assert np.abs(vals[:7]).sum() > 0       # known keys carry state
+    np.testing.assert_array_equal(vals[7], 0)  # unknown → zeros
+    # duplicate keys map to identical values
+    v2 = srv.embed_lookup(np.array([some[0], some[0]], np.uint64))
+    np.testing.assert_array_equal(v2[0], v2[1])
