@@ -50,6 +50,7 @@ class HostStore:
         }
         self._touched = np.zeros(self._alloc, dtype=bool)
         self._lock = threading.Lock()
+        self._spill_files: list = []  # active disk-tier files (spill_cold)
 
     def _shape(self, field: str, n: int) -> Tuple[int, ...]:
         return (n, self.mf_dim) if field in _2D_FIELDS else (n,)
@@ -97,17 +98,61 @@ class HostStore:
                 self._arr[f][rows] = data[f]
             self._touched[rows] = True
 
+    # ---- shared helpers (score / eviction / dump format) ----
+    def _score(self, rows: np.ndarray, nonclk_coeff: float,
+               clk_coeff: float) -> np.ndarray:
+        """Feature heat (ctr_accessor shrink rule): coeffs over show/clk."""
+        show, clk = self._arr["show"][rows], self._arr["clk"][rows]
+        return nonclk_coeff * (show - clk) + clk_coeff * clk
+
+    def _free(self, keys: np.ndarray) -> np.ndarray:
+        """Release keys and zero their rows; returns freed row ids."""
+        freed = self.index.release(keys)
+        for f in FIELDS:
+            self._arr[f][freed] = 0
+        self._touched[freed] = False
+        return freed
+
     # ---- checkpoint (SaveBase/SaveDelta, box_wrapper.cc:1383-1415) ----
-    def _dump(self, path: str, keys: np.ndarray, rows: np.ndarray) -> int:
-        np.savez_compressed(
-            path, keys=keys, mf_dim=np.int32(self.mf_dim),
-            **{f: self._arr[f][rows] for f in FIELDS})
+    def _dump(self, path: str, keys: np.ndarray, rows: np.ndarray,
+              extra: Optional[Dict[str, Dict[str, np.ndarray]]] = None
+              ) -> int:
+        """npz dump of rows; ``extra`` appends out-of-RAM rows (spilled
+        tiers) as {field: values} with their own key array."""
+        blobs = {f: self._arr[f][rows] for f in FIELDS}
+        if extra:
+            keys = np.concatenate([keys, extra["keys"]])
+            for f in FIELDS:
+                blobs[f] = np.concatenate([blobs[f], extra[f]])
+        np.savez_compressed(path, keys=keys, mf_dim=np.int32(self.mf_dim),
+                            **blobs)
         return len(keys)
 
+    def _spilled_not_in_ram(self) -> Optional[Dict[str, np.ndarray]]:
+        """Rows living only in spill files (for complete base exports)."""
+        if not self._spill_files:
+            return None
+        out = {f: [] for f in FIELDS}
+        out_keys = []
+        for p in list(self._spill_files):
+            blob = np.load(p)
+            dkeys = blob["keys"]
+            dead = self.index.lookup(
+                np.ascontiguousarray(dkeys, np.uint64)) < 0
+            out_keys.append(dkeys[dead])
+            for f in FIELDS:
+                out[f].append(blob[f][dead])
+        res = {f: np.concatenate(v) for f, v in out.items()}
+        res["keys"] = np.concatenate(out_keys)
+        return res if len(res["keys"]) else None
+
     def save_base(self, path: str) -> int:
+        """Full model dump — includes rows currently spilled to disk
+        tiers, so the exported base is always the COMPLETE model."""
         with self._lock:
             keys, rows = self.index.items()
-            n = self._dump(path, keys, rows)
+            n = self._dump(path, keys, rows,
+                           extra=self._spilled_not_in_ram())
             self._touched[:] = False
         log.info("save_base: %d rows -> %s", n, path)
         return n
@@ -137,6 +182,66 @@ class HostStore:
                 self._arr[f][rows] = blob[f]
         return len(keys)
 
+    # ---- disk tier (SSD role: LoadSSD2Mem, box_wrapper.cc:1415) ----
+    def spill_cold(self, path: str, threshold: float,
+                   nonclk_coeff: float = 0.1, clk_coeff: float = 1.0) -> int:
+        """Move COLD rows (score < threshold) to a disk file and free
+        their host rows — the host-RAM ↔ SSD boundary of the reference's
+        tiered store (hot rows stay in mem, cold spill to SSD until a
+        later ``load_from_disk`` promotes them back for a pass).
+
+        Only rows whose updates are already exported spill (touched rows
+        stay in RAM): a spilled row is on disk in BOTH the spill file and
+        the last base, so no save_delta update can be lost, and
+        ``save_base`` merges spill files in so exports stay complete."""
+        with self._lock:
+            keys, rows = self.index.items()
+            if len(keys) == 0:
+                np.savez_compressed(path, keys=np.empty(0, np.uint64),
+                                    mf_dim=np.int32(self.mf_dim))
+                return 0
+            cold = self._score(rows, nonclk_coeff, clk_coeff) < threshold
+            cold &= ~self._touched[rows]  # unsaved updates never spill
+            ck, cr = keys[cold], rows[cold]
+            self._dump_subset(path, ck, cr)
+            self._free(ck)
+            if path not in self._spill_files:  # re-spill overwrites
+                self._spill_files.append(path)
+        log.info("spill_cold: %d/%d rows -> %s", len(ck), len(keys), path)
+        return int(len(ck))
+
+    def _dump_subset(self, path: str, keys: np.ndarray,
+                     rows: np.ndarray) -> None:
+        np.savez_compressed(path, keys=keys, mf_dim=np.int32(self.mf_dim),
+                            **{f: self._arr[f][rows] for f in FIELDS})
+
+    def load_from_disk(self, path: str, keys: Optional[np.ndarray] = None
+                       ) -> int:
+        """Promote spilled rows back into host RAM (LoadSSD2Mem). With
+        ``keys``, only the requested subset (a pass working set) loads;
+        rows already live in RAM keep their fresher in-memory state."""
+        blob = np.load(path)
+        dkeys = blob["keys"]
+        if len(dkeys) == 0:
+            return 0
+        sel = np.ones(len(dkeys), bool)
+        if keys is not None:
+            sel = np.isin(dkeys, np.ascontiguousarray(keys, np.uint64))
+        with self._lock:
+            live = self.index.lookup(
+                np.ascontiguousarray(dkeys, np.uint64)) >= 0
+            sel &= ~live  # RAM state wins over the spilled copy
+            lk = dkeys[sel]
+            rows = self.index.assign(lk)
+            if len(rows):
+                self._ensure(int(rows.max()))
+            for f in FIELDS:
+                self._arr[f][rows] = blob[f][sel]
+            if keys is None and path in self._spill_files:
+                self._spill_files.remove(path)  # fully promoted
+        log.info("load_from_disk: %d rows <- %s", len(lk), path)
+        return int(len(lk))
+
     # ---- feature aging (ShrinkTable, box_wrapper.h:638) ----
     def shrink(self, delete_threshold: Optional[float] = None,
                decay: Optional[float] = None,
@@ -151,12 +256,7 @@ class HostStore:
             self._arr["show"] *= dk
             self._arr["clk"] *= dk
             self._arr["delta_score"] *= dk
-            show, clk = self._arr["show"][rows], self._arr["clk"][rows]
-            score = nonclk_coeff * (show - clk) + clk_coeff * clk
-            drop = score < thr
-            freed = self.index.release(keys[drop])
-            for f in FIELDS:
-                self._arr[f][freed] = 0
-            self._touched[freed] = False
+            drop = self._score(rows, nonclk_coeff, clk_coeff) < thr
+            freed = self._free(keys[drop])
         log.info("host shrink: freed %d/%d rows", len(freed), len(keys))
         return int(len(freed))

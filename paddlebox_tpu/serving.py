@@ -64,21 +64,12 @@ class ServingModel:
 
         @jax.jit
         def _fwd(table_data, params, dev: DeviceBatch):
-            from paddlebox_tpu.ps.table import (TableState,
-                                                gather_full_rows,
-                                                pull_values)
-            table = TableState(table_data)
-            vals_u = pull_values(gather_full_rows(table, dev.unique_rows))
-            values_k = vals_u[dev.gather_idx]
-            dense, label, show, clk = unpack_floats(dev.floats)
-            show_clk = jnp.stack([show, clk], axis=1)
-            # knob order mirrors TrainStep._step's fused_seqpool_cvm call
-            pooled = fused_seqpool_cvm(
-                values_k, dev.segments, show_clk, b, s,
-                self.use_cvm, self.cvm_offset, 0.0, self.need_filter,
-                0.2, 1.0, 0.96, self.quant_ratio)
-            logits = self.model.apply(params, pooled, dense)
-            return jax.nn.sigmoid(logits)
+            from paddlebox_tpu.ps.table import TableState
+            from paddlebox_tpu.train.step import ctr_forward
+            return ctr_forward(
+                TableState(table_data), params, self.model, dev, b, s,
+                self.use_cvm, self.cvm_offset, self.need_filter,
+                self.quant_ratio)
 
         self._fwd = _fwd  # jit retraces per batch-bucket shape itself
 
@@ -127,11 +118,19 @@ class ServingModel:
              vals[:, NUM_FIXED:] * gate], axis=1)
         return out[inv]
 
-    def predict(self, batch: SlotBatch) -> np.ndarray:
-        """CTR predictions for one batch (unknown keys pull zeros)."""
+    def predict(self, batch: SlotBatch,
+                return_valid: bool = False):
+        """CTR predictions for one batch (unknown keys pull zeros).
+
+        A batch shorter than ``desc.batch_size`` is padded; padding
+        entries hold the net's output on zero rows, NOT real
+        predictions — pass ``return_valid=True`` to also get the 0/1
+        validity mask and filter them."""
         if self.params is None:
             raise RuntimeError("load_dense first")
         idx = self.table.prepare_eval(batch)
         dev = make_device_batch(batch, idx)
-        return np.asarray(self._fwd(self.table.state.data, self.params,
-                                    dev))
+        pred, ins_w = self._fwd(self.table.state.data, self.params, dev)
+        if return_valid:
+            return np.asarray(pred), np.asarray(ins_w)
+        return np.asarray(pred)

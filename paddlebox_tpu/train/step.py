@@ -121,6 +121,25 @@ def make_device_batch(batch: SlotBatch, idx: PullIndex) -> DeviceBatch:
                        floats=jnp.asarray(floats))
 
 
+def ctr_forward(table: TableState, params: Any, model, batch,
+                batch_size: int, num_slots: int, use_cvm: bool = True,
+                cvm_offset: int = 2, need_filter: bool = False,
+                quant_ratio: int = 0) -> Tuple[jax.Array, jax.Array]:
+    """THE CTR inference path (pull → fused_seqpool_cvm → model →
+    sigmoid), shared by the train step's eval and the serving loader so
+    the seqpool constants live in exactly one place. Returns
+    (pred [B], ins_w [B]) — ins_w masks batch-padding instances."""
+    batch_show_clk = jnp.stack([batch.show, batch.clk], axis=1)
+    vals_u = pull_values(gather_full_rows(table, batch.unique_rows))
+    values_k = expand_pull(vals_u, batch.gather_idx)
+    pooled = fused_seqpool_cvm(
+        values_k, batch.segments, batch_show_clk, batch_size, num_slots,
+        use_cvm, cvm_offset, 0.0, need_filter, 0.2, 1.0, 0.96, quant_ratio)
+    logits = model.apply(params, pooled, batch.dense)
+    ins_w = (batch.show > 0).astype(jnp.float32)
+    return jax.nn.sigmoid(logits), ins_w
+
+
 class StepState(NamedTuple):
     table: TableState
     params: Any
@@ -234,17 +253,10 @@ class TrainStep:
     def _forward(self, table: TableState, params: Any,
                  batch: DeviceBatch) -> Tuple[jax.Array, jax.Array]:
         """Shared inference path: pull → seqpool_cvm → model → pred."""
-        b, s = self.batch_size, self.num_slots
-        batch_show_clk = jnp.stack([batch.show, batch.clk], axis=1)
-        vals_u = pull_values(gather_full_rows(table, batch.unique_rows))
-        values_k = expand_pull(vals_u, batch.gather_idx)
-        pooled = fused_seqpool_cvm(
-            values_k, batch.segments, batch_show_clk, b, s,
-            self.use_cvm, self.cvm_offset, 0.0, self.need_filter,
-            0.2, 1.0, 0.96, self.quant_ratio)
-        logits = self.model.apply(params, pooled, batch.dense)
-        ins_w = (batch.show > 0).astype(jnp.float32)
-        return jax.nn.sigmoid(logits), ins_w
+        return ctr_forward(table, params, self.model, batch,
+                           self.batch_size, self.num_slots, self.use_cvm,
+                           self.cvm_offset, self.need_filter,
+                           self.quant_ratio)
 
     def _eval_step(self, table: TableState, params: Any, auc: AucState,
                    batch: DeviceBatch) -> Tuple[AucState, jax.Array]:

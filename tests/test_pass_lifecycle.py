@@ -151,3 +151,49 @@ def test_boxps_helper_multi_pass_training(criteo_files, tmp_path):
     # full model dump contains the union of both passes' features
     base = str(tmp_path / "base.npz")
     assert helper.save_base(base) == len(hs)
+
+
+def test_host_store_disk_tier(tmp_path):
+    """spill_cold → load_from_disk roundtrip (the host-RAM↔SSD boundary:
+    LoadSSD2Mem semantics; RAM state wins over stale spilled copies)."""
+    hs = HostStore(mf_dim=2, capacity=1 << 12)
+    keys = np.arange(1, 21, dtype=np.uint64)
+    data = {f: (np.random.default_rng(0).normal(
+        size=(20, 2)).astype(np.float32) if f == "embedx_w"
+        else np.zeros(20, np.float32)) for f in
+        ("show", "clk", "delta_score", "slot", "embed_w", "embed_g2sum",
+         "embedx_g2sum", "mf_size", "embedx_w")}
+    data["show"][:10] = 100.0   # hot rows
+    data["clk"][:10] = 5.0
+    data["embed_w"][:] = np.arange(20, dtype=np.float32) + 1
+    hs.update(keys, data)
+
+    ssd = str(tmp_path / "cold.npz")
+    # touched (never-exported) rows refuse to spill
+    assert hs.spill_cold(ssd, threshold=1.0) == 0
+    hs.save_base(str(tmp_path / "b0.npz"))  # export → rows become spillable
+    n = hs.spill_cold(ssd, threshold=1.0)
+    assert n == 10 and len(hs) == 10
+    # base exports stay COMPLETE while rows are spilled
+    full = str(tmp_path / "full.npz")
+    assert hs.save_base(full) == 20
+    blob = np.load(full)
+    assert len(np.unique(blob["keys"])) == 20
+    # cold keys gone from RAM
+    assert (hs.index.lookup(keys[10:]) == -1).all()
+
+    # mutate a HOT row after the spill; promote everything back
+    upd = {f: data[f][:1].copy() for f in data}
+    upd["embed_w"][0] = 999.0
+    hs.update(keys[:1], upd)
+    got = hs.load_from_disk(ssd)
+    assert got == 10 and len(hs) == 20
+    vals = hs.fetch(keys)
+    np.testing.assert_allclose(vals["embed_w"][0], 999.0)   # RAM wins
+    np.testing.assert_allclose(vals["embed_w"][10:],
+                               np.arange(10, 20) + 1)       # promoted
+
+    # subset promotion: only the pass working set loads
+    hs2 = HostStore(mf_dim=2, capacity=1 << 12)
+    hs2.load_from_disk(ssd, keys=keys[10:13])
+    assert len(hs2) == 3
