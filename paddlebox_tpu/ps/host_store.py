@@ -51,6 +51,7 @@ class HostStore:
         self._touched = np.zeros(self._alloc, dtype=bool)
         self._lock = threading.Lock()
         self._spill_files: list = []  # active disk-tier files (spill_cold)
+        self._spill_keys: Dict[str, np.ndarray] = {}  # path → spilled keys
 
     def _shape(self, field: str, n: int) -> Tuple[int, ...]:
         return (n, self.mf_dim) if field in _2D_FIELDS else (n,)
@@ -77,9 +78,24 @@ class HostStore:
     # ---- pass staging ----
     def fetch(self, keys: np.ndarray) -> Dict[str, np.ndarray]:
         """Values for ``keys``; unknown keys read as zero-initialized rows
-        (they materialize on update — lazy feature creation)."""
+        (they materialize on update — lazy feature creation). Keys that
+        live only in a disk-tier spill file are promoted transparently
+        first (the LoadSSD2Mem step of the pass lifecycle), so
+        PassScopedTable.stage never trains a spilled feature from zero."""
+        keys_u64 = np.ascontiguousarray(keys, np.uint64)
+        if self._spill_files:
+            with self._lock:
+                missing = self.index.lookup(keys_u64) < 0
+            if missing.any():
+                want = keys_u64[missing]
+                for p in list(self._spill_files):
+                    cached = self._spill_keys.get(p)
+                    if cached is not None and not np.isin(
+                            want, cached).any():
+                        continue  # no requested key spilled in this file
+                    self.load_from_disk(p, keys=want)
         with self._lock:
-            rows = self.index.lookup(np.ascontiguousarray(keys, np.uint64))
+            rows = self.index.lookup(keys_u64)
             known = rows >= 0
             out = {}
             for f in FIELDS:
@@ -128,6 +144,30 @@ class HostStore:
                             **blobs)
         return len(keys)
 
+    def _purge_spilled(self, keys: np.ndarray) -> None:
+        """Drop keys from every registered spill file (rewrite) — called
+        with shrink-deleted keys so an aged-out feature's stale spilled
+        copy can never resurrect into a base export. Caller holds _lock."""
+        if not self._spill_files or len(keys) == 0:
+            return
+        for p in list(self._spill_files):
+            cached = self._spill_keys.get(p)
+            if cached is not None and not np.isin(cached, keys).any():
+                continue  # file holds none of the dropped keys
+            blob = np.load(p)
+            dkeys = blob["keys"]
+            keep = ~np.isin(dkeys, keys)
+            if keep.all():
+                continue
+            if keep.any():
+                np.savez_compressed(
+                    p, keys=dkeys[keep], mf_dim=np.int32(self.mf_dim),
+                    **{f: blob[f][keep] for f in FIELDS})
+                self._spill_keys[p] = dkeys[keep]
+            else:
+                self._spill_files.remove(p)
+                self._spill_keys.pop(p, None)
+
     def _spilled_not_in_ram(self) -> Optional[Dict[str, np.ndarray]]:
         """Rows living only in spill files (for complete base exports)."""
         if not self._spill_files:
@@ -175,6 +215,8 @@ class HostStore:
                 for f in FIELDS:
                     self._arr[f][:] = 0
                 self._touched[:] = False
+                self._spill_files = []  # old model's tiers don't carry over
+                self._spill_keys = {}
             rows = self.index.assign(keys)
             if len(rows):
                 self._ensure(int(rows.max()))
@@ -194,32 +236,35 @@ class HostStore:
         stay in RAM): a spilled row is on disk in BOTH the spill file and
         the last base, so no save_delta update can be lost, and
         ``save_base`` merges spill files in so exports stay complete."""
+        if path in self._spill_files:
+            raise ValueError(
+                f"{path} already holds an active spill — overwriting would "
+                "lose its still-spilled rows; use a fresh path per spill")
         with self._lock:
             keys, rows = self.index.items()
             if len(keys) == 0:
-                np.savez_compressed(path, keys=np.empty(0, np.uint64),
-                                    mf_dim=np.int32(self.mf_dim))
                 return 0
             cold = self._score(rows, nonclk_coeff, clk_coeff) < threshold
             cold &= ~self._touched[rows]  # unsaved updates never spill
             ck, cr = keys[cold], rows[cold]
-            self._dump_subset(path, ck, cr)
+            if len(ck) == 0:
+                return 0
+            self._dump(path, ck, cr)
             self._free(ck)
-            if path not in self._spill_files:  # re-spill overwrites
-                self._spill_files.append(path)
+            self._spill_files.append(path)
+            self._spill_keys[path] = ck
         log.info("spill_cold: %d/%d rows -> %s", len(ck), len(keys), path)
         return int(len(ck))
-
-    def _dump_subset(self, path: str, keys: np.ndarray,
-                     rows: np.ndarray) -> None:
-        np.savez_compressed(path, keys=keys, mf_dim=np.int32(self.mf_dim),
-                            **{f: self._arr[f][rows] for f in FIELDS})
 
     def load_from_disk(self, path: str, keys: Optional[np.ndarray] = None
                        ) -> int:
         """Promote spilled rows back into host RAM (LoadSSD2Mem). With
         ``keys``, only the requested subset (a pass working set) loads;
-        rows already live in RAM keep their fresher in-memory state."""
+        rows already live in RAM keep their fresher in-memory state.
+
+        Promoted (or RAM-superseded) keys are REMOVED from the spill
+        file's accounting — a later shrink of a promoted key can never
+        resurrect its stale spilled copy into a base export."""
         blob = np.load(path)
         dkeys = blob["keys"]
         if len(dkeys) == 0:
@@ -237,8 +282,18 @@ class HostStore:
                 self._ensure(int(rows.max()))
             for f in FIELDS:
                 self._arr[f][rows] = blob[f][sel]
-            if keys is None and path in self._spill_files:
-                self._spill_files.remove(path)  # fully promoted
+            # deregister what no longer lives only on disk
+            remain = ~(sel | live)
+            if path in self._spill_files:
+                if remain.any():
+                    np.savez_compressed(
+                        path, keys=dkeys[remain],
+                        mf_dim=np.int32(self.mf_dim),
+                        **{f: blob[f][remain] for f in FIELDS})
+                    self._spill_keys[path] = dkeys[remain]
+                else:
+                    self._spill_files.remove(path)  # nothing left spilled
+                    self._spill_keys.pop(path, None)
         log.info("load_from_disk: %d rows <- %s", len(lk), path)
         return int(len(lk))
 
@@ -258,5 +313,6 @@ class HostStore:
             self._arr["delta_score"] *= dk
             drop = self._score(rows, nonclk_coeff, clk_coeff) < thr
             freed = self._free(keys[drop])
+            self._purge_spilled(keys[drop])
         log.info("host shrink: freed %d/%d rows", len(freed), len(keys))
         return int(len(freed))

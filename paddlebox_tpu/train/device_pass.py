@@ -84,7 +84,12 @@ class ResidentPass:
             rk = np.full(b.key_capacity, cap, np.int32)
             with table.host_lock:  # vs shrink/save on the main thread
                 r = table.index.assign(b.keys[:nk])
-                table._touched[r] = True
+            # NOTE: _touched is deliberately NOT set here — a preloaded
+            # pass hasn't trained yet, and a checkpoint save landing
+            # between build and training would clear the flags and lose
+            # the pass's updates from the next delta. The trainer marks
+            # the pass's rows touched AFTER the pass runs
+            # (mark_trained_rows).
             rk[:nk] = r
             rows_l.append(rk)
             floats_l.append(pack_floats(b.dense, b.label, b.show, b.clk,
@@ -122,6 +127,15 @@ class ResidentPass:
     def nbytes(self) -> int:
         n = self.rows.nbytes + self.floats.nbytes + self.meta.nbytes
         return n + (self.segs.nbytes if self.segs is not None else 0)
+
+    def mark_trained_rows(self, table) -> None:
+        """Flag this pass's rows as touched-since-last-save — called by
+        the trainer AFTER the pass runs, so delta saves include them
+        regardless of when a checkpoint landed relative to the preload."""
+        rows = np.unique(self.rows)
+        rows = rows[rows < table.capacity]  # drop sentinel/OOB pads
+        with table.host_lock:
+            table._touched[rows] = True
 
 
 class _BatchView:

@@ -435,6 +435,7 @@ class ShardedTrainer:
         rp.upload()
         self.state = self.step_fn.run_resident(self.state, rp, self._rng)
         jax.block_until_ready(self.state.step)
+        rp.mark_trained_rows(self.table)
         self.global_step += rp.num_batches
         timer.pause()
         self.table.state = self.state.table
@@ -504,6 +505,16 @@ class ShardedResidentPass:
             arrays[f] = np.stack(parts)
         n_rec = sum(int((b.show > 0).sum()) for g in groups for b in g)
         return cls(arrays, n_rec, trainer.mesh)
+
+    def mark_trained_rows(self, table: ShardedEmbeddingTable) -> None:
+        """Per-shard touched flags for this pass's served rows, set AFTER
+        training (same delta-save rationale as ResidentPass)."""
+        sr = self.arrays["serve_rows"]  # [nb, N, A2]
+        with table.host_lock:
+            for s in range(sr.shape[1]):
+                rows = np.unique(sr[:, s])
+                rows = rows[rows < table.capacity]
+                table._touched[s][rows] = True
 
     def upload(self) -> None:
         """Stage to HBM with the device dim sharded over the mesh axis."""

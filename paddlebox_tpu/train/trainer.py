@@ -202,6 +202,7 @@ class Trainer:
             self._resident_runners[key] = runner
         self.state = runner.run_pass(self.state, rp, self._rng)
         jax.block_until_ready(self.state.step)
+        rp.mark_trained_rows(self.table)
         self.global_step += rp.num_batches
         timer.pause()
         self.sync_table()

@@ -177,3 +177,22 @@ def test_delta_resave_same_step_no_loop(setup):
     assert meta["prev_step"] != tr.global_step
     tr2 = mk()
     assert cm.restore(tr2) == tr.global_step  # terminates, correct chain
+
+
+def test_delta_includes_preloaded_pass_rows(setup):
+    """A checkpoint save landing between a pass's PRELOAD (build) and its
+    training must not erase the pass's rows from the next delta —
+    regression for build-time touched marking."""
+    ds, mk, root = setup
+    tr = mk()
+    cm = CheckpointManager(root, keep=10)
+    from paddlebox_tpu.train import ResidentPass
+    rp1 = ResidentPass.build(ds, tr.table)   # preload pass 1
+    rp2 = ResidentPass.build(ds, tr.table)   # preload pass 2 (same keys)
+    tr.train_pass_resident(rp1)
+    cm.save(tr)                              # base clears touched flags
+    tr.train_pass_resident(rp2)              # trains rows built BEFORE save
+    cm.save(tr, delta=True)
+    meta = cm._meta(tr.global_step)
+    assert meta["sparse_rows"] > 0, \
+        "delta lost the preloaded pass's trained rows"

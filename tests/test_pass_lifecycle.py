@@ -197,3 +197,37 @@ def test_host_store_disk_tier(tmp_path):
     hs2 = HostStore(mf_dim=2, capacity=1 << 12)
     hs2.load_from_disk(ssd, keys=keys[10:13])
     assert len(hs2) == 3
+
+
+def test_disk_tier_read_through_and_no_resurrection(tmp_path):
+    """fetch() transparently promotes spilled keys (LoadSSD2Mem in the
+    pass path); shrink-deleted keys never resurrect from spill files;
+    duplicate spill paths refuse; load(merge=False) drops registration."""
+    from paddlebox_tpu.ps.host_store import FIELDS
+    hs = HostStore(mf_dim=2, capacity=1 << 12)
+    keys = np.arange(1, 11, dtype=np.uint64)
+    mk = lambda n, v: {f: (np.full((n, 2), v, np.float32)
+                           if f == "embedx_w" else np.full(n, v, np.float32))
+                       for f in FIELDS}
+    hs.update(keys, mk(10, 3.0))
+    hs.save_base(str(tmp_path / "b.npz"))        # flags clear → spillable
+    ssd = str(tmp_path / "s1.npz")
+    assert hs.spill_cold(ssd, threshold=1e9) == 10  # everything cold
+    assert len(hs) == 0
+    with pytest.raises(ValueError):              # duplicate path refused
+        hs.spill_cold(ssd, threshold=1e9)
+    # read-through: fetch promotes from disk instead of zero-filling
+    got = hs.fetch(keys[:3])
+    np.testing.assert_allclose(got["embed_w"], 3.0)
+    assert len(hs) == 3
+    # shrink a promoted key; it must not resurrect into the next base
+    hs._arr["show"][hs.index.lookup(keys[:1])] = 0.0
+    hs.shrink(delete_threshold=10.0, decay=1.0)  # drops all 3 promoted
+    full = str(tmp_path / "full.npz")
+    n = hs.save_base(full)
+    blob = np.load(full)
+    assert keys[0] not in blob["keys"]           # no resurrection
+    assert n == 7                                # the 7 still-spilled rows
+    # reset-load forgets old spill registration
+    hs.load(str(tmp_path / "b.npz"), merge=False)
+    assert hs._spill_files == []
