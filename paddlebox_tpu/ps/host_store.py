@@ -86,14 +86,13 @@ class HostStore:
         if self._spill_files:
             with self._lock:
                 missing = self.index.lookup(keys_u64) < 0
-            if missing.any():
                 want = keys_u64[missing]
-                for p in list(self._spill_files):
-                    cached = self._spill_keys.get(p)
-                    if cached is not None and not np.isin(
-                            want, cached).any():
-                        continue  # no requested key spilled in this file
-                    self.load_from_disk(p, keys=want)
+                candidates = [
+                    p for p in self._spill_files
+                    if np.isin(want, self._spill_keys[p]).any()
+                ] if missing.any() else []
+            for p in candidates:
+                self.load_from_disk(p, keys=want)
         with self._lock:
             rows = self.index.lookup(keys_u64)
             known = rows >= 0
@@ -151,19 +150,12 @@ class HostStore:
         if not self._spill_files or len(keys) == 0:
             return
         for p in list(self._spill_files):
-            cached = self._spill_keys.get(p)
-            if cached is not None and not np.isin(cached, keys).any():
-                continue  # file holds none of the dropped keys
-            blob = np.load(p)
-            dkeys = blob["keys"]
-            keep = ~np.isin(dkeys, keys)
+            reg = self._spill_keys[p]
+            keep = ~np.isin(reg, keys)
             if keep.all():
                 continue
             if keep.any():
-                np.savez_compressed(
-                    p, keys=dkeys[keep], mf_dim=np.int32(self.mf_dim),
-                    **{f: blob[f][keep] for f in FIELDS})
-                self._spill_keys[p] = dkeys[keep]
+                self._spill_keys[p] = reg[keep]
             else:
                 self._spill_files.remove(p)
                 self._spill_keys.pop(p, None)
@@ -177,11 +169,13 @@ class HostStore:
         for p in list(self._spill_files):
             blob = np.load(p)
             dkeys = blob["keys"]
+            reg = self._spill_keys[p]
             dead = self.index.lookup(
                 np.ascontiguousarray(dkeys, np.uint64)) < 0
-            out_keys.append(dkeys[dead])
+            sel = dead & np.isin(dkeys, reg)
+            out_keys.append(dkeys[sel])
             for f in FIELDS:
-                out[f].append(blob[f][dead])
+                out[f].append(blob[f][sel])
         res = {f: np.concatenate(v) for f, v in out.items()}
         res["keys"] = np.concatenate(out_keys)
         return res if len(res["keys"]) else None
@@ -236,11 +230,12 @@ class HostStore:
         stay in RAM): a spilled row is on disk in BOTH the spill file and
         the last base, so no save_delta update can be lost, and
         ``save_base`` merges spill files in so exports stay complete."""
-        if path in self._spill_files:
-            raise ValueError(
-                f"{path} already holds an active spill — overwriting would "
-                "lose its still-spilled rows; use a fresh path per spill")
         with self._lock:
+            if path in self._spill_files:
+                raise ValueError(
+                    f"{path} already holds an active spill — overwriting "
+                    "would lose its still-spilled rows; use a fresh path "
+                    "per spill")
             keys, rows = self.index.items()
             if len(keys) == 0:
                 return 0
@@ -251,6 +246,8 @@ class HostStore:
                 return 0
             self._dump(path, ck, cr)
             self._free(ck)
+            # the file is IMMUTABLE from here on; _spill_keys[path] is the
+            # live accounting of which of its rows are still disk-only
             self._spill_files.append(path)
             self._spill_keys[path] = ck
         log.info("spill_cold: %d/%d rows -> %s", len(ck), len(keys), path)
@@ -262,10 +259,11 @@ class HostStore:
         ``keys``, only the requested subset (a pass working set) loads;
         rows already live in RAM keep their fresher in-memory state.
 
-        Promoted (or RAM-superseded) keys are REMOVED from the spill
-        file's accounting — a later shrink of a promoted key can never
-        resurrect its stale spilled copy into a base export."""
-        blob = np.load(path)
+        Promoted (or RAM-superseded) keys leave the spill ACCOUNTING
+        (_spill_keys — the file itself is immutable): a later shrink of a
+        promoted key can never resurrect its stale spilled copy into a
+        base export, and no call ever rewrites a spill file."""
+        blob = np.load(path)  # immutable file: safe to read unlocked
         dkeys = blob["keys"]
         if len(dkeys) == 0:
             return 0
@@ -282,17 +280,14 @@ class HostStore:
                 self._ensure(int(rows.max()))
             for f in FIELDS:
                 self._arr[f][rows] = blob[f][sel]
-            # deregister what no longer lives only on disk
-            remain = ~(sel | live)
-            if path in self._spill_files:
-                if remain.any():
-                    np.savez_compressed(
-                        path, keys=dkeys[remain],
-                        mf_dim=np.int32(self.mf_dim),
-                        **{f: blob[f][remain] for f in FIELDS})
-                    self._spill_keys[path] = dkeys[remain]
+            reg = self._spill_keys.get(path)
+            if reg is not None:
+                gone = dkeys[sel | live]
+                remaining = reg[~np.isin(reg, gone)]
+                if len(remaining):
+                    self._spill_keys[path] = remaining
                 else:
-                    self._spill_files.remove(path)  # nothing left spilled
+                    self._spill_files.remove(path)
                     self._spill_keys.pop(path, None)
         log.info("load_from_disk: %d rows <- %s", len(lk), path)
         return int(len(lk))

@@ -73,6 +73,9 @@ class Trainer:
         self.global_step = 0
         self._dump_cfg = None
         self._resident_runners: Dict[Any, Any] = {}
+        # per-pass stage timers (PrintSyncTimer role, box_wrapper.cc:1182)
+        from paddlebox_tpu.utils.profiler import StageTimers
+        self.stage_timers = StageTimers()
 
     # ---- host-side prefetch: batch build + dedup + row assign + H2D ----
     def _prefetch_iter(
@@ -86,11 +89,18 @@ class Trainer:
         compute through bounded channels."""
         from paddlebox_tpu.utils.prefetch import prefetch_iter
         prep = prepare or self.table.prepare
-        prepared = prefetch_iter(batches, lambda b: (b, prep(b)),
-                                 capacity=self.prefetch)
-        return prefetch_iter(
-            prepared, lambda t: (t[0], make_device_batch(t[0], t[1])),
-            capacity=self.prefetch)
+        st = self.stage_timers
+
+        def do_prep(b):
+            with st.stage("prepare"):
+                return b, prep(b)
+
+        def do_h2d(t):
+            with st.stage("h2d"):
+                return t[0], make_device_batch(t[0], t[1])
+
+        prepared = prefetch_iter(batches, do_prep, capacity=self.prefetch)
+        return prefetch_iter(prepared, do_h2d, capacity=self.prefetch)
 
     def set_dump(self, cfg) -> None:
         """Enable per-sample prediction dump for subsequent passes
@@ -107,6 +117,7 @@ class Trainer:
         """One pass over the dataset — train_from_dataset analogue."""
         timer = Timer()
         timer.start()
+        self.stage_timers.reset()  # this pass's stages only (report below)
         nb = 0
         stats = None
         dump_writer = None
@@ -159,6 +170,8 @@ class Trainer:
                    last_loss=last_loss)
         log.info("%spass done: %d batches, %.0f ex/s, auc=%.4f",
                  log_prefix, nb, out["examples_per_sec"], res.auc)
+        if FLAGS.profile:
+            self.stage_timers.report(log_prefix)  # PrintSyncTimer role
         return out
 
     def train_pass_resident(self, pass_or_dataset,
@@ -227,6 +240,7 @@ class Trainer:
         nb = 0
         timer = Timer()
         timer.start()
+        self.stage_timers.reset()
         it = self._prefetch_iter(dataset.batches(),
                                  prepare=self.table.prepare_eval)
         for batch, dev in it:
