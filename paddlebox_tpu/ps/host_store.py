@@ -144,9 +144,11 @@ class HostStore:
         return len(keys)
 
     def _purge_spilled(self, keys: np.ndarray) -> None:
-        """Drop keys from every registered spill file (rewrite) — called
-        with shrink-deleted keys so an aged-out feature's stale spilled
-        copy can never resurrect into a base export. Caller holds _lock."""
+        """Drop keys from every spill file's in-memory REGISTRY (the files
+        themselves are immutable snapshots; _spill_keys is the only
+        authority on which rows are still disk-resident) — called with
+        shrink-deleted keys so an aged-out feature's stale spilled copy
+        can never resurrect into a base export. Caller holds _lock."""
         if not self._spill_files or len(keys) == 0:
             return
         for p in list(self._spill_files):
@@ -271,6 +273,12 @@ class HostStore:
         if keys is not None:
             sel = np.isin(dkeys, np.ascontiguousarray(keys, np.uint64))
         with self._lock:
+            reg0 = self._spill_keys.get(path)
+            if reg0 is not None:
+                # the file is a snapshot; only its REGISTERED keys are
+                # still disk-authoritative — a promoted-then-updated key's
+                # stale copy must never load back over fresher state
+                sel &= np.isin(dkeys, reg0)
             live = self.index.lookup(
                 np.ascontiguousarray(dkeys, np.uint64)) >= 0
             sel &= ~live  # RAM state wins over the spilled copy

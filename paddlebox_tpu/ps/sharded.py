@@ -110,10 +110,21 @@ class ShardedEmbeddingTable:
         self.host_lock = threading.Lock()
 
     # ------------------------------------------------------------------
+    def prepare_global_eval(self, batches: List[SlotBatch],
+                            req_capacity: Optional[int] = None,
+                            serve_capacity: Optional[int] = None
+                            ) -> ShardedPullIndex:
+        """Read-only routing plan: unknown keys serve the zero sentinel
+        row instead of allocating (inference; no index mutation). Only
+        legal for pull-only steps — serve_rows may repeat the sentinel,
+        which the push path's unique-scatter promise forbids."""
+        return self.prepare_global(batches, req_capacity, serve_capacity,
+                                   assign=False)
+
     def prepare_global(self, batches: List[SlotBatch],
                        req_capacity: Optional[int] = None,
-                       serve_capacity: Optional[int] = None
-                       ) -> ShardedPullIndex:
+                       serve_capacity: Optional[int] = None,
+                       assign: bool = True) -> ShardedPullIndex:
         """Build the routing plan for N per-device batches (one global
         batch). All batches must share K_pad/batch_size/num_slots.
         ``req_capacity``/``serve_capacity`` force the A/A2 buckets — the
@@ -151,8 +162,13 @@ class ShardedEmbeddingTable:
                 sel = np.nonzero(owners == s)[0]
                 keys_s = uniq[sel]
                 with self.host_lock:
-                    rows_s = self.indexes[s].assign(keys_s)
-                    self._touched[s][rows_s] = True
+                    if assign:
+                        rows_s = self.indexes[s].assign(keys_s)
+                        self._touched[s][rows_s] = True
+                    else:
+                        rows_s = self.indexes[s].lookup(keys_s)
+                        rows_s = np.where(rows_s < 0, C,
+                                          rows_s).astype(rows_s.dtype)
                 req_rows[d][s] = rows_s
                 req_slots[d][s] = dev_uniq_slot[d][sel]
                 pos[sel, 0] = s

@@ -199,15 +199,9 @@ class CheckpointManager:
             first = False
         with open(os.path.join(self._dir(target), "dense.pkl"), "rb") as fh:
             params, opt_state, auc = pickle.load(fh)
-        from paddlebox_tpu.train.step import StepState
-        import jax.numpy as jnp
-        trainer.state = StepState(
-            table=trainer.table.state,
-            params=jax.device_put(params),
-            opt_state=jax.device_put(opt_state),
-            auc=jax.device_put(auc),
-            step=jnp.asarray(target, jnp.int32))
-        trainer.global_step = target
+        trainer.restore_state(jax.device_put(params),
+                              jax.device_put(opt_state),
+                              jax.device_put(auc), target)
         log.info("restored step %d (chain: %s)", target, chain)
         return target
 

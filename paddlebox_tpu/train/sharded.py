@@ -271,6 +271,51 @@ class ShardedTrainStep:
                  rng: jax.Array):
         return self._sharded(state, batch, rng)
 
+    # ---- forward-only mesh eval (test-phase run) ----
+    def _device_eval(self, table_st: TableState, params, auc_st: AucState,
+                     batch: GlobalBatch) -> AucState:
+        n, b, s = self.n, self.batch_size, self.num_slots
+        table = TableState(*[l[0] for l in table_st])
+        auc = AucState(*[l[0] for l in auc_st])
+        resp_idx = batch.resp_idx[0]
+        serve_rows = batch.serve_rows[0]
+        gather_idx = batch.gather_idx[0]
+        segments = batch.segments[0]
+        dense = batch.dense[0]
+        label = batch.label[0]
+        show = batch.show[0]
+        clk = batch.clk[0]
+        a = resp_idx.shape[1]
+        d = 3 + table.mf_dim
+
+        serve_vals = pull_values(gather_full_rows(table, serve_rows))
+        resp = serve_vals[resp_idx]
+        recv = jax.lax.all_to_all(resp, DATA_AXIS, 0, 0, tiled=True)
+        vals_flat = recv.reshape(n * a, d)
+        values_k = vals_flat[gather_idx]
+        pooled = fused_seqpool_cvm(
+            values_k, segments, jnp.stack([show, clk], axis=1), b, s,
+            self.use_cvm, self.cvm_offset)
+        logits = self.model.apply(params, pooled, dense)
+        ins_w = (show > 0).astype(jnp.float32)
+        auc = auc_add_batch(auc, jax.nn.sigmoid(logits), label, ins_w)
+        return AucState(*[l[None] for l in auc])
+
+    def eval(self, table_st: TableState, params, auc_st: AucState,
+             batch: GlobalBatch) -> AucState:
+        if not hasattr(self, "_eval_jit"):
+            shard0 = P(DATA_AXIS)
+            rep = P()
+            auc_spec = AucState(*([shard0] * len(AucState._fields)))
+            batch_spec = GlobalBatch(
+                *([shard0] * len(GlobalBatch._fields)))
+            self._eval_jit = jax.jit(jax.shard_map(
+                self._device_eval, mesh=self.mesh,
+                in_specs=(TableState(shard0), rep, auc_spec, batch_spec),
+                out_specs=auc_spec, check_vma=False),
+                donate_argnums=(2,))
+        return self._eval_jit(table_st, params, auc_st, batch)
+
     # ---- resident pass: the whole loop inside one shard_map program ----
     def _resident_runner(self, n_steps: int):
         key = ("resident", n_steps)
@@ -410,6 +455,55 @@ class ShardedTrainer:
 
     def reset_metrics(self) -> None:
         self.state = self.state._replace(auc=init_sharded_auc(self.n))
+
+    # ---- checkpoint hooks (CheckpointManager trainer contract) ----
+    def sync_table(self) -> None:
+        self.table.state = self.state.table
+
+    def restore_state(self, params, opt_state, auc, step: int) -> None:
+        self.state = ShardedStepState(
+            table=self.table.state, params=params, opt_state=opt_state,
+            auc=auc, step=jnp.asarray(step, jnp.int32))
+        self.global_step = step
+
+    def eval_pass(self, dataset, log_prefix: str = "") -> Dict[str, float]:
+        """Forward-only mesh pass: pull + model over the device axis,
+        no pushes, no dense update; AUC reduced across shards (the
+        test-phase run of the reference workers, at pod scale)."""
+        from paddlebox_tpu.metrics import auc_compute
+        from paddlebox_tpu.utils import Timer
+        from paddlebox_tpu.utils.logging import get_logger
+        log = get_logger(__name__)
+        timer = Timer()
+        timer.start()
+        auc = init_sharded_auc(self.n)
+        nb = 0
+        for gb in self._prefetch_iter_eval(dataset.batches()):
+            auc = self.step_fn.eval(self.state.table, self.state.params,
+                                    auc, gb)
+            nb += 1
+        timer.pause()
+        auc_host = AucState(*[jnp.sum(l, axis=0) for l in auc])
+        res = auc_compute(auc_host)
+        out = res.as_dict()
+        out.update(batches=nb, elapsed_sec=timer.elapsed_sec(),
+                   examples_per_sec=res.ins_num /
+                   max(timer.elapsed_sec(), 1e-9))
+        log.info("%ssharded eval pass: %d global batches, auc=%.4f",
+                 log_prefix, nb, res.auc)
+        return out
+
+    def _prefetch_iter_eval(self, batches):
+        from paddlebox_tpu.utils.prefetch import prefetch_iter
+
+        def prep(group):
+            # read-only routing: lookup instead of assign (unknown keys
+            # serve the zero sentinel row, prepare_eval semantics)
+            return make_global_batch(
+                group, self.table.prepare_global_eval(group))
+
+        return prefetch_iter(self._group_iter(batches), prep,
+                             capacity=self.prefetch)
 
     # ---- device-resident passes over the mesh ----
     def build_resident_pass(self, dataset) -> "ShardedResidentPass":

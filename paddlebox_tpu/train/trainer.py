@@ -268,6 +268,14 @@ class Trainer:
         facade (for save/shrink/load host ops)."""
         self.table.state = self.state.table
 
+    def restore_state(self, params, opt_state, auc, step: int) -> None:
+        """Rebind dense + metric state after a checkpoint restore (the
+        table was already loaded); CheckpointManager's trainer hook."""
+        self.state = StepState(table=self.table.state, params=params,
+                               opt_state=opt_state, auc=auc,
+                               step=jnp.asarray(step, jnp.int32))
+        self.global_step = step
+
     def adopt_table(self) -> None:
         """Point the jit state at the table facade's (re)built state —
         used by the pass lifecycle after begin_pass swaps the working set."""

@@ -231,3 +231,25 @@ def test_disk_tier_read_through_and_no_resurrection(tmp_path):
     # reset-load forgets old spill registration
     hs.load(str(tmp_path / "b.npz"), merge=False)
     assert hs._spill_files == []
+
+
+def test_spill_stale_copy_never_shadows_fresh_state(tmp_path):
+    """A promoted-then-updated-then-respilled key's STALE copy in an old
+    spill file must never load back (registry-filtered load)."""
+    from paddlebox_tpu.ps.host_store import FIELDS
+    hs = HostStore(mf_dim=2, capacity=1 << 12)
+    mk = lambda n, v: {f: (np.full((n, 2), v, np.float32)
+                           if f == "embedx_w" else np.full(n, v, np.float32))
+                       for f in FIELDS}
+    k12 = np.array([1, 2], np.uint64)
+    hs.update(k12, mk(2, 1.0))
+    hs.save_base(str(tmp_path / "b.npz"))
+    f1 = str(tmp_path / "f1.npz")
+    assert hs.spill_cold(f1, threshold=1e9) == 2      # {k1,k2} → f1
+    hs.fetch(np.array([2], np.uint64))                # promote k2
+    hs.update(np.array([2], np.uint64), mk(1, 7.0))   # fresh value
+    hs.save_base(str(tmp_path / "b2.npz"))
+    f2 = str(tmp_path / "f2.npz")
+    assert hs.spill_cold(f2, threshold=1e9) == 1      # fresh k2 → f2
+    got = hs.fetch(k12)                               # k1 via f1, k2 via f2
+    np.testing.assert_allclose(got["embed_w"], [1.0, 7.0])
