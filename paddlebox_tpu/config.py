@@ -71,6 +71,10 @@ class Flags:
 
     # --- metrics (reference: metrics.h:46 table_size 1e6+1) ---
     auc_num_buckets: int = 1_000_000
+    # reduce the AUC bucket tables to scalars ON DEVICE and fetch ~8 floats
+    # instead of pulling [2, nbins] to host each pass (the pull is dead
+    # weight on a tunneled/remote device). False = exact f64 host compute.
+    auc_device_reduce: bool = True
 
     # --- runtime ---
     profile: bool = False

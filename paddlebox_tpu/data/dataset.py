@@ -51,6 +51,43 @@ def shard_filelist(files: Sequence[str], rank: Optional[int] = None,
     return list(files[rank::world])
 
 
+def _slots_shuffle_columnar(col, sel_slots: np.ndarray,
+                            rng: np.random.Generator):
+    """Vectorized SlotsShuffle over a ColumnarRecords store: each record
+    keeps its non-selected slots and takes the selected slots' feasigns
+    from a random donor record (permutation)."""
+    import dataclasses as _dc
+    n = col.num_records
+    if n == 0:
+        return col
+    counts = np.diff(col.offsets)
+    rec_of_key = np.repeat(np.arange(n, dtype=np.int64), counts)
+    mask = np.isin(col.key_slot, sel_slots)
+    keep = ~mask
+    # CSR of the selected-slot keys, per record
+    mrec = rec_of_key[mask]
+    mcount = np.bincount(mrec, minlength=n).astype(np.int64)
+    moff = np.zeros(n + 1, np.int64)
+    np.cumsum(mcount, out=moff[1:])
+    d = rng.permutation(n)
+    glen = moff[d + 1] - moff[d]
+    tot = int(glen.sum())
+    # concat-of-ranges: indices into the masked arrays for each donor span
+    base = np.cumsum(glen) - glen
+    idx = (np.arange(tot, dtype=np.int64) - np.repeat(base, glen)
+           + np.repeat(moff[d], glen))
+    all_keys = np.concatenate([col.keys[keep], col.keys[mask][idx]])
+    all_slot = np.concatenate([col.key_slot[keep], col.key_slot[mask][idx]])
+    all_rec = np.concatenate([rec_of_key[keep],
+                              np.repeat(np.arange(n, dtype=np.int64), glen)])
+    order = np.lexsort((all_slot, all_rec))  # keys stay slot-grouped
+    new_counts = np.bincount(all_rec, minlength=n).astype(np.int64)
+    new_off = np.zeros(n + 1, np.int64)
+    np.cumsum(new_counts, out=new_off[1:])
+    return _dc.replace(col, keys=all_keys[order], key_slot=all_slot[order],
+                       offsets=new_off)
+
+
 class Dataset:
     """Base: file list + schema + threaded readers."""
 
@@ -103,19 +140,40 @@ class Dataset:
         file_ch.close()
         group = ReaderGroup()
 
+        pipe_cmd = self.desc.pipe_command
+
+        def parse_lines(parser, lines) -> tuple:
+            n_ok = n_bad = 0
+            for line in lines:
+                rec = parser.parse(line)
+                if rec is None:
+                    n_bad += 1
+                    continue
+                out.put(rec)
+                n_ok += 1
+            return n_ok, n_bad
+
         def worker() -> None:
             try:
                 parser = parser_factory()
                 for path in file_ch:
-                    n_ok = n_bad = 0
-                    with open(path, "r") as fh:
-                        for line in fh:
-                            rec = parser.parse(line)
-                            if rec is None:
-                                n_bad += 1
-                                continue
-                            out.put(rec)
-                            n_ok += 1
+                    if pipe_cmd:
+                        # LoadIntoMemoryByCommand (data_feed.h:1674): the
+                        # file streams through a shell command; the parser
+                        # consumes its stdout
+                        import subprocess
+                        with open(path, "rb") as fh:
+                            proc = subprocess.Popen(
+                                pipe_cmd, shell=True, stdin=fh,
+                                stdout=subprocess.PIPE, text=True)
+                            n_ok, n_bad = parse_lines(parser, proc.stdout)
+                            if proc.wait() != 0:
+                                raise RuntimeError(
+                                    f"pipe_command {pipe_cmd!r} failed "
+                                    f"(rc={proc.returncode}) on {path}")
+                    else:
+                        with open(path, "r") as fh:
+                            n_ok, n_bad = parse_lines(parser, fh)
                     stat_add("records_parsed", n_ok)
                     stat_add("records_dropped", n_bad)
             except BaseException as e:
@@ -155,6 +213,9 @@ class InMemoryDataset(Dataset):
         self.records: List[SlotRecord] = []
         self._pass_keys: Optional[np.ndarray] = None
         self.columnar = None  # ColumnarRecords once columnarize()d
+        self._fea_eval = False
+        self._fea_eval_candidates = 10000
+        self._merge_size: Optional[int] = None  # set_merge_by_lineid
 
     def load_into_memory(self) -> None:
         if not self.filelist:
@@ -163,6 +224,8 @@ class InMemoryDataset(Dataset):
         # subclasses (PaddleBoxDataset) run record-level pass protocols
         # (global shuffle / key merge) that need SlotRecord objects
         if (FLAGS.native_parse and type(self) is InMemoryDataset
+                and not self.desc.pipe_command
+                and self._merge_size is None
                 and self._load_columnar_native()):
             return
         ch: Channel[SlotRecord] = Channel(capacity=FLAGS.channel_capacity)
@@ -179,6 +242,8 @@ class InMemoryDataset(Dataset):
         self._pass_keys = None
         log.info("loaded %d records from %d files",
                  len(self.records), len(self.filelist))
+        if self._merge_size is not None:
+            self.merge_records_by_insid()
 
     def _load_columnar_native(self) -> bool:
         """Native bulk parse: file bytes → columnar arrays per file (C++,
@@ -264,6 +329,79 @@ class InMemoryDataset(Dataset):
             self.records = shuffler.exchange(self.records)
             self._pass_keys = None
         self.local_shuffle(seed)
+
+    def set_merge_by_lineid(self, merge_size: int = 2) -> None:
+        """Merge records sharing an ins_id after load (reference
+        dataset.py ``set_merge_by_lineid``; MergeByInsId data_set.cc:1517).
+        Applied by ``merge_records_by_insid`` or automatically at the end
+        of ``load_into_memory`` when set."""
+        self._merge_size = int(merge_size)
+
+    def merge_records_by_insid(self) -> int:
+        """Run the ins_id merge now; returns the dropped-record count."""
+        from paddlebox_tpu.data.pv import merge_by_insid
+        if self.columnar is not None:
+            raise RuntimeError("merge_by_insid needs record objects; call "
+                               "it before columnarize()")
+        ms = self._merge_size if self._merge_size is not None else 2
+        self.records, dropped = merge_by_insid(
+            self.records, ms, len(self.desc.sparse_slots))
+        if dropped:
+            log.warning("merge_by_insid dropped %d records", dropped)
+        stat_add("records_dropped_by_merge", dropped)
+        self._pass_keys = None
+        return dropped
+
+    def set_fea_eval(self, record_candidate_size: int = 10000,
+                     fea_eval: bool = True) -> None:
+        """Enable feature-evaluation mode — precondition for
+        ``slots_shuffle`` (reference dataset.py:143 ``set_fea_eval``;
+        ``slots_shuffle_fea_eval_`` guard, data_set.cc:1858)."""
+        self._fea_eval = fea_eval
+        self._fea_eval_candidates = int(record_candidate_size)
+
+    def slots_shuffle(self, slots: Sequence) -> None:
+        """Replace the chosen sparse slots' feasigns in every record with
+        the feasigns of a RANDOM OTHER record, in place — destroying the
+        slot's per-instance signal while preserving its marginal
+        distribution (feature-importance eval; MultiSlotDataset::
+        SlotsShuffle + GetRandomData, data_set.cc:1713-1881).
+
+        ``slots`` holds sparse slot names or indices. Works on both the
+        record-object store and the columnar store."""
+        if not self._fea_eval:
+            raise RuntimeError(
+                "fea eval mode off, need set_fea_eval() for slots_shuffle")
+        sel = np.array(
+            [self.desc.sparse_slot_index(s) if isinstance(s, str) else int(s)
+             for s in slots], dtype=np.int64)
+        rng = np.random.default_rng(FLAGS.seed)
+        if self.columnar is not None:
+            self.columnar = _slots_shuffle_columnar(self.columnar, sel, rng)
+        elif self.records:
+            # donor permutation = one random candidate per record, capped
+            # reservoir semantics degenerate to this when the pool spans
+            # the whole pass
+            n = len(self.records)
+            perm = rng.permutation(n)
+            sel_set = set(int(s) for s in sel)
+            num_slots = len(self.desc.sparse_slots)
+            # snapshot donor spans BEFORE mutating (GetRandomData reads the
+            # originals, data_set.cc:1720)
+            donor_spans = [
+                {s: self.records[perm[i]].slot_keys(s).copy()
+                 for s in sel_set} for i in range(n)]
+            for i, rec in enumerate(self.records):
+                chunks, offs = [], [0]
+                for s in range(num_slots):
+                    span = (donor_spans[i][s] if s in sel_set
+                            else rec.slot_keys(s))
+                    chunks.append(span)
+                    offs.append(offs[-1] + len(span))
+                rec.keys = (np.concatenate(chunks) if chunks
+                            else np.empty(0, np.uint64))
+                rec.slot_offsets = np.array(offs, dtype=np.int32)
+        self._pass_keys = None
 
     def pass_keys(self) -> np.ndarray:
         """Deduped uint64 key-set of the loaded pass."""

@@ -75,43 +75,52 @@ def _line_count(buf: bytes) -> int:
     return n + (1 if buf and not buf.endswith(b"\n") else 0)
 
 
+def _bulk_slot_text_parse(fn, desc: DataFeedDesc,
+                          path: str) -> Optional[dict]:
+    """Shared driver for the bulk columnar C ABI (slot_text_parse
+    signature — native lib or user plugin .so): buffer sizing, the
+    retry-on-key-arena-overflow loop (n == -1 → double), result slicing."""
+    import ctypes
+    buf = _read_bytes(path)
+    max_rec = buf.count(b"\n") + 1
+    spec = _slot_text_spec(desc)
+    dense_dim = desc.dense_dim
+    key_cap = max(1024, max_rec * max(1, len(desc.sparse_slots)))
+    while True:
+        keys = np.empty(key_cap, np.uint64)
+        key_slot = np.empty(key_cap, np.int32)
+        offs = np.empty(max_rec + 1, np.int64)
+        dense = np.empty((max_rec, dense_dim), np.float32)
+        label = np.empty(max_rec, np.float32)
+        show = np.empty(max_rec, np.float32)
+        clk = np.empty(max_rec, np.float32)
+        ptr = lambda a: a.ctypes.data_as(ctypes.c_void_p)
+        n = fn(ctypes.c_char_p(buf), ctypes.c_int64(len(buf)), ptr(spec),
+               ctypes.c_int64(len(desc.slots)), ctypes.c_int64(dense_dim),
+               ctypes.c_int64(max_rec), ctypes.c_int64(key_cap),
+               ptr(keys), ptr(key_slot), ptr(offs), ptr(dense),
+               ptr(label), ptr(show), ptr(clk))
+        if n == -1:  # key arena overflowed: double and retry
+            key_cap *= 2
+            continue
+        n = int(n)
+        nk = int(offs[n])
+        return dict(keys=keys[:nk].copy(),
+                    key_slot=key_slot[:nk].copy(),
+                    offsets=offs[:n + 1].copy(),
+                    dense=dense[:n].copy(), label=label[:n].copy(),
+                    show=show[:n].copy(), clk=clk[:n].copy(),
+                    dropped=_line_count(buf) - n)
+
+
 class _NativeSlotTextMixin:
     """parse_file_columnar via native slot_text_parse."""
 
     def parse_file_columnar(self, path: str) -> Optional[dict]:
-        import ctypes
         lib = _native_lib()
         if lib is None:
             return None
-        buf = _read_bytes(path)
-        desc = self.desc
-        max_rec = buf.count(b"\n") + 1
-        spec = _slot_text_spec(desc)
-        dense_dim = desc.dense_dim
-        key_cap = max(1024, max_rec * max(1, len(desc.sparse_slots)))
-        while True:
-            keys = np.empty(key_cap, np.uint64)
-            key_slot = np.empty(key_cap, np.int32)
-            offs = np.empty(max_rec + 1, np.int64)
-            dense = np.empty((max_rec, dense_dim), np.float32)
-            label = np.empty(max_rec, np.float32)
-            show = np.empty(max_rec, np.float32)
-            clk = np.empty(max_rec, np.float32)
-            ptr = lambda a: a.ctypes.data_as(ctypes.c_void_p)
-            n = lib.slot_text_parse(
-                buf, len(buf), ptr(spec), len(desc.slots), dense_dim,
-                max_rec, key_cap, ptr(keys), ptr(key_slot), ptr(offs),
-                ptr(dense), ptr(label), ptr(show), ptr(clk))
-            if n == -1:  # key arena overflowed: double and retry
-                key_cap *= 2
-                continue
-            nk = int(offs[n])
-            return dict(keys=keys[:nk].copy(),
-                        key_slot=key_slot[:nk].copy(),
-                        offsets=offs[:n + 1].copy(),
-                        dense=dense[:n].copy(), label=label[:n].copy(),
-                        show=show[:n].copy(), clk=clk[:n].copy(),
-                        dropped=_line_count(buf) - int(n))
+        return _bulk_slot_text_parse(lib.slot_text_parse, self.desc, path)
 
 
 class _NativeCriteoMixin:
@@ -259,6 +268,73 @@ def get_parser(desc: DataFeedDesc) -> BaseParser:
         raise KeyError(
             f"unknown parser {desc.parser!r}; registered: {sorted(_PARSERS)}"
         ) from None
+
+
+class _PluginSoParser(SlotTextParser):
+    """Parser backed by a user shared library exposing the bulk columnar
+    C ABI (same signature as native/slot_parser.cpp ``slot_text_parse``).
+    Per-line fallback is the slot_text format."""
+
+    _lib = None
+    _symbol = "slot_text_parse"
+
+    def parse_file_columnar(self, path: str) -> Optional[dict]:
+        import ctypes
+        fn = getattr(type(self)._lib, type(self)._symbol)
+        fn.restype = ctypes.c_int64
+        return _bulk_slot_text_parse(fn, self.desc, path)
+
+
+def load_parser_plugin(spec: str, name: Optional[str] = None) -> List[str]:
+    """Load a custom parser plugin and register its parsers — the
+    ``DLManager``/``CustomParser`` extension point (data_feed.h:450,:698,
+    ``LoadIntoMemoryByLib`` data_feed.h:1675), without requiring the
+    paddle .so ABI. Three plugin forms:
+
+    - ``"pkg.module"`` / ``"pkg.module:attr"``: imported; the module either
+      self-registers via :func:`register_parser` or exposes a ``PARSERS``
+      dict of {name: BaseParser subclass}.
+    - ``"/path/to/plugin.py"``: executed as a module, same contract.
+    - ``"/path/to/libcustom.so"`` or ``".so:symbol"``: ctypes-loaded
+      library exposing the bulk columnar C ABI (the signature of
+      native/slot_parser.cpp ``slot_text_parse``); registered under
+      ``name`` (default: the file stem).
+
+    Returns the list of parser names registered by this call."""
+    import ctypes
+    import importlib
+    import importlib.util
+    import os
+
+    before = set(_PARSERS)
+
+    path, sym = spec, None
+    head, colon, tail = spec.rpartition(":")
+    if colon and not spec.endswith(".so") and not spec.endswith(".py"):
+        path, sym = head, tail
+
+    if path.endswith(".so"):
+        lib = ctypes.CDLL(path)
+        pname = name or os.path.splitext(os.path.basename(path))[0]
+        cls = type(f"PluginParser_{pname}", (_PluginSoParser,),
+                   {"_lib": lib, "_symbol": sym or "slot_text_parse"})
+        register_parser(pname, cls)
+        return [pname]
+
+    if path.endswith(".py"):
+        modname = name or os.path.splitext(os.path.basename(path))[0]
+        mspec = importlib.util.spec_from_file_location(
+            f"pbox_parser_plugin_{modname}", path)
+        mod = importlib.util.module_from_spec(mspec)
+        mspec.loader.exec_module(mod)
+    else:
+        mod = importlib.import_module(path)
+        if sym:
+            mod = getattr(mod, sym)
+
+    for pname, cls in getattr(mod, "PARSERS", {}).items():
+        register_parser(pname, cls)
+    return sorted(set(_PARSERS) - before)
 
 
 register_parser("slot_text", SlotTextParser)

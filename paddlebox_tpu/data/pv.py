@@ -47,6 +47,47 @@ def group_by_search_id(records: Sequence[SlotRecord]) -> List[List[SlotRecord]]:
     return pvs
 
 
+def merge_by_insid(records: Sequence[SlotRecord], merge_size: int = 2,
+                   num_slots: int = 0) -> Tuple[List[SlotRecord], int]:
+    """Merge records sharing an ``ins_id`` into one record
+    (MultiSlotDataset::MergeByInsId, data_set.cc:1517): sparse slots
+    concatenate across the group's records (slot order preserved); dense/
+    label/show/clk come from the first record. When ``merge_size`` > 0,
+    groups whose size differs are DROPPED (reference drops and warns).
+    Returns (merged_records, dropped_count)."""
+    buckets: Dict[str, List[SlotRecord]] = {}
+    for r in records:
+        buckets.setdefault(r.ins_id, []).append(r)
+    merged: List[SlotRecord] = []
+    dropped = 0
+    for ins_id in sorted(buckets):
+        grp = buckets[ins_id]
+        if merge_size > 0 and len(grp) != merge_size:
+            dropped += len(grp)
+            continue
+        if len(grp) == 1:
+            merged.append(grp[0])
+            continue
+        first = grp[0]
+        s = (num_slots or len(first.slot_offsets) - 1)
+        chunks: List[np.ndarray] = []
+        offs = [0]
+        for slot in range(s):
+            for r in grp:
+                chunks.append(r.slot_keys(slot))
+            offs.append(offs[-1] + sum(
+                len(r.slot_keys(slot)) for r in grp))
+        merged.append(SlotRecord(
+            keys=(np.concatenate(chunks) if offs[-1]
+                  else np.empty(0, np.uint64)),
+            slot_offsets=np.array(offs, dtype=np.int32),
+            dense=first.dense, label=first.label, show=first.show,
+            clk=first.clk, ins_id=ins_id, search_id=first.search_id,
+            rank=first.rank, cmatch=first.cmatch, uid=first.uid,
+            timestamp=first.timestamp))
+    return merged, dropped
+
+
 def group_by_uid(records: Sequence[SlotRecord],
                  sort_by_time: bool = True) -> List[List[SlotRecord]]:
     """Group records by uid (merge_by_uid path: user timeline grouping),

@@ -34,7 +34,10 @@ class SlotDef:
 class DataFeedDesc:
     slots: List[SlotDef] = dataclasses.field(default_factory=list)
     batch_size: int = 512
-    parser: str = "slot_text"        # registered parser name (pipe_command analogue)
+    parser: str = "slot_text"        # registered parser name
+    # shell command each reader pipes a file through before parsing its
+    # stdout (data_feed.proto:45 pipe_command / LoadIntoMemoryByCommand)
+    pipe_command: Optional[str] = None
     label_slot: Optional[str] = None  # which slot is the click label
     show_slot: Optional[str] = None
     clk_slot: Optional[str] = None

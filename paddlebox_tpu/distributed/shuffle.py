@@ -1,0 +1,213 @@
+"""Cross-host global-shuffle transport — the ``PaddleShuffler`` analogue.
+
+Reference: PadBoxSlotDataset::ShuffleData (data_set.cc:2573): each MPI rank
+routes every record to ``hash(record) % mpi_size``, serializes batches with
+``BinaryArchive`` and sends them through the closed ``boxps::PaddleShuffler``
+callbacks; peers collect into ``ReceiveSuffleData`` (:2681).
+
+TPU-native redesign: the MPI plane is replaced by a plain TCP full mesh
+over DCN (record exchange is host-side data plane, not accelerator
+traffic — XLA collectives stay reserved for tensors inside jit). Records
+travel in a compact self-describing binary layout (no pickle on the
+wire), one length-framed buffer per (src, dst) pair. The route hash is
+deterministic in (uid | ins_id | record content, seed) so every rank
+computes the same placement without coordination.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import zlib
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.data.dataset import Shuffler
+from paddlebox_tpu.data.record import SlotRecord
+from paddlebox_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+_REC_HDR = struct.Struct("<iiii fff qqq ii")  # see serialize_records
+
+
+def serialize_records(records: Sequence[SlotRecord]) -> bytes:
+    """Records → one compact buffer (BinaryArchive role). Layout per
+    record: fixed header (counts, scalars, metadata) followed by the
+    keys/slot_offsets/dense arrays and the utf-8 ins_id."""
+    parts: List[bytes] = [struct.pack("<q", len(records))]
+    for r in records:
+        keys = np.ascontiguousarray(r.keys, dtype=np.uint64)
+        offs = np.ascontiguousarray(r.slot_offsets, dtype=np.int32)
+        dense = np.ascontiguousarray(r.dense, dtype=np.float32)
+        ins = r.ins_id.encode("utf-8")
+        parts.append(_REC_HDR.pack(
+            keys.size, offs.size, dense.size, len(ins),
+            float(r.label), float(r.show), float(r.clk),
+            int(r.search_id), int(r.uid), int(r.timestamp),
+            int(r.rank), int(r.cmatch)))
+        parts += [keys.tobytes(), offs.tobytes(), dense.tobytes(), ins]
+    return b"".join(parts)
+
+
+def deserialize_records(buf: bytes) -> List[SlotRecord]:
+    (n,) = struct.unpack_from("<q", buf, 0)
+    pos = 8
+    out: List[SlotRecord] = []
+    for _ in range(n):
+        (nk, ns, nd, ni, label, show, clk, sid, uid, ts, rank,
+         cmatch) = _REC_HDR.unpack_from(buf, pos)
+        pos += _REC_HDR.size
+        keys = np.frombuffer(buf, np.uint64, nk, pos).copy()
+        pos += nk * 8
+        offs = np.frombuffer(buf, np.int32, ns, pos).copy()
+        pos += ns * 4
+        dense = np.frombuffer(buf, np.float32, nd, pos).copy()
+        pos += nd * 4
+        ins = buf[pos:pos + ni].decode("utf-8")
+        pos += ni
+        out.append(SlotRecord(keys=keys, slot_offsets=offs, dense=dense,
+                              label=label, show=show, clk=clk, ins_id=ins,
+                              search_id=sid, uid=uid, timestamp=ts,
+                              rank=rank, cmatch=cmatch))
+    return out
+
+
+def default_route(rec: SlotRecord, world: int, seed: int) -> int:
+    """hash(record) % world — uid first (keeps user timelines on one host
+    for the WuAUC/uid-merge paths), then ins_id, then record content."""
+    if rec.uid:
+        h = zlib.crc32(struct.pack("<qq", rec.uid, seed))
+    elif rec.ins_id:
+        h = zlib.crc32(rec.ins_id.encode() + struct.pack("<q", seed))
+    else:
+        h = zlib.crc32(rec.keys.tobytes() + struct.pack("<q", seed))
+    return h % world
+
+
+def _recv_exact(conn: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        b = conn.recv(min(n, 1 << 20))
+        if not b:
+            raise ConnectionError("peer closed mid-message")
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+class TcpShuffler(Shuffler):
+    """Full-mesh TCP record exchange: rank i sends partition j to rank j
+    and returns its own partition plus everything received. One exchange
+    per call; the listener stays up for reuse across passes.
+
+    ``endpoints`` — "host:port" per rank, index == rank. Every rank must
+    call :meth:`exchange` once per pass (the call is a data barrier, like
+    the reference's shuffler wait, data_set.cc:2681)."""
+
+    def __init__(self, rank: int, world: int, endpoints: Sequence[str],
+                 seed: int = 0,
+                 route_fn: Optional[Callable[[SlotRecord, int, int], int]]
+                 = None, timeout: float = 120.0) -> None:
+        if len(endpoints) != world:
+            raise ValueError("need one endpoint per rank")
+        self.rank, self.world = rank, world
+        self.endpoints = [(e.rsplit(":", 1)[0], int(e.rsplit(":", 1)[1]))
+                          for e in endpoints]
+        self.seed = seed
+        self.route_fn = route_fn or default_route
+        self.timeout = timeout
+        self._round = 0
+        # payloads from peers that already advanced to round r+1 while we
+        # are still collecting round r (no global barrier between passes)
+        self._early: Dict[Tuple[int, int], bytes] = {}
+        host, port = self.endpoints[rank]
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(world)
+
+    @property
+    def bound_port(self) -> int:
+        return self._srv.getsockname()[1]
+
+    def close(self) -> None:
+        self._srv.close()
+
+    def _serve(self, inbox: Dict[int, bytes], errors: List[BaseException],
+               expect: int) -> None:
+        try:
+            self._srv.settimeout(self.timeout)
+            got = 0
+            while got < expect:
+                conn, _ = self._srv.accept()
+                with conn:
+                    conn.settimeout(self.timeout)
+                    src, rnd, nbytes = struct.unpack(
+                        "<iiq", _recv_exact(conn, 16))
+                    payload = _recv_exact(conn, nbytes)
+                    if rnd == self._round + 1:
+                        # fast peer already in its next exchange — stash
+                        # for our next round instead of failing the pass
+                        self._early[(rnd, src)] = payload
+                    elif rnd != self._round:
+                        raise RuntimeError(
+                            f"shuffle round mismatch: got {rnd} from "
+                            f"rank {src}, at {self._round}")
+                    else:
+                        inbox[src] = payload
+                        got += 1
+        except BaseException as e:
+            errors.append(e)
+
+    def _send_to(self, dst: int, payload: bytes,
+                 errors: List[BaseException]) -> None:
+        try:
+            with socket.create_connection(self.endpoints[dst],
+                                          timeout=self.timeout) as c:
+                c.sendall(struct.pack("<iiq", self.rank, self._round,
+                                      len(payload)))
+                c.sendall(payload)
+        except BaseException as e:
+            errors.append(e)
+
+    def exchange(self, records: List[SlotRecord]) -> List[SlotRecord]:
+        parts: List[List[SlotRecord]] = [[] for _ in range(self.world)]
+        for r in records:
+            parts[self.route_fn(r, self.world, self.seed)].append(r)
+        inbox: Dict[int, bytes] = {}
+        errors: List[BaseException] = []
+        # payloads that arrived early during the previous round
+        for (rnd, src) in list(self._early):
+            if rnd == self._round:
+                inbox[src] = self._early.pop((rnd, src))
+        srv = threading.Thread(
+            target=self._serve,
+            args=(inbox, errors, self.world - 1 - len(inbox)),
+            daemon=True)
+        srv.start()
+        senders = []
+        for dst in range(self.world):
+            if dst == self.rank:
+                continue
+            t = threading.Thread(
+                target=self._send_to,
+                args=(dst, serialize_records(parts[dst]), errors),
+                daemon=True)
+            t.start()
+            senders.append(t)
+        for t in senders:
+            t.join()
+        srv.join()
+        if errors:
+            raise errors[0]
+        self._round += 1
+        out = list(parts[self.rank])
+        kept = len(out)
+        for src in sorted(inbox):
+            out.extend(deserialize_records(inbox[src]))
+        log.info("shuffle r%d: kept %d, received %d records", self.rank,
+                 kept, len(out) - kept)
+        return out

@@ -75,6 +75,9 @@ class ResidentPass:
         ``floats_dtype=jnp.bfloat16`` halves the float block on the wire
         (dense features, label/show/clk — the latter are small integers,
         exact in bf16); the step casts back to f32 on device."""
+        col = getattr(dataset, "columnar", None)
+        if col is not None:
+            return cls._build_columnar(dataset, col, table, floats_dtype)
         rows_l, floats_l, meta_l, segs_l = [], [], [], []
         trivial = True
         nrec = 0
@@ -115,6 +118,63 @@ class ResidentPass:
         return cls(rows, np.stack(floats_l), np.asarray(meta_l, np.int32),
                    segs, nrec)
 
+    @classmethod
+    def _build_columnar(cls, dataset: Dataset, col, table,
+                        floats_dtype) -> "ResidentPass":
+        """Vectorized whole-pass packer for columnar datasets: ONE native
+        index.assign over the pass's key stream + bulk reshapes, instead
+        of 32+ per-batch SlotBatch constructions (the per-batch python
+        path was the pipeline bottleneck — build must stay under the
+        device pass time for the preload to fully overlap)."""
+        desc = dataset.desc
+        bs = desc.batch_size
+        s = len(desc.sparse_slots)
+        r = col.num_records
+        if r == 0:
+            raise ValueError("empty pass")
+        nb = (r + bs - 1) // bs
+        cap = table.capacity
+        offsets = col.offsets
+        with table.host_lock:  # one pass-wide key→row assignment
+            rows_all = table.index.assign(col.keys)
+        rows_all = rows_all.astype(np.int32, copy=False)
+        # per-batch key spans + uniform padded capacity (one jit variant)
+        bounds = offsets[np.minimum(np.arange(nb + 1) * bs, r)]
+        nk = np.diff(bounds)
+        k_max = desc.key_capacity(int(nk.max()))
+        rows = np.full((nb, k_max), cap, np.int32)
+        counts = np.diff(offsets)
+        # trivial layout = exactly one key per slot per record, slot-order:
+        # segments are then derivable on device (DeviceBatch.segments)
+        trivial = (col.key_slot.size == r * s and bool((counts == s).all())
+                   and bool((col.key_slot.reshape(r, s)
+                             == np.arange(s, dtype=np.int32)).all()))
+        pad_seg = bs * s
+        segs = None
+        if not trivial:
+            rec_of_key = np.repeat(np.arange(r, dtype=np.int64), counts)
+            segs_global = ((rec_of_key % bs) * s
+                           + col.key_slot).astype(np.int32)
+            segs = np.full((nb, k_max), pad_seg, np.int32)
+        for i in range(nb):
+            a, b = bounds[i], bounds[i + 1]
+            rows[i, :b - a] = rows_all[a:b]
+            if segs is not None:
+                segs[i, :b - a] = segs_global[a:b]
+        # float block: pack the whole pass, zero-pad the tail batch
+        floats_full = pack_floats(col.dense, col.label, col.show, col.clk)
+        d3 = floats_full.shape[1]
+        if nb * bs != r:
+            padded = np.zeros((nb * bs, d3), np.float32)
+            padded[:r] = floats_full
+            floats_full = padded
+        floats = floats_full.reshape(nb, bs, d3).astype(
+            floats_dtype, copy=False)
+        meta = np.stack(
+            [nk.astype(np.int32),
+             np.full(nb, pad_seg, np.int32)], axis=1)
+        return cls(rows, floats, meta, segs, int((col.show > 0).sum()))
+
     def upload(self) -> None:
         """Stage to HBM — three (four with segs) bulk transfers."""
         if self.dev is not None:
@@ -131,9 +191,12 @@ class ResidentPass:
     def mark_trained_rows(self, table) -> None:
         """Flag this pass's rows as touched-since-last-save — called by
         the trainer AFTER the pass runs, so delta saves include them
-        regardless of when a checkpoint landed relative to the preload."""
-        rows = np.unique(self.rows)
-        rows = rows[rows < table.capacity]  # drop sentinel/OOB pads
+        regardless of when a checkpoint landed relative to the preload.
+        Duplicate-tolerant boolean scatter (no sort): every row id in the
+        pack is ≤ capacity by construction (padding is the sentinel row),
+        and the sentinel flag is harmless — save paths only read rows the
+        index owns."""
+        rows = self.rows.ravel()
         with table.host_lock:
             table._touched[rows] = True
 

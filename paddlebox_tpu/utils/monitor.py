@@ -45,3 +45,26 @@ STATS = StatRegistry()
 
 def stat_add(name: str, value: int = 1) -> None:
     STATS.add(name, value)
+
+
+def device_mem_used(device=None) -> Dict[str, int]:
+    """HBM usage for one device — the ``GpuMemUsed`` report
+    (fleet/box_wrapper.h:420). Returns {bytes_in_use, peak_bytes_in_use,
+    bytes_limit} (0s when the backend exposes no allocator stats, e.g.
+    virtual CPU devices)."""
+    import jax
+    if device is None:
+        device = jax.local_devices()[0]
+    stats = device.memory_stats() or {}
+    return {"bytes_in_use": int(stats.get("bytes_in_use", 0)),
+            "peak_bytes_in_use": int(stats.get("peak_bytes_in_use", 0)),
+            "bytes_limit": int(stats.get("bytes_limit", 0))}
+
+
+def log_device_mem(tag: str = "") -> Dict[str, int]:
+    """Record HBM usage into the stat registry and return it."""
+    m = device_mem_used()
+    prefix = f"hbm_{tag}_" if tag else "hbm_"
+    for k, v in m.items():
+        STATS.set(prefix + k, v)
+    return m

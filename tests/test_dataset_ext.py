@@ -1,0 +1,220 @@
+"""Dataset extension points: pipe_command readers, slots_shuffle
+(feature-importance eval), and the custom-parser plugin loader.
+
+Reference behaviors covered: LoadIntoMemoryByCommand (data_feed.h:1674),
+MultiSlotDataset::SlotsShuffle/GetRandomData (data_set.cc:1713-1881),
+DLManager/CustomParser plugin parsers (data_feed.h:450,:698).
+"""
+
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config import FLAGS
+from paddlebox_tpu.data import DataFeedDesc, InMemoryDataset, SlotDef
+from paddlebox_tpu.data.dataset import QueueDataset, _slots_shuffle_columnar
+from paddlebox_tpu.data.parser import get_parser, load_parser_plugin
+
+
+def _desc(**kw) -> DataFeedDesc:
+    slots = [SlotDef("label", "float", 1), SlotDef("a", "uint64"),
+             SlotDef("b", "uint64"), SlotDef("d", "float", 2)]
+    return DataFeedDesc(slots=slots, label_slot="label", batch_size=4, **kw)
+
+
+def _write_slot_text(path, rows):
+    # one line per record: label grp, a grp, b grp, dense grp(dim 2)
+    with open(path, "w") as fh:
+        for label, a_keys, b_keys, dense in rows:
+            toks = ["1", str(label)]
+            toks += [str(len(a_keys))] + [str(k) for k in a_keys]
+            toks += [str(len(b_keys))] + [str(k) for k in b_keys]
+            toks += ["2"] + [str(v) for v in dense]
+            fh.write(" ".join(toks) + "\n")
+
+
+ROWS = [(1.0, [11, 12], [21], [0.5, 1.5]),
+        (0.0, [13], [22, 23], [2.5, 3.5]),
+        (1.0, [14], [24], [4.5, 5.5]),
+        (0.0, [15, 16, 17], [25], [6.5, 7.5])]
+
+
+def test_pipe_command_transforms_input(tmp_path):
+    # raw file is comma-separated; pipe_command rewrites it to slot_text
+    raw = tmp_path / "raw.txt"
+    _write_slot_text(str(raw), ROWS)
+    csv = tmp_path / "data.csv"
+    csv.write_text(raw.read_text().replace(" ", ","))
+
+    ds = InMemoryDataset(_desc(pipe_command="tr ',' ' '"))
+    ds.set_filelist([str(csv)])
+    ds.load_into_memory()
+    assert len(ds.records) == 4
+    got = sorted(float(r.label) for r in ds.records)
+    assert got == [0.0, 0.0, 1.0, 1.0]
+    rec = next(r for r in ds.records if len(r.slot_keys(0)) == 3)
+    assert list(rec.slot_keys(0)) == [15, 16, 17]
+
+
+def test_pipe_command_failure_raises(tmp_path):
+    f = tmp_path / "x.txt"
+    _write_slot_text(str(f), ROWS)
+    ds = InMemoryDataset(_desc(pipe_command="false"))
+    ds.set_filelist([str(f)])
+    with pytest.raises(RuntimeError, match="pipe_command"):
+        ds.load_into_memory()
+
+
+def test_pipe_command_queue_dataset(tmp_path):
+    f = tmp_path / "x.txt"
+    _write_slot_text(str(f), ROWS)
+    ds = QueueDataset(_desc(pipe_command="cat"))
+    ds.set_filelist([str(f)])
+    ds.set_thread(1)
+    batches = list(ds.batches())
+    assert sum(int((b.show > 0).sum()) for b in batches) == 4
+
+
+def _make_inmem(records_rows, columnar: bool) -> InMemoryDataset:
+    ds = InMemoryDataset(_desc())
+    parser = get_parser(ds.desc)
+    lines = []
+    for label, a_keys, b_keys, dense in records_rows:
+        toks = ["1", str(label),
+                str(len(a_keys)), *map(str, a_keys),
+                str(len(b_keys)), *map(str, b_keys),
+                "2", *map(str, dense)]
+        lines.append(" ".join(toks))
+    ds.records = [parser.parse(l) for l in lines]
+    if columnar:
+        ds.columnarize()
+    return ds
+
+
+@pytest.mark.parametrize("columnar", [False, True])
+def test_slots_shuffle_preserves_marginals(columnar):
+    ds = _make_inmem(ROWS, columnar)
+    with pytest.raises(RuntimeError):
+        ds.slots_shuffle(["a"])
+    ds.set_fea_eval(100, True)
+    if columnar:
+        before_a = np.sort(ds.columnar.keys[ds.columnar.key_slot == 0])
+        before_b_per_rec = [sorted(
+            ds.columnar.keys[ds.columnar.offsets[i]:ds.columnar.offsets[i+1]]
+            [ds.columnar.key_slot[ds.columnar.offsets[i]:
+                                  ds.columnar.offsets[i+1]] == 1])
+            for i in range(4)]
+    else:
+        before_a = np.sort(np.concatenate(
+            [r.slot_keys(0) for r in ds.records]))
+        before_b_per_rec = [sorted(r.slot_keys(1)) for r in ds.records]
+    ds.slots_shuffle(["a"])
+    if columnar:
+        col = ds.columnar
+        after_a = np.sort(col.keys[col.key_slot == 0])
+        after_b_per_rec = [sorted(
+            col.keys[col.offsets[i]:col.offsets[i+1]]
+            [col.key_slot[col.offsets[i]:col.offsets[i+1]] == 1])
+            for i in range(4)]
+        # keys stay slot-grouped within each record
+        for i in range(4):
+            ks = col.key_slot[col.offsets[i]:col.offsets[i + 1]]
+            assert (np.diff(ks) >= 0).all()
+    else:
+        after_a = np.sort(np.concatenate(
+            [r.slot_keys(0) for r in ds.records]))
+        after_b_per_rec = [sorted(r.slot_keys(1)) for r in ds.records]
+    # shuffled slot: global multiset preserved
+    np.testing.assert_array_equal(before_a, after_a)
+    # untouched slot: per-record values preserved
+    assert before_b_per_rec == after_b_per_rec
+
+
+def test_slots_shuffle_columnar_matches_batching():
+    ds = _make_inmem(ROWS * 8, True)
+    ds.set_fea_eval()
+    ds.slots_shuffle([0])
+    batches = list(ds.batches())
+    assert sum(int((b.show > 0).sum()) for b in batches) == 32
+
+
+def test_merge_by_insid():
+    from paddlebox_tpu.data.pv import merge_by_insid
+    from paddlebox_tpu.data.record import SlotRecord
+
+    def rec(ins_id, a_keys, b_keys, label=1.0):
+        keys = np.array(a_keys + b_keys, np.uint64)
+        offs = np.array([0, len(a_keys), len(a_keys) + len(b_keys)],
+                        np.int32)
+        return SlotRecord(keys=keys, slot_offsets=offs,
+                          dense=np.array([label], np.float32),
+                          label=label, ins_id=ins_id)
+
+    recs = [rec("x", [1], [10]), rec("x", [2, 3], [20]),
+            rec("y", [4], [40]), rec("z", [5], [50]), rec("z", [6], [60])]
+    merged, dropped = merge_by_insid(recs, merge_size=2, num_slots=2)
+    # group y has size 1 != merge_size → dropped
+    assert dropped == 1
+    assert sorted(m.ins_id for m in merged) == ["x", "z"]
+    mx = next(m for m in merged if m.ins_id == "x")
+    assert sorted(mx.slot_keys(0)) == [1, 2, 3]   # slot a concatenated
+    assert sorted(mx.slot_keys(1)) == [10, 20]    # slot b concatenated
+    # merge_size=0: keep all groups, singletons pass through
+    merged0, dropped0 = merge_by_insid(recs, merge_size=0, num_slots=2)
+    assert dropped0 == 0 and len(merged0) == 3
+
+
+def test_dataset_merge_by_lineid(tmp_path):
+    f = tmp_path / "x.txt"
+    _write_slot_text(str(f), ROWS)
+    ds = InMemoryDataset(_desc())
+    ds.set_filelist([str(f)])
+    ds.set_merge_by_lineid(0)  # ins_id empty for text loads → one group
+    ds.load_into_memory()
+    assert len(ds.records) == 1
+    assert len(ds.records[0].slot_keys(0)) == 7  # all slot-a keys merged
+
+
+def test_device_mem_used():
+    from paddlebox_tpu.utils.monitor import device_mem_used, log_device_mem
+    m = device_mem_used()
+    assert set(m) == {"bytes_in_use", "peak_bytes_in_use", "bytes_limit"}
+    out = log_device_mem("test")
+    from paddlebox_tpu.utils import STATS
+    assert STATS.get("hbm_test_bytes_in_use") == out["bytes_in_use"]
+
+
+def test_parser_plugin_python_module(tmp_path):
+    plug = tmp_path / "my_parser.py"
+    plug.write_text(textwrap.dedent("""
+        from paddlebox_tpu.data.parser import SlotTextParser
+
+        class UpperParser(SlotTextParser):
+            pass
+
+        PARSERS = {"my_custom": UpperParser}
+    """))
+    names = load_parser_plugin(str(plug))
+    assert "my_custom" in names
+    d = _desc()
+    d.parser = "my_custom"
+    assert get_parser(d).__class__.__name__ == "UpperParser"
+
+
+def test_parser_plugin_so(tmp_path):
+    # the framework's own native lib doubles as a plugin .so — it exposes
+    # the documented bulk columnar ABI under `slot_text_parse`
+    from paddlebox_tpu.native import _SO, load_native
+    if load_native() is None:
+        pytest.skip("no native toolchain")
+    names = load_parser_plugin(_SO + ":slot_text_parse", name="plug_native")
+    assert names == ["plug_native"]
+    f = tmp_path / "x.txt"
+    _write_slot_text(str(f), ROWS)
+    d = _desc()
+    d.parser = "plug_native"
+    out = get_parser(d).parse_file_columnar(str(f))
+    assert out is not None and len(out["label"]) == 4
+    np.testing.assert_allclose(np.sort(out["label"]), [0, 0, 1, 1])
