@@ -104,11 +104,22 @@ def main() -> None:
         # throughput is the steady-state estimate (robust to one transient
         # stall of this environment's tunnel)
         per_pass = []
+        debug = os.environ.get("BENCH_DEBUG", "0") == "1"
+        no_overlap = os.environ.get("BENCH_NO_OVERLAP", "0") == "1"
         for _ in range(num_passes):
             t0 = time.perf_counter()
             rp = pre.wait()
-            pre.start_next()
+            t_wait = time.perf_counter() - t0
+            if not no_overlap:
+                pre.start_next()
+            t1 = time.perf_counter()
             tr.train_pass_resident(rp)
+            t_train = time.perf_counter() - t1
+            if no_overlap:
+                pre.start_next()
+            if debug:
+                print(f"pass: wait={t_wait:.3f}s train={t_train:.3f}s",
+                      file=sys.stderr)
             per_pass.append(rp.num_records / (time.perf_counter() - t0))
         value = float(np.median(per_pass))
     baseline_per_chip = 1_000_000 / 16  # v5p-32 north-star / chips

@@ -51,11 +51,10 @@ def shard_filelist(files: Sequence[str], rank: Optional[int] = None,
     return list(files[rank::world])
 
 
-def _slots_shuffle_columnar(col, sel_slots: np.ndarray,
-                            rng: np.random.Generator):
-    """Vectorized SlotsShuffle over a ColumnarRecords store: each record
+def _slots_shuffle_columnar(col, sel_slots: np.ndarray, d: np.ndarray):
+    """Vectorized SlotsShuffle over a ColumnarRecords store: record i
     keeps its non-selected slots and takes the selected slots' feasigns
-    from a random donor record (permutation)."""
+    from donor record ``d[i]``."""
     import dataclasses as _dc
     n = col.num_records
     if n == 0:
@@ -69,7 +68,6 @@ def _slots_shuffle_columnar(col, sel_slots: np.ndarray,
     mcount = np.bincount(mrec, minlength=n).astype(np.int64)
     moff = np.zeros(n + 1, np.int64)
     np.cumsum(mcount, out=moff[1:])
-    d = rng.permutation(n)
     glen = moff[d + 1] - moff[d]
     tot = int(glen.sum())
     # concat-of-ranges: indices into the masked arrays for each donor span
@@ -166,7 +164,13 @@ class Dataset:
                             proc = subprocess.Popen(
                                 pipe_cmd, shell=True, stdin=fh,
                                 stdout=subprocess.PIPE, text=True)
-                            n_ok, n_bad = parse_lines(parser, proc.stdout)
+                            try:
+                                n_ok, n_bad = parse_lines(parser,
+                                                          proc.stdout)
+                            except BaseException:
+                                proc.kill()  # don't leak a blocked child
+                                proc.wait()
+                                raise
                             if proc.wait() != 0:
                                 raise RuntimeError(
                                     f"pipe_command {pipe_cmd!r} failed "
@@ -376,14 +380,21 @@ class InMemoryDataset(Dataset):
             [self.desc.sparse_slot_index(s) if isinstance(s, str) else int(s)
              for s in slots], dtype=np.int64)
         rng = np.random.default_rng(FLAGS.seed)
-        if self.columnar is not None:
-            self.columnar = _slots_shuffle_columnar(self.columnar, sel, rng)
-        elif self.records:
-            # donor permutation = one random candidate per record, capped
-            # reservoir semantics degenerate to this when the pool spans
-            # the whole pass
-            n = len(self.records)
+        n = len(self.columnar.label) if self.columnar is not None \
+            else len(self.records)
+        # donor choice: a permutation when the candidate pool spans the
+        # pass; a capped random pool otherwise (RecordCandidateList
+        # reservoir semantics — set_fea_eval's record_candidate_size)
+        cap = self._fea_eval_candidates
+        if cap >= n:
             perm = rng.permutation(n)
+        else:
+            pool = rng.choice(n, size=cap, replace=False)
+            perm = pool[rng.integers(0, cap, size=n)]
+        if self.columnar is not None:
+            self.columnar = _slots_shuffle_columnar(self.columnar, sel,
+                                                    perm)
+        elif self.records:
             sel_set = set(int(s) for s in sel)
             num_slots = len(self.desc.sparse_slots)
             # snapshot donor spans BEFORE mutating (GetRandomData reads the

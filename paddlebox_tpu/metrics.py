@@ -54,8 +54,14 @@ def auc_add_batch(state: AucState, pred: jax.Array, label: jax.Array,
     b = jnp.clip((pred * n).astype(jnp.int32), 0, n - 1)
     w = weight.astype(jnp.float32)
     lw = label.astype(jnp.float32) * w
-    pos = state.pos + jax.ops.segment_sum(lw, b, num_segments=n)
-    neg = state.neg + jax.ops.segment_sum(w - lw, b, num_segments=n)
+    # ONE histogram scatter for both tables (TPU scatters carry a large
+    # fixed per-call cost — measured ~20ms/call on v5p regardless of
+    # update count): pos buckets at [0, n), neg at [n, 2n)
+    both = jax.ops.segment_sum(
+        jnp.concatenate([lw, w - lw]),
+        jnp.concatenate([b, b + n]), num_segments=2 * n)
+    pos = state.pos + both[:n]
+    neg = state.neg + both[n:]
     err = (pred - label) * w
     return AucState(
         pos=pos, neg=neg,

@@ -1,0 +1,61 @@
+"""Wire bit-packing for pass uploads — host pack (numpy), device unpack
+(jit, a few gathers/shifts on the VPU).
+
+Rationale: the resident-pass pack (train/device_pass.py) is pure index
+data whose value ranges are far below 32 bits — unique table rows fit 24
+bits at the default 8M-row shard, per-key gather positions fit 18 bits at
+the default batch sizes. Host→device bandwidth is the scarce resource
+(tunneled dev runs measured 8-500 MB/s; production PCIe is shared with
+everything else the host streams), so the pack ships split low/high
+arrays and the step reassembles them in-register:
+
+  - 24-bit ("u24"): uint16 low + uint8 high  (3 B/value vs 4)
+  - 18-bit ("u18"): uint16 low + 2-bit high packed 4/byte (2.25 B/value)
+
+Both unpacks are exact; values must be non-negative and in range (the
+packers assert).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def pack_u24(values: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """int array (any shape, values in [0, 2^24)) → (lo uint16, hi uint8)."""
+    v = values.astype(np.uint32, copy=False)
+    assert v.max(initial=0) < (1 << 24), "pack_u24 range"
+    return (v & 0xFFFF).astype(np.uint16), (v >> 16).astype(np.uint8)
+
+
+def unpack_u24(lo: jax.Array, hi: jax.Array) -> jax.Array:
+    """(lo uint16, hi uint8) → int32, elementwise."""
+    return (lo.astype(jnp.int32)
+            | (hi.astype(jnp.int32) << 16))
+
+
+def pack_u18(values: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """int array [..., K] (values in [0, 2^18), K % 4 == 0) →
+    (lo uint16 [..., K], hi2 uint8 [..., K/4] — four 2-bit highs/byte)."""
+    v = values.astype(np.uint32, copy=False)
+    assert v.max(initial=0) < (1 << 18), "pack_u18 range"
+    assert v.shape[-1] % 4 == 0, "pack_u18 needs K % 4 == 0"
+    lo = (v & 0xFFFF).astype(np.uint16)
+    hi = (v >> 16).astype(np.uint8)  # < 4
+    h = hi.reshape(*hi.shape[:-1], -1, 4)
+    hi2 = (h[..., 0] | (h[..., 1] << 2) | (h[..., 2] << 4)
+           | (h[..., 3] << 6)).astype(np.uint8)
+    return lo, hi2
+
+
+def unpack_u18(lo: jax.Array, hi2: jax.Array) -> jax.Array:
+    """(lo uint16 [K], hi2 uint8 [K/4]) → int32 [K] (traced)."""
+    k = lo.shape[-1]
+    pos = jnp.arange(k, dtype=jnp.int32)
+    byte = hi2[..., pos >> 2].astype(jnp.int32)
+    hi = (byte >> ((pos & 3) * 2)) & 3
+    return lo.astype(jnp.int32) | (hi << 16)

@@ -228,30 +228,15 @@ def push_stats(gather_idx: jax.Array, key_valid: jax.Array,
     return touched, slot_val
 
 
-def push_stats_fast(unique_rows: jax.Array, gather_idx: jax.Array,
-                    key_valid: jax.Array, slot_of_key: jax.Array,
-                    capacity: int) -> Tuple[jax.Array, jax.Array]:
-    """Cheaper push_stats for the dup-free unique_rows contract: every
-    in-bounds unique row was hit by ≥1 valid key (pads are OOB), so
-    ``touched`` is a vector compare instead of a segment count; the slot
-    id comes from one segment_max over valid keys (the reference stores
-    THE slot of the feasign — keys live in one slot — so max ≡ it)."""
-    touched = unique_rows <= capacity  # sentinel counts; OOB pads don't
-    slot_val = jax.ops.segment_max(
-        jnp.where(key_valid > 0, slot_of_key, -1.0), gather_idx,
-        num_segments=unique_rows.shape[0])
-    return touched, jnp.maximum(slot_val, 0.0)
-
-
 def apply_push(
     state: TableState,
     unique_rows: jax.Array,   # int32 [U_pad]
     unique_grads: jax.Array,  # [U_pad, 3+mf_dim]: [g_show, g_clk, g_embed, g_embedx…]
-    touched: jax.Array,       # bool [U_pad]
-    slot_val: jax.Array,      # f32 [U_pad]
     cfg: SparseSGDConfig,
     rng: jax.Array,
     rows_full: Optional[jax.Array] = None,  # [U_pad, F] from gather_full_rows
+    touched: Optional[jax.Array] = None,    # bool [U_pad]; None → derived
+    slot_val: Optional[jax.Array] = None,   # f32 [U_pad]; None → keep col
 ) -> TableState:
     """In-table optimizer on merged grads — dy_mf_update_value
     (optimizer.cuh.h:80) + scatter write-back.
@@ -263,8 +248,14 @@ def apply_push(
     gathers clamp to the zero sentinel row.
 
     ``rows_full`` lets the caller reuse the rows gathered for the pull
-    (gather_full_rows) instead of re-gathering here."""
+    (gather_full_rows) instead of re-gathering here. ``touched`` defaults
+    to the dup-free contract (every in-bounds row was hit); ``slot_val``
+    None keeps the stored slot column — the single-process tables track
+    slot as HOST metadata (EmbeddingTable.slot_host), so no device
+    segment op is spent on it."""
     g = unique_grads
+    if touched is None:
+        touched = unique_rows <= state.capacity
     if rows_full is None:
         rows_full = gather_full_rows(state, unique_rows)
     rows = RowState(
@@ -277,7 +268,10 @@ def apply_push(
     mf_dim = state.mf_dim
     new = adagrad_update(rows, g[:, 0], g[:, 1], g[:, 2], g[:, 3:3 + mf_dim],
                          touched, cfg, rng)
-    slot_new = jnp.where(touched, slot_val, rows_full[:, 3])
+    if slot_val is None:
+        slot_new = rows_full[:, 3]
+    else:
+        slot_new = jnp.where(touched, slot_val, rows_full[:, 3])
     new_mat = jnp.concatenate([
         new.show[:, None], new.clk[:, None], new.delta_score[:, None],
         slot_new[:, None], new.embed_w[:, None], new.embed_g2sum[:, None],
@@ -308,6 +302,10 @@ class EmbeddingTable:
         self._push_count = 0
         self.unique_bucket_min = unique_bucket_min
         self._touched = np.zeros(self.capacity + 1, dtype=bool)
+        # per-row slot id — HOST metadata (the FeatureValue slot field,
+        # feature_value.h:570). Slot never changes for a key, and the host
+        # sees every key at assign time, so no device work tracks it.
+        self.slot_host = np.zeros(self.capacity + 1, dtype=np.int16)
         # serializes host-side index/touched mutation across threads
         # (prefetch prepare, ResidentPass.build preload, shrink/save/load)
         self.host_lock = threading.Lock()
@@ -335,11 +333,21 @@ class EmbeddingTable:
         key_valid[:batch.num_keys] = 1.0
         return PullIndex(unique_rows, gather_idx, key_valid, u)
 
+    def record_slots(self, rows: np.ndarray, inv: np.ndarray,
+                     slot_of_key: np.ndarray) -> None:
+        """Record each unique row's slot (first key occurrence wins via
+        the reversed assignment). Caller holds host_lock."""
+        self.slot_host[rows[inv[::-1]]] = slot_of_key[::-1]
+
     def prepare(self, batch: SlotBatch) -> PullIndex:
         valid = batch.keys[:batch.num_keys]
         with self.host_lock:
             rows, inv = self.index.assign_unique(valid)
             self._touched[rows] = True
+            self.record_slots(
+                rows, inv,
+                (batch.segments[:batch.num_keys]
+                 % batch.num_slots).astype(np.int16))
         return self._build_index(batch, rows, inv)
 
     def prepare_eval(self, batch: SlotBatch) -> PullIndex:
@@ -366,18 +374,24 @@ class EmbeddingTable:
             slot_of_key = jnp.zeros(idx.gather_idx.shape[0], jnp.float32)
         gi = jnp.asarray(idx.gather_idx)
         kv = jnp.asarray(idx.key_valid)
-        g, touched, slot_val = merge_push(
-            key_grads, gi, kv, slot_of_key, idx.unique_rows.shape[0])
+        # grad merge only (PushMergeCopy) — touched derives from the
+        # dup-free _build_index contract inside apply_push, slot is host
+        # metadata: no segment-stat scatters
+        g = jax.ops.segment_sum(key_grads * kv[:, None], gi,
+                                num_segments=idx.unique_rows.shape[0])
         self.state = apply_push(
-            self.state, jnp.asarray(idx.unique_rows), g, touched, slot_val,
+            self.state, jnp.asarray(idx.unique_rows), g,
             self.cfg, self.next_rng())
 
     # ---- lifecycle: save / load / shrink (box_wrapper.cc:1383-1415) ----
     def _gather_host(self, rows: np.ndarray) -> Dict[str, np.ndarray]:
         """Per-field host dict (the save-file format stays field-named,
-        independent of the device AoS layout)."""
+        independent of the device AoS layout). The slot field comes from
+        host metadata — the device column is not maintained."""
         data = np.asarray(jax.device_get(self.state.data))
-        return {f: field_slice(data[rows], f) for f in FIELDS}
+        out = {f: field_slice(data[rows], f) for f in FIELDS}
+        out["slot"] = self.slot_host[rows].astype(np.float32)
+        return out
 
     def save_base(self, path: str) -> int:
         """Full model dump (day-level batch model). Returns rows saved."""
@@ -411,9 +425,13 @@ class EmbeddingTable:
                 self.index = HostKV(self.capacity)
                 self.state = init_table_state(self.capacity, self.mf_dim)
                 self._touched[:] = False
+                self.slot_host[:] = 0
             rows = self.index.assign(keys)
+            self.slot_host[rows] = blob["slot"].astype(np.int16)
         data = np.asarray(jax.device_get(self.state.data)).copy()
         for f in FIELDS:
+            if f == "slot":
+                continue  # host metadata (slot_host); device col stays 0
             field_assign(data, rows, f, blob[f])
         self.state = TableState(jnp.asarray(data))
         return len(keys)
@@ -441,6 +459,7 @@ class EmbeddingTable:
             data[freed_rows] = 0.0
             self.state = TableState(jnp.asarray(data))
             self._touched[freed_rows] = False
+            self.slot_host[freed_rows] = 0
         log.info("shrink: freed %d/%d rows", len(freed_rows), len(keys))
         return int(len(freed_rows))
 

@@ -30,7 +30,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddlebox_tpu.data.dataset import Dataset
-from paddlebox_tpu.ops.device_unique import dedup_rows
+from paddlebox_tpu.ops.bitpack import (pack_u18, pack_u24, unpack_u18,
+                                       unpack_u24)
 from paddlebox_tpu.train.step import pack_floats, unpack_floats
 from paddlebox_tpu.utils.logging import get_logger
 
@@ -40,18 +41,32 @@ log = get_logger(__name__)
 class ResidentPass:
     """One pass's batches, packed host-side then staged to HBM.
 
-    Arrays (nb = #batches, K = uniform per-batch key capacity):
-      rows:   int32 [nb, K]      per-key table row; padding → sentinel row
+    The pack ships HOST-DEDUPED pull indexes (the DedupKeysAndFillIdx
+    step, done once per batch by the native hash index at build time):
+    an on-device sort+searchsorted dedup was measured at ~50ms of a 68ms
+    step on v5p — ~75% of the whole pass — while the host dedup rides the
+    build thread that overlaps the previous pass's training.
+
+    Arrays (nb = #batches, K = uniform per-batch key capacity, U =
+    uniform per-batch unique capacity):
+      uniq:   int32 [nb, U]      per-batch unique table rows (ascending
+              real rows first; padding = DISTINCT out-of-bounds ids, the
+              fill_oob_pads contract — gathers clamp to the zero
+              sentinel row, scatters drop)
+      gidx:   int32 [nb, K]      per-key position in uniq; key padding →
+              the first pad position (num_unique)
       floats: f32   [nb, B, D+3] [dense | label | show | clk]
-      meta:   int32 [nb, 2]      [num_keys, pad_segment]
+      meta:   int32 [nb, 3]      [num_keys, pad_segment, num_unique]
       segs:   int32 [nb, K] | None   None when every batch has the trivial
               one-key-per-slot layout (segments derived on device)
     """
 
-    def __init__(self, rows: np.ndarray, floats: np.ndarray,
+    def __init__(self, uniq: np.ndarray, gidx: np.ndarray,
+                 floats: np.ndarray,
                  meta: np.ndarray, segs: Optional[np.ndarray],
                  num_records: int) -> None:
-        self.rows = rows
+        self.uniq = uniq
+        self.gidx = gidx
         self.floats = floats
         self.meta = meta
         self.segs = segs
@@ -60,72 +75,60 @@ class ResidentPass:
 
     @property
     def num_batches(self) -> int:
-        return self.rows.shape[0]
+        return self.gidx.shape[0]
 
     @property
     def key_capacity(self) -> int:
-        return self.rows.shape[1]
+        return self.gidx.shape[1]
+
+    @property
+    def unique_capacity(self) -> int:
+        return self.uniq.shape[1]
 
     @classmethod
     def build(cls, dataset: Dataset, table,
               floats_dtype=np.float32) -> "ResidentPass":
-        """Pack a dataset's batches; assigns table rows for every key
-        (the FeedPass key registration step, done by the native index).
+        """Pack a dataset's batches; assigns table rows for every key and
+        dedups per batch (the FeedPass key registration +
+        DedupKeysAndFillIdx steps, both done by the native index).
 
         ``floats_dtype=jnp.bfloat16`` halves the float block on the wire
         (dense features, label/show/clk — the latter are small integers,
-        exact in bf16); the step casts back to f32 on device."""
+        exact in bf16); the step casts back to f32 on device.
+
+        NOTE: table._touched is deliberately NOT set here — a preloaded
+        pass hasn't trained yet, and a checkpoint save landing between
+        build and training would clear the flags and lose the pass's
+        updates from the next delta. The trainer marks the pass's rows
+        touched AFTER the pass runs (mark_trained_rows)."""
         col = getattr(dataset, "columnar", None)
         if col is not None:
             return cls._build_columnar(dataset, col, table, floats_dtype)
-        rows_l, floats_l, meta_l, segs_l = [], [], [], []
+        per_batch = []
+        floats_l = []
         trivial = True
         nrec = 0
-        cap = table.capacity
         for b in dataset.batches():
             nk = b.num_keys
-            rk = np.full(b.key_capacity, cap, np.int32)
-            with table.host_lock:  # vs shrink/save on the main thread
-                r = table.index.assign(b.keys[:nk])
-            # NOTE: _touched is deliberately NOT set here — a preloaded
-            # pass hasn't trained yet, and a checkpoint save landing
-            # between build and training would clear the flags and lose
-            # the pass's updates from the next delta. The trainer marks
-            # the pass's rows touched AFTER the pass runs
-            # (mark_trained_rows).
-            rk[:nk] = r
-            rows_l.append(rk)
+            slot_of_key = (b.segments[:nk] % b.num_slots).astype(np.int16)
+            per_batch.append((b.keys[:nk], slot_of_key, b.key_capacity,
+                              b.pad_segment,
+                              b.segments[:nk].astype(np.int32, copy=False)))
             floats_l.append(pack_floats(b.dense, b.label, b.show, b.clk,
                                         dtype=floats_dtype))
-            meta_l.append((nk, b.pad_segment))
-            segs_l.append(b.segments.astype(np.int32, copy=False))
-            trivial = trivial and getattr(b, "segments_trivial", False)
             nrec += int((b.show > 0).sum())
-        if not rows_l:
+            trivial = trivial and getattr(b, "segments_trivial", False)
+        if not per_batch:
             raise ValueError("empty pass")
-        k_max = max(r.shape[0] for r in rows_l)
-        nb = len(rows_l)
-        rows = np.full((nb, k_max), cap, np.int32)
-        for i, r in enumerate(rows_l):
-            rows[i, :r.shape[0]] = r
-        if trivial:
-            segs = None  # derived on device — skip the [nb, k_max] copy
-        else:
-            segs = np.empty((nb, k_max), np.int32)
-            for i, (s, (nk, pad)) in enumerate(zip(segs_l, meta_l)):
-                segs[i, :s.shape[0]] = s
-                segs[i, s.shape[0]:] = pad
-        return cls(rows, np.stack(floats_l), np.asarray(meta_l, np.int32),
-                   segs, nrec)
+        return cls._pack(per_batch, np.stack(floats_l), trivial, nrec, table)
 
     @classmethod
     def _build_columnar(cls, dataset: Dataset, col, table,
                         floats_dtype) -> "ResidentPass":
-        """Vectorized whole-pass packer for columnar datasets: ONE native
-        index.assign over the pass's key stream + bulk reshapes, instead
-        of 32+ per-batch SlotBatch constructions (the per-batch python
-        path was the pipeline bottleneck — build must stay under the
-        device pass time for the preload to fully overlap)."""
+        """Vectorized whole-pass packer for columnar datasets: per-batch
+        native dedup+assign over array slices + bulk reshapes — no
+        SlotBatch objects, no per-record python (build must stay under
+        the device pass time for the preload to fully overlap)."""
         desc = dataset.desc
         bs = desc.batch_size
         s = len(desc.sparse_slots)
@@ -133,16 +136,10 @@ class ResidentPass:
         if r == 0:
             raise ValueError("empty pass")
         nb = (r + bs - 1) // bs
-        cap = table.capacity
         offsets = col.offsets
-        with table.host_lock:  # one pass-wide key→row assignment
-            rows_all = table.index.assign(col.keys)
-        rows_all = rows_all.astype(np.int32, copy=False)
-        # per-batch key spans + uniform padded capacity (one jit variant)
         bounds = offsets[np.minimum(np.arange(nb + 1) * bs, r)]
-        nk = np.diff(bounds)
-        k_max = desc.key_capacity(int(nk.max()))
-        rows = np.full((nb, k_max), cap, np.int32)
+        nk_arr = np.diff(bounds)
+        k_max = desc.key_capacity(int(nk_arr.max()))
         counts = np.diff(offsets)
         # trivial layout = exactly one key per slot per record, slot-order:
         # segments are then derivable on device (DeviceBatch.segments)
@@ -150,17 +147,18 @@ class ResidentPass:
                    and bool((col.key_slot.reshape(r, s)
                              == np.arange(s, dtype=np.int32)).all()))
         pad_seg = bs * s
-        segs = None
+        segs_global = None
         if not trivial:
             rec_of_key = np.repeat(np.arange(r, dtype=np.int64), counts)
             segs_global = ((rec_of_key % bs) * s
                            + col.key_slot).astype(np.int32)
-            segs = np.full((nb, k_max), pad_seg, np.int32)
+        per_batch = []
         for i in range(nb):
-            a, b = bounds[i], bounds[i + 1]
-            rows[i, :b - a] = rows_all[a:b]
-            if segs is not None:
-                segs[i, :b - a] = segs_global[a:b]
+            a, b = int(bounds[i]), int(bounds[i + 1])
+            per_batch.append((
+                col.keys[a:b], col.key_slot[a:b].astype(np.int16),
+                k_max, pad_seg,
+                None if trivial else segs_global[a:b]))
         # float block: pack the whole pass, zero-pad the tail batch
         floats_full = pack_floats(col.dense, col.label, col.show, col.clk)
         d3 = floats_full.shape[1]
@@ -170,33 +168,95 @@ class ResidentPass:
             floats_full = padded
         floats = floats_full.reshape(nb, bs, d3).astype(
             floats_dtype, copy=False)
-        meta = np.stack(
-            [nk.astype(np.int32),
-             np.full(nb, pad_seg, np.int32)], axis=1)
-        return cls(rows, floats, meta, segs, int((col.show > 0).sum()))
+        return cls._pack(per_batch, floats, trivial,
+                         int((col.show > 0).sum()), table)
 
-    def upload(self) -> None:
-        """Stage to HBM — three (four with segs) bulk transfers."""
-        if self.dev is not None:
-            return
-        segs = (jnp.zeros((1, 1), jnp.int32) if self.segs is None
-                else jnp.asarray(self.segs))
-        self.dev = (jnp.asarray(self.rows), jnp.asarray(self.floats),
-                    jnp.asarray(self.meta), segs)
+    @classmethod
+    def _pack(cls, per_batch, floats, trivial: bool, nrec: int,
+              table) -> "ResidentPass":
+        """Shared tail: per-batch dedup+assign through the native index,
+        then pack uniq/gidx/meta/segs to uniform buckets (slot ids go to
+        the table's host-side slot_host, not the wire)."""
+        from paddlebox_tpu.ps.table import fill_oob_pads
+        nb = len(per_batch)
+        cap = table.capacity
+        dedup = []
+        u_max = 1
+        for keys, *_ in per_batch:
+            with table.host_lock:  # vs shrink/save on the main thread
+                rows_u, inv = table.index.assign_unique(keys)
+            dedup.append((rows_u, inv))
+            u_max = max(u_max, len(rows_u) + 1)
+        u_pad = table.unique_bucket_min
+        while u_pad < u_max:
+            u_pad *= 2
+        k_max = max(kc for _, _, kc, _, _ in per_batch)
+        uniq = np.empty((nb, u_pad), np.int32)
+        gidx = np.empty((nb, k_max), np.int32)
+        meta = np.empty((nb, 3), np.int32)
+        segs = None if trivial else np.empty((nb, k_max), np.int32)
+        for i, ((keys, slot_of_key, _, pad_seg, seg_arr),
+                (rows_u, inv)) in enumerate(zip(per_batch, dedup)):
+            nk, u = len(keys), len(rows_u)
+            uniq[i, :u] = rows_u
+            fill_oob_pads(uniq[i], u, cap)
+            gidx[i, :nk] = inv
+            gidx[i, nk:] = u  # key pads → first OOB pad position
+            with table.host_lock:  # slot = host metadata (slot_host)
+                table.record_slots(rows_u, inv, slot_of_key)
+            meta[i] = (nk, pad_seg, u)
+            if segs is not None:
+                segs[i, :nk] = seg_arr
+                segs[i, nk:] = pad_seg
+        return cls(uniq, gidx, floats, meta, segs, nrec)
+
+    def upload(self, materialize: bool = False) -> None:
+        """Stage to HBM, bit-packing the index arrays for the wire (H2D
+        bandwidth is the scarce resource — ops/bitpack.py): uniq rides as
+        16+8-bit halves when rows fit 24 bits, gidx as 16-bit lows plus
+        packed 2-bit highs when positions fit 18 bits; the step
+        reassembles in-register.
+
+        ``materialize=True`` forces the bytes onto the device NOW (a tiny
+        fetch per array): plain ``jnp.asarray`` is lazy on tunneled
+        runtimes and the deferred transfer would otherwise serialize into
+        the first training step that consumes the pass — the preloader
+        materializes from its thread so the transfer rides alongside the
+        previous pass's compute."""
+        if self.dev is None:
+            if int(self.uniq.max()) < (1 << 24):
+                uniq = tuple(jnp.asarray(a) for a in pack_u24(self.uniq))
+            else:
+                uniq = (jnp.asarray(self.uniq),)
+            if (int(self.gidx.max(initial=0)) < (1 << 18)
+                    and self.gidx.shape[1] % 4 == 0):
+                gidx = tuple(jnp.asarray(a) for a in pack_u18(self.gidx))
+            else:
+                gidx = (jnp.asarray(self.gidx),)
+            segs = (jnp.zeros((1, 1), jnp.int32) if self.segs is None
+                    else jnp.asarray(self.segs))
+            self.dev = (uniq, gidx, jnp.asarray(self.floats),
+                        jnp.asarray(self.meta), segs)
+        if materialize:
+            for a in jax.tree.leaves(self.dev):
+                jax.device_get(a.ravel()[0])
 
     def nbytes(self) -> int:
-        n = self.rows.nbytes + self.floats.nbytes + self.meta.nbytes
+        """Wire bytes (after upload packing; host estimate before)."""
+        if self.dev is not None:
+            return sum(a.nbytes for a in jax.tree.leaves(self.dev))
+        n = (self.uniq.nbytes + self.gidx.nbytes
+             + self.floats.nbytes + self.meta.nbytes)
         return n + (self.segs.nbytes if self.segs is not None else 0)
 
     def mark_trained_rows(self, table) -> None:
         """Flag this pass's rows as touched-since-last-save — called by
         the trainer AFTER the pass runs, so delta saves include them
         regardless of when a checkpoint landed relative to the preload.
-        Duplicate-tolerant boolean scatter (no sort): every row id in the
-        pack is ≤ capacity by construction (padding is the sentinel row),
-        and the sentinel flag is harmless — save paths only read rows the
-        index owns."""
-        rows = self.rows.ravel()
+        Duplicate-tolerant boolean scatter after dropping the OOB pad
+        ids (save paths only read rows the index owns)."""
+        rows = self.uniq.ravel()
+        rows = rows[rows <= table.capacity]
         with table.host_lock:
             table._touched[rows] = True
 
@@ -205,7 +265,8 @@ class _BatchView:
     """Duck-typed DeviceBatch built inside the trace from pass slices."""
 
     def __init__(self, unique_rows, gather_idx, key_valid, segments,
-                 dense, label, show, clk) -> None:
+                 dense, label, show, clk, slot_val=None,
+                 segments_trivial=False) -> None:
         self.unique_rows = unique_rows
         self.gather_idx = gather_idx
         self.key_valid = key_valid
@@ -214,6 +275,12 @@ class _BatchView:
         self.label = label
         self.show = show
         self.clk = clk
+        self.slot_val = slot_val
+        self.segments_trivial = segments_trivial
+
+    @property
+    def pool_segments(self):
+        return None if self.segments_trivial else self.segments
 
 
 class ResidentPassRunner:
@@ -228,29 +295,34 @@ class ResidentPassRunner:
         self.chunk = chunk
         self._jit: Dict[int, object] = {}  # n_steps → compiled runner
 
-    def _make_view(self, rows, floats, meta, segs) -> _BatchView:
-        k = rows.shape[0]
-        unique_rows, gather_idx = dedup_rows(rows, self.capacity)
+    def _make_view(self, uniq_t, gidx_t, floats, meta,
+                   segs) -> _BatchView:
+        uniq = (unpack_u24(*uniq_t) if len(uniq_t) == 2 else uniq_t[0])
+        gidx = (unpack_u18(*gidx_t) if len(gidx_t) == 2 else gidx_t[0])
+        k = gidx.shape[0]
         num_keys, pad_seg = meta[0], meta[1]
         pos = jnp.arange(k, dtype=jnp.int32)
-        key_valid = (pos < num_keys).astype(jnp.float32)
         if self.trivial:
             segments = jnp.where(pos < num_keys, pos, pad_seg)
         else:
             segments = segs
+        key_valid = (pos < num_keys).astype(jnp.float32)
         dense, label, show, clk = unpack_floats(floats)
         return _BatchView(
-            unique_rows, gather_idx, key_valid, segments,
-            dense=dense, label=label, show=show, clk=clk)
+            uniq, gidx, key_valid, segments,
+            dense=dense, label=label, show=show, clk=clk,
+            segments_trivial=self.trivial)
 
     def _run(self, n_steps: int):
         if n_steps not in self._jit:
-            def run(state, rows_p, floats_p, meta_p, segs_p, start, rng):
+            def run(state, uniq_t, gidx_t, floats_p, meta_p,
+                    segs_p, start, rng):
                 def body(i, carry):
                     state, rng = carry
                     view = self._make_view(
-                        rows_p[i], floats_p[i], meta_p[i],
-                        segs_p[i % segs_p.shape[0]])
+                        tuple(a[i] for a in uniq_t),
+                        tuple(a[i] for a in gidx_t), floats_p[i],
+                        meta_p[i], segs_p[i % segs_p.shape[0]])
                     # 1-based like Trainer.train_pass's fold of the
                     # pre-incremented global_step
                     rng_i = jax.random.fold_in(rng, state.step + 1)
@@ -307,7 +379,10 @@ class PassPreloader:
             else:
                 rp = ResidentPass.build(ds, self._table,
                                         floats_dtype=self._floats_dtype)
-            rp.upload()
+            # forced materialization moves pass k+1's bytes NOW, riding
+            # alongside pass k's compute (see ResidentPass.upload); a
+            # lazy upload would instead serialize into k+1's first step
+            rp.upload(materialize=True)
             self._next = rp
         except BaseException as e:  # surfaces on next()
             self._err = e
@@ -332,4 +407,6 @@ class PassPreloader:
         if self._err is not None:
             err, self._err = self._err, None
             raise err
+        if self._next is not None:
+            self._next.upload()  # no-op unless build_fn skipped it
         return self._next

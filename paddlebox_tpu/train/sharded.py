@@ -225,9 +225,10 @@ class ShardedTrainStep:
         gb = jnp.concatenate(
             [g_serve[:, :2], g_serve[:, 2:] * (-1.0 * b * n)], axis=1)
         touched = serve_valid > 0
-        table = apply_push(table, serve_rows, gb, touched, serve_slot,
+        table = apply_push(table, serve_rows, gb,
                            self.sgd_cfg, jax.random.fold_in(rng, me),
-                           rows_full=rows_full)
+                           rows_full=rows_full, touched=touched,
+                           slot_val=serve_slot)
 
         # ---- dense sync ----
         if self.zero1:

@@ -26,7 +26,7 @@ from paddlebox_tpu.ops import fused_seqpool_cvm
 from paddlebox_tpu.ps.sgd import SparseSGDConfig
 from paddlebox_tpu.ps.table import (PullIndex, TableState, apply_push,
                                     expand_pull, gather_full_rows,
-                                    pull_values, push_stats_fast)
+                                    pull_values)
 
 
 def pack_floats(dense: np.ndarray, label: np.ndarray, show: np.ndarray,
@@ -89,6 +89,16 @@ class DeviceBatch(NamedTuple):
                 < self.num_keys).astype(jnp.float32)
 
     @property
+    def segments_trivial(self) -> bool:
+        return self.ints_k.shape[0] == 1
+
+    @property
+    def pool_segments(self):
+        """Segments for fused_seqpool_cvm — None declares the trivial
+        layout (pool becomes a reshape; no TPU scatter)."""
+        return None if self.segments_trivial else self.segments
+
+    @property
     def dense(self) -> jax.Array:
         return unpack_floats(self.floats)[0]
 
@@ -132,8 +142,9 @@ def ctr_forward(table: TableState, params: Any, model, batch,
     batch_show_clk = jnp.stack([batch.show, batch.clk], axis=1)
     vals_u = pull_values(gather_full_rows(table, batch.unique_rows))
     values_k = expand_pull(vals_u, batch.gather_idx)
+    segs = getattr(batch, "pool_segments", batch.segments)
     pooled = fused_seqpool_cvm(
-        values_k, batch.segments, batch_show_clk, batch_size, num_slots,
+        values_k, segs, batch_show_clk, batch_size, num_slots,
         use_cvm, cvm_offset, 0.0, need_filter, 0.2, 1.0, 0.96, quant_ratio)
     logits = model.apply(params, pooled, batch.dense)
     ins_w = (batch.show > 0).astype(jnp.float32)
@@ -202,10 +213,12 @@ class TrainStep:
         rows_full = gather_full_rows(state.table, batch.unique_rows)
         vals_u = pull_values(rows_full)
 
+        pool_segs = getattr(batch, "pool_segments", batch.segments)
+
         def loss_fn(params, vals_u):
             values_k = expand_pull(vals_u, batch.gather_idx)
             pooled = fused_seqpool_cvm(
-                values_k, batch.segments, batch_show_clk, b, s,
+                values_k, pool_segs, batch_show_clk, b, s,
                 self.use_cvm, self.cvm_offset, 0.0, self.need_filter,
                 0.2, 1.0, 0.96, self.quant_ratio)
             logits = self.model.apply(params, pooled, batch.dense)
@@ -224,13 +237,11 @@ class TrainStep:
         # adagrad ADDS ratio*g/g_show, so push carries the negated sum-grad).
         g_vals_u = jnp.concatenate(
             [g_vals_u[:, :2], g_vals_u[:, 2:] * (-1.0 * b)], axis=1)
-        slot_of_key = (batch.segments % s).astype(jnp.float32)
-        touched, slot_val = push_stats_fast(
-            batch.unique_rows, batch.gather_idx, batch.key_valid,
-            slot_of_key, state.table.capacity)
+        # touched derives from the dup-free unique_rows contract inside
+        # apply_push; slot is host metadata (EmbeddingTable.slot_host) —
+        # no segment op spent on either
         table = apply_push(state.table, batch.unique_rows, g_vals_u,
-                           touched, slot_val, self.sgd_cfg, rng,
-                           rows_full=rows_full)
+                           self.sgd_cfg, rng, rows_full=rows_full)
 
         updates, opt_state = self.tx.update(g_params, state.opt_state,
                                             state.params)

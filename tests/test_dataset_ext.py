@@ -132,6 +132,22 @@ def test_slots_shuffle_preserves_marginals(columnar):
     assert before_b_per_rec == after_b_per_rec
 
 
+def test_slots_shuffle_capped_candidates():
+    """record_candidate_size < pass size → donors come from a capped
+    pool (reservoir semantics), not the whole pass."""
+    ds = _make_inmem(ROWS * 16, True)   # 64 records
+    ds.set_fea_eval(record_candidate_size=4)
+    before_b = ds.columnar.keys[ds.columnar.key_slot == 1].copy()
+    ds.slots_shuffle(["a"])
+    col = ds.columnar
+    # untouched slot preserved; shuffled slot values all come from the
+    # original value set (marginal support preserved)
+    np.testing.assert_array_equal(
+        np.sort(col.keys[col.key_slot == 1]), np.sort(before_b))
+    a_vals = set(col.keys[col.key_slot == 0].tolist())
+    assert a_vals <= {11, 12, 13, 14, 15, 16, 17}
+
+
 def test_slots_shuffle_columnar_matches_batching():
     ds = _make_inmem(ROWS * 8, True)
     ds.set_fea_eval()

@@ -164,13 +164,24 @@ def test_build_columnar_matches_record_path(trivial):
     rp_col = ResidentPass.build(ds_col, tb)   # vectorized path
     assert rp_rec.num_batches == rp_col.num_batches
     assert rp_rec.num_records == rp_col.num_records
-    np.testing.assert_array_equal(rp_rec.rows, rp_col.rows)
+    np.testing.assert_array_equal(rp_rec.uniq, rp_col.uniq)
+    np.testing.assert_array_equal(rp_rec.gidx, rp_col.gidx)
+    np.testing.assert_array_equal(ta.slot_host, tb.slot_host)
+    assert ta.slot_host.max() > 0  # slots were recorded host-side
     np.testing.assert_array_equal(rp_rec.meta, rp_col.meta)
     np.testing.assert_allclose(rp_rec.floats, rp_col.floats)
     if rp_rec.segs is None:
         assert rp_col.segs is None
     else:
         np.testing.assert_array_equal(rp_rec.segs, rp_col.segs)
+    # the pull-index invariants the step relies on: duplicate-free rows,
+    # OOB pads after the real block, gather idx within [0, u]
+    for i in range(rp_col.num_batches):
+        u = rp_col.meta[i, 2]
+        assert len(np.unique(rp_col.uniq[i])) == rp_col.uniq.shape[1]
+        assert (rp_col.uniq[i, :u] <= ta.capacity).all()
+        assert (rp_col.uniq[i, u:] > ta.capacity).all()
+        assert (rp_col.gidx[i] <= u).all()
 
 
 def test_pass_preloader(criteo_files):
