@@ -19,6 +19,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 import zlib
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -165,8 +166,21 @@ class TcpShuffler(Shuffler):
     def _send_to(self, dst: int, payload: bytes,
                  errors: List[BaseException]) -> None:
         try:
-            with socket.create_connection(self.endpoints[dst],
-                                          timeout=self.timeout) as c:
+            deadline = time.monotonic() + self.timeout
+            delay = 0.05
+            while True:
+                try:
+                    c = socket.create_connection(self.endpoints[dst],
+                                                 timeout=self.timeout)
+                    break
+                except OSError:
+                    # peer hasn't bound its shuffler yet (ranks start at
+                    # different speeds) — retry until the data deadline
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(delay)
+                    delay = min(delay * 2, 1.0)
+            with c:
                 c.sendall(struct.pack("<iiq", self.rank, self._round,
                                       len(payload)))
                 c.sendall(payload)

@@ -51,25 +51,31 @@ class ExtendedEmbeddingTable:
         # single dedup feeding both value spaces)
         valid = batch.keys[:batch.num_keys]
         uniq, inv = np.unique(valid, return_inverse=True)
+        slot_k = (batch.segments[:batch.num_keys]
+                  % batch.num_slots).astype(np.int16)
         # same locking discipline as EmbeddingTable.prepare (this runs on
         # the prefetch thread; shrink/save may run on the main thread)
         with self.base.host_lock:
             rows_b = self.base.index.assign(uniq)
             self.base._touched[rows_b] = True
+            self.base.record_slots(rows_b, inv.astype(np.int32), slot_k)
         idx_b = self.base._build_index(batch, rows_b, inv.astype(np.int32))
         if not self.skip_extend_slots:
             with self.extend.host_lock:
                 rows_e = self.extend.index.assign(uniq)
                 self.extend._touched[rows_e] = True
+                self.extend.record_slots(rows_e, inv.astype(np.int32),
+                                         slot_k)
             idx_e = self.extend._build_index(batch, rows_e,
                                              inv.astype(np.int32))
         else:
-            slot_k = batch.segments[:batch.num_keys] % batch.num_slots
             keep = ~np.isin(slot_k, list(self.skip_extend_slots))
             uniq_e, inv_e = np.unique(valid[keep], return_inverse=True)
             with self.extend.host_lock:
                 rows_e = self.extend.index.assign(uniq_e)
                 self.extend._touched[rows_e] = True
+                self.extend.record_slots(rows_e, inv_e.astype(np.int32),
+                                         slot_k[keep])
             u = len(uniq_e)
             cap = self.extend.unique_bucket_min
             while cap < u + 1:

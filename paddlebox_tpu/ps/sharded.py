@@ -49,7 +49,8 @@ from paddlebox_tpu.data.batch import SlotBatch
 from paddlebox_tpu.ps.sgd import SparseSGDConfig
 from paddlebox_tpu.ps.table import (FIELDS, NUM_FIXED, HostKV, TableState,
                                     field_assign, field_slice,
-                                    fill_oob_pads, init_table_state)
+                                    fill_oob_pads, init_table_state,
+                                    next_bucket)
 from paddlebox_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -72,10 +73,7 @@ class ShardedPullIndex(NamedTuple):
 
 
 def _bucket(n: int, bucket_min: int) -> int:
-    cap = bucket_min
-    while cap < n:
-        cap *= 2
-    return cap
+    return next_bucket(bucket_min, n)
 
 
 class ShardedEmbeddingTable:

@@ -142,6 +142,16 @@ def field_assign(data: np.ndarray, rows: np.ndarray, name: str,
         data[rows, FIELD_COL[name]] = values
 
 
+def next_bucket(minimum: int, need: int) -> int:
+    """Power-of-two padding ladder: the smallest doubling of ``minimum``
+    that is ≥ ``need`` (bounds distinct XLA compilations). THE bucket
+    rule for unique-row capacities across all index builders."""
+    cap = minimum
+    while cap < need:
+        cap *= 2
+    return cap
+
+
 def fill_oob_pads(unique_rows: np.ndarray, u: int, capacity: int) -> None:
     """Fill positions [u:] with DISTINCT out-of-bounds row ids (> capacity).
 
@@ -320,9 +330,7 @@ class EmbeddingTable:
         (rows itself is dup-free: assign_unique returns distinct rows;
         lookup_unique collapses all misses into ONE sentinel entry.)"""
         u = len(rows)
-        cap = self.unique_bucket_min
-        while cap < u + 1:
-            cap *= 2
+        cap = next_bucket(self.unique_bucket_min, u + 1)
         unique_rows = np.empty(cap, dtype=np.int32)
         unique_rows[:u] = rows
         fill_oob_pads(unique_rows, u, self.capacity)
@@ -369,9 +377,15 @@ class EmbeddingTable:
 
     def push(self, idx: PullIndex, key_grads: jax.Array,
              slot_of_key: Optional[jax.Array] = None) -> None:
-        """Per-key-occurrence grads in → dedup-merge → optimizer apply."""
-        if slot_of_key is None:
-            slot_of_key = jnp.zeros(idx.gather_idx.shape[0], jnp.float32)
+        """Per-key-occurrence grads in → dedup-merge → optimizer apply.
+        ``slot_of_key`` (per padded key) records the rows' slot ids into
+        the host-side slot metadata (save files read slot from there)."""
+        if slot_of_key is not None:
+            sok = np.asarray(slot_of_key)
+            kvm = np.asarray(idx.key_valid) > 0
+            with self.host_lock:
+                self.record_slots(idx.unique_rows, idx.gather_idx[kvm],
+                                  sok[kvm].astype(np.int16))
         gi = jnp.asarray(idx.gather_idx)
         kv = jnp.asarray(idx.key_valid)
         # grad merge only (PushMergeCopy) — touched derives from the

@@ -177,7 +177,7 @@ class ResidentPass:
         """Shared tail: per-batch dedup+assign through the native index,
         then pack uniq/gidx/meta/segs to uniform buckets (slot ids go to
         the table's host-side slot_host, not the wire)."""
-        from paddlebox_tpu.ps.table import fill_oob_pads
+        from paddlebox_tpu.ps.table import fill_oob_pads, next_bucket
         nb = len(per_batch)
         cap = table.capacity
         dedup = []
@@ -187,9 +187,7 @@ class ResidentPass:
                 rows_u, inv = table.index.assign_unique(keys)
             dedup.append((rows_u, inv))
             u_max = max(u_max, len(rows_u) + 1)
-        u_pad = table.unique_bucket_min
-        while u_pad < u_max:
-            u_pad *= 2
+        u_pad = next_bucket(table.unique_bucket_min, u_max)
         k_max = max(kc for _, _, kc, _, _ in per_batch)
         uniq = np.empty((nb, u_pad), np.int32)
         gidx = np.empty((nb, k_max), np.int32)
@@ -265,7 +263,7 @@ class _BatchView:
     """Duck-typed DeviceBatch built inside the trace from pass slices."""
 
     def __init__(self, unique_rows, gather_idx, key_valid, segments,
-                 dense, label, show, clk, slot_val=None,
+                 dense, label, show, clk,
                  segments_trivial=False) -> None:
         self.unique_rows = unique_rows
         self.gather_idx = gather_idx
@@ -275,7 +273,6 @@ class _BatchView:
         self.label = label
         self.show = show
         self.clk = clk
-        self.slot_val = slot_val
         self.segments_trivial = segments_trivial
 
     @property

@@ -611,13 +611,18 @@ class ShardedResidentPass:
                 rows = rows[rows < table.capacity]
                 table._touched[s][rows] = True
 
-    def upload(self) -> None:
-        """Stage to HBM with the device dim sharded over the mesh axis."""
-        if self.dev is not None:
-            return
-        put = {}
-        for f, arr in self.arrays.items():
-            spec = P(*([None, DATA_AXIS] + [None] * (arr.ndim - 2)))
-            put[f] = jax.device_put(
-                jnp.asarray(arr), NamedSharding(self.mesh, spec))
-        self.dev = GlobalBatch(**put)
+    def upload(self, materialize: bool = False) -> None:
+        """Stage to HBM with the device dim sharded over the mesh axis.
+        ``materialize=True`` forces the transfers now (see
+        ResidentPass.upload — lazy uploads serialize into the first
+        consuming step on tunneled runtimes)."""
+        if self.dev is None:
+            put = {}
+            for f, arr in self.arrays.items():
+                spec = P(*([None, DATA_AXIS] + [None] * (arr.ndim - 2)))
+                put[f] = jax.device_put(
+                    jnp.asarray(arr), NamedSharding(self.mesh, spec))
+            self.dev = GlobalBatch(**put)
+        if materialize:
+            for a in jax.tree.leaves(self.dev):
+                jax.device_get(a.ravel()[0])

@@ -157,3 +157,43 @@ def test_shrink_frees_low_score_rows():
     # freed row is zeroed on device
     st = np.asarray(t.state.show)
     assert (st > 0).sum() == 1
+
+
+def test_slot_host_recorded_on_all_paths(tmp_path):
+    """Saved slot metadata must be populated by every prepare/push path:
+    EmbeddingTable.prepare, push(slot_of_key=...), and the
+    ExtendedEmbeddingTable pair (regression: the extended path once
+    saved slot=0 for every row)."""
+    import jax.numpy as jnp
+
+    # prepare path: keys 1..4 land in slots 0,1,0,1 (mkbatch: pos % S)
+    t = EmbeddingTable(mf_dim=2, capacity=32, unique_bucket_min=8)
+    idx = t.prepare(mkbatch([1, 2, 3, 4], k_pad=8))
+    t.push(idx, jnp.zeros((8, 5)))
+    p = str(tmp_path / "b.npz")
+    t.save_base(p)
+    blob = np.load(p)
+    by_key = dict(zip(blob["keys"].tolist(), blob["slot"].tolist()))
+    assert by_key == {1: 0.0, 2: 1.0, 3: 0.0, 4: 1.0}
+
+    # eager push(slot_of_key) path on a fresh table (no prepare slots)
+    t2 = EmbeddingTable(mf_dim=2, capacity=32, unique_bucket_min=8)
+    b = mkbatch([7, 8], k_pad=8)
+    with t2.host_lock:
+        rows, inv = t2.index.assign_unique(b.keys[:2])
+        t2._touched[rows] = True
+    idx2 = t2._build_index(b, rows, inv)
+    t2.push(idx2, jnp.zeros((8, 5)),
+            slot_of_key=jnp.asarray(np.array([0, 1] + [0] * 6, np.float32)))
+    assert t2.slot_host[t2.index.lookup(np.array([8], np.uint64))[0]] == 1
+
+    # extended pair records slots for BOTH tables
+    from paddlebox_tpu.ps.extended import ExtendedEmbeddingTable
+    te = ExtendedEmbeddingTable(mf_dim=2, extend_mf_dim=2, capacity=32,
+                                unique_bucket_min=8,
+                                skip_extend_slots=(0,))
+    te.prepare(mkbatch([11, 12], k_pad=8))
+    rb = te.base.index.lookup(np.array([12], np.uint64))[0]
+    assert te.base.slot_host[rb] == 1
+    re_ = te.extend.index.lookup(np.array([12], np.uint64))[0]
+    assert re_ >= 0 and te.extend.slot_host[re_] == 1
