@@ -12,7 +12,7 @@ layout documented in SURVEY.md):
 - ``paddlebox_tpu.ops``      — CTR op library: fused_seqpool_cvm family,
   rank_attention, batch_fc, … (reference: paddle/fluid/operators/*).
 - ``paddlebox_tpu.models``   — ctr_dnn / DeepFM / Wide&Deep / DCN-v2 /
-  AdsRank (PV ads ranking with rank attention).
+  AdsRank (PV ads ranking with rank attention) / MMoE (multi-task).
 - ``paddlebox_tpu.train``    — trainer runtime: pass lifecycle, jit train
   step, checkpointing (reference: framework/boxps_trainer.cc, boxps_worker.cc).
 - ``paddlebox_tpu.parallel`` — mesh construction, collectives, shardings

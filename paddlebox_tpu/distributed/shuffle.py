@@ -170,12 +170,16 @@ class TcpShuffler(Shuffler):
             delay = 0.05
             while True:
                 try:
-                    c = socket.create_connection(self.endpoints[dst],
-                                                 timeout=self.timeout)
+                    c = socket.create_connection(
+                        self.endpoints[dst],
+                        timeout=max(0.05, deadline - time.monotonic()))
                     break
-                except OSError:
+                except (ConnectionRefusedError, ConnectionResetError,
+                        TimeoutError, socket.timeout):
                     # peer hasn't bound its shuffler yet (ranks start at
-                    # different speeds) — retry until the data deadline
+                    # different speeds) — retry until the data deadline;
+                    # permanent errors (bad host, EADDRNOTAVAIL) raise
+                    # immediately via the enclosing handler
                     if time.monotonic() >= deadline:
                         raise
                     time.sleep(delay)

@@ -3,6 +3,7 @@ from paddlebox_tpu.models.deepfm import DeepFM
 from paddlebox_tpu.models.wide_deep import WideDeep
 from paddlebox_tpu.models.dcn import DCNv2
 from paddlebox_tpu.models.ads_rank import AdsRank
+from paddlebox_tpu.models.mmoe import MMoE, MMoESingle
 
 MODEL_REGISTRY = {
     "ctr_dnn": CtrDnn,
@@ -10,7 +11,8 @@ MODEL_REGISTRY = {
     "wide_deep": WideDeep,
     "dcn_v2": DCNv2,
     "ads_rank": AdsRank,
+    "mmoe": MMoESingle,
 }
 
 __all__ = ["CtrDnn", "DeepFM", "WideDeep", "DCNv2", "AdsRank",
-           "MODEL_REGISTRY"]
+           "MMoE", "MMoESingle", "MODEL_REGISTRY"]

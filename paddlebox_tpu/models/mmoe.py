@@ -1,0 +1,84 @@
+"""MMoE — multi-gate mixture-of-experts multi-task CTR tower.
+
+Reference context: PaddleBox serves multi-task CTR models (the metric
+registry ships a MultiTaskMetricMsg variant, fleet/metrics.h:198-567, and
+the MoE building blocks live in python/paddle/incubate/distributed/
+models/moe/); the canonical dense architecture pairing them is MMoE
+(multi-gate mixture of experts) — shared expert towers, one softmax gate
+per task, one logit head per task.
+
+TPU-native notes: experts run as ONE batched einsum over the expert dim
+(``bd,edh->ebh`` — a single MXU matmul per layer, no per-expert python
+loop), gates are tiny softmax Dense layers fused into it by XLA. The
+module returns [B, num_tasks] logits; single-task callers (the standard
+trainer) read task 0 via ``MMoESingle``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MMoE(nn.Module):
+    num_experts: int = 4
+    num_tasks: int = 2
+    expert_hidden: Sequence[int] = (256, 128)
+    tower_hidden: Sequence[int] = (64,)
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, pooled: jax.Array, dense: jax.Array) -> jax.Array:
+        """(pooled [B, S, D], dense [B, Dd]) → logits [B, num_tasks]."""
+        b = pooled.shape[0]
+        x = jnp.concatenate([pooled.reshape(b, -1), dense],
+                            axis=1).astype(self.compute_dtype)
+        d_in = x.shape[-1]
+
+        # all experts in one einsum per layer: [B, d] x [E, d, h] → [E, B, h]
+        h = jnp.broadcast_to(x, (self.num_experts,) + x.shape)
+        din = d_in
+        for li, width in enumerate(self.expert_hidden):
+            w = self.param(f"expert_w{li}",
+                           nn.initializers.glorot_uniform(),
+                           (self.num_experts, din, width),
+                           self.compute_dtype)
+            bias = self.param(f"expert_b{li}", nn.initializers.zeros,
+                              (self.num_experts, 1, width),
+                              self.compute_dtype)
+            h = nn.relu(jnp.einsum("ebd,edh->ebh", h, w) + bias)
+            din = width
+        experts = h  # [E, B, H]
+
+        logits = []
+        for t in range(self.num_tasks):
+            gate = nn.softmax(
+                nn.Dense(self.num_experts, dtype=self.compute_dtype,
+                         name=f"gate{t}")(x), axis=-1)       # [B, E]
+            mixed = jnp.einsum("be,ebh->bh", gate, experts)  # [B, H]
+            y = mixed
+            for wi, width in enumerate(self.tower_hidden):
+                y = nn.relu(nn.Dense(width, dtype=self.compute_dtype,
+                                     name=f"tower{t}_{wi}")(y))
+            logits.append(nn.Dense(1, dtype=jnp.float32,
+                                   name=f"head{t}")(y.astype(jnp.float32)))
+        return jnp.concatenate(logits, axis=-1)  # [B, T]
+
+
+class MMoESingle(nn.Module):
+    """Task-0 view of MMoE — plugs into the standard single-label
+    TrainStep (apply(params, pooled, dense) → [B])."""
+
+    num_experts: int = 4
+    num_tasks: int = 2
+    expert_hidden: Sequence[int] = (256, 128)
+    tower_hidden: Sequence[int] = (64,)
+
+    @nn.compact
+    def __call__(self, pooled: jax.Array, dense: jax.Array) -> jax.Array:
+        out = MMoE(self.num_experts, self.num_tasks, self.expert_hidden,
+                   self.tower_hidden, name="mmoe")(pooled, dense)
+        return out[:, 0]

@@ -23,7 +23,7 @@ import numpy as np
 from paddlebox_tpu.data.batch import SlotBatch
 from paddlebox_tpu.ps.sgd import SparseSGDConfig
 from paddlebox_tpu.ps.table import (EmbeddingTable, PullIndex,
-                                    fill_oob_pads)
+                                    fill_oob_pads, next_bucket)
 
 
 class ExtendedEmbeddingTable:
@@ -77,9 +77,7 @@ class ExtendedEmbeddingTable:
                 self.extend.record_slots(rows_e, inv_e.astype(np.int32),
                                          slot_k[keep])
             u = len(uniq_e)
-            cap = self.extend.unique_bucket_min
-            while cap < u + 1:
-                cap *= 2
+            cap = next_bucket(self.extend.unique_bucket_min, u + 1)
             unique_rows = np.empty(cap, np.int32)
             unique_rows[:u] = rows_e
             fill_oob_pads(unique_rows, u, self.extend.capacity)

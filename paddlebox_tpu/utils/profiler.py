@@ -16,13 +16,95 @@ per-HLO device timing — the chrome-trace equivalent."""
 from __future__ import annotations
 
 import contextlib
-from typing import Dict, Iterator, Optional
+import json
+import threading
+import time
+from typing import Dict, Iterator, List, Optional
 
 from paddlebox_tpu.config import FLAGS
 from paddlebox_tpu.utils.logging import get_logger
 from paddlebox_tpu.utils.timer import Timer
 
 log = get_logger(__name__)
+
+
+class ChromeTraceWriter:
+    """Host-side chrome://tracing ("Perfetto") event log — the
+    ``chrometracing_logger.cc`` role for OUR runtime stages (pass build,
+    upload, train, shuffle, checkpoint...). Device-side HLO timing comes
+    from ``trace()`` (XPlane) — this covers the host orchestration the
+    XPlane view doesn't label.
+
+    Thread-safe; events carry the recording thread id so overlapped
+    preload/train lanes render as separate tracks. The buffer is CAPPED
+    (``max_events``, default 1M ≈ 200MB of JSON): stages fire per batch,
+    and an uncapped log would grow without bound over a long job —
+    events past the cap are counted and reported, not stored."""
+
+    def __init__(self, max_events: int = 1_000_000) -> None:
+        self._events: List[dict] = []
+        self._max = max_events
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+
+    def _append(self, ev: dict) -> None:
+        with self._lock:
+            if len(self._events) >= self._max:
+                self.dropped += 1
+                return
+            self._events.append(ev)
+
+    @contextlib.contextmanager
+    def event(self, name: str, **args) -> Iterator[None]:
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            end = time.perf_counter()
+            self._append({
+                "name": name, "ph": "X", "pid": 0,
+                "tid": threading.get_ident() & 0xFFFF,
+                "ts": (start - self._t0) * 1e6,
+                "dur": (end - start) * 1e6,
+                **({"args": args} if args else {}),
+            })
+
+    def instant(self, name: str, **args) -> None:
+        self._append({
+            "name": name, "ph": "i", "pid": 0, "s": "g",
+            "tid": threading.get_ident() & 0xFFFF,
+            "ts": (time.perf_counter() - self._t0) * 1e6,
+            **({"args": args} if args else {}),
+        })
+
+    def save(self, path: str) -> int:
+        """Write chrome://tracing JSON; returns the event count."""
+        with self._lock:
+            evs = list(self._events)
+            dropped = self.dropped
+        with open(path, "w") as fh:
+            json.dump({"traceEvents": evs,
+                       "displayTimeUnit": "ms"}, fh)
+        if dropped:
+            log.warning("chrome trace: %d events past max_events dropped",
+                        dropped)
+        log.info("chrome trace: %d events -> %s", len(evs), path)
+        return len(evs)
+
+
+_CHROME: Optional[ChromeTraceWriter] = None
+
+
+def set_chrome_trace(writer: Optional[ChromeTraceWriter]) -> None:
+    """Install a process-wide writer; StageTimers.stage() then records
+    every stage as a trace event too."""
+    global _CHROME
+    _CHROME = writer
+
+
+def chrome_trace() -> Optional[ChromeTraceWriter]:
+    return _CHROME
 
 
 class StageTimers:
@@ -41,8 +123,13 @@ class StageTimers:
     def stage(self, name: str) -> Iterator[Timer]:
         t = self[name]
         t.resume()
+        w = _CHROME  # snapshot: set_chrome_trace may race from other threads
         try:
-            yield t
+            if w is not None:
+                with w.event(name):
+                    yield t
+            else:
+                yield t
         finally:
             t.pause()
 

@@ -114,7 +114,6 @@ def test_two_process_shuffle_and_train(tmp_path):
             for r, (p, o) in enumerate(zip(procs, outs))))
 
     res = [json.load(open(out_dir / f"r{r}.json")) for r in range(world)]
-    total = world * 0  # accumulate below
     # every record loaded somewhere, every record landed somewhere
     assert sum(r["loaded"] for r in res) == 1200
     assert sum(r["after_shuffle"] for r in res) == 1200
