@@ -66,7 +66,6 @@ class Flags:
 
     # --- pallas kernels (ops/pallas_kernels.py; interpret-mode off-TPU) ---
     use_pallas_gather: bool = False
-    use_pallas_scatter: bool = False
     use_pallas_seqpool: bool = False
 
     # --- metrics (reference: metrics.h:46 table_size 1e6+1) ---

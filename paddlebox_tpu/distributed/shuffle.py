@@ -174,12 +174,12 @@ class TcpShuffler(Shuffler):
                         self.endpoints[dst],
                         timeout=max(0.05, deadline - time.monotonic()))
                     break
-                except (ConnectionRefusedError, ConnectionResetError,
-                        TimeoutError, socket.timeout):
-                    # peer hasn't bound its shuffler yet (ranks start at
-                    # different speeds) — retry until the data deadline;
-                    # permanent errors (bad host, EADDRNOTAVAIL) raise
-                    # immediately via the enclosing handler
+                except socket.gaierror:
+                    raise  # bad hostname — permanent, fail fast
+                except OSError:
+                    # peer hasn't bound its shuffler / its host or route
+                    # is still coming up (ECONNREFUSED, ENETUNREACH,
+                    # EHOSTUNREACH, timeouts) — retry until the deadline
                     if time.monotonic() >= deadline:
                         raise
                     time.sleep(delay)

@@ -39,17 +39,18 @@ class MMoE(nn.Module):
         d_in = x.shape[-1]
 
         # all experts in one einsum per layer: [B, d] x [E, d, h] → [E, B, h]
+        # (params stay fp32 like nn.Dense's param_dtype; cast at use)
         h = jnp.broadcast_to(x, (self.num_experts,) + x.shape)
         din = d_in
         for li, width in enumerate(self.expert_hidden):
             w = self.param(f"expert_w{li}",
                            nn.initializers.glorot_uniform(),
-                           (self.num_experts, din, width),
-                           self.compute_dtype)
+                           (self.num_experts, din, width), jnp.float32)
             bias = self.param(f"expert_b{li}", nn.initializers.zeros,
-                              (self.num_experts, 1, width),
-                              self.compute_dtype)
-            h = nn.relu(jnp.einsum("ebd,edh->ebh", h, w) + bias)
+                              (self.num_experts, 1, width), jnp.float32)
+            h = nn.relu(jnp.einsum(
+                "ebd,edh->ebh", h, w.astype(self.compute_dtype))
+                + bias.astype(self.compute_dtype))
             din = width
         experts = h  # [E, B, H]
 

@@ -100,7 +100,10 @@ def gather_rows(table: jax.Array, rows: jax.Array) -> jax.Array:
 
 def scatter_rows(table: jax.Array, rows: jax.Array,
                  values: jax.Array) -> jax.Array:
-    """Write values[i] into table[rows[i]] in place (buffer aliased).
+    """REFERENCE-ONLY (interpret mode; no production consumer since the
+    packed-line layout made apply_push a masked line scatter-ADD — see
+    TableState/DESIGN_NOTES §2): write values[i] into table[rows[i]] in
+    place (buffer aliased).
 
     In-bounds rows must be duplicate-free (the unique-scatter contract);
     out-of-bounds pad rows clamp to the sentinel row C-1, whose racy

@@ -132,7 +132,7 @@ class PassScopedTable(EmbeddingTable):
         data = np.zeros((c1, NUM_FIXED + self.mf_dim), np.float32)
         for f in FIELDS:
             field_assign(data, rows, f, st.values[f])
-        self.state = TableState(jax.device_put(data))
+        self.state = TableState.from_logical(data, self.capacity)
         self._touched[:] = False
         self.in_pass = True
         log.info("begin_pass: %d working-set rows in HBM", len(st.keys))

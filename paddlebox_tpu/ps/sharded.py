@@ -95,12 +95,11 @@ class ShardedEmbeddingTable:
         self.indexes = [HostKV(self.capacity) for _ in range(num_shards)]
         self.req_bucket_min = req_bucket_min
         self.serve_bucket_min = serve_bucket_min
-        # stacked state [N, C+1, ...] — sharded over the mesh axis
+        # stacked state [N, L, 128] — sharded over the mesh axis
         single = init_table_state(self.capacity, mf_dim)
-        self.state = TableState(*[
-            jnp.broadcast_to(leaf[None], (num_shards,) + leaf.shape).copy()
-            for leaf in single
-        ])
+        self.state = single.with_packed(
+            jnp.broadcast_to(single.packed[None],
+                             (num_shards,) + single.packed.shape).copy())
         self._touched = np.zeros((num_shards, self.capacity + 1), dtype=bool)
         # serializes host index/touched mutation across threads (resident
         # pass preloading vs save/shrink — same discipline as
@@ -292,5 +291,5 @@ class ShardedEmbeddingTable:
             for f in FIELDS:
                 field_assign(data[s], rows, f, blob[f"{f}_{s}"])
             total += len(keys)
-        self.state = TableState(jnp.asarray(data))
+        self.state = TableState.from_logical(data, self.capacity)
         return total

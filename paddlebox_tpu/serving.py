@@ -63,11 +63,10 @@ class ServingModel:
         s = len(self.desc.sparse_slots)
 
         @jax.jit
-        def _fwd(table_data, params, dev: DeviceBatch):
-            from paddlebox_tpu.ps.table import TableState
+        def _fwd(table_state, params, dev: DeviceBatch):
             from paddlebox_tpu.train.step import ctr_forward
             return ctr_forward(
-                TableState(table_data), params, self.model, dev, b, s,
+                table_state, params, self.model, dev, b, s,
                 self.use_cvm, self.cvm_offset, self.need_filter,
                 self.quant_ratio)
 
@@ -130,7 +129,7 @@ class ServingModel:
             raise RuntimeError("load_dense first")
         idx = self.table.prepare_eval(batch)
         dev = make_device_batch(batch, idx)
-        pred, ins_w = self._fwd(self.table.state.data, self.params, dev)
+        pred, ins_w = self._fwd(self.table.state, self.params, dev)
         if return_valid:
             return np.asarray(pred), np.asarray(ins_w)
         return np.asarray(pred)

@@ -131,7 +131,8 @@ class ShardedTrainStep:
         shard0 = P(DATA_AXIS)
         rep = P()
         state_spec = ShardedStepState(
-            table=TableState(shard0),  # one AoS leaf [N, C+1, F]
+            # spec-prefix: covers TableState's single packed leaf [N,L,128]
+            table=shard0,
             params=rep, opt_state=(shard0 if zero1 else rep),
             auc=AucState(*([shard0] * len(AucState._fields))),
             step=rep)
@@ -173,7 +174,7 @@ class ShardedTrainStep:
         n, b, s = self.n, self.batch_size, self.num_slots
         me = jax.lax.axis_index(DATA_AXIS)
         # blocks arrive with leading dim 1; drop it
-        table = TableState(*[l[0] for l in state.table])
+        table = state.table.with_packed(state.table.packed[0])
         auc = AucState(*[l[0] for l in state.auc])
         resp_idx = batch.resp_idx[0]       # [N, A]
         serve_rows = batch.serve_rows[0]   # [A2]
@@ -262,7 +263,7 @@ class ShardedTrainStep:
         loss = jax.lax.psum(loss_local, DATA_AXIS)
 
         new_state = ShardedStepState(
-            table=TableState(*[l[None] for l in table]),
+            table=table.with_packed(table.packed[None]),
             params=params, opt_state=opt_state,
             auc=AucState(*[l[None] for l in auc]),
             step=state.step + 1)
@@ -276,7 +277,7 @@ class ShardedTrainStep:
     def _device_eval(self, table_st: TableState, params, auc_st: AucState,
                      batch: GlobalBatch) -> AucState:
         n, b, s = self.n, self.batch_size, self.num_slots
-        table = TableState(*[l[0] for l in table_st])
+        table = table_st.with_packed(table_st.packed[0])
         auc = AucState(*[l[0] for l in auc_st])
         resp_idx = batch.resp_idx[0]
         serve_rows = batch.serve_rows[0]
@@ -312,7 +313,7 @@ class ShardedTrainStep:
                 *([shard0] * len(GlobalBatch._fields)))
             self._eval_jit = jax.jit(jax.shard_map(
                 self._device_eval, mesh=self.mesh,
-                in_specs=(TableState(shard0), rep, auc_spec, batch_spec),
+                in_specs=(shard0, rep, auc_spec, batch_spec),
                 out_specs=auc_spec, check_vma=False),
                 donate_argnums=(2,))
         return self._eval_jit(table_st, params, auc_st, batch)

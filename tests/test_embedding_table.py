@@ -159,6 +159,28 @@ def test_shrink_frees_low_score_rows():
     assert (st > 0).sum() == 1
 
 
+def test_packed_gather_oob_pads_read_zero():
+    """Regression: with capacity % rows_per_line == rpl-1 the first OOB
+    pad id lands past the last storage line; a naive line-index clamp
+    then aliases a REAL row. Pads must read the sentinel's zeros."""
+    import jax.numpy as jnp
+    from paddlebox_tpu.ps.table import (TableState, gather_full_rows,
+                                        pack_geometry)
+    cap = 999           # rpl=8 for F=16 → (cap+1) % 8 == 0, the bad case
+    mf = 8
+    rpl, fp, nl = pack_geometry(cap, 16)
+    assert (cap + 1) % rpl == 0
+    logical = np.zeros((cap + 1, 16), np.float32)
+    logical[:cap, 4] = 7.0  # every real row has embed_w = 7
+    st = TableState.from_logical(logical, cap)
+    # sentinel (cap), first OOB pad (cap+1), far OOB pads
+    rows = jnp.asarray(np.array([0, cap, cap + 1, cap + 8, cap + 4096],
+                                np.int32))
+    got = np.asarray(gather_full_rows(st, rows))
+    assert got[0, 4] == 7.0            # real row reads its value
+    np.testing.assert_array_equal(got[1:], 0.0)  # sentinel + pads → zeros
+
+
 def test_slot_host_recorded_on_all_paths(tmp_path):
     """Saved slot metadata must be populated by every prepare/push path:
     EmbeddingTable.prepare, push(slot_of_key=...), and the

@@ -154,7 +154,7 @@ def test_table_pull_push_with_pallas_flags():
             return np.asarray(vals), np.asarray(t.pull(idx))
 
     v0, p0 = run()
-    v1, p1 = run(use_pallas_gather=True, use_pallas_scatter=True)
+    v1, p1 = run(use_pallas_gather=True)
     np.testing.assert_allclose(v0, v1, rtol=1e-6)
     np.testing.assert_allclose(p0, p1, rtol=1e-6)
 

@@ -66,7 +66,9 @@ def test_pass_scoped_table_promote_and_writeback():
     # simulate a jit update: bump show on the working set rows
     rows = t.index.lookup(keys)
     st = t.state
-    t.state = type(st)(st.data.at[rows, 0].set(5.0))  # col 0 = show
+    d = np.asarray(st.data).copy()
+    d[rows, 0] = 5.0  # col 0 = show
+    t.state = type(st).from_logical(d, st.capacity)
     t.end_pass()
     assert not t.in_pass
     np.testing.assert_allclose(hs.fetch(keys)["show"], 5.0)

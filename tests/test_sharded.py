@@ -84,15 +84,15 @@ def test_sharded_pull_matches_single_table(mesh):
     for s in range(N):
         keys, rows = table.indexes[s].items()
         data[s][rows, FIELD_COL["embed_w"]] = keys.astype(np.float32)
-    table.state = type(table.state)(jnp.asarray(data))
+    table.state = type(table.state).from_logical(data, table.capacity)
 
     gb = make_global_batch(batches, idx)
     from jax.sharding import PartitionSpec as P
     from paddlebox_tpu.parallel.mesh import DATA_AXIS
     from paddlebox_tpu.ps.table import pull_rows, TableState
 
-    def pull_blk(table_leaves, resp_idx, serve_rows, gather_idx):
-        t = TableState(*[l[0] for l in table_leaves])
+    def pull_blk(table_st, resp_idx, serve_rows, gather_idx):
+        t = table_st.with_packed(table_st.packed[0])
         vals = pull_rows(t, serve_rows[0])
         resp = vals[resp_idx[0]]
         recv = jax.lax.all_to_all(resp, DATA_AXIS, 0, 0, tiled=True)
@@ -101,7 +101,7 @@ def test_sharded_pull_matches_single_table(mesh):
 
     f = jax.jit(jax.shard_map(
         pull_blk, mesh=mesh,
-        in_specs=(TableState(P(DATA_AXIS)), P(DATA_AXIS),
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS),
                   P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=P(DATA_AXIS), check_vma=False))
     got = np.asarray(f(table.state, gb.resp_idx, gb.serve_rows,
@@ -152,7 +152,7 @@ def test_sharded_save_load_roundtrip(mesh, tmp_path):
     for s in range(N):
         keys, rows = table.indexes[s].items()
         data[s][rows, FIELD_COL["embed_w"]] = keys.astype(np.float32) * 2
-    table.state = type(table.state)(jnp.asarray(data))
+    table.state = type(table.state).from_logical(data, table.capacity)
     path = str(tmp_path / "sharded.npz")
     n_saved = table.save_base(path)
     assert n_saved == table.feature_count() > 0
@@ -187,7 +187,7 @@ def test_sharded_save_delta_and_reset_load(mesh, tmp_path):
     from paddlebox_tpu.ps.table import FIELD_COL
     data = np.asarray(jax.device_get(table.state.data)).copy()
     data[0][:, FIELD_COL["embed_w"]] = 99.0
-    table.state = type(table.state)(jnp.asarray(data))
+    table.state = type(table.state).from_logical(data, table.capacity)
     got = table.load(base)  # merge=False resets everything first
     assert got == n1
     w0 = np.asarray(table.state.embed_w)[0]
