@@ -56,7 +56,8 @@ class ResidentPass:
       gidx:   int32 [nb, K]      per-key position in uniq; key padding →
               the first pad position (num_unique)
       floats: f32   [nb, B, D+3] [dense | label | show | clk]
-      meta:   int32 [nb, 3]      [num_keys, pad_segment, num_unique]
+      meta:   int32 [nb, 4]      [num_keys, pad_segment, num_unique,
+              first_unique_row (the delta-wire base)]
       segs:   int32 [nb, K] | None   None when every batch has the trivial
               one-key-per-slot layout (segments derived on device)
     """
@@ -191,18 +192,24 @@ class ResidentPass:
         k_max = max(kc for _, _, kc, _, _ in per_batch)
         uniq = np.empty((nb, u_pad), np.int32)
         gidx = np.empty((nb, k_max), np.int32)
-        meta = np.empty((nb, 3), np.int32)
+        meta = np.empty((nb, 4), np.int32)
         segs = None if trivial else np.empty((nb, k_max), np.int32)
         for i, ((keys, slot_of_key, _, pad_seg, seg_arr),
                 (rows_u, inv)) in enumerate(zip(per_batch, dedup)):
             nk, u = len(keys), len(rows_u)
-            uniq[i, :u] = rows_u
-            fill_oob_pads(uniq[i], u, cap)
-            gidx[i, :nk] = inv
-            gidx[i, nk:] = u  # key pads → first OOB pad position
             with table.host_lock:  # slot = host metadata (slot_host)
                 table.record_slots(rows_u, inv, slot_of_key)
-            meta[i] = (nk, pad_seg, u)
+            # SORT the unique rows ascending and remap the inverse: the
+            # wire then ships u16 DELTAS (ops/bitpack-style byte cut) and
+            # the table scatter gets nondecreasing line indices
+            order = np.argsort(rows_u, kind="stable")
+            rank = np.empty(u, np.int32)
+            rank[order] = np.arange(u, dtype=np.int32)
+            uniq[i, :u] = rows_u[order]
+            fill_oob_pads(uniq[i], u, cap)
+            gidx[i, :nk] = rank[inv]
+            gidx[i, nk:] = u  # key pads → first OOB pad position
+            meta[i] = (nk, pad_seg, u, uniq[i, 0])
             if segs is not None:
                 segs[i, :nk] = seg_arr
                 segs[i, nk:] = pad_seg
@@ -222,10 +229,7 @@ class ResidentPass:
         materializes from its thread so the transfer rides alongside the
         previous pass's compute."""
         if self.dev is None:
-            if int(self.uniq.max()) < (1 << 24):
-                uniq = tuple(jnp.asarray(a) for a in pack_u24(self.uniq))
-            else:
-                uniq = (jnp.asarray(self.uniq),)
+            uniq = self._uniq_wire()
             if (int(self.gidx.max(initial=0)) < (1 << 18)
                     and self.gidx.shape[1] % 4 == 0):
                 gidx = tuple(jnp.asarray(a) for a in pack_u18(self.gidx))
@@ -238,6 +242,44 @@ class ResidentPass:
         if materialize:
             for a in jax.tree.leaves(self.dev):
                 jax.device_get(a.ravel()[0])
+
+    _EXC = 32  # per-batch budget of >=2^16 delta gaps in the u16 wire
+
+    def _uniq_wire(self):
+        """Wire encoding for the (ascending) per-batch unique rows, in
+        preference order: u16 DELTAS + sparse gap exceptions (2 B/value;
+        the common case — mean row gap is capacity/u), else 16+8-bit
+        halves (3 B), else raw int32. The device reconstructs with one
+        cumsum (_make_view)."""
+        nb, u_pad = self.uniq.shape
+        nu = self.meta[:, 2]
+        d = np.zeros((nb, u_pad), np.int64)
+        d[:, 1:] = self.uniq[:, 1:].astype(np.int64) - \
+            self.uniq[:, :-1].astype(np.int64)
+        pos = np.arange(u_pad)
+        real = pos[None, :] < nu[:, None]   # delta j belongs to real run
+        d[~real] = 0
+        if (d < 0).any():
+            # the delta wire REQUIRES ascending uniq (a negative delta
+            # would wrap mod 2^16 and decode to a wrong in-bounds row);
+            # _pack sorts, but a hand-built pass may not — fall through
+            # to the order-agnostic encodings
+            if int(self.uniq.max()) < (1 << 24):
+                return tuple(jnp.asarray(a) for a in pack_u24(self.uniq))
+            return (jnp.asarray(self.uniq),)
+        big = (d >= (1 << 16))
+        if int(big.sum(axis=1).max()) <= self._EXC:
+            d16 = d.astype(np.uint16)       # wraps the big ones; corrected
+            epos = np.full((nb, self._EXC), u_pad, np.int32)
+            eext = np.zeros((nb, self._EXC), np.int32)
+            for i in range(nb):
+                bj = np.nonzero(big[i])[0]
+                epos[i, :len(bj)] = bj
+                eext[i, :len(bj)] = (d[i, bj] - d16[i, bj]).astype(np.int64)
+            return (jnp.asarray(d16), jnp.asarray(epos), jnp.asarray(eext))
+        if int(self.uniq.max()) < (1 << 24):
+            return tuple(jnp.asarray(a) for a in pack_u24(self.uniq))
+        return (jnp.asarray(self.uniq),)
 
     def nbytes(self) -> int:
         """Wire bytes (after upload packing; host estimate before)."""
@@ -294,7 +336,22 @@ class ResidentPassRunner:
 
     def _make_view(self, uniq_t, gidx_t, floats, meta,
                    segs) -> _BatchView:
-        uniq = (unpack_u24(*uniq_t) if len(uniq_t) == 2 else uniq_t[0])
+        if len(uniq_t) == 3:
+            # u16-delta wire: cumsum(base-relative deltas) + sparse gap
+            # corrections; pad region derived (fill_oob_pads pattern)
+            d16, epos, eext = uniq_t
+            u_pad = d16.shape[0]
+            upos = jnp.arange(u_pad, dtype=jnp.int32)
+            ucum = meta[3] + jnp.cumsum(d16.astype(jnp.int32))
+            corr = jnp.sum(
+                jnp.where(upos[:, None] >= epos[None, :],
+                          eext[None, :], 0), axis=1)
+            uniq = jnp.where(upos < meta[2], ucum + corr,
+                             self.capacity + 1 + upos)
+        elif len(uniq_t) == 2:
+            uniq = unpack_u24(*uniq_t)
+        else:
+            uniq = uniq_t[0]
         gidx = (unpack_u18(*gidx_t) if len(gidx_t) == 2 else gidx_t[0])
         k = gidx.shape[0]
         num_keys, pad_seg = meta[0], meta[1]

@@ -184,6 +184,79 @@ def test_build_columnar_matches_record_path(trivial):
         assert (rp_col.gidx[i] <= u).all()
 
 
+def _decode_uniq(rp, runner):
+    """Decode every batch's uniq through the runner's traced view."""
+    rp.upload()
+    uniq_t, gidx_t = rp.dev[0], rp.dev[1]
+    out = []
+    for i in range(rp.num_batches):
+        view = runner._make_view(
+            tuple(jnp.asarray(a[i]) for a in uniq_t),
+            tuple(jnp.asarray(a[i]) for a in gidx_t),
+            jnp.asarray(rp.floats[i]), jnp.asarray(rp.meta[i]),
+            jnp.zeros((1,), jnp.int32) if rp.segs is None
+            else jnp.asarray(rp.segs[i]))
+        out.append((np.asarray(view.unique_rows),
+                    np.asarray(view.gather_idx)))
+    return out
+
+
+def test_uniq_wire_roundtrip_dense():
+    """u16-delta wire: dense row sets (the common case) reconstruct the
+    exact pull index through the runner's traced decode."""
+    from paddlebox_tpu.data import InMemoryDataset, SlotDef
+    from paddlebox_tpu.train.device_pass import ResidentPassRunner
+    recs = _rand_records(300, num_slots=4, seed=7, trivial=True)
+    slots = [SlotDef("label", "float", 1), SlotDef("d", "float", 3)]
+    slots += [SlotDef(f"S{i}", "uint64") for i in range(4)]
+    desc = DataFeedDesc(slots=slots, label_slot="label", batch_size=64,
+                        key_bucket_min=512)
+    ds = InMemoryDataset(desc)
+    ds.records = recs
+    ds.columnarize()
+    table = EmbeddingTable(mf_dim=4, capacity=1 << 13,
+                           unique_bucket_min=64)
+    rp = ResidentPass.build(ds, table)
+    runner = ResidentPassRunner(None, table.capacity, rp.segs is None)
+    decoded = _decode_uniq(rp, runner)
+    assert len(rp.dev[0]) == 3  # the delta encoding was chosen
+    for i, (du, dg) in enumerate(decoded):
+        u = rp.meta[i, 2]
+        np.testing.assert_array_equal(du[:u], rp.uniq[i, :u])
+        assert (du[u:] > table.capacity).all()
+        np.testing.assert_array_equal(dg, rp.gidx[i])
+
+
+@pytest.mark.parametrize("n_rows,expect_delta", [(20, True), (100, False)])
+def test_uniq_wire_roundtrip_sparse_gaps(n_rows, expect_delta):
+    """Huge row gaps (sparse occupancy of a big table): few gaps ride the
+    u16 wire's exception correction; many gaps fall back to u24 halves.
+    Built directly (the hash index assigns rows densely in practice)."""
+    from paddlebox_tpu.train.device_pass import (ResidentPass,
+                                                 ResidentPassRunner)
+    from paddlebox_tpu.ps.table import fill_oob_pads
+    cap = 1 << 23
+    rng = np.random.default_rng(3)
+    rows = np.sort(rng.choice(cap - 1, size=n_rows, replace=False)
+                   .astype(np.int32))
+    u_pad = 64 if n_rows <= 64 else 512
+    uniq = np.empty((1, u_pad), np.int32)
+    uniq[0, :n_rows] = rows
+    fill_oob_pads(uniq[0], n_rows, cap)
+    k = 128
+    gidx = rng.integers(0, n_rows, size=(1, k)).astype(np.int32)
+    floats = np.zeros((1, 4, 7), np.float32)
+    meta = np.array([[k, 8, n_rows, int(rows[0])]], np.int32)
+    rp = ResidentPass(uniq, gidx, floats, meta, None, 4)
+    runner = ResidentPassRunner(None, cap, True)
+    decoded = _decode_uniq(rp, runner)
+    assert (len(rp.dev[0]) == 3) == expect_delta
+    du, dg = decoded[0]
+    np.testing.assert_array_equal(du[:n_rows], rows)
+    assert (du[n_rows:] > cap).all()
+    np.testing.assert_array_equal(dg, gidx[0])
+
+
 def test_pass_preloader(criteo_files):
     tr, ds = _make(criteo_files)
     datasets = iter([ds, ds, ds])
