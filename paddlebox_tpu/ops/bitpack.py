@@ -38,6 +38,53 @@ def unpack_u24(lo: jax.Array, hi: jax.Array) -> jax.Array:
             | (hi.astype(jnp.int32) << 16))
 
 
+def pack_delta16(values: np.ndarray, num_real: np.ndarray,
+                 max_exceptions: int):
+    """Ascending per-row sequences → 16-bit delta wire.
+
+    ``values`` int [nb, U]; rows must be ASCENDING over their real prefix
+    ``num_real[i]`` (checked — returns None on violation, as a negative
+    delta would wrap mod 2^16 and silently decode to a wrong value).
+    Returns (d16 uint16 [nb, U], epos int32 [nb, E], eext int32 [nb, E])
+    — deltas relative to values[:, 0] (the base travels separately), with
+    up to E per-row gap exceptions (delta ≥ 2^16) as position+remainder
+    pairs (unused slots: epos = U, eext = 0) — or None when a row needs
+    more than E exceptions (caller falls back to an absolute encoding).
+
+    Decode contract (:func:`unpack_delta16`): value[j] = base +
+    cumsum(d16)[j] + Σ_e [j ≥ epos_e] · eext_e for j < num_real."""
+    nb, u_pad = values.shape
+    d = np.zeros((nb, u_pad), np.int64)
+    d[:, 1:] = values[:, 1:].astype(np.int64) - values[:, :-1].astype(np.int64)
+    real = np.arange(u_pad)[None, :] < num_real[:, None]
+    d[~real] = 0
+    if (d < 0).any():
+        return None
+    big = d >= (1 << 16)
+    if int(big.sum(axis=1).max(initial=0)) > max_exceptions:
+        return None
+    d16 = d.astype(np.uint16)
+    epos = np.full((nb, max_exceptions), u_pad, np.int32)
+    eext = np.zeros((nb, max_exceptions), np.int32)
+    for i in range(nb):
+        bj = np.nonzero(big[i])[0]
+        epos[i, :len(bj)] = bj
+        eext[i, :len(bj)] = (d[i, bj] - d16[i, bj]).astype(np.int64)
+    return d16, epos, eext
+
+
+def unpack_delta16(d16: jax.Array, epos: jax.Array, eext: jax.Array,
+                   base: jax.Array) -> jax.Array:
+    """One row of the pack_delta16 wire → int32 [U] absolute values
+    (traced; valid over the real prefix — callers mask the tail)."""
+    u_pad = d16.shape[-1]
+    upos = jnp.arange(u_pad, dtype=jnp.int32)
+    cum = base + jnp.cumsum(d16.astype(jnp.int32))
+    corr = jnp.sum(jnp.where(upos[:, None] >= epos[None, :],
+                             eext[None, :], 0), axis=1)
+    return cum + corr
+
+
 def pack_u18(values: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     """int array [..., K] (values in [0, 2^18), K % 4 == 0) →
     (lo uint16 [..., K], hi2 uint8 [..., K/4] — four 2-bit highs/byte)."""

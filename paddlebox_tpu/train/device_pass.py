@@ -30,7 +30,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddlebox_tpu.data.dataset import Dataset
-from paddlebox_tpu.ops.bitpack import (pack_u18, pack_u24, unpack_u18,
+from paddlebox_tpu.ops.bitpack import (pack_delta16, pack_u18, pack_u24,
+                                       unpack_delta16, unpack_u18,
                                        unpack_u24)
 from paddlebox_tpu.train.step import pack_floats, unpack_floats
 from paddlebox_tpu.utils.logging import get_logger
@@ -247,36 +248,19 @@ class ResidentPass:
 
     def _uniq_wire(self):
         """Wire encoding for the (ascending) per-batch unique rows, in
-        preference order: u16 DELTAS + sparse gap exceptions (2 B/value;
-        the common case — mean row gap is capacity/u), else 16+8-bit
-        halves (3 B), else raw int32. The device reconstructs with one
-        cumsum (_make_view)."""
-        nb, u_pad = self.uniq.shape
-        nu = self.meta[:, 2]
-        d = np.zeros((nb, u_pad), np.int64)
-        d[:, 1:] = self.uniq[:, 1:].astype(np.int64) - \
-            self.uniq[:, :-1].astype(np.int64)
-        pos = np.arange(u_pad)
-        real = pos[None, :] < nu[:, None]   # delta j belongs to real run
-        d[~real] = 0
-        if (d < 0).any():
-            # the delta wire REQUIRES ascending uniq (a negative delta
-            # would wrap mod 2^16 and decode to a wrong in-bounds row);
-            # _pack sorts, but a hand-built pass may not — fall through
-            # to the order-agnostic encodings
-            if int(self.uniq.max()) < (1 << 24):
-                return tuple(jnp.asarray(a) for a in pack_u24(self.uniq))
-            return (jnp.asarray(self.uniq),)
-        big = (d >= (1 << 16))
-        if int(big.sum(axis=1).max()) <= self._EXC:
-            d16 = d.astype(np.uint16)       # wraps the big ones; corrected
-            epos = np.full((nb, self._EXC), u_pad, np.int32)
-            eext = np.zeros((nb, self._EXC), np.int32)
-            for i in range(nb):
-                bj = np.nonzero(big[i])[0]
-                epos[i, :len(bj)] = bj
-                eext[i, :len(bj)] = (d[i, bj] - d16[i, bj]).astype(np.int64)
-            return (jnp.asarray(d16), jnp.asarray(epos), jnp.asarray(eext))
+        preference order: u16 DELTAS + sparse gap exceptions
+        (ops/bitpack.pack_delta16; 2 B/value — the common case, mean row
+        gap is capacity/u), else 16+8-bit halves (3 B), else raw int32.
+        The device reconstructs with one cumsum (_make_view). Hand-built
+        passes that violate the delta wire's preconditions (unsorted
+        rows, old 3-column meta without the base) fall through to the
+        order-agnostic encodings."""
+        delta = None
+        if self.meta.shape[1] >= 4 and bool(
+                (self.meta[:, 3] == self.uniq[:, 0]).all()):
+            delta = pack_delta16(self.uniq, self.meta[:, 2], self._EXC)
+        if delta is not None:
+            return tuple(jnp.asarray(a) for a in delta)
         if int(self.uniq.max()) < (1 << 24):
             return tuple(jnp.asarray(a) for a in pack_u24(self.uniq))
         return (jnp.asarray(self.uniq),)
@@ -337,16 +321,12 @@ class ResidentPassRunner:
     def _make_view(self, uniq_t, gidx_t, floats, meta,
                    segs) -> _BatchView:
         if len(uniq_t) == 3:
-            # u16-delta wire: cumsum(base-relative deltas) + sparse gap
-            # corrections; pad region derived (fill_oob_pads pattern)
-            d16, epos, eext = uniq_t
-            u_pad = d16.shape[0]
+            # u16-delta wire (ops/bitpack.unpack_delta16); the pad
+            # region is derived (fill_oob_pads pattern: distinct, > cap)
+            u_pad = uniq_t[0].shape[0]
             upos = jnp.arange(u_pad, dtype=jnp.int32)
-            ucum = meta[3] + jnp.cumsum(d16.astype(jnp.int32))
-            corr = jnp.sum(
-                jnp.where(upos[:, None] >= epos[None, :],
-                          eext[None, :], 0), axis=1)
-            uniq = jnp.where(upos < meta[2], ucum + corr,
+            uniq = jnp.where(upos < meta[2],
+                             unpack_delta16(*uniq_t, base=meta[3]),
                              self.capacity + 1 + upos)
         elif len(uniq_t) == 2:
             uniq = unpack_u24(*uniq_t)
