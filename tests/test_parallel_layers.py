@@ -95,3 +95,35 @@ def test_pipeline_matches_sequential():
     for i in range(4):
         want = np.tanh(want @ ws[i])
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+
+def test_hierarchical_allreduce_matches_flat_psum():
+    """2-level [dcn, ici] allreduce (reduce-scatter → DCN sum →
+    all-gather; boxps_worker.cc:1217-1234 ladder) must equal a flat psum
+    over both axes — exercised on a 2x4 virtual mesh."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from paddlebox_tpu.parallel.mesh import (DCN_AXIS, ICI_AXIS,
+                                             hierarchical_allreduce,
+                                             make_hierarchical_mesh)
+    mesh = make_hierarchical_mesh(n_slices=2)
+    assert mesh.shape == {DCN_AXIS: 2, ICI_AXIS: 4}
+    rng = np.random.default_rng(0)
+    # odd length exercises the pad path (37 % 4 != 0)
+    x = rng.normal(size=(8, 37)).astype(np.float32)
+
+    def block(v):
+        v = v.reshape(37)
+        h = hierarchical_allreduce(v)
+        f = jax.lax.psum(jax.lax.psum(v, ICI_AXIS), DCN_AXIS)
+        return h[None], f[None]
+
+    h, f = jax.jit(jax.shard_map(
+        block, mesh=mesh,
+        in_specs=P((DCN_AXIS, ICI_AXIS)),
+        out_specs=(P((DCN_AXIS, ICI_AXIS)), P((DCN_AXIS, ICI_AXIS))),
+        check_vma=False))(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(h), np.asarray(f), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(h)[0], x.sum(axis=0), rtol=1e-4)
