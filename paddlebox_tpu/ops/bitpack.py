@@ -38,21 +38,31 @@ def unpack_u24(lo: jax.Array, hi: jax.Array) -> jax.Array:
             | (hi.astype(jnp.int32) << 16))
 
 
-def pack_delta16(values: np.ndarray, num_real: np.ndarray,
-                 max_exceptions: int):
-    """Ascending per-row sequences → 16-bit delta wire.
+def pack_delta(values: np.ndarray, num_real: np.ndarray,
+               max_exceptions: int, bits: int = 16):
+    """Ascending per-row sequences → ``bits``-wide (8 or 16) delta wire.
 
     ``values`` int [nb, U]; rows must be ASCENDING over their real prefix
     ``num_real[i]`` (checked — returns None on violation, as a negative
-    delta would wrap mod 2^16 and silently decode to a wrong value).
-    Returns (d16 uint16 [nb, U], epos int32 [nb, E], eext int32 [nb, E])
+    delta would wrap mod 2^bits and silently decode to a wrong value).
+    Returns (d uint{bits} [nb, U], epos int32 [nb, E], eext int32 [nb, E])
     — deltas relative to values[:, 0] (the base travels separately), with
-    up to E per-row gap exceptions (delta ≥ 2^16) as position+remainder
+    up to E per-row gap exceptions (delta ≥ 2^bits) as position+remainder
     pairs (unused slots: epos = U, eext = 0) — or None when a row needs
-    more than E exceptions (caller falls back to an absolute encoding).
+    more than E exceptions (caller falls back to a wider encoding).
 
     Decode contract (:func:`unpack_delta16`): value[j] = base +
-    cumsum(d16)[j] + Σ_e [j ≥ epos_e] · eext_e for j < num_real."""
+    cumsum(d)[j] + Σ_e [j ≥ epos_e] · eext_e for j < num_real."""
+    assert bits in (8, 16)
+    d = _delta_matrix(values, num_real)
+    if d is None:
+        return None
+    return _pack_delta_from(d, max_exceptions, bits)
+
+
+def _delta_matrix(values: np.ndarray, num_real: np.ndarray):
+    """Per-row deltas over the real prefix (int64 [nb, U]), or None if
+    any real-prefix row is not ascending."""
     nb, u_pad = values.shape
     d = np.zeros((nb, u_pad), np.int64)
     d[:, 1:] = values[:, 1:].astype(np.int64) - values[:, :-1].astype(np.int64)
@@ -60,17 +70,39 @@ def pack_delta16(values: np.ndarray, num_real: np.ndarray,
     d[~real] = 0
     if (d < 0).any():
         return None
-    big = d >= (1 << 16)
+    return d
+
+
+def _pack_delta_from(d: np.ndarray, max_exceptions: int, bits: int):
+    nb, u_pad = d.shape
+    big = d >= (1 << bits)
     if int(big.sum(axis=1).max(initial=0)) > max_exceptions:
         return None
-    d16 = d.astype(np.uint16)
+    dn = d.astype(np.uint8 if bits == 8 else np.uint16)
     epos = np.full((nb, max_exceptions), u_pad, np.int32)
     eext = np.zeros((nb, max_exceptions), np.int32)
     for i in range(nb):
         bj = np.nonzero(big[i])[0]
         epos[i, :len(bj)] = bj
-        eext[i, :len(bj)] = (d[i, bj] - d16[i, bj]).astype(np.int64)
-    return d16, epos, eext
+        eext[i, :len(bj)] = (d[i, bj] - dn[i, bj]).astype(np.int64)
+    return dn, epos, eext
+
+
+def pack_delta_auto(values: np.ndarray, num_real: np.ndarray,
+                    max_exc8: int, max_exc16: int):
+    """One delta scan, narrowest width that fits: u8 wire (≤ max_exc8
+    gap exceptions per row), else u16 (≤ max_exc16), else None."""
+    d = _delta_matrix(values, num_real)
+    if d is None:
+        return None
+    return (_pack_delta_from(d, max_exc8, 8)
+            or _pack_delta_from(d, max_exc16, 16))
+
+
+def pack_delta16(values: np.ndarray, num_real: np.ndarray,
+                 max_exceptions: int):
+    """16-bit :func:`pack_delta` (kept for call-site clarity)."""
+    return pack_delta(values, num_real, max_exceptions, bits=16)
 
 
 def unpack_delta16(d16: jax.Array, epos: jax.Array, eext: jax.Array,

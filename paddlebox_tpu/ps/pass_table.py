@@ -132,6 +132,14 @@ class PassScopedTable(EmbeddingTable):
         data = np.zeros((c1, NUM_FIXED + self.mf_dim), np.float32)
         for f in FIELDS:
             field_assign(data, rows, f, st.values[f])
+        # slot is HOST metadata (_gather_host reads slot_host, never the
+        # device column) and the index was just rebuilt (make_kv
+        # reassigns row ids) — reset it wholesale, then seed the staged
+        # slots so a working-set row survives begin_pass → end_pass even
+        # when no prepare()/record_slots touches it during the window
+        # (eval-only passes, staged key supersets)
+        self.slot_host[:] = 0
+        self.slot_host[rows] = st.values["slot"].astype(np.int16)
         self.state = TableState.from_logical(data, self.capacity)
         self._touched[:] = False
         self.in_pass = True

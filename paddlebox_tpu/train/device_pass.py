@@ -23,6 +23,7 @@ hooks, dynamic NaN aborts mid-pass) still runs via Trainer.train_pass.
 from __future__ import annotations
 
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Iterator, Optional, Tuple
 
 import jax
@@ -30,10 +31,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddlebox_tpu.data.dataset import Dataset
-from paddlebox_tpu.ops.bitpack import (pack_delta16, pack_u18, pack_u24,
+from paddlebox_tpu.ops.bitpack import (pack_delta_auto, pack_u18,
+                                       pack_u24,
                                        unpack_delta16, unpack_u18,
                                        unpack_u24)
-from paddlebox_tpu.train.step import pack_floats, unpack_floats
+from paddlebox_tpu.train.step import (dequantize_floats, pack_floats,
+                                      quantize_floats, unpack_floats)
 from paddlebox_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -66,13 +69,15 @@ class ResidentPass:
     def __init__(self, uniq: np.ndarray, gidx: np.ndarray,
                  floats: np.ndarray,
                  meta: np.ndarray, segs: Optional[np.ndarray],
-                 num_records: int) -> None:
+                 num_records: int,
+                 qmeta: Optional[np.ndarray] = None) -> None:
         self.uniq = uniq
         self.gidx = gidx
         self.floats = floats
         self.meta = meta
         self.segs = segs
         self.num_records = num_records
+        self.qmeta = qmeta  # f32 [2, D] when floats is the q8 wire
         self.dev: Optional[Tuple[jax.Array, ...]] = None
 
     @property
@@ -103,13 +108,67 @@ class ResidentPass:
         build and training would clear the flags and lose the pass's
         updates from the next delta. The trainer marks the pass's rows
         touched AFTER the pass runs (mark_trained_rows)."""
+        per_batch, floats, qmeta, trivial, nrec = cls._front(
+            dataset, floats_dtype)
+        dedup, u_pad, k_max = cls._dedup_phase(per_batch, table)
+        host = cls._pack_chunk(per_batch, dedup, u_pad, k_max, trivial,
+                               table.capacity)
+        return cls(host[0], host[1], floats, host[2], host[3], nrec,
+                   qmeta=qmeta)
+
+    @classmethod
+    def build_streamed(cls, dataset: Dataset, table,
+                       floats_dtype=np.float32,
+                       threads: int = 4) -> "ResidentPass":
+        """Build with the upload IN FLIGHT. ``jax.device_put`` is async
+        on this runtime (measured: the H2D transfer streams while the
+        host packs; per-array forced fetches cost a ~0.25 s round-trip
+        each). The float block is put before dedup/pack begin, so its
+        transfer rides under the host build; the index blocks are put
+        once packing completes (their encode depends on the whole-pass
+        u_pad/format choice), so their transfer overlaps only the
+        encode tail — pass wall ≈ host build + index transfer, with the
+        float transfer and all sync round-trips hidden. (Chunk-wise
+        index packing could hide ~0.5 s more behind the dedup phase but
+        needs a chunked runner — revisit with the compact-rows wire.)
+        The only blocking wait is one ``block_until_ready`` at the end.
+        Wire format matches upload() exactly; the returned pass is
+        already staged (dev set)."""
+        per_batch, floats, qmeta, trivial, nrec = cls._front(
+            dataset, floats_dtype)
+        floats_t = jax.device_put(floats)
+        qm = jax.device_put(np.zeros((2, 0), np.float32)
+                            if qmeta is None else qmeta)
+        dedup, u_pad, k_max = cls._dedup_phase(per_batch, table, threads)
+        uniq, gidx, meta, segs = cls._pack_chunk(
+            per_batch, dedup, u_pad, k_max, trivial, table.capacity)
+        uniq_t = tuple(jax.device_put(a)
+                       for a in cls._encode_uniq(uniq, meta))
+        gidx_t = tuple(jax.device_put(a) for a in cls._encode_gidx(gidx))
+        segs_t = jax.device_put(np.zeros((1, 1), np.int32)
+                                if segs is None else segs)
+        rp = cls(uniq, gidx, floats, meta, segs, nrec, qmeta=qmeta)
+        rp.dev = (uniq_t, gidx_t, floats_t, jax.device_put(meta),
+                  segs_t, qm)
+        jax.block_until_ready(list(jax.tree.leaves(rp.dev)))
+        return rp
+
+    @classmethod
+    def _front(cls, dataset: Dataset, floats_dtype):
+        """Shared front-end: slice the pass into per-batch key views and
+        pack the float block. Returns (per_batch, floats, qmeta, trivial,
+        nrec); per_batch entries are (keys, slot_of_key, key_capacity,
+        pad_segment, segments-or-None)."""
         col = getattr(dataset, "columnar", None)
         if col is not None:
-            return cls._build_columnar(dataset, col, table, floats_dtype)
+            return cls._front_columnar(dataset, col, floats_dtype)
         per_batch = []
         floats_l = []
         trivial = True
         nrec = 0
+        # q8 needs whole-pass f32 staging for the range stats; other
+        # wires cast per batch so the host never holds a full f32 copy
+        batch_dtype = np.float32 if floats_dtype == "q8" else floats_dtype
         for b in dataset.batches():
             nk = b.num_keys
             slot_of_key = (b.segments[:nk] % b.num_slots).astype(np.int16)
@@ -117,20 +176,23 @@ class ResidentPass:
                               b.pad_segment,
                               b.segments[:nk].astype(np.int32, copy=False)))
             floats_l.append(pack_floats(b.dense, b.label, b.show, b.clk,
-                                        dtype=floats_dtype))
+                                        dtype=batch_dtype))
             nrec += int((b.show > 0).sum())
             trivial = trivial and getattr(b, "segments_trivial", False)
         if not per_batch:
             raise ValueError("empty pass")
-        return cls._pack(per_batch, np.stack(floats_l), trivial, nrec, table)
+        floats = np.stack(floats_l)
+        qmeta = None
+        if floats_dtype == "q8":
+            floats, qmeta = cls._encode_floats(floats, floats_dtype)
+        return per_batch, floats, qmeta, trivial, nrec
 
     @classmethod
-    def _build_columnar(cls, dataset: Dataset, col, table,
-                        floats_dtype) -> "ResidentPass":
-        """Vectorized whole-pass packer for columnar datasets: per-batch
-        native dedup+assign over array slices + bulk reshapes — no
-        SlotBatch objects, no per-record python (build must stay under
-        the device pass time for the preload to fully overlap)."""
+    def _front_columnar(cls, dataset: Dataset, col, floats_dtype):
+        """Vectorized whole-pass front for columnar datasets: array slices
+        + bulk reshapes — no SlotBatch objects, no per-record python
+        (build must stay under the device pass time for the preload to
+        fully overlap)."""
         desc = dataset.desc
         bs = desc.batch_size
         s = len(desc.sparse_slots)
@@ -168,53 +230,85 @@ class ResidentPass:
             padded = np.zeros((nb * bs, d3), np.float32)
             padded[:r] = floats_full
             floats_full = padded
-        floats = floats_full.reshape(nb, bs, d3).astype(
-            floats_dtype, copy=False)
-        return cls._pack(per_batch, floats, trivial,
-                         int((col.show > 0).sum()), table)
+        floats = floats_full.reshape(nb, bs, d3)
+        floats, qmeta = cls._encode_floats(floats, floats_dtype)
+        return per_batch, floats, qmeta, trivial, int((col.show > 0).sum())
+
+    @staticmethod
+    def _encode_floats(floats: np.ndarray, floats_dtype):
+        """Apply the requested float wire to a packed f32 block
+        [nb, B, D+3]: "q8" → per-column affine uint8 over the whole pass
+        (train/step.quantize_floats; range stats over real rows only —
+        show > 0 — so zero-filled batch padding doesn't dilute
+        precision; falls back to bf16 when the data doesn't fit), else a
+        plain dtype cast."""
+        if floats_dtype == "q8":
+            nb, b, d3 = floats.shape
+            flat = floats.reshape(nb * b, d3)
+            q = quantize_floats(flat[:, :-3], flat[:, -3], flat[:, -2],
+                                flat[:, -1], valid=flat[:, -2] > 0)
+            if q is not None:
+                block, qmeta = q
+                return block.reshape(nb, b, d3), qmeta
+            log.warning("q8 float wire: data out of range, using bf16")
+            floats_dtype = jnp.bfloat16
+        return floats.astype(floats_dtype, copy=False), None
 
     @classmethod
-    def _pack(cls, per_batch, floats, trivial: bool, nrec: int,
-              table) -> "ResidentPass":
-        """Shared tail: per-batch dedup+assign through the native index,
-        then pack uniq/gidx/meta/segs to uniform buckets (slot ids go to
-        the table's host-side slot_host, not the wire)."""
-        from paddlebox_tpu.ps.table import fill_oob_pads, next_bucket
-        nb = len(per_batch)
-        cap = table.capacity
-        dedup = []
-        u_max = 1
-        for keys, *_ in per_batch:
-            with table.host_lock:  # vs shrink/save on the main thread
-                rows_u, inv = table.index.assign_unique(keys)
-            dedup.append((rows_u, inv))
-            u_max = max(u_max, len(rows_u) + 1)
+    def _dedup_phase(cls, per_batch, table, threads: int = 4):
+        """Per-batch dedup + row assignment (the FeedPass registration +
+        DedupKeysAndFillIdx steps): the native index assigns serially
+        under the table lock (deterministic row order), the sort/rank
+        work fans out over a thread pool (numpy releases the GIL).
+        Returns ([(uniq_sorted, gidx)] per batch, u_pad, k_max)."""
+        from paddlebox_tpu.ps.table import next_bucket
+
+        def sort_rank(rows_u, inv):
+            u = len(rows_u)
+            order = np.argsort(rows_u, kind="stable")
+            rank = np.empty(u, np.int32)
+            rank[order] = np.arange(u, dtype=np.int32)
+            return rows_u[order], rank[inv]
+
+        futs = []
+        with ThreadPoolExecutor(max_workers=threads) as pool:
+            for keys, slot_of_key, *_ in per_batch:
+                with table.host_lock:  # vs shrink/save on the main thread
+                    rows_u, inv = table.index.assign_unique(keys)
+                    # slot = host metadata (slot_host), not wire bytes
+                    table.record_slots(rows_u, inv, slot_of_key)
+                futs.append(pool.submit(sort_rank, rows_u, inv))
+            dedup = [f.result() for f in futs]
+        u_max = max(len(u) + 1 for u, _ in dedup)
         u_pad = next_bucket(table.unique_bucket_min, u_max)
         k_max = max(kc for _, _, kc, _, _ in per_batch)
+        return dedup, u_pad, k_max
+
+    @classmethod
+    def _pack_chunk(cls, per_batch, dedup, u_pad: int, k_max: int,
+                    trivial: bool, cap: int):
+        """Pack a run of batches into uniform host arrays
+        (uniq, gidx, meta, segs-or-None) — SORTED unique rows so the wire
+        ships byte-cut deltas and the table scatter gets nondecreasing
+        line indices."""
+        from paddlebox_tpu.ps.table import fill_oob_pads
+        nb = len(per_batch)
         uniq = np.empty((nb, u_pad), np.int32)
         gidx = np.empty((nb, k_max), np.int32)
         meta = np.empty((nb, 4), np.int32)
         segs = None if trivial else np.empty((nb, k_max), np.int32)
-        for i, ((keys, slot_of_key, _, pad_seg, seg_arr),
-                (rows_u, inv)) in enumerate(zip(per_batch, dedup)):
-            nk, u = len(keys), len(rows_u)
-            with table.host_lock:  # slot = host metadata (slot_host)
-                table.record_slots(rows_u, inv, slot_of_key)
-            # SORT the unique rows ascending and remap the inverse: the
-            # wire then ships u16 DELTAS (ops/bitpack-style byte cut) and
-            # the table scatter gets nondecreasing line indices
-            order = np.argsort(rows_u, kind="stable")
-            rank = np.empty(u, np.int32)
-            rank[order] = np.arange(u, dtype=np.int32)
-            uniq[i, :u] = rows_u[order]
+        for i, ((keys, _, _, pad_seg, seg_arr),
+                (uniq_s, gidx_i)) in enumerate(zip(per_batch, dedup)):
+            nk, u = len(keys), len(uniq_s)
+            uniq[i, :u] = uniq_s
             fill_oob_pads(uniq[i], u, cap)
-            gidx[i, :nk] = rank[inv]
+            gidx[i, :nk] = gidx_i
             gidx[i, nk:] = u  # key pads → first OOB pad position
             meta[i] = (nk, pad_seg, u, uniq[i, 0])
             if segs is not None:
                 segs[i, :nk] = seg_arr
                 segs[i, nk:] = pad_seg
-        return cls(uniq, gidx, floats, meta, segs, nrec)
+        return uniq, gidx, meta, segs
 
     def upload(self, materialize: bool = False) -> None:
         """Stage to HBM, bit-packing the index arrays for the wire (H2D
@@ -230,40 +324,48 @@ class ResidentPass:
         materializes from its thread so the transfer rides alongside the
         previous pass's compute."""
         if self.dev is None:
-            uniq = self._uniq_wire()
-            if (int(self.gidx.max(initial=0)) < (1 << 18)
-                    and self.gidx.shape[1] % 4 == 0):
-                gidx = tuple(jnp.asarray(a) for a in pack_u18(self.gidx))
-            else:
-                gidx = (jnp.asarray(self.gidx),)
+            uniq = tuple(jnp.asarray(a) for a in
+                         self._encode_uniq(self.uniq, self.meta))
+            gidx = tuple(jnp.asarray(a) for a in
+                         self._encode_gidx(self.gidx))
             segs = (jnp.zeros((1, 1), jnp.int32) if self.segs is None
                     else jnp.asarray(self.segs))
+            qm = (jnp.zeros((2, 0), jnp.float32) if self.qmeta is None
+                  else jnp.asarray(self.qmeta))
             self.dev = (uniq, gidx, jnp.asarray(self.floats),
-                        jnp.asarray(self.meta), segs)
+                        jnp.asarray(self.meta), segs, qm)
         if materialize:
             for a in jax.tree.leaves(self.dev):
-                jax.device_get(a.ravel()[0])
+                if a.size:
+                    jax.device_get(a.ravel()[0])
 
-    _EXC = 32  # per-batch budget of >=2^16 delta gaps in the u16 wire
+    _EXC = 32    # per-batch budget of >=2^16 delta gaps in the u16 wire
+    _EXC8 = 64   # per-batch budget of >=2^8 gaps in the u8 wire
 
-    def _uniq_wire(self):
+    @classmethod
+    def _encode_uniq(cls, uniq: np.ndarray, meta: np.ndarray):
         """Wire encoding for the (ascending) per-batch unique rows, in
-        preference order: u16 DELTAS + sparse gap exceptions
-        (ops/bitpack.pack_delta16; 2 B/value — the common case, mean row
-        gap is capacity/u), else 16+8-bit halves (3 B), else raw int32.
-        The device reconstructs with one cumsum (_make_view). Hand-built
-        passes that violate the delta wire's preconditions (unsorted
-        rows, old 3-column meta without the base) fall through to the
-        order-agnostic encodings."""
-        delta = None
-        if self.meta.shape[1] >= 4 and bool(
-                (self.meta[:, 3] == self.uniq[:, 0]).all()):
-            delta = pack_delta16(self.uniq, self.meta[:, 2], self._EXC)
-        if delta is not None:
-            return tuple(jnp.asarray(a) for a in delta)
-        if int(self.uniq.max()) < (1 << 24):
-            return tuple(jnp.asarray(a) for a in pack_u24(self.uniq))
-        return (jnp.asarray(self.uniq),)
+        preference order: u8 DELTAS + sparse gap exceptions (1 B/value —
+        the common case once the table is warm, mean row gap is
+        rows_assigned/u), u16 deltas (2 B), 16+8-bit halves (3 B), raw
+        int32. The device reconstructs with one cumsum (_make_view).
+        Hand-built passes that violate the delta wire's preconditions
+        (unsorted rows, old 3-column meta without the base) fall through
+        to the order-agnostic encodings."""
+        if meta.shape[1] >= 4 and bool((meta[:, 3] == uniq[:, 0]).all()):
+            delta = pack_delta_auto(uniq, meta[:, 2], cls._EXC8, cls._EXC)
+            if delta is not None:
+                return delta
+        if int(uniq.max()) < (1 << 24):
+            return pack_u24(uniq)
+        return (uniq,)
+
+    @staticmethod
+    def _encode_gidx(gidx: np.ndarray):
+        if (int(gidx.max(initial=0)) < (1 << 18)
+                and gidx.shape[1] % 4 == 0):
+            return pack_u18(gidx)
+        return (gidx,)
 
     def nbytes(self) -> int:
         """Wire bytes (after upload packing; host estimate before)."""
@@ -319,7 +421,7 @@ class ResidentPassRunner:
         self._jit: Dict[int, object] = {}  # n_steps → compiled runner
 
     def _make_view(self, uniq_t, gidx_t, floats, meta,
-                   segs) -> _BatchView:
+                   segs, qmeta) -> _BatchView:
         if len(uniq_t) == 3:
             # u16-delta wire (ops/bitpack.unpack_delta16); the pad
             # region is derived (fill_oob_pads pattern: distinct, > cap)
@@ -341,7 +443,10 @@ class ResidentPassRunner:
         else:
             segments = segs
         key_valid = (pos < num_keys).astype(jnp.float32)
-        dense, label, show, clk = unpack_floats(floats)
+        if floats.dtype == jnp.uint8:  # q8 wire (quantize_floats)
+            dense, label, show, clk = dequantize_floats(floats, qmeta)
+        else:
+            dense, label, show, clk = unpack_floats(floats)
         return _BatchView(
             uniq, gidx, key_valid, segments,
             dense=dense, label=label, show=show, clk=clk,
@@ -350,13 +455,13 @@ class ResidentPassRunner:
     def _run(self, n_steps: int):
         if n_steps not in self._jit:
             def run(state, uniq_t, gidx_t, floats_p, meta_p,
-                    segs_p, start, rng):
+                    segs_p, qmeta, start, rng):
                 def body(i, carry):
                     state, rng = carry
                     view = self._make_view(
                         tuple(a[i] for a in uniq_t),
                         tuple(a[i] for a in gidx_t), floats_p[i],
-                        meta_p[i], segs_p[i % segs_p.shape[0]])
+                        meta_p[i], segs_p[i % segs_p.shape[0]], qmeta)
                     # 1-based like Trainer.train_pass's fold of the
                     # pre-incremented global_step
                     rng_i = jax.random.fold_in(rng, state.step + 1)
@@ -410,13 +515,15 @@ class PassPreloader:
         try:
             if self._build_fn is not None:
                 rp = self._build_fn(ds)
+                # forced materialization moves pass k+1's bytes NOW,
+                # riding alongside pass k's compute (see
+                # ResidentPass.upload); a lazy upload would instead
+                # serialize into k+1's first step
+                rp.upload(materialize=True)
             else:
-                rp = ResidentPass.build(ds, self._table,
-                                        floats_dtype=self._floats_dtype)
-            # forced materialization moves pass k+1's bytes NOW, riding
-            # alongside pass k's compute (see ResidentPass.upload); a
-            # lazy upload would instead serialize into k+1's first step
-            rp.upload(materialize=True)
+                # build+upload overlapped AND forced (same rationale)
+                rp = ResidentPass.build_streamed(
+                    ds, self._table, floats_dtype=self._floats_dtype)
             self._next = rp
         except BaseException as e:  # surfaces on next()
             self._err = e

@@ -46,6 +46,57 @@ def unpack_floats(floats: jax.Array):
     return floats[:, :-3], floats[:, -3], floats[:, -2], floats[:, -1]
 
 
+def quantize_floats(dense: np.ndarray, label: np.ndarray, show: np.ndarray,
+                    clk: np.ndarray, valid: Optional[np.ndarray] = None):
+    """Optional q8 float wire: dense features as per-column affine uint8
+    (q = round((x - zp) / scale)), label/show/clk as raw uint8 — CTR dense
+    features are counts/logs where 8-bit affine precision is ample, and
+    the reference itself runs int8 dense paths (scaled_int8fc,
+    fused_scale_int8_op.cu). ``valid`` (bool [B]) restricts the range
+    stats to real rows — batch-padding rows (show == 0, zero-filled)
+    must not widen the range and dilute real-feature precision; their
+    encodings clip, which is fine because ins_w masks them everywhere.
+    Returns (block u8 [B, D+3], qmeta f32 [2, D] = [scale; zp]) or None
+    when the data doesn't fit the wire (non-finite dense, or
+    label/show/clk outside exact-u8 range) — callers fall back to the
+    bf16 wire."""
+    d = dense.astype(np.float32, copy=False)
+    lsc = np.stack([label, show, clk], axis=1)
+    if not np.isfinite(d).all():
+        return None
+    if (lsc < 0).any() or (lsc > 255).any() or (lsc != np.rint(lsc)).any():
+        return None
+    stat = d if valid is None else d[valid]
+    if stat.size == 0:
+        stat = d[:1]
+    # winsorized range: heavy-tailed count features are the norm in CTR
+    # logs and a single extreme value must not collapse a whole column's
+    # precision to one bucket for the pass — clip the range to the
+    # [0.1, 99.9] percentiles when the tails are outlier-dominated
+    # (values beyond the range saturate; bounded error instead of
+    # unbounded precision loss)
+    lo = stat.min(axis=0)
+    hi = stat.max(axis=0)
+    if stat.shape[0] >= 1000:
+        p_lo, p_hi = np.percentile(stat, [0.1, 99.9], axis=0)
+        wild = (hi - lo) > 4.0 * np.maximum(p_hi - p_lo, 1e-30)
+        lo = np.where(wild, p_lo, lo)
+        hi = np.where(wild, p_hi, hi)
+    scale = (hi - lo) / 255.0
+    scale = np.where(scale > 0, scale, 1.0).astype(np.float32)
+    q = np.clip(np.rint((d - lo[None, :]) / scale[None, :]), 0, 255)
+    block = np.concatenate([q, lsc], axis=1).astype(np.uint8)
+    qmeta = np.stack([scale, lo.astype(np.float32)])
+    return block, qmeta
+
+
+def dequantize_floats(block: jax.Array, qmeta: jax.Array):
+    """(dense, label, show, clk) from a quantize_floats block (traced)."""
+    f = block.astype(jnp.float32)
+    dense = f[:, :-3] * qmeta[0][None, :] + qmeta[1][None, :]
+    return dense, f[:, -3], f[:, -2], f[:, -1]
+
+
 class DeviceBatch(NamedTuple):
     """Everything the device step consumes for one batch, packed into THREE
     host→device transfers (the tunnel/PCIe round-trip is the real cost, not

@@ -195,7 +195,8 @@ def _decode_uniq(rp, runner):
             tuple(jnp.asarray(a[i]) for a in gidx_t),
             jnp.asarray(rp.floats[i]), jnp.asarray(rp.meta[i]),
             jnp.zeros((1,), jnp.int32) if rp.segs is None
-            else jnp.asarray(rp.segs[i]))
+            else jnp.asarray(rp.segs[i]),
+            jnp.zeros((2, 0), jnp.float32))
         out.append((np.asarray(view.unique_rows),
                     np.asarray(view.gather_idx)))
     return out
@@ -273,3 +274,123 @@ def test_pass_preloader(criteo_files):
             break
     assert len(results) == 3
     assert all(np.isfinite(r["auc"]) for r in results)
+
+
+def test_quantize_floats_roundtrip():
+    """q8 float wire: affine dequant error bounded by scale/2 per column;
+    label/show/clk ride exactly; out-of-range data falls back (None)."""
+    from paddlebox_tpu.train.step import dequantize_floats, quantize_floats
+    rng = np.random.default_rng(5)
+    dense = rng.normal(size=(64, 5)).astype(np.float32) * \
+        np.array([1, 10, 0.1, 100, 1], np.float32)
+    label = (rng.random(64) < 0.3).astype(np.float32)
+    show = np.ones(64, np.float32)
+    clk = label.copy()
+    block, qmeta = quantize_floats(dense, label, show, clk)
+    d, l, s, c = dequantize_floats(jnp.asarray(block), jnp.asarray(qmeta))
+    span = dense.max(axis=0) - dense.min(axis=0)
+    assert (np.abs(np.asarray(d) - dense) <= span / 255.0 * 0.51 + 1e-7).all()
+    np.testing.assert_array_equal(np.asarray(l), label)
+    np.testing.assert_array_equal(np.asarray(s), show)
+    np.testing.assert_array_equal(np.asarray(c), clk)
+    # constant column: scale clamps to 1, roundtrips exactly
+    const = np.full((8, 2), 3.5, np.float32)
+    blk2, qm2 = quantize_floats(const, label[:8], show[:8], clk[:8])
+    d2 = np.asarray(dequantize_floats(jnp.asarray(blk2),
+                                      jnp.asarray(qm2))[0])
+    np.testing.assert_allclose(d2, const)
+    # fallbacks
+    assert quantize_floats(np.array([[np.nan]], np.float32),
+                           label[:1], show[:1], clk[:1]) is None
+    assert quantize_floats(const[:1], np.array([0.5], np.float32),
+                           show[:1], clk[:1]) is None
+
+
+def test_resident_q8_wire_learns(criteo_files):
+    """The q8 wire trains end-to-end and tracks the f32 wire's AUC."""
+    tr_a, ds = _make(criteo_files)
+    tr_b, _ = _make(criteo_files)
+    for _ in range(3):
+        ra = tr_a.train_pass_resident(ResidentPass.build(ds, tr_a.table))
+        rb = tr_b.train_pass_resident(
+            ResidentPass.build(ds, tr_b.table, floats_dtype="q8"))
+    assert rb["auc"] > 0.55
+    assert np.isclose(rb["auc"], ra["auc"], atol=5e-3)
+
+
+def test_build_streamed_equals_build(criteo_files):
+    """Streamed (chunked, overlapped-upload) build produces the exact
+    same staged pass as the plain builder."""
+    tr_a, ds = _make(criteo_files)
+    tr_b, _ = _make(criteo_files)
+    rp_a = ResidentPass.build(ds, tr_a.table, floats_dtype="q8")
+    rp_a.upload()
+    rp_b = ResidentPass.build_streamed(ds, tr_b.table, floats_dtype="q8")
+    np.testing.assert_array_equal(rp_a.uniq, rp_b.uniq)
+    np.testing.assert_array_equal(rp_a.gidx, rp_b.gidx)
+    np.testing.assert_array_equal(rp_a.meta, rp_b.meta)
+    np.testing.assert_array_equal(rp_a.floats, rp_b.floats)
+    if rp_a.segs is None:
+        assert rp_b.segs is None
+    else:
+        np.testing.assert_array_equal(rp_a.segs, rp_b.segs)
+    assert rp_b.dev is not None
+    for a, b in zip(jax.tree.leaves(rp_a.dev), jax.tree.leaves(rp_b.dev)):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # and it trains
+    tr_b.train_pass_resident(rp_b)
+
+
+def test_uniq_wire_d8(criteo_files):
+    """Warm tables produce small row gaps → the u8 delta wire engages."""
+    tr, ds = _make(criteo_files)
+    ResidentPass.build(ds, tr.table)          # warm the index
+    rp = ResidentPass.build(ds, tr.table)     # steady state
+    rp.upload()
+    assert len(rp.dev[0]) == 3 and rp.dev[0][0].dtype == jnp.uint8
+    from paddlebox_tpu.train.device_pass import ResidentPassRunner
+    runner = ResidentPassRunner(None, tr.table.capacity, rp.segs is None)
+    decoded = _decode_uniq(rp, runner)
+    for i, (du, dg) in enumerate(decoded):
+        u = rp.meta[i, 2]
+        np.testing.assert_array_equal(du[:u], rp.uniq[i, :u])
+        assert (du[u:] > tr.table.capacity).all()
+
+
+def test_q8_range_excludes_padding():
+    """Batch-padding rows (zero-filled, show=0) must not widen the q8
+    range: a column living far from 0 keeps its tight scale."""
+    from paddlebox_tpu.train.step import quantize_floats
+    dense = np.full((10, 2), 1000.0, np.float32)
+    dense[:, 1] = np.linspace(1000.0, 1010.0, 10)
+    dense[8:] = 0.0  # zero-filled pad rows
+    show = np.ones(10, np.float32)
+    show[8:] = 0.0
+    label = np.zeros(10, np.float32)
+    block, qmeta = quantize_floats(dense, label, show, label,
+                                   valid=show > 0)
+    scale, zp = qmeta
+    assert zp[1] == 1000.0 and scale[1] <= 10.0 / 255.0 + 1e-6
+    # pad rows clip instead of wrapping
+    assert (block[8:, :2] == 0).all()
+
+
+def test_q8_outlier_does_not_collapse_precision():
+    """One extreme value must not flatten a column to a single bucket:
+    the range winsorizes to the [0.1, 99.9] percentiles and the outlier
+    saturates with bounded error."""
+    from paddlebox_tpu.train.step import dequantize_floats, quantize_floats
+    rng = np.random.default_rng(7)
+    n = 4096
+    dense = rng.uniform(0, 100, size=(n, 1)).astype(np.float32)
+    dense[17, 0] = 1e6  # heavy-tail outlier
+    label = np.zeros(n, np.float32)
+    show = np.ones(n, np.float32)
+    block, qmeta = quantize_floats(dense, label, show, label)
+    d = np.asarray(dequantize_floats(jnp.asarray(block),
+                                     jnp.asarray(qmeta))[0])
+    body = np.delete(np.arange(n), 17)
+    err = np.abs(d[body, 0] - dense[body, 0])
+    assert err.max() < 1.0          # body keeps ~100/255 resolution
+    assert d[17, 0] >= d[body, 0].max()  # outlier saturates high

@@ -255,3 +255,30 @@ def test_spill_stale_copy_never_shadows_fresh_state(tmp_path):
     assert hs.spill_cold(f2, threshold=1e9) == 1      # fresh k2 → f2
     got = hs.fetch(k12)                               # k1 via f1, k2 via f2
     np.testing.assert_allclose(got["embed_w"], [1.0, 7.0])
+
+
+def test_slot_survives_pass_roundtrip_without_prepare():
+    """Slot metadata must survive begin_pass -> end_pass untouched: the
+    write-back sources slot from host metadata (slot_host), which
+    begin_pass must seed from the staged values — a working-set row not
+    re-visited by prepare()/record_slots during the window (eval-only
+    passes, staged key supersets) must not write slot=0, or a stale row
+    id's slot, back into the persistent HostStore."""
+    hs = HostStore(mf_dim=4, capacity=1 << 12)
+    keys = np.array([7, 8, 9], np.uint64)
+    d = {f: np.zeros((3, 4) if f == "embedx_w" else (3,), np.float32)
+         for f in ("show", "clk", "delta_score", "slot", "embed_w",
+                   "embed_g2sum", "embedx_w", "embedx_g2sum", "mf_size")}
+    d["slot"] = np.array([3.0, 4.0, 5.0], np.float32)
+    hs.update(keys, d)
+    t = PassScopedTable(hs, pass_capacity=64, cfg=SparseSGDConfig())
+    t.begin_pass(keys)       # no prepare()/record_slots in the window
+    t.end_pass()
+    np.testing.assert_allclose(hs.fetch(keys)["slot"], [3.0, 4.0, 5.0])
+    # a second pass over a DIFFERENT key set must not inherit stale
+    # slot_host entries from the first pass's (rebuilt) row ids
+    k2 = np.array([21, 22], np.uint64)
+    t.begin_pass(k2)
+    t.end_pass()
+    np.testing.assert_allclose(hs.fetch(k2)["slot"], 0.0)
+    np.testing.assert_allclose(hs.fetch(keys)["slot"], [3.0, 4.0, 5.0])
