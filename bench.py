@@ -71,8 +71,13 @@ def main() -> None:
         return d
 
     cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=1e-3)
+    # slot-arena allocation → the resident path ships the COMPACT wire
+    # (per-key ~17-bit slot-local rows, no dedup streams); set
+    # BENCH_ARENA=0 to measure the host-dedup wire instead
+    arena = int(os.environ.get("BENCH_ARENA", "1"))
     table = EmbeddingTable(mf_dim=mf_dim, capacity=1 << 23, cfg=cfg,
-                           unique_bucket_min=1 << 12)
+                           unique_bucket_min=1 << 12,
+                           arena_slots=26 if arena else None)
     tr = Trainer(DeepFM(hidden=(512, 256, 128)), table, desc,
                  tx=optax.adam(1e-3), prefetch=8)
 

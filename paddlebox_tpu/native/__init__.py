@@ -88,6 +88,22 @@ def load_native() -> ctypes.CDLL | None:
         lib.kv_lookup_unique.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                          ctypes.c_int64, ctypes.c_int32,
                                          ctypes.c_void_p, ctypes.c_void_p]
+        lib.kv_arena_enable.restype = ctypes.c_int32
+        lib.kv_arena_enable.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                        ctypes.c_int32]
+        lib.kv_assign_slotted.restype = ctypes.c_int64
+        lib.kv_assign_slotted.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                          ctypes.c_void_p, ctypes.c_int64,
+                                          ctypes.c_void_p, ctypes.c_void_p]
+        lib.kv_assign_unique_slotted.restype = ctypes.c_int64
+        lib.kv_assign_unique_slotted.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p]
+        lib.kv_arena_chunk_count.restype = ctypes.c_int32
+        lib.kv_arena_chunk_count.argtypes = [ctypes.c_void_p]
+        lib.kv_arena_export.restype = ctypes.c_int32
+        lib.kv_arena_export.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                        ctypes.c_void_p]
         lib.criteo_parse.restype = ctypes.c_int64
         lib.criteo_parse.argtypes = [ctypes.c_char_p, ctypes.c_int64,
                                      ctypes.c_int64, ctypes.c_void_p,

@@ -31,6 +31,77 @@ inline uint64_t mix(uint64_t k) {
   return k ^ (k >> 31);
 }
 
+// Optional slot-arena row allocator: rows are carved from fixed-size,
+// chunk-aligned extents owned by one slot each, so a slot's rows cluster
+// into few chunks and a (slot, local) pair addresses any row with
+// local < n_chunks(slot) * chunk_size — the compact resident-pass wire
+// ships per-key LOCAL rows in ~17 bits instead of per-batch dedup
+// streams (train/device_pass.py). Mirrors the reference's slot-grouped
+// pull/push layouts (multi-mf build groups keys by slot dim class,
+// ps_gpu_wrapper.cc BuildGPUTask); here the grouping buys wire entropy.
+struct Arena {
+  int32_t chunk_bits = 0;  // 0 = disabled
+  int32_t n_slots = 0;     // fixed at enable time (slot ids < n_slots)
+  int32_t next_chunk = 0;
+  int32_t max_chunks = 0;
+  std::vector<int32_t> chunk_slot;   // [max_chunks] owning slot or -1
+  std::vector<int32_t> chunk_rank;   // [max_chunks] rank within its slot
+  std::vector<int32_t> slot_nchunks;            // [n_slots]
+  std::vector<int32_t> slot_tail_chunk;         // [n_slots] current chunk
+  std::vector<int32_t> slot_fill;               // rows used in tail chunk
+  std::vector<std::vector<int32_t>> slot_free;  // freed global rows
+
+  bool enabled() const { return chunk_bits > 0; }
+
+  void init(int32_t bits, int32_t slots, int32_t max_rows) {
+    chunk_bits = bits;
+    n_slots = slots;
+    max_chunks = (max_rows + (1 << bits) - 1) >> bits;
+    chunk_slot.assign(max_chunks, -1);
+    chunk_rank.assign(max_chunks, -1);
+    slot_nchunks.assign(n_slots, 0);
+    slot_tail_chunk.assign(n_slots, -1);
+    slot_fill.assign(n_slots, 0);
+    slot_free.assign(n_slots, {});
+  }
+
+  // allocate a global row from slot s's arena; -2 when out of chunks
+  int32_t alloc(int32_t s, int32_t max_rows) {
+    if (!slot_free[s].empty()) {
+      int32_t r = slot_free[s].back();
+      slot_free[s].pop_back();
+      return r;
+    }
+    int32_t cs = 1 << chunk_bits;
+    if (slot_tail_chunk[s] < 0 || slot_fill[s] == cs) {
+      if (next_chunk >= max_chunks) return -2;
+      int32_t c = next_chunk++;
+      chunk_slot[c] = s;
+      chunk_rank[c] = slot_nchunks[s]++;
+      slot_tail_chunk[s] = c;
+      slot_fill[s] = 0;
+    }
+    int32_t row = (slot_tail_chunk[s] << chunk_bits) + slot_fill[s]++;
+    return row < max_rows ? row : -2;  // final partial chunk guard
+  }
+
+  // clamp out-of-range slot ids to the default (slotless) arena — the
+  // caller's compact wire then sees local = -1 and falls back, instead
+  // of the out-of-bounds vector writes a raw slot id would cause
+  int32_t clamp_slot(int32_t s) const {
+    return (s >= 0 && s < n_slots) ? s : n_slots;
+  }
+
+  // slot-local address of a global row; -1 when the row's owning arena
+  // is not `s` (key previously assigned slotless or under another slot)
+  int32_t local_of(int32_t row, int32_t s) const {
+    if (s < 0 || s >= n_slots) return -1;  // incl. the default arena id
+    int32_t c = row >> chunk_bits;
+    if (chunk_slot[c] != s) return -1;
+    return (chunk_rank[c] << chunk_bits) | (row & ((1 << chunk_bits) - 1));
+  }
+};
+
 struct KvIndex {
   std::vector<uint64_t> keys;
   std::vector<int32_t> rows;
@@ -41,6 +112,7 @@ struct KvIndex {
   int64_t tombs = 0;       // tombstoned buckets (reclaimed only by rehash)
   int32_t next_row = 0;
   int32_t max_rows = 0;
+  Arena arena;
 
   // per-call dedup scratch, keyed by row (rows are unique per key):
   // seen_epoch[row] == cur_epoch marks "already emitted this call";
@@ -98,8 +170,10 @@ struct KvIndex {
     tombs = 0;
   }
 
-  // returns row, or -2 if table full (new key, no rows left)
-  int32_t assign_one(uint64_t k) {
+  // returns row, or -2 if table full (new key, no rows left).
+  // feat_slot >= 0 routes new-key allocation to that slot's arena when
+  // arena mode is on; -1 = slotless (default arena in arena mode).
+  int32_t assign_one(uint64_t k, int32_t feat_slot = -1) {
     // tombstones count toward occupancy: without this, churn
     // (assign/release cycles) exhausts EMPTY slots and probes loop forever
     if ((size + tombs + 1) * 10 >= static_cast<int64_t>(mask + 1) * 7) grow();
@@ -113,7 +187,11 @@ struct KvIndex {
       h = (h + 1) & mask;
     }
     int32_t row;
-    if (!free_rows.empty()) {
+    if (arena.enabled()) {
+      int32_t s = arena.clamp_slot(feat_slot);
+      row = arena.alloc(s, max_rows);
+      if (row == -2) return -2;
+    } else if (!free_rows.empty()) {
       row = free_rows.back();
       free_rows.pop_back();
     } else if (next_row < max_rows) {
@@ -147,7 +225,12 @@ struct KvIndex {
         int32_t row = rows[h];
         state[h] = TOMB;
         rows[h] = -1;
-        free_rows.push_back(row);
+        if (arena.enabled()) {  // rows return to their OWNING arena
+          arena.slot_free[arena.chunk_slot[row >> arena.chunk_bits]]
+              .push_back(row);
+        } else {
+          free_rows.push_back(row);
+        }
         --size;
         ++tombs;
         return row;
@@ -174,7 +257,13 @@ int64_t kv_size(void* p) { return static_cast<KvIndex*>(p)->size; }
 // (== n on success). rows_out[i] = row of keys[i].
 int64_t kv_assign(void* p, const uint64_t* in, int64_t n, int32_t* rows_out) {
   KvIndex* kv = static_cast<KvIndex*>(p);
+  constexpr int64_t PF = 16;
   for (int64_t i = 0; i < n; ++i) {
+    if (i + PF < n) {
+      uint64_t h = mix(in[i + PF]) & kv->mask;
+      __builtin_prefetch(&kv->state[h]);
+      __builtin_prefetch(&kv->keys[h]);
+    }
     int32_t r = kv->assign_one(in[i]);
     if (r == -2) return i;
     rows_out[i] = r;
@@ -209,7 +298,13 @@ int64_t kv_assign_unique(void* p, const uint64_t* in, int64_t n,
   KvIndex* kv = static_cast<KvIndex*>(p);
   uint32_t epoch = kv->next_epoch();
   int64_t u = 0;
+  constexpr int64_t PF = 16;
   for (int64_t i = 0; i < n; ++i) {
+    if (i + PF < n) {
+      uint64_t h = mix(in[i + PF]) & kv->mask;
+      __builtin_prefetch(&kv->state[h]);
+      __builtin_prefetch(&kv->keys[h]);
+    }
     int32_t row = kv->assign_one(in[i]);
     if (row == -2) return -1;
     if (kv->seen_epoch[row] != epoch) {
@@ -252,6 +347,94 @@ int64_t kv_lookup_unique(void* p, const uint64_t* in, int64_t n,
     inverse_out[i] = kv->seen_pos[row];
   }
   return u;
+}
+
+// ---- slot arena (compact resident-pass wire) ----
+
+// Enable chunked slot-arena allocation. Must be called before any row is
+// assigned (returns -1 otherwise). slot ids must be < n_slots; slotless
+// assigns draw from an internal default arena.
+int32_t kv_arena_enable(void* p, int32_t chunk_bits, int32_t n_slots) {
+  KvIndex* kv = static_cast<KvIndex*>(p);
+  if (kv->size != 0 || kv->next_row != 0 || kv->arena.enabled()) return -1;
+  kv->arena.init(chunk_bits, n_slots + 1, kv->max_rows);
+  kv->arena.n_slots = n_slots;  // default arena = id n_slots (internal)
+  return 0;
+}
+
+// Per-key slotted assign: rows_out[i] = global row (or the call stops at
+// i and returns i when the table/arena fills); local_out[i] = slot-local
+// row, or -1 when the key's row lives in another slot's arena (assigned
+// earlier slotless or under a different slot) — callers seeing any -1
+// fall back to the dedup wire for that pass.
+int64_t kv_assign_slotted(void* p, const uint64_t* in, const uint16_t* slots,
+                          int64_t n, int32_t* rows_out, int32_t* local_out) {
+  KvIndex* kv = static_cast<KvIndex*>(p);
+  // The per-key cost is cache misses on the bucket arrays (the table is
+  // far larger than LLC at CTR scale); software-prefetch the probe
+  // window a fixed distance ahead — measured ~2x on the 213k-key batch
+  // assign that gates the preload pipeline.
+  constexpr int64_t PF = 16;
+  for (int64_t i = 0; i < n; ++i) {
+    if (i + PF < n) {
+      uint64_t h = mix(in[i + PF]) & kv->mask;
+      __builtin_prefetch(&kv->state[h]);
+      __builtin_prefetch(&kv->keys[h]);
+    }
+    int32_t s = static_cast<int32_t>(slots[i]);
+    int32_t r = kv->assign_one(in[i], s);
+    if (r == -2) return i;
+    rows_out[i] = r;
+    if (local_out) local_out[i] = kv->arena.local_of(r, s);
+  }
+  return n;
+}
+
+// Slotted variant of kv_assign_unique (same dedup contract): new keys
+// allocate in their slot's arena.
+int64_t kv_assign_unique_slotted(void* p, const uint64_t* in,
+                                 const uint16_t* slots, int64_t n,
+                                 int32_t* uniq_rows_out,
+                                 int32_t* inverse_out) {
+  KvIndex* kv = static_cast<KvIndex*>(p);
+  uint32_t epoch = kv->next_epoch();
+  int64_t u = 0;
+  constexpr int64_t PF = 16;
+  for (int64_t i = 0; i < n; ++i) {
+    if (i + PF < n) {
+      uint64_t h = mix(in[i + PF]) & kv->mask;
+      __builtin_prefetch(&kv->state[h]);
+      __builtin_prefetch(&kv->keys[h]);
+    }
+    int32_t row = kv->assign_one(in[i], static_cast<int32_t>(slots[i]));
+    if (row == -2) return -1;
+    if (kv->seen_epoch[row] != epoch) {
+      kv->seen_epoch[row] = epoch;
+      kv->seen_pos[row] = static_cast<int32_t>(u);
+      uniq_rows_out[u] = row;
+      ++u;
+    }
+    inverse_out[i] = kv->seen_pos[row];
+  }
+  return u;
+}
+
+// Export the chunk ownership map: chunk_slot_out/chunk_rank_out sized
+// kv_arena_chunk_count(); returns the number of allocated chunks.
+// chunk_map[slot, rank] = chunk id reconstructs vectorized host-side.
+int32_t kv_arena_chunk_count(void* p) {
+  return static_cast<KvIndex*>(p)->arena.next_chunk;
+}
+
+int32_t kv_arena_export(void* p, int32_t* chunk_slot_out,
+                        int32_t* chunk_rank_out) {
+  const KvIndex* kv = static_cast<KvIndex*>(p);
+  int32_t n = kv->arena.next_chunk;
+  std::memcpy(chunk_slot_out, kv->arena.chunk_slot.data(),
+              sizeof(int32_t) * n);
+  std::memcpy(chunk_rank_out, kv->arena.chunk_rank.data(),
+              sizeof(int32_t) * n);
+  return n;
 }
 
 // dump all live (key,row) pairs; buffers must hold kv_size entries.

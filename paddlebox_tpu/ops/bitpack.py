@@ -117,24 +117,40 @@ def unpack_delta16(d16: jax.Array, epos: jax.Array, eext: jax.Array,
     return cum + corr
 
 
-def pack_u18(values: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-    """int array [..., K] (values in [0, 2^18), K % 4 == 0) →
-    (lo uint16 [..., K], hi2 uint8 [..., K/4] — four 2-bit highs/byte)."""
+def pack_u16m(values: np.ndarray, mbits: int
+              ) -> Tuple[np.ndarray, np.ndarray]:
+    """int array [..., K] (values in [0, 2^(16+m)), m ∈ {1,2,4,8},
+    K % (8/m) == 0) → (lo uint16 [..., K], hi uint8 [..., K*m/8] —
+    8/m m-bit highs per byte, little-endian within the byte)."""
+    assert mbits in (1, 2, 4, 8)
     v = values.astype(np.uint32, copy=False)
-    assert v.max(initial=0) < (1 << 18), "pack_u18 range"
-    assert v.shape[-1] % 4 == 0, "pack_u18 needs K % 4 == 0"
+    assert v.max(initial=0) < (1 << (16 + mbits)), "pack_u16m range"
+    per = 8 // mbits
+    assert v.shape[-1] % per == 0, "pack_u16m alignment"
     lo = (v & 0xFFFF).astype(np.uint16)
-    hi = (v >> 16).astype(np.uint8)  # < 4
-    h = hi.reshape(*hi.shape[:-1], -1, 4)
-    hi2 = (h[..., 0] | (h[..., 1] << 2) | (h[..., 2] << 4)
-           | (h[..., 3] << 6)).astype(np.uint8)
-    return lo, hi2
+    hi = (v >> 16).astype(np.uint8)
+    h = hi.reshape(*hi.shape[:-1], -1, per)
+    packed = np.zeros(h.shape[:-1], np.uint8)
+    for j in range(per):
+        packed |= h[..., j] << (j * mbits)
+    return lo, packed
+
+
+def unpack_u16m(lo: jax.Array, hi: jax.Array, mbits: int) -> jax.Array:
+    """(lo uint16 [K], hi uint8 [K*m/8]) → int32 [K] (traced)."""
+    k = lo.shape[-1]
+    per = 8 // mbits
+    pos = jnp.arange(k, dtype=jnp.int32)
+    byte = hi[..., pos // per].astype(jnp.int32)
+    h = (byte >> ((pos % per) * mbits)) & ((1 << mbits) - 1)
+    return lo.astype(jnp.int32) | (h << 16)
+
+
+def pack_u18(values: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """18-bit :func:`pack_u16m` (kept for call-site clarity)."""
+    return pack_u16m(values, 2)
 
 
 def unpack_u18(lo: jax.Array, hi2: jax.Array) -> jax.Array:
     """(lo uint16 [K], hi2 uint8 [K/4]) → int32 [K] (traced)."""
-    k = lo.shape[-1]
-    pos = jnp.arange(k, dtype=jnp.int32)
-    byte = hi2[..., pos >> 2].astype(jnp.int32)
-    hi = (byte >> ((pos & 3) * 2)) & 3
-    return lo.astype(jnp.int32) | (hi << 16)
+    return unpack_u16m(lo, hi2, 2)

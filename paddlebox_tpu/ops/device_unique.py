@@ -43,15 +43,20 @@ def dedup_rows(rows: jax.Array, capacity: int) -> Tuple[jax.Array, jax.Array]:
       serialized TPU scatter.
     """
     k = rows.shape[0]
-    sr = jnp.sort(rows)
+    # ONE sort carrying original positions — replaces the earlier
+    # sort + 18-deep searchsorted + second sort formulation (the
+    # binary-search loop alone measured ~27 ms at K=213k on v5p; the
+    # two K-scalar scatters below are ~4 ms each)
+    pos = jnp.arange(k, dtype=jnp.int32)
+    sr, perm = jax.lax.sort((rows, pos), num_keys=1)
     is_first = jnp.concatenate(
         [jnp.ones(1, bool), sr[1:] != sr[:-1]])
     uid_sorted = jnp.cumsum(is_first.astype(jnp.int32)) - 1
-    # each key's unique id: first-occurrence position in sr, then its uid
-    first_pos = jnp.searchsorted(sr, rows)
-    gather_idx = uid_sorted[first_pos]
-    # compaction without scatter: mask non-firsts to distinct OOB values
-    # and re-sort — distinct real rows land in positions 0..U-1, pads after
-    oob = capacity + 1 + jnp.arange(k, dtype=jnp.int32)
-    unique_rows = jnp.sort(jnp.where(is_first, sr, oob))
+    # each key's unique id rides back through the sort permutation
+    gather_idx = jnp.zeros(k, jnp.int32).at[perm].set(
+        uid_sorted, unique_indices=True)
+    # compaction: duplicates of a run write the SAME value to the same
+    # uid slot (commutes); pads prefill with distinct OOB ids
+    oob = capacity + 1 + pos
+    unique_rows = oob.at[uid_sorted].set(sr)
     return unique_rows, gather_idx

@@ -401,11 +401,26 @@ class EmbeddingTable:
 
     def __init__(self, mf_dim: int = 8, capacity: Optional[int] = None,
                  cfg: Optional[SparseSGDConfig] = None, seed: int = 0,
-                 unique_bucket_min: int = 1024) -> None:
+                 unique_bucket_min: int = 1024,
+                 arena_slots: Optional[int] = None,
+                 arena_chunk_bits: int = 12) -> None:
+        """``arena_slots``: enable the slot-arena row allocator (native
+        kv_index Arena) for ``arena_slots`` feature slots — rows cluster
+        into per-slot chunk extents so the resident-pass COMPACT wire can
+        ship ~17-bit slot-local rows instead of dedup streams
+        (train/device_pass.py). Purely an allocation policy: every other
+        table path (save/load/shrink/streaming prepare) is unchanged and
+        correct either way; keys that enter through slotless paths make
+        the compact wire fall back to the dedup wire for passes touching
+        them."""
         self.mf_dim = mf_dim
         self.capacity = capacity or FLAGS.table_capacity_per_shard
         self.cfg = cfg or SparseSGDConfig()
         self.index = HostKV(self.capacity)
+        self.arena_slots = arena_slots
+        self.arena_chunk_bits = arena_chunk_bits
+        if arena_slots is not None:
+            self.index.arena_enable(arena_chunk_bits, arena_slots)
         self.state = init_table_state(self.capacity, mf_dim)
         self._rng = jax.random.PRNGKey(seed)
         self._push_count = 0
@@ -536,11 +551,23 @@ class EmbeddingTable:
         with self.host_lock:
             if not merge:
                 self.index = HostKV(self.capacity)
+                if self.arena_slots is not None:
+                    self.index.arena_enable(self.arena_chunk_bits,
+                                            self.arena_slots)
                 self.state = init_table_state(self.capacity, self.mf_dim)
                 self._touched[:] = False
                 self.slot_host[:] = 0
-            rows = self.index.assign(keys)
-            self.slot_host[rows] = blob["slot"].astype(np.int16)
+            slots_b = blob["slot"].astype(np.int16)
+            if (getattr(self.index, "arena_enabled", False)
+                    and (0 <= slots_b).all()
+                    and (slots_b < (self.arena_slots or 0)).all()):
+                # keep loaded rows in their slot arenas so the compact
+                # wire stays available after a restore
+                rows, _ = self.index.assign_slotted(
+                    keys, slots_b.astype(np.uint16))
+            else:
+                rows = self.index.assign(keys)
+            self.slot_host[rows] = slots_b
         data = np.asarray(jax.device_get(self.state.data)).copy()
         for f in FIELDS:
             if f == "slot":

@@ -31,10 +31,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddlebox_tpu.data.dataset import Dataset
-from paddlebox_tpu.ops.bitpack import (pack_delta_auto, pack_u18,
-                                       pack_u24,
-                                       unpack_delta16, unpack_u18,
-                                       unpack_u24)
+from paddlebox_tpu.ops.bitpack import (pack_delta_auto, pack_u16m,
+                                       pack_u18, pack_u24, unpack_delta16,
+                                       unpack_u16m, unpack_u18, unpack_u24)
+from paddlebox_tpu.ops.device_unique import dedup_rows
 from paddlebox_tpu.train.step import (dequantize_floats, pack_floats,
                                       quantize_floats, unpack_floats)
 from paddlebox_tpu.utils.logging import get_logger
@@ -79,6 +79,13 @@ class ResidentPass:
         self.num_records = num_records
         self.qmeta = qmeta  # f32 [2, D] when floats is the q8 wire
         self.dev: Optional[Tuple[jax.Array, ...]] = None
+        # "dedup": uniq/gidx are the host-deduped pull index (default).
+        # "compact": built against a slot-arena table — uniq holds the
+        # per-key GLOBAL rows, gidx the slot-LOCAL rows; the wire ships
+        # the locals + the arena chunk map and the device rebuilds global
+        # rows and dedups in-trace (ops/device_unique.py).
+        self.wire = "dedup"
+        self.chunk_bits: Optional[int] = None
 
     @property
     def num_batches(self) -> int:
@@ -139,6 +146,14 @@ class ResidentPass:
         floats_t = jax.device_put(floats)
         qm = jax.device_put(np.zeros((2, 0), np.float32)
                             if qmeta is None else qmeta)
+        if getattr(table.index, "arena_enabled", False):
+            rp = cls._compact_tail(per_batch, floats, qmeta, trivial,
+                                   nrec, table, floats_t, qm)
+            if rp is not None:
+                return rp
+            log.warning("compact wire unavailable for this pass "
+                        "(foreign rows or width overflow); using dedup "
+                        "wire")
         dedup, u_pad, k_max = cls._dedup_phase(per_batch, table, threads)
         uniq, gidx, meta, segs = cls._pack_chunk(
             per_batch, dedup, u_pad, k_max, trivial, table.capacity)
@@ -152,6 +167,88 @@ class ResidentPass:
                   segs_t, qm)
         jax.block_until_ready(list(jax.tree.leaves(rp.dev)))
         return rp
+
+    @classmethod
+    def _compact_tail(cls, per_batch, floats, qmeta, trivial: bool,
+                      nrec: int, table, floats_t, qm
+                      ) -> Optional["ResidentPass"]:
+        """COMPACT wire for slot-arena tables: ship per-key slot-LOCAL
+        rows (≈17 bits at CTR scale — at/near the wire's entropy floor)
+        plus the tiny arena chunk map; the device rebuilds global rows
+        ((chunk_map[slot, local>>CB] << CB) | low bits) and dedups
+        in-trace (ops/device_unique.dedup_rows). Eliminates the whole
+        per-batch uniq stream and the host sort/rank work; the trade is
+        ~30-50 ms/step of device sort, the right side of the trade
+        whenever the wire, not the chip, is the bottleneck. Returns None
+        (caller falls back to the dedup wire) when any key's row lives
+        outside its slot's arena or the local width overflows 24 bits."""
+        nb = len(per_batch)
+        k_max = max(kc for _, _, kc, _, _ in per_batch)
+        cap = table.capacity
+        n_arena = int(table.arena_slots)
+        if any(int(sk.max(initial=0)) >= n_arena
+               for _, sk, _, _, _ in per_batch):
+            return None  # slots beyond the arena → dedup wire
+        locs = np.zeros((nb, k_max), np.int32)
+        rows_g = np.full((nb, k_max), cap + 1, np.int32)
+        meta = np.zeros((nb, 4), np.int32)
+        segs = None if trivial else np.empty((nb, k_max), np.int32)
+        for i, (keys, slot_of_key, _, pad_seg, seg_arr) in \
+                enumerate(per_batch):
+            nk = len(keys)
+            su = slot_of_key.astype(np.uint16, copy=False)
+            with table.host_lock:
+                r, l = table.index.assign_slotted(keys, su)
+                table.slot_host[r] = slot_of_key
+            if (l < 0).any():
+                return None
+            locs[i, :nk] = l
+            rows_g[i, :nk] = r
+            meta[i] = (nk, pad_seg, 0, 0)
+            if segs is not None:
+                segs[i, :nk] = seg_arr
+                segs[i, nk:] = pad_seg
+        bits = max(int(locs.max()).bit_length(), 1)
+        if bits > 24:
+            return None
+        with table.host_lock:
+            cs_map, cr_map = table.index.arena_export()
+        n_slots = int(table.arena_slots)
+        valid = cs_map < n_slots  # default (slotless) arena excluded
+        stride = int(cr_map[valid].max()) + 1 if valid.any() else 1
+        # bucket the stride (power-of-two ladder) so the chunk map's
+        # shape — and therefore the compiled runner — stays stable as
+        # slots grow new chunks across passes
+        from paddlebox_tpu.ps.table import next_bucket
+        stride = min(next_bucket(8, stride),
+                     (cap >> int(table.arena_chunk_bits)) + 1)
+        cmap = np.zeros((n_slots, stride), np.int32)
+        cmap[cs_map[valid], cr_map[valid]] = \
+            np.nonzero(valid)[0].astype(np.int32)
+        loc_t = tuple(jax.device_put(a)
+                      for a in cls._encode_locals(locs, bits))
+        segs_t = jax.device_put(np.zeros((1, 1), np.int32)
+                                if segs is None else segs)
+        rp = cls(rows_g, locs, floats, meta, segs, nrec, qmeta=qmeta)
+        rp.wire = "compact"
+        rp.chunk_bits = int(table.arena_chunk_bits)
+        rp.dev = (loc_t, (jax.device_put(cmap),), floats_t,
+                  jax.device_put(meta), segs_t, qm)
+        jax.block_until_ready(list(jax.tree.leaves(rp.dev)))
+        return rp
+
+    @staticmethod
+    def _encode_locals(locs: np.ndarray, bits: int):
+        """Wire for slot-local rows: plain u16 when they fit, else
+        16-bit lows + m-bit packed highs (ops/bitpack.pack_u16m),
+        else raw int32."""
+        if bits <= 16:
+            return (locs.astype(np.uint16),)
+        k = locs.shape[-1]
+        for m in (1, 2, 4, 8):
+            if bits <= 16 + m and k % (8 // m) == 0:
+                return pack_u16m(locs, m)
+        return (locs,)
 
     @classmethod
     def _front(cls, dataset: Dataset, floats_dtype):
@@ -270,11 +367,20 @@ class ResidentPass:
             rank[order] = np.arange(u, dtype=np.int32)
             return rows_u[order], rank[inv]
 
+        # arena tables assign slotted even on the dedup wire, so keys
+        # seen here first don't land in the default arena and poison the
+        # compact wire for every later pass
+        slotted = getattr(table.index, "arena_enabled", False)
         futs = []
         with ThreadPoolExecutor(max_workers=threads) as pool:
             for keys, slot_of_key, *_ in per_batch:
                 with table.host_lock:  # vs shrink/save on the main thread
-                    rows_u, inv = table.index.assign_unique(keys)
+                    if slotted:
+                        rows_u, inv = table.index.assign_unique_slotted(
+                            keys, slot_of_key.astype(np.uint16,
+                                                     copy=False))
+                    else:
+                        rows_u, inv = table.index.assign_unique(keys)
                     # slot = host metadata (slot_host), not wire bytes
                     table.record_slots(rows_u, inv, slot_of_key)
                 futs.append(pool.submit(sort_rank, rows_u, inv))
@@ -413,15 +519,23 @@ class ResidentPassRunner:
     (lax.fori_loop over the staged batches)."""
 
     def __init__(self, step, capacity: int, trivial_segments: bool,
-                 chunk: int = 0) -> None:
+                 chunk: int = 0, wire: str = "dedup",
+                 num_slots: Optional[int] = None,
+                 chunk_bits: Optional[int] = None) -> None:
         self.step = step            # TrainStep
         self.capacity = capacity
         self.trivial = trivial_segments
         self.chunk = chunk
+        self.wire = wire            # "dedup" | "compact"
+        self.num_slots = num_slots  # compact: derive slot = pos % S
+        self.chunk_bits = chunk_bits
         self._jit: Dict[int, object] = {}  # n_steps → compiled runner
 
     def _make_view(self, uniq_t, gidx_t, floats, meta,
                    segs, qmeta) -> _BatchView:
+        if self.wire == "compact":
+            return self._make_view_compact(uniq_t, gidx_t[0], floats,
+                                           meta, segs, qmeta)
         if len(uniq_t) == 3:
             # u16-delta wire (ops/bitpack.unpack_delta16); the pad
             # region is derived (fill_oob_pads pattern: distinct, > cap)
@@ -452,15 +566,55 @@ class ResidentPassRunner:
             dense=dense, label=label, show=show, clk=clk,
             segments_trivial=self.trivial)
 
+    def _make_view_compact(self, loc_t, cmap, floats, meta, segs,
+                           qmeta) -> _BatchView:
+        """Decode the compact wire: slot-local rows → global rows via the
+        arena chunk map, then in-trace dedup (DedupKeysAndFillIdx on the
+        chip — ops/device_unique.py)."""
+        if len(loc_t) == 2:
+            k = loc_t[0].shape[-1]
+            m = 8 * loc_t[1].shape[-1] // k
+            local = unpack_u16m(loc_t[0], loc_t[1], m)
+        else:
+            local = loc_t[0].astype(jnp.int32)
+        k = local.shape[-1]
+        num_keys, pad_seg = meta[0], meta[1]
+        pos = jnp.arange(k, dtype=jnp.int32)
+        s = self.num_slots
+        if self.trivial:
+            segments = jnp.where(pos < num_keys, pos, pad_seg)
+            slot = pos % s
+        else:
+            segments = segs
+            slot = segments % s
+        cb = self.chunk_bits
+        stride = cmap.shape[1]
+        chunk = cmap.reshape(-1)[slot * stride + (local >> cb)]
+        rows = (chunk << cb) | (local & ((1 << cb) - 1))
+        rows = jnp.where(pos < num_keys, rows, self.capacity)
+        uniq, gidx = dedup_rows(rows, self.capacity)
+        key_valid = (pos < num_keys).astype(jnp.float32)
+        if floats.dtype == jnp.uint8:
+            dense, label, show, clk = dequantize_floats(floats, qmeta)
+        else:
+            dense, label, show, clk = unpack_floats(floats)
+        return _BatchView(
+            uniq, gidx, key_valid, segments,
+            dense=dense, label=label, show=show, clk=clk,
+            segments_trivial=self.trivial)
+
     def _run(self, n_steps: int):
         if n_steps not in self._jit:
             def run(state, uniq_t, gidx_t, floats_p, meta_p,
                     segs_p, qmeta, start, rng):
                 def body(i, carry):
                     state, rng = carry
+                    # compact wire: gidx slot carries the PASS-global
+                    # arena chunk map, not per-batch data — don't index
+                    gi = (gidx_t if self.wire == "compact"
+                          else tuple(a[i] for a in gidx_t))
                     view = self._make_view(
-                        tuple(a[i] for a in uniq_t),
-                        tuple(a[i] for a in gidx_t), floats_p[i],
+                        tuple(a[i] for a in uniq_t), gi, floats_p[i],
                         meta_p[i], segs_p[i % segs_p.shape[0]], qmeta)
                     # 1-based like Trainer.train_pass's fold of the
                     # pre-incremented global_step

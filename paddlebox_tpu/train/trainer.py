@@ -207,11 +207,14 @@ class Trainer:
         rp = (pass_or_dataset if isinstance(pass_or_dataset, ResidentPass)
               else ResidentPass.build(pass_or_dataset, self.table))
         trivial = rp.segs is None
-        key = (rp.key_capacity, trivial)
+        wire = getattr(rp, "wire", "dedup")
+        key = (rp.key_capacity, trivial, wire, rp.chunk_bits)
         runner = self._resident_runners.get(key)
         if runner is None:
-            runner = ResidentPassRunner(self.step_fn, self.table.capacity,
-                                        trivial)
+            runner = ResidentPassRunner(
+                self.step_fn, self.table.capacity, trivial, wire=wire,
+                num_slots=self.step_fn.num_slots,
+                chunk_bits=getattr(rp, "chunk_bits", None))
             self._resident_runners[key] = runner
         self.state = runner.run_pass(self.state, rp, self._rng)
         jax.block_until_ready(self.state.step)

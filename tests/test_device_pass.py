@@ -394,3 +394,72 @@ def test_q8_outlier_does_not_collapse_precision():
     err = np.abs(d[body, 0] - dense[body, 0])
     assert err.max() < 1.0          # body keeps ~100/255 resolution
     assert d[17, 0] >= d[body, 0].max()  # outlier saturates high
+
+
+def _make_arena(files, bs=128):
+    desc = DataFeedDesc.criteo(batch_size=bs)
+    desc.key_bucket_min = 4096
+    ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+    ds.set_filelist(files)
+    ds.set_thread(2)
+    ds.load_into_memory()
+    cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=0.0,
+                          learning_rate=0.05, mf_learning_rate=0.05)
+    table = EmbeddingTable(mf_dim=4, capacity=1 << 13, cfg=cfg,
+                           unique_bucket_min=4096, arena_slots=26,
+                           arena_chunk_bits=6)
+    tr = Trainer(DeepFM(hidden=(16, 8)), table, desc, tx=optax.adam(1e-2),
+                 seed=3)
+    return tr, ds
+
+
+def test_compact_wire_matches_dedup_wire(criteo_files):
+    """The compact (slot-arena local rows + device dedup) wire must train
+    identically to the host-dedup wire — same per-key embeddings, same
+    dense params — despite a completely different row layout."""
+    tr_a, ds = _make(criteo_files)          # dedup wire
+    tr_b, _ = _make_arena(criteo_files)     # compact wire
+    for _ in range(2):
+        rp_a = ResidentPass.build_streamed(ds, tr_a.table)
+        assert rp_a.wire == "dedup"
+        ra = tr_a.train_pass_resident(rp_a)
+        rp_b = ResidentPass.build_streamed(ds, tr_b.table)
+        assert rp_b.wire == "compact"
+        rb = tr_b.train_pass_resident(rp_b)
+    assert np.isclose(rb["auc"], ra["auc"], atol=2e-3)
+    pa = jax.tree.leaves(tr_a.state.params)
+    pb = jax.tree.leaves(tr_b.state.params)
+    for a, b in zip(pa, pb):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-2, atol=2e-3)
+    keys, rows_a = tr_a.table.index.items()
+    rows_b = tr_b.table.index.lookup(keys)
+    assert (rows_b >= 0).all()
+    st_a = jax.device_get(tr_a.state.table)
+    st_b = jax.device_get(tr_b.state.table)
+    np.testing.assert_allclose(np.asarray(st_a.embed_w)[rows_a],
+                               np.asarray(st_b.embed_w)[rows_b],
+                               rtol=2e-2, atol=2e-3)
+
+
+def test_compact_wire_q8_learns(criteo_files):
+    tr, ds = _make_arena(criteo_files)
+    first = tr.train_pass_resident(
+        ResidentPass.build_streamed(ds, tr.table, floats_dtype="q8"))
+    for _ in range(3):
+        last = tr.train_pass_resident(
+            ResidentPass.build_streamed(ds, tr.table, floats_dtype="q8"))
+    assert last["auc"] > max(first["auc"], 0.55)
+
+
+def test_compact_falls_back_after_slotless_assign(criteo_files):
+    """Keys that entered through a slotless path poison the compact wire
+    for passes touching them — it must fall back to the dedup wire and
+    still train correctly."""
+    tr, ds = _make_arena(criteo_files)
+    some = ds.columnar.keys[:10].astype(np.uint64)
+    tr.table.index.assign(some)  # slotless → default arena
+    rp = ResidentPass.build_streamed(ds, tr.table)
+    assert rp.wire == "dedup"
+    res = tr.train_pass_resident(rp)
+    assert np.isfinite(res["auc"])

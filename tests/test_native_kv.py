@@ -165,3 +165,114 @@ def test_pykv_lookup_unique_miss_collapse():
     # all-miss batch
     r2, inv2 = py.lookup_unique(np.array([777, 888], np.uint64), 9999)
     assert len(r2) == 1 and r2[0] == 9999 and (inv2 == 0).all()
+
+
+def _arena_invariants(kv, chunk_bits, n_slots, keys, slots):
+    rows, locs = kv.assign_slotted(keys, slots)
+    cs_map, cr_map = kv.arena_export()
+    cb = chunk_bits
+    # every row decodes back through (slot, local) and the chunk map
+    assert (locs >= 0).all()
+    chunk_of = rows >> cb
+    np.testing.assert_array_equal(cs_map[chunk_of], slots.astype(np.int32))
+    recon = (cr_map[chunk_of] << cb) | (rows & ((1 << cb) - 1))
+    np.testing.assert_array_equal(recon, locs)
+    # stable on re-assign
+    rows2, locs2 = kv.assign_slotted(keys, slots)
+    np.testing.assert_array_equal(rows, rows2)
+    np.testing.assert_array_equal(locs, locs2)
+    return rows, locs
+
+
+@pytest.mark.parametrize("impl", ["native", "py"])
+def test_arena_slotted_assign_roundtrip(impl):
+    if impl == "native" and load_native() is None:
+        pytest.skip("native lib unavailable")
+    kv = (NativeKV(1 << 12, load_native()) if impl == "native"
+          else PyKV(1 << 12))
+    kv.arena_enable(4, 8)  # 16-row chunks, 8 slots
+    rng = np.random.default_rng(3)
+    keys = rng.integers(0, 500, size=400).astype(np.uint64)
+    slots = (keys % 8).astype(np.uint16)  # slot stable per key
+    _arena_invariants(kv, 4, 8, keys, slots)
+
+
+@pytest.mark.parametrize("impl", ["native", "py"])
+def test_arena_foreign_row_flags_minus_one(impl):
+    if impl == "native" and load_native() is None:
+        pytest.skip("native lib unavailable")
+    kv = (NativeKV(256, load_native()) if impl == "native" else PyKV(256))
+    kv.arena_enable(4, 4)
+    k = np.array([7, 8], np.uint64)
+    kv.assign(k)  # slotless → default arena
+    rows, locs = kv.assign_slotted(k, np.array([1, 2], np.uint16))
+    assert (locs == -1).all()  # foreign rows are flagged, not mislabeled
+    # fresh keys under the right slot are fine
+    rows2, locs2 = kv.assign_slotted(np.array([9], np.uint64),
+                                     np.array([1], np.uint16))
+    assert locs2[0] >= 0
+
+
+@pytest.mark.parametrize("impl", ["native", "py"])
+def test_arena_release_reuses_within_slot(impl):
+    if impl == "native" and load_native() is None:
+        pytest.skip("native lib unavailable")
+    kv = (NativeKV(256, load_native()) if impl == "native" else PyKV(256))
+    kv.arena_enable(3, 4)
+    keys = np.arange(20, dtype=np.uint64)
+    slots = np.full(20, 2, np.uint16)
+    rows, _ = kv.assign_slotted(keys, slots)
+    kv.release(keys[:5])
+    nk = np.arange(100, 105, dtype=np.uint64)
+    nrows, nlocs = kv.assign_slotted(nk, np.full(5, 2, np.uint16))
+    assert set(nrows.tolist()) == set(rows[:5].tolist())  # reused in-slot
+    assert (nlocs >= 0).all()
+
+
+@pytest.mark.parametrize("impl", ["native", "py"])
+def test_arena_assign_unique_slotted(impl):
+    if impl == "native" and load_native() is None:
+        pytest.skip("native lib unavailable")
+    kv = (NativeKV(1 << 10, load_native()) if impl == "native"
+          else PyKV(1 << 10))
+    kv.arena_enable(4, 4)
+    keys = np.array([5, 9, 5, 13, 9, 5], np.uint64)
+    slots = np.array([1, 2, 1, 3, 2, 1], np.uint16)
+    uniq_rows, inv = kv.assign_unique_slotted(keys, slots)
+    assert len(uniq_rows) == 3
+    np.testing.assert_array_equal(uniq_rows[inv],
+                                  kv.assign_slotted(keys, slots)[0])
+    # rows landed in their slots' arenas
+    cs_map, _ = kv.arena_export()
+    _, locs = kv.assign_slotted(keys, slots)
+    assert (locs >= 0).all()
+
+
+def test_arena_enable_after_assign_raises():
+    kv = PyKV(64)
+    kv.assign(np.array([1], np.uint64))
+    with pytest.raises(RuntimeError):
+        kv.arena_enable(4, 4)
+    if load_native() is not None:
+        nv = NativeKV(64, load_native())
+        nv.assign(np.array([1], np.uint64))
+        with pytest.raises(RuntimeError):
+            nv.arena_enable(4, 4)
+
+
+@pytest.mark.parametrize("impl", ["native", "py"])
+def test_arena_out_of_range_slot_clamps_to_default(impl):
+    """Slot ids >= n_slots must clamp to the default arena (local = -1),
+    never index out of bounds."""
+    if impl == "native" and load_native() is None:
+        pytest.skip("native lib unavailable")
+    kv = (NativeKV(256, load_native()) if impl == "native" else PyKV(256))
+    kv.arena_enable(4, 4)
+    rows, locs = kv.assign_slotted(np.array([1, 2], np.uint64),
+                                   np.array([100, 4], np.uint16))
+    assert (locs == -1).all()
+    assert (rows >= 0).all()
+    # in-range keys still work afterwards (no corruption)
+    r2, l2 = kv.assign_slotted(np.array([3], np.uint64),
+                               np.array([1], np.uint16))
+    assert l2[0] >= 0 and len(kv) == 3
