@@ -99,11 +99,6 @@ def pack_delta_auto(values: np.ndarray, num_real: np.ndarray,
             or _pack_delta_from(d, max_exc16, 16))
 
 
-def pack_delta16(values: np.ndarray, num_real: np.ndarray,
-                 max_exceptions: int):
-    """16-bit :func:`pack_delta` (kept for call-site clarity)."""
-    return pack_delta(values, num_real, max_exceptions, bits=16)
-
 
 def unpack_delta16(d16: jax.Array, epos: jax.Array, eext: jax.Array,
                    base: jax.Array) -> jax.Array:

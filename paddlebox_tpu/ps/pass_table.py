@@ -49,6 +49,14 @@ class PassScopedTable(EmbeddingTable):
     def __init__(self, host: HostStore, pass_capacity: Optional[int] = None,
                  cfg: Optional[SparseSGDConfig] = None, seed: int = 0,
                  unique_bucket_min: int = 1024) -> None:
+        from paddlebox_tpu.ps.sgd import opt_ext_width
+        if cfg is not None and opt_ext_width(cfg, host.mf_dim):
+            raise ValueError(
+                "PassScopedTable persists rows through the HostStore "
+                "field schema, which has no optimizer-extension block — "
+                "Adam state would silently reset every pass. Use the "
+                "resident EmbeddingTable for SparseAdam, or extend "
+                "HostStore FIELDS first.")
         super().__init__(mf_dim=host.mf_dim,
                          capacity=pass_capacity or
                          FLAGS.table_capacity_per_shard,

@@ -50,9 +50,33 @@ class SparseSGDConfig:
 
 @dataclasses.dataclass(frozen=True)
 class SparseAdamConfig(SparseSGDConfig):
+    """Selects SparseAdamOptimizer (optimizer.cuh.h:148) —
+    ``shared=True`` selects SparseAdamSharedOptimizer (:330), whose
+    embedx moments are single scalars shared across dims (each dim's
+    update starts from the same stored moment; the stored value becomes
+    the MEAN of the per-dim new moments)."""
+
     beta1_decay_rate: float = 0.9
     beta2_decay_rate: float = 0.999
     ada_epsilon: float = 1e-8
+    shared: bool = False
+
+
+def opt_ext_width(cfg: SparseSGDConfig, mf_dim: int) -> int:
+    """Width of the per-row optimizer EXTENSION block appended after
+    embedx_w in the table row (the optimizer's EmbedDim/EmbedxDim beyond
+    what the base layout already stores — optimizer.cuh.h Dim()).
+
+    Layout (documented here, sliced only by RowState/apply_push):
+      adagrad      → 0 (embed_g2sum/embedx_g2sum base columns suffice)
+      adam         → [embed_gsum, embed_b1p, embed_b2p, emx_b1p,
+                      emx_b2p, emx_m1[mf], emx_m2[mf]]  = 5 + 2*mf
+      adam shared  → [embed_gsum, embed_b1p, embed_b2p, emx_b1p,
+                      emx_b2p, emx_m1, emx_m2]          = 7
+    """
+    if not isinstance(cfg, SparseAdamConfig):
+        return 0
+    return 7 if cfg.shared else 5 + 2 * mf_dim
 
 
 class RowState(NamedTuple):
@@ -66,6 +90,7 @@ class RowState(NamedTuple):
     embedx_w: jax.Array      # [U, mf_dim]
     embedx_g2sum: jax.Array  # [U]
     mf_size: jax.Array       # [U] 0/1 — embedx materialized flag
+    opt_ext: jax.Array       # [U, opt_ext_width] optimizer extension
 
 
 def _adagrad_dir(g: jax.Array, g2sum: jax.Array, scale: jax.Array,
@@ -125,9 +150,123 @@ def adagrad_update(
     mf_size = jnp.where(create, 1.0, rows.mf_size)
 
     upd = RowState(show, clk, delta, embed_w, embed_g2sum, embedx_w,
-                   embedx_g2sum, mf_size)
+                   embedx_g2sum, mf_size, rows.opt_ext)
+    return _mask_untouched(upd, rows, touched)
+
+
+def _mask_untouched(upd: RowState, rows: RowState,
+                    touched: jax.Array) -> RowState:
     t = touched
     return RowState(*[
         jnp.where(t[:, None] if new.ndim == 2 else t, new, old)
         for new, old in zip(upd, rows)
     ])
+
+
+def _adam_dir(w, m1, m2, b1p, b2p, g, scale, cfg: SparseAdamConfig):
+    """One SparseAdam update_lr/update_mf (optimizer.cuh.h:159-236) over
+    [U] or [U, n] grads with per-row (m1, m2 matching g's shape) moments
+    and scalar beta powers. Returns (new_w, new_m1, new_m2, new_b1p,
+    new_b2p). Both directions use cfg.learning_rate and the mf bounds —
+    mirroring the reference exactly (update_lr clips with mf_min/max and
+    reads optimizer_config.learning_rate)."""
+    b1, b2 = cfg.beta1_decay_rate, cfg.beta2_decay_rate
+    ratio = (cfg.learning_rate * jnp.sqrt(1.0 - b2p)
+             / (1.0 - b1p))
+    safe = jnp.maximum(scale, 1e-20)
+    scaled = g / (safe[..., None] if g.ndim == 2 else safe)
+    new_m1 = b1 * m1 + (1.0 - b1) * scaled
+    new_m2 = b2 * m2 + (1.0 - b2) * scaled * scaled
+    step = new_m1 / (jnp.sqrt(new_m2) + cfg.ada_epsilon)
+    r = ratio[..., None] if g.ndim == 2 else ratio
+    new_w = jnp.clip(w + r * step, cfg.mf_min_bound, cfg.mf_max_bound)
+    return new_w, new_m1, new_m2, b1p * b1, b2p * b2
+
+
+def adam_update(
+    rows: RowState,
+    g_show: jax.Array,    # [U]
+    g_clk: jax.Array,     # [U]
+    g_embed: jax.Array,   # [U]
+    g_embedx: jax.Array,  # [U, mf_dim]
+    touched: jax.Array,   # [U] bool
+    cfg: SparseAdamConfig,
+    rng: jax.Array,
+) -> RowState:
+    """Batched SparseAdam[Shared]Optimizer::dy_mf_update_value
+    (optimizer.cuh.h:244-273 / :395-446). Per-row beta powers live in
+    the opt_ext block (see opt_ext_width); a beta power of 0 with
+    show == 0 marks a never-initialized row, whose powers behave as the
+    creation value (beta itself) — trained rows whose powers underflow
+    to 0 keep show > 0 and are NOT re-initialized (they are exactly the
+    fully-bias-corrected regime, as in the reference)."""
+    b1, b2 = cfg.beta1_decay_rate, cfg.beta2_decay_rate
+    mf = rows.embedx_w.shape[1]
+    ext = rows.opt_ext
+    e_gsum, e_b1p, e_b2p = ext[:, 0], ext[:, 1], ext[:, 2]
+    x_b1p, x_b2p = ext[:, 3], ext[:, 4]
+    if cfg.shared:
+        x_m1 = ext[:, 5:6]     # scalar moments broadcast over dims
+        x_m2 = ext[:, 6:7]
+    else:
+        x_m1 = ext[:, 5:5 + mf]
+        x_m2 = ext[:, 5 + mf:5 + 2 * mf]
+
+    show = rows.show + g_show
+    clk = rows.clk + g_clk
+    delta = rows.delta_score + cfg.nonclk_coeff * (g_show - g_clk) \
+        + cfg.clk_coeff * g_clk
+
+    # embed (lr) direction — n=1 scalars
+    fresh = (rows.show == 0) & (e_b1p == 0)
+    eb1p = jnp.where(fresh, b1, e_b1p)
+    eb2p = jnp.where(fresh, b2, e_b2p)
+    # (shared variant: the stored moment is the mean of new moments —
+    # n=1 for the embed direction, so mean == value, same code path)
+    embed_w, e_gsum_n, e_g2sum_n, eb1p_n, eb2p_n = _adam_dir(
+        rows.embed_w, e_gsum, rows.embed_g2sum, eb1p, eb2p,
+        g_embed, g_show, cfg)
+
+    # embedx (mf) direction: update existing, lazily create the rest
+    if cfg.shared:
+        upd_w, m1_full, m2_full, xb1p_n, xb2p_n = _adam_dir(
+            rows.embedx_w, x_m1, x_m2, x_b1p, x_b2p,
+            g_embedx, g_show, cfg)
+        m1_n = jnp.mean(m1_full, axis=1, keepdims=True)
+        m2_n = jnp.mean(m2_full, axis=1, keepdims=True)
+    else:
+        upd_w, m1_n, m2_n, xb1p_n, xb2p_n = _adam_dir(
+            rows.embedx_w, x_m1, x_m2, x_b1p, x_b2p,
+            g_embedx, g_show, cfg)
+    has_mf = rows.mf_size > 0
+    score = cfg.nonclk_coeff * (show - clk) + cfg.clk_coeff * clk
+    create = (~has_mf) & (score >= cfg.mf_create_thresholds)
+    init = jax.random.uniform(rng, rows.embedx_w.shape,
+                              rows.embedx_w.dtype) * cfg.mf_initial_range
+    embedx_w = jnp.where(create[:, None], init,
+                         jnp.where(has_mf[:, None], upd_w, rows.embedx_w))
+    # on creation the reference writes the beta powers = decay rates
+    # (optimizer.cuh.h:285-289); moments start at 0
+    x_m1_out = jnp.where(has_mf[:, None], m1_n, x_m1)
+    x_m2_out = jnp.where(has_mf[:, None], m2_n, x_m2)
+    xb1p_out = jnp.where(create, b1, jnp.where(has_mf, xb1p_n, x_b1p))
+    xb2p_out = jnp.where(create, b2, jnp.where(has_mf, xb2p_n, x_b2p))
+    mf_size = jnp.where(create, 1.0, rows.mf_size)
+
+    ext_new = jnp.concatenate(
+        [e_gsum_n[:, None], eb1p_n[:, None], eb2p_n[:, None],
+         xb1p_out[:, None], xb2p_out[:, None], x_m1_out, x_m2_out], axis=1)
+    upd = RowState(show, clk, delta, embed_w, e_g2sum_n, embedx_w,
+                   rows.embedx_g2sum, mf_size, ext_new)
+    return _mask_untouched(upd, rows, touched)
+
+
+def sparse_update(rows: RowState, g_show, g_clk, g_embed, g_embedx,
+                  touched, cfg: SparseSGDConfig, rng) -> RowState:
+    """Dispatch to the configured in-table optimizer (the OptimizerType
+    selection of heter_ps — adagrad / adam / adam-shared)."""
+    if isinstance(cfg, SparseAdamConfig):
+        return adam_update(rows, g_show, g_clk, g_embed, g_embedx,
+                           touched, cfg, rng)
+    return adagrad_update(rows, g_show, g_clk, g_embed, g_embedx,
+                          touched, cfg, rng)

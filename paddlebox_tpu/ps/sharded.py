@@ -92,11 +92,13 @@ class ShardedEmbeddingTable:
         self.mf_dim = mf_dim
         self.capacity = capacity_per_shard or FLAGS.table_capacity_per_shard
         self.cfg = cfg or SparseSGDConfig()
+        from paddlebox_tpu.ps.sgd import opt_ext_width
+        self.opt_ext = opt_ext_width(self.cfg, mf_dim)
         self.indexes = [HostKV(self.capacity) for _ in range(num_shards)]
         self.req_bucket_min = req_bucket_min
         self.serve_bucket_min = serve_bucket_min
         # stacked state [N, L, 128] — sharded over the mesh axis
-        single = init_table_state(self.capacity, mf_dim)
+        single = init_table_state(self.capacity, mf_dim, ext=self.opt_ext)
         self.state = single.with_packed(
             jnp.broadcast_to(single.packed[None],
                              (num_shards,) + single.packed.shape).copy())
@@ -280,7 +282,8 @@ class ShardedEmbeddingTable:
             data = np.asarray(jax.device_get(self.state.data)).copy()
         else:
             data = np.zeros(
-                (self.n, self.capacity + 1, NUM_FIXED + self.mf_dim),
+                (self.n, self.capacity + 1,
+                 NUM_FIXED + self.mf_dim + self.opt_ext),
                 np.float32)
             self.indexes = [HostKV(self.capacity) for _ in range(self.n)]
             self._touched[:] = False
@@ -291,5 +294,6 @@ class ShardedEmbeddingTable:
             for f in FIELDS:
                 field_assign(data[s], rows, f, blob[f"{f}_{s}"])
             total += len(keys)
-        self.state = TableState.from_logical(data, self.capacity)
+        self.state = TableState.from_logical(data, self.capacity,
+                                             ext=self.opt_ext)
         return total

@@ -34,7 +34,8 @@ import numpy as np
 from paddlebox_tpu.config import FLAGS
 from paddlebox_tpu.data.batch import SlotBatch
 from paddlebox_tpu.ops.pallas_kernels import gather_rows
-from paddlebox_tpu.ps.sgd import RowState, SparseSGDConfig, adagrad_update
+from paddlebox_tpu.ps.sgd import (RowState, SparseSGDConfig,
+                                  opt_ext_width, sparse_update)
 from paddlebox_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -105,29 +106,33 @@ class TableState:
     layouts from FIELDS/TWO_D_FIELDS below; host code converts with
     pack_host/unpack_host (or the ``.data`` logical property)."""
 
-    def __init__(self, packed: jax.Array, capacity: int, feat: int) -> None:
+    def __init__(self, packed: jax.Array, capacity: int, feat: int,
+                 ext: int = 0) -> None:
         self.packed = packed
         self._capacity = int(capacity)
         self._feat = int(feat)
+        # optimizer extension width appended after embedx_w
+        # (ps/sgd.opt_ext_width): feat = NUM_FIXED + mf_dim + ext
+        self._ext = int(ext)
 
     @classmethod
-    def from_logical(cls, data, capacity: Optional[int] = None
-                     ) -> "TableState":
+    def from_logical(cls, data, capacity: Optional[int] = None,
+                     ext: int = 0) -> "TableState":
         """Build from a logical [..., C+1, F] matrix (host np or jnp)."""
         cap = data.shape[-2] - 1 if capacity is None else capacity
         feat = data.shape[-1]
         packed = pack_host(np.asarray(data), cap, feat)
-        return cls(jnp.asarray(packed), cap, feat)
+        return cls(jnp.asarray(packed), cap, feat, ext)
 
     def tree_flatten(self):
-        return (self.packed,), (self._capacity, self._feat)
+        return (self.packed,), (self._capacity, self._feat, self._ext)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         return cls(children[0], *aux)
 
     def with_packed(self, packed: jax.Array) -> "TableState":
-        return TableState(packed, self._capacity, self._feat)
+        return TableState(packed, self._capacity, self._feat, self._ext)
 
     @property
     def geometry(self):
@@ -177,15 +182,23 @@ class TableState:
 
     @property
     def embedx_w(self) -> jax.Array:
-        return self.data[..., NUM_FIXED:]
+        return self.data[..., NUM_FIXED:NUM_FIXED + self.mf_dim]
+
+    @property
+    def opt_ext(self) -> jax.Array:
+        return self.data[..., NUM_FIXED + self.mf_dim:]
 
     @property
     def capacity(self) -> int:
         return self._capacity
 
     @property
+    def ext(self) -> int:
+        return self._ext
+
+    @property
     def mf_dim(self) -> int:
-        return self._feat - NUM_FIXED
+        return self._feat - NUM_FIXED - self._ext
 
 
 # field-name → column mapping (host mirrors and save files use names)
@@ -206,9 +219,11 @@ def field_slice(data, name: str):
 def field_assign(data: np.ndarray, rows: np.ndarray, name: str,
                  values: np.ndarray) -> None:
     """Write counterpart of field_slice: data[rows, <field cols>] = values.
-    The single place that knows which fields are the embedx block."""
+    The single place that knows which fields are the embedx block (whose
+    width follows the values — tables with an optimizer extension write
+    mf-only blocks, field_slice round-trips write the full tail)."""
     if name == "embedx_w":
-        data[rows, NUM_FIXED:] = values
+        data[rows, NUM_FIXED:NUM_FIXED + values.shape[-1]] = values
     else:
         data[rows, FIELD_COL[name]] = values
 
@@ -249,10 +264,11 @@ from paddlebox_tpu.ps.kv import make_kv as HostKV  # noqa: N813
 
 
 def init_table_state(capacity: int, mf_dim: int,
-                     dtype=jnp.float32) -> TableState:
-    feat = NUM_FIXED + mf_dim
+                     dtype=jnp.float32, ext: int = 0) -> TableState:
+    feat = NUM_FIXED + mf_dim + ext
     _, _, n_lines = pack_geometry(capacity, feat)
-    return TableState(jnp.zeros((n_lines, 128), dtype), capacity, feat)
+    return TableState(jnp.zeros((n_lines, 128), dtype), capacity, feat,
+                      ext)
 
 
 def gather_full_rows(state: TableState, unique_rows: jax.Array) -> jax.Array:
@@ -277,12 +293,16 @@ def gather_full_rows(state: TableState, unique_rows: jax.Array) -> jax.Array:
     return vals[:, :state._feat] if fp != state._feat else vals
 
 
-def pull_values(rows_full: jax.Array) -> jax.Array:
+def pull_values(rows_full: jax.Array,
+                mf_dim: Optional[int] = None) -> jax.Array:
     """Pull-value view of gathered rows → [U, 3+mf_dim] laid out as
     [show, clk, embed_w, embedx…] (FeaturePullValue, feature_value.h:161).
-    Non-materialized mf (mf_size==0) reads as zeros, as in CopyForPull."""
+    Non-materialized mf (mf_size==0) reads as zeros, as in CopyForPull.
+    ``mf_dim`` must be passed for tables with an optimizer extension
+    block (defaults to everything after the fixed columns)."""
     gate = (rows_full[:, 7] > 0).astype(rows_full.dtype)
-    mf = rows_full[:, NUM_FIXED:] * gate[:, None]
+    end = rows_full.shape[1] if mf_dim is None else NUM_FIXED + mf_dim
+    mf = rows_full[:, NUM_FIXED:end] * gate[:, None]
     return jnp.concatenate(
         [rows_full[:, 0:2], rows_full[:, 4:5], mf], axis=1)
 
@@ -290,7 +310,7 @@ def pull_values(rows_full: jax.Array) -> jax.Array:
 def pull_rows(state: TableState, unique_rows: jax.Array) -> jax.Array:
     """gather_full_rows + pull_values (kept for callers that don't reuse
     the full rows for the push)."""
-    return pull_values(gather_full_rows(state, unique_rows))
+    return pull_values(gather_full_rows(state, unique_rows), state.mf_dim)
 
 
 def expand_pull(values_u: jax.Array, gather_idx: jax.Array) -> jax.Array:
@@ -358,16 +378,19 @@ def apply_push(
         touched = unique_rows <= state.capacity
     if rows_full is None:
         rows_full = gather_full_rows(state, unique_rows)
+    mf_dim = state.mf_dim
+    mf_end = NUM_FIXED + mf_dim
     rows = RowState(
         show=rows_full[:, 0], clk=rows_full[:, 1],
         delta_score=rows_full[:, 2],
         embed_w=rows_full[:, 4], embed_g2sum=rows_full[:, 5],
-        embedx_w=rows_full[:, NUM_FIXED:], embedx_g2sum=rows_full[:, 6],
+        embedx_w=rows_full[:, NUM_FIXED:mf_end],
+        embedx_g2sum=rows_full[:, 6],
         mf_size=rows_full[:, 7],
+        opt_ext=rows_full[:, mf_end:],
     )
-    mf_dim = state.mf_dim
-    new = adagrad_update(rows, g[:, 0], g[:, 1], g[:, 2], g[:, 3:3 + mf_dim],
-                         touched, cfg, rng)
+    new = sparse_update(rows, g[:, 0], g[:, 1], g[:, 2], g[:, 3:3 + mf_dim],
+                        touched, cfg, rng)
     if slot_val is None:
         slot_new = rows_full[:, 3]
     else:
@@ -376,6 +399,7 @@ def apply_push(
         new.show[:, None], new.clk[:, None], new.delta_score[:, None],
         slot_new[:, None], new.embed_w[:, None], new.embed_g2sum[:, None],
         new.embedx_g2sum[:, None], new.mf_size[:, None], new.embedx_w,
+        new.opt_ext,
     ], axis=1)
     rpl, fp, _ = state.geometry
     u = new_mat.shape[0]
@@ -416,12 +440,14 @@ class EmbeddingTable:
         self.mf_dim = mf_dim
         self.capacity = capacity or FLAGS.table_capacity_per_shard
         self.cfg = cfg or SparseSGDConfig()
+        self.opt_ext = opt_ext_width(self.cfg, mf_dim)
         self.index = HostKV(self.capacity)
         self.arena_slots = arena_slots
         self.arena_chunk_bits = arena_chunk_bits
         if arena_slots is not None:
             self.index.arena_enable(arena_chunk_bits, arena_slots)
-        self.state = init_table_state(self.capacity, mf_dim)
+        self.state = init_table_state(self.capacity, mf_dim,
+                                      ext=self.opt_ext)
         self._rng = jax.random.PRNGKey(seed)
         self._push_count = 0
         self.unique_bucket_min = unique_bucket_min
@@ -517,8 +543,13 @@ class EmbeddingTable:
         independent of the device AoS layout). The slot field comes from
         host metadata — the device column is not maintained."""
         data = np.asarray(jax.device_get(self.state.data))
-        out = {f: field_slice(data[rows], f) for f in FIELDS}
+        sub = data[rows]
+        mf_end = NUM_FIXED + self.mf_dim
+        out = {f: (sub[:, NUM_FIXED:mf_end] if f == "embedx_w"
+                   else field_slice(sub, f)) for f in FIELDS}
         out["slot"] = self.slot_host[rows].astype(np.float32)
+        if self.opt_ext:
+            out["opt_ext"] = sub[:, mf_end:]
         return out
 
     def save_base(self, path: str) -> int:
@@ -554,7 +585,8 @@ class EmbeddingTable:
                 if self.arena_slots is not None:
                     self.index.arena_enable(self.arena_chunk_bits,
                                             self.arena_slots)
-                self.state = init_table_state(self.capacity, self.mf_dim)
+                self.state = init_table_state(self.capacity, self.mf_dim,
+                                              ext=self.opt_ext)
                 self._touched[:] = False
                 self.slot_host[:] = 0
             slots_b = blob["slot"].astype(np.int16)
@@ -569,11 +601,23 @@ class EmbeddingTable:
                 rows = self.index.assign(keys)
             self.slot_host[rows] = slots_b
         data = np.asarray(jax.device_get(self.state.data)).copy()
+        mf_end = NUM_FIXED + self.mf_dim
         for f in FIELDS:
             if f == "slot":
                 continue  # host metadata (slot_host); device col stays 0
-            field_assign(data, rows, f, blob[f])
-        self.state = TableState.from_logical(data, self.capacity)
+            if f == "embedx_w":
+                data[np.ix_(rows, range(NUM_FIXED, mf_end))] = blob[f]
+            else:
+                field_assign(data, rows, f, blob[f])
+        if self.opt_ext:
+            if "opt_ext" in blob and blob["opt_ext"].shape[1] == self.opt_ext:
+                data[np.ix_(rows, range(mf_end, mf_end + self.opt_ext))] = \
+                    blob["opt_ext"]
+            else:
+                log.warning("load: file has no matching opt_ext block; "
+                            "optimizer state starts fresh for loaded rows")
+        self.state = TableState.from_logical(data, self.capacity,
+                                             ext=self.opt_ext)
         return len(keys)
 
     def shrink(self, delete_threshold: Optional[float] = None,
@@ -597,7 +641,8 @@ class EmbeddingTable:
             drop_keys = keys[drop]
             freed_rows = self.index.release(drop_keys)
             data[freed_rows] = 0.0
-            self.state = TableState.from_logical(data, self.capacity)
+            self.state = TableState.from_logical(data, self.capacity,
+                                                 ext=self.opt_ext)
             self._touched[freed_rows] = False
             self.slot_host[freed_rows] = 0
         log.info("shrink: freed %d/%d rows", len(freed_rows), len(keys))

@@ -111,10 +111,11 @@ class ServingModel:
         rows_c = np.minimum(rows, self.table.capacity)  # OOB pads clamp
         vals = data[rows_c]
         gate = (vals[:, FIELD_COL["mf_size"]:FIELD_COL["mf_size"] + 1] > 0)
+        mf_end = NUM_FIXED + self.table.mf_dim
         out = np.concatenate(
             [vals[:, FIELD_COL["show"]:FIELD_COL["clk"] + 1],
              vals[:, FIELD_COL["embed_w"]:FIELD_COL["embed_w"] + 1],
-             vals[:, NUM_FIXED:] * gate], axis=1)
+             vals[:, NUM_FIXED:mf_end] * gate], axis=1)
         return out[inv]
 
     def predict(self, batch: SlotBatch,

@@ -193,7 +193,7 @@ class ShardedTrainStep:
         # ---- pull: serve my rows, exchange, reassemble ----
         # one AoS gather serves the pull AND the push optimizer state
         rows_full = gather_full_rows(table, serve_rows)    # [A2, F]
-        serve_vals = pull_values(rows_full)                # [A2, D]
+        serve_vals = pull_values(rows_full, table.mf_dim)  # [A2, D]
         resp = serve_vals[resp_idx]                        # [N, A, D]
         recv = jax.lax.all_to_all(resp, DATA_AXIS, 0, 0, tiled=True)
         vals_flat = recv.reshape(n * a, d)
@@ -290,7 +290,8 @@ class ShardedTrainStep:
         a = resp_idx.shape[1]
         d = 3 + table.mf_dim
 
-        serve_vals = pull_values(gather_full_rows(table, serve_rows))
+        serve_vals = pull_values(gather_full_rows(table, serve_rows),
+                                 table.mf_dim)
         resp = serve_vals[resp_idx]
         recv = jax.lax.all_to_all(resp, DATA_AXIS, 0, 0, tiled=True)
         vals_flat = recv.reshape(n * a, d)

@@ -191,7 +191,8 @@ def ctr_forward(table: TableState, params: Any, model, batch,
     the seqpool constants live in exactly one place. Returns
     (pred [B], ins_w [B]) — ins_w masks batch-padding instances."""
     batch_show_clk = jnp.stack([batch.show, batch.clk], axis=1)
-    vals_u = pull_values(gather_full_rows(table, batch.unique_rows))
+    vals_u = pull_values(gather_full_rows(table, batch.unique_rows),
+                         table.mf_dim)
     values_k = expand_pull(vals_u, batch.gather_idx)
     segs = getattr(batch, "pool_segments", batch.segments)
     pooled = fused_seqpool_cvm(
@@ -262,7 +263,7 @@ class TrainStep:
         # ONE gather serves both the pull values and the push optimizer
         # state (AoS rows — see TableState)
         rows_full = gather_full_rows(state.table, batch.unique_rows)
-        vals_u = pull_values(rows_full)
+        vals_u = pull_values(rows_full, state.table.mf_dim)
 
         pool_segs = getattr(batch, "pool_segments", batch.segments)
 

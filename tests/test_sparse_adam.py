@@ -1,0 +1,219 @@
+"""SparseAdam / SparseAdamShared in-table optimizers: numeric parity with
+a numpy transcription of the reference CUDA math (optimizer.cuh.h:148-477)
+plus e2e training and save/load of the optimizer extension block."""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.data import DataFeedDesc, DatasetFactory
+from paddlebox_tpu.data.criteo import generate_criteo_files
+from paddlebox_tpu.models import DeepFM
+from paddlebox_tpu.ps import EmbeddingTable, SparseAdamConfig
+from paddlebox_tpu.ps.sgd import RowState, adam_update, opt_ext_width
+from paddlebox_tpu.train import Trainer
+
+
+def _np_adam_dir(w, m1, m2, b1p, b2p, g, scale, cfg):
+    """update_lr/update_mf (optimizer.cuh.h:159-236), numpy, one row."""
+    eps = cfg.ada_epsilon
+    ratio = cfg.learning_rate * np.sqrt(1.0 - b2p) / (1.0 - b1p)
+    w, m1, m2 = w.copy(), m1.copy(), m2.copy()
+    for i in range(len(w)):
+        scaled = g[i] / scale
+        m1[i] = cfg.beta1_decay_rate * m1[i] \
+            + (1 - cfg.beta1_decay_rate) * scaled
+        m2[i] = cfg.beta2_decay_rate * m2[i] \
+            + (1 - cfg.beta2_decay_rate) * scaled * scaled
+        w[i] = np.clip(w[i] + ratio * (m1[i] / (np.sqrt(m2[i]) + eps)),
+                       cfg.mf_min_bound, cfg.mf_max_bound)
+    return w, m1, m2, b1p * cfg.beta1_decay_rate, \
+        b2p * cfg.beta2_decay_rate
+
+
+def _np_adam_shared_dir(w, m1s, m2s, b1p, b2p, g, scale, cfg):
+    """update_value_work (optimizer.cuh.h:340-386), numpy, one row —
+    scalar moments shared across dims, stored value = mean of new."""
+    eps = cfg.ada_epsilon
+    ratio = cfg.learning_rate * np.sqrt(1.0 - b2p) / (1.0 - b1p)
+    w = w.copy()
+    n = len(w)
+    sum1 = sum2 = 0.0
+    for i in range(n):
+        scaled = g[i] / scale
+        nm1 = cfg.beta1_decay_rate * m1s + (1 - cfg.beta1_decay_rate) * scaled
+        nm2 = cfg.beta2_decay_rate * m2s \
+            + (1 - cfg.beta2_decay_rate) * scaled * scaled
+        w[i] = np.clip(w[i] + ratio * (nm1 / (np.sqrt(nm2) + eps)),
+                       cfg.mf_min_bound, cfg.mf_max_bound)
+        sum1 += nm1
+        sum2 += nm2
+    return w, sum1 / n, sum2 / n, b1p * cfg.beta1_decay_rate, \
+        b2p * cfg.beta2_decay_rate
+
+
+def _row_state(mf, ext, u=3):
+    z = lambda *s: jnp.zeros(s, jnp.float32)
+    return RowState(show=z(u), clk=z(u), delta_score=z(u),
+                    embed_w=z(u), embed_g2sum=z(u),
+                    embedx_w=z(u, mf), embedx_g2sum=z(u),
+                    mf_size=jnp.ones(u), opt_ext=z(u, ext))
+
+
+@pytest.mark.parametrize("shared", [False, True])
+def test_adam_update_matches_numpy(shared):
+    mf = 4
+    cfg = SparseAdamConfig(shared=shared, mf_create_thresholds=1e9,
+                           learning_rate=0.01)
+    ext = opt_ext_width(cfg, mf)
+    rng = np.random.default_rng(0)
+    u = 3
+    rows = _row_state(mf, ext, u)
+    # pre-seeded state: nonzero weights/moments/pows (a mid-training row)
+    embed_w = rng.normal(size=u).astype(np.float32)
+    embedx_w = rng.normal(size=(u, mf)).astype(np.float32)
+    ext0 = np.zeros((u, ext), np.float32)
+    ext0[:, 0] = rng.normal(size=u) * 0.1          # embed gsum (m1)
+    eg2 = np.abs(rng.normal(size=u)).astype(np.float32) * 0.1  # embed m2
+    ext0[:, 1] = 0.9 ** 3                          # embed b1p
+    ext0[:, 2] = 0.999 ** 3                        # embed b2p
+    ext0[:, 3] = 0.9 ** 2                          # embedx b1p
+    ext0[:, 4] = 0.999 ** 2                        # embedx b2p
+    if shared:
+        ext0[:, 5] = rng.normal(size=u) * 0.1
+        ext0[:, 6] = np.abs(rng.normal(size=u)) * 0.1
+    else:
+        ext0[:, 5:5 + mf] = rng.normal(size=(u, mf)) * 0.1
+        ext0[:, 5 + mf:] = np.abs(rng.normal(size=(u, mf))) * 0.1
+    rows = rows._replace(
+        show=jnp.asarray(rng.uniform(1, 5, u).astype(np.float32)),
+        embed_w=jnp.asarray(embed_w), embed_g2sum=jnp.asarray(eg2),
+        embedx_w=jnp.asarray(embedx_w), opt_ext=jnp.asarray(ext0))
+    g_show = rng.uniform(1, 3, u).astype(np.float32)
+    g_clk = rng.uniform(0, 1, u).astype(np.float32)
+    g_embed = rng.normal(size=u).astype(np.float32)
+    g_embedx = rng.normal(size=(u, mf)).astype(np.float32)
+    out = adam_update(rows, jnp.asarray(g_show), jnp.asarray(g_clk),
+                      jnp.asarray(g_embed), jnp.asarray(g_embedx),
+                      jnp.ones(u, bool), cfg, jax.random.PRNGKey(0))
+    out = jax.device_get(out)
+    for r in range(u):
+        # embed direction (n=1); g2sum column doubles as adam m2
+        w_ref, m1_ref, m2_ref, b1p_ref, b2p_ref = _np_adam_dir(
+            np.array([embed_w[r]]), np.array([ext0[r, 0]]),
+            np.array([eg2[r]]), ext0[r, 1], ext0[r, 2],
+            np.array([g_embed[r]]), g_show[r], cfg)
+        np.testing.assert_allclose(out.embed_w[r], w_ref[0], rtol=2e-5)
+        np.testing.assert_allclose(out.opt_ext[r, 0], m1_ref[0], rtol=2e-5)
+        np.testing.assert_allclose(out.embed_g2sum[r], m2_ref[0],
+                                   rtol=2e-5)
+        np.testing.assert_allclose(out.opt_ext[r, 1], b1p_ref, rtol=1e-6)
+        np.testing.assert_allclose(out.opt_ext[r, 2], b2p_ref, rtol=1e-6)
+        # embedx direction
+        if shared:
+            xw, xm1, xm2, xb1, xb2 = _np_adam_shared_dir(
+                embedx_w[r], ext0[r, 5], ext0[r, 6], ext0[r, 3],
+                ext0[r, 4], g_embedx[r], g_show[r], cfg)
+            np.testing.assert_allclose(out.opt_ext[r, 5], xm1, rtol=2e-5)
+            np.testing.assert_allclose(out.opt_ext[r, 6], xm2, rtol=2e-5)
+        else:
+            xw, xm1, xm2, xb1, xb2 = _np_adam_dir(
+                embedx_w[r], ext0[r, 5:5 + mf], ext0[r, 5 + mf:],
+                ext0[r, 3], ext0[r, 4], g_embedx[r], g_show[r], cfg)
+            np.testing.assert_allclose(out.opt_ext[r, 5:5 + mf], xm1,
+                                       rtol=2e-5)
+            np.testing.assert_allclose(out.opt_ext[r, 5 + mf:], xm2,
+                                       rtol=2e-5)
+        np.testing.assert_allclose(out.embedx_w[r], xw, rtol=2e-5)
+        np.testing.assert_allclose(out.opt_ext[r, 3], xb1, rtol=1e-6)
+        np.testing.assert_allclose(out.opt_ext[r, 4], xb2, rtol=1e-6)
+
+
+def test_adam_fresh_row_uses_creation_pows():
+    """A never-touched row (show == 0, pows == 0) behaves as if its beta
+    powers were initialized to the decay rates; mf creation writes the
+    decay rates into the embedx pows (optimizer.cuh.h:285-289)."""
+    mf = 2
+    cfg = SparseAdamConfig(mf_create_thresholds=0.0, mf_initial_range=0.0)
+    ext = opt_ext_width(cfg, mf)
+    rows = _row_state(mf, ext, u=1)._replace(mf_size=jnp.zeros(1))
+    out = adam_update(rows, jnp.ones(1), jnp.ones(1), jnp.ones(1) * 0.5,
+                      jnp.ones((1, mf)), jnp.ones(1, bool), cfg,
+                      jax.random.PRNGKey(1))
+    b1, b2 = cfg.beta1_decay_rate, cfg.beta2_decay_rate
+    np.testing.assert_allclose(out.opt_ext[0, 1], b1 * b1, rtol=1e-6)
+    np.testing.assert_allclose(out.opt_ext[0, 2], b2 * b2, rtol=1e-6)
+    # mf was created this step: pows = decay rates, moments untouched
+    assert float(out.mf_size[0]) == 1.0
+    np.testing.assert_allclose(out.opt_ext[0, 3], b1, rtol=1e-6)
+    np.testing.assert_allclose(out.opt_ext[0, 4], b2, rtol=1e-6)
+    np.testing.assert_allclose(out.opt_ext[0, 5:], 0.0)
+
+
+@pytest.fixture(scope="module")
+def criteo_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("criteo_adam")
+    return generate_criteo_files(str(d), num_files=2, rows_per_file=1500,
+                                 vocab_per_slot=40, seed=11)
+
+
+def _make(files, cfg):
+    desc = DataFeedDesc.criteo(batch_size=128)
+    desc.key_bucket_min = 4096
+    ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+    ds.set_filelist(files)
+    ds.set_thread(2)
+    ds.load_into_memory()
+    table = EmbeddingTable(mf_dim=4, capacity=1 << 13, cfg=cfg,
+                           unique_bucket_min=4096)
+    tr = Trainer(DeepFM(hidden=(16, 8)), table, desc, tx=optax.adam(1e-2),
+                 seed=3)
+    return tr, ds
+
+
+@pytest.mark.parametrize("shared", [False, True])
+def test_adam_e2e_learns(criteo_files, shared):
+    cfg = SparseAdamConfig(shared=shared, mf_create_thresholds=0.0,
+                           mf_initial_range=0.0, learning_rate=0.02)
+    tr, ds = _make(criteo_files, cfg)
+    first = tr.train_pass(ds)
+    tr.reset_metrics()
+    for _ in range(3):
+        last = tr.train_pass(ds)
+    assert np.isfinite(last["auc"])
+    assert last["auc"] > max(first["auc"], 0.55)
+
+
+def test_adam_resident_matches_streaming(criteo_files):
+    cfg = SparseAdamConfig(mf_create_thresholds=0.0, mf_initial_range=0.0,
+                           learning_rate=0.02)
+    tr_a, ds = _make(criteo_files, cfg)
+    tr_b, _ = _make(criteo_files, cfg)
+    ra = [tr_a.train_pass(ds) for _ in range(2)][-1]
+    rb = [tr_b.train_pass_resident(ds) for _ in range(2)][-1]
+    assert np.isclose(rb["auc"], ra["auc"], atol=2e-3)
+
+
+def test_adam_save_load_roundtrip(criteo_files, tmp_path):
+    cfg = SparseAdamConfig(mf_create_thresholds=0.0, mf_initial_range=0.0)
+    tr, ds = _make(criteo_files, cfg)
+    tr.train_pass(ds)
+    path = str(tmp_path / "adam_base.npz")
+    tr.table.save_base(path)
+    t2 = EmbeddingTable(mf_dim=4, capacity=1 << 13, cfg=cfg,
+                        unique_bucket_min=4096)
+    t2.load(path)
+    keys, rows1 = tr.table.index.items()
+    rows2 = t2.index.lookup(keys)
+    d1 = np.asarray(jax.device_get(tr.table.state.data))
+    d2 = np.asarray(jax.device_get(t2.state.data))
+    # full row parity including the optimizer extension block (slot col
+    # lives host-side)
+    cols = [c for c in range(d1.shape[1]) if c != 3]
+    np.testing.assert_allclose(d1[np.ix_(rows1, cols)],
+                               d2[np.ix_(rows2, cols)], rtol=1e-6)
+    np.testing.assert_array_equal(tr.table.slot_host[rows1],
+                                  t2.slot_host[rows2])
