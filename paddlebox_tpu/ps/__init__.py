@@ -1,4 +1,5 @@
 from paddlebox_tpu.ps.sgd import SparseSGDConfig, SparseAdamConfig
+from paddlebox_tpu.ps.multi_mf import MultiMfEmbeddingTable
 from paddlebox_tpu.ps.table import (
     EmbeddingTable, TableState, PullIndex, pull_rows, expand_pull,
     apply_push, merge_push, push_stats, init_table_state,
@@ -10,6 +11,7 @@ from paddlebox_tpu.ps.extended import ExtendedEmbeddingTable
 from paddlebox_tpu.ps.replica_cache import InputTable, ReplicaCache
 
 __all__ = ["SparseSGDConfig", "SparseAdamConfig", "EmbeddingTable",
+           "MultiMfEmbeddingTable",
            "TableState", "PullIndex", "pull_rows", "expand_pull",
            "apply_push", "merge_push", "push_stats", "init_table_state",
            "HostStore", "PassScopedTable", "BoxPSHelper",

@@ -1,0 +1,185 @@
+"""Per-slot embedding dims (multi_mf_dim) — dim-class sharded tables.
+
+Reference: ``CommonFeatureValueAccessor`` stores a per-feature ``mf_dim``
+and lays every value out dynamically (feature_value.h:42-185); the build
+pipeline groups keys by their slot's dim class (``multi_mf_dim_`` paths in
+ps_gpu_wrapper.cc BuildGPUTask) and the pull/push copy kernels
+(``CopyForPull/CopyForPush`` dy_mf variants) read per-slot widths.
+
+TPU-native redesign: dynamic per-row widths are hostile to XLA (no static
+shapes, ragged gathers), but the DIMENSIONALITY only varies by SLOT, and
+slots partition the key space. So: one full :class:`EmbeddingTable` per
+DIM CLASS (each with its static row width, packed-line layout, optimizer
+and slot arena), a per-slot class map, and a batch splitter that routes
+each key to its class sub-batch. Gather/scatter cost on TPU is per INDEX,
+so C class-wise pulls cost the same total as one mixed pull — the only
+overhead is C small dispatches. Pooled outputs keep their per-slot widths
+and concatenate in canonical slot order (the fused_seqpool_cvm +
+concat contract downstream of pull_gpups_sparse)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.data.batch import SlotBatch
+from paddlebox_tpu.ps.sgd import SparseSGDConfig
+from paddlebox_tpu.ps.table import (EmbeddingTable, PullIndex,
+                                    next_bucket)
+from paddlebox_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class ClassBatch:
+    """One dim class's slice of a batch: a synthetic SlotBatch over the
+    class's slots (S_c bins) plus its PullIndex."""
+
+    batch: SlotBatch
+    index: PullIndex
+
+
+class MultiMfEmbeddingTable:
+    """Facade over one EmbeddingTable per distinct slot mf_dim.
+
+    ``slot_mf_dims[i]`` is the embedx width of desc.sparse_slots[i].
+    Keys are routed by their slot's class; each class table sees a
+    synthetic batch over only its slots, with segments renumbered to
+    ``record * S_c + rank_of_slot_in_class``."""
+
+    def __init__(self, slot_mf_dims: Sequence[int],
+                 capacity_per_class: Optional[Dict[int, int]] = None,
+                 capacity: Optional[int] = None,
+                 cfg: Optional[SparseSGDConfig] = None, seed: int = 0,
+                 unique_bucket_min: int = 1024,
+                 arena_chunk_bits: Optional[int] = None) -> None:
+        self.slot_mf_dims = np.asarray(slot_mf_dims, np.int32)
+        if (self.slot_mf_dims <= 0).any():
+            raise ValueError("slot mf dims must be positive")
+        self.dims: List[int] = sorted(set(int(d) for d in slot_mf_dims))
+        self.num_slots = len(self.slot_mf_dims)
+        self.class_of_slot = np.array(
+            [self.dims.index(int(d)) for d in self.slot_mf_dims], np.int32)
+        # rank of each slot within its class (segment renumbering)
+        self.slot_rank = np.zeros(self.num_slots, np.int32)
+        self.class_slots: List[np.ndarray] = []
+        for c in range(len(self.dims)):
+            idx = np.nonzero(self.class_of_slot == c)[0]
+            self.slot_rank[idx] = np.arange(len(idx), dtype=np.int32)
+            self.class_slots.append(idx.astype(np.int32))
+        caps = capacity_per_class or {}
+        self.tables: List[EmbeddingTable] = []
+        for c, d in enumerate(self.dims):
+            n_slots_c = len(self.class_slots[c])
+            self.tables.append(EmbeddingTable(
+                mf_dim=d, capacity=caps.get(d, capacity), cfg=cfg,
+                seed=seed + c, unique_bucket_min=unique_bucket_min,
+                arena_slots=(n_slots_c if arena_chunk_bits is not None
+                             else None),
+                arena_chunk_bits=arena_chunk_bits or 12))
+
+    # ------------------------------------------------------------------
+    @property
+    def num_classes(self) -> int:
+        return len(self.dims)
+
+    @property
+    def feature_count(self) -> int:
+        return sum(t.feature_count for t in self.tables)
+
+    def class_dim(self, c: int) -> int:
+        return self.dims[c]
+
+    def pooled_width(self, cvm_offset: int = 2, use_cvm: bool = True) -> int:
+        """Per-record width of the canonical slot-ordered pooled concat."""
+        per = (cvm_offset if use_cvm else 0) + 1
+        return int(sum(per + d for d in self.slot_mf_dims))
+
+    # ------------------------------------------------------------------
+    def split_batch(self, batch: SlotBatch
+                    ) -> Tuple[List[SlotBatch], List[np.ndarray]]:
+        """Route keys to per-class synthetic SlotBatches (the multi-mf
+        BuildGPUTask grouping, done per batch on the host)."""
+        nk = batch.num_keys
+        s = batch.num_slots
+        if s != self.num_slots:
+            raise ValueError(
+                f"batch has {s} slots, table configured for "
+                f"{self.num_slots}")
+        segs = batch.segments[:nk]
+        slot_of_key = (segs % s).astype(np.int32)
+        rec_of_key = segs // s
+        cls_of_key = self.class_of_slot[slot_of_key]
+        out = []
+        gslots = []
+        for c in range(self.num_classes):
+            m = cls_of_key == c
+            keys_c = batch.keys[:nk][m]
+            gslots.append(slot_of_key[m].astype(np.int16))
+            s_c = len(self.class_slots[c])
+            segs_c = (rec_of_key[m] * s_c
+                      + self.slot_rank[slot_of_key[m]]).astype(np.int32)
+            kcap = next_bucket(1024, len(keys_c) + 1)
+            keys_pad = np.zeros(kcap, np.uint64)
+            keys_pad[:len(keys_c)] = keys_c
+            segs_pad = np.full(kcap, batch.batch_size * s_c, np.int32)
+            segs_pad[:len(keys_c)] = segs_c
+            out.append(SlotBatch(
+                keys=keys_pad, segments=segs_pad, num_keys=len(keys_c),
+                dense=batch.dense, label=batch.label, show=batch.show,
+                clk=batch.clk, batch_size=batch.batch_size,
+                num_slots=s_c,
+                segments_trivial=batch.segments_trivial))
+        return out, gslots
+
+    def prepare(self, batch: SlotBatch) -> List[ClassBatch]:
+        """Per-class dedup + row assignment (DedupKeysAndFillIdx per dim
+        class). Returns one ClassBatch per class, in class order."""
+        subs, gslots = self.split_batch(batch)
+        out = []
+        for b, t, gs in zip(subs, self.tables, gslots):
+            idx = t.prepare(b)
+            # re-record GLOBAL slot ids: the sub-batch's segments carry
+            # class-local ranks, and the persisted FeatureValue slot
+            # field must stay globally meaningful (feature_value.h:570)
+            with t.host_lock:
+                t.record_slots(idx.unique_rows[:idx.num_unique],
+                               idx.gather_idx[:b.num_keys], gs)
+            out.append(ClassBatch(b, idx))
+        return out
+
+    # ---- lifecycle: delegate per class ----
+    def save_base(self, path: str) -> int:
+        return sum(t.save_base(f"{path}.mf{d}.npz")
+                   for t, d in zip(self.tables, self.dims))
+
+    def save_delta(self, path: str) -> int:
+        return sum(t.save_delta(f"{path}.mf{d}.npz")
+                   for t, d in zip(self.tables, self.dims))
+
+    def load(self, path: str, merge: bool = False) -> int:
+        return sum(t.load(f"{path}.mf{d}.npz", merge=merge)
+                   for t, d in zip(self.tables, self.dims))
+
+    def shrink(self, **kw) -> int:
+        return sum(t.shrink(**kw) for t in self.tables)
+
+    def pull(self, keys: np.ndarray, slots: np.ndarray) -> np.ndarray:
+        """Host-side lookup: per-key pull values, padded to the MAX class
+        width ([n, 3 + max_mf]; columns beyond the key's slot width are
+        zero) — the dy_mf CopyForPull contract with per-slot widths.
+        Unknown keys read zeros."""
+        keys = np.ascontiguousarray(keys, np.uint64)
+        slots = np.asarray(slots, np.int32)
+        out = np.zeros((len(keys), 3 + max(self.dims)), np.float32)
+        for c in range(self.num_classes):
+            m = self.class_of_slot[slots] == c
+            if not m.any():
+                continue
+            vals = self.tables[c].host_pull(keys[m])
+            out[np.nonzero(m)[0], :vals.shape[1]] = vals
+        return out
+

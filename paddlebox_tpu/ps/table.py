@@ -481,6 +481,25 @@ class EmbeddingTable:
         key_valid[:batch.num_keys] = 1.0
         return PullIndex(unique_rows, gather_idx, key_valid, u)
 
+    def host_pull(self, keys: np.ndarray,
+                  data: Optional[np.ndarray] = None) -> np.ndarray:
+        """[n] keys → [n, 3+mf] pull values on HOST (show, clk, embed_w,
+        embedx…); unknown keys → zeros. Shared by the serving mirror and
+        MultiMfEmbeddingTable.pull — THE host-side CopyForPull.
+        ``data`` lets callers pass a cached logical mirror."""
+        keys = np.ascontiguousarray(keys, np.uint64)
+        rows, inv = self.index.lookup_unique(keys, self.capacity)
+        if data is None:
+            data = np.asarray(jax.device_get(self.state.data))
+        vals = data[np.minimum(rows, self.capacity)]  # OOB pads clamp
+        mf_end = NUM_FIXED + self.mf_dim
+        gate = vals[:, FIELD_COL["mf_size"]:FIELD_COL["mf_size"] + 1] > 0
+        out = np.concatenate(
+            [vals[:, FIELD_COL["show"]:FIELD_COL["clk"] + 1],
+             vals[:, FIELD_COL["embed_w"]:FIELD_COL["embed_w"] + 1],
+             vals[:, NUM_FIXED:mf_end] * gate], axis=1)
+        return out[inv]
+
     def record_slots(self, rows: np.ndarray, inv: np.ndarray,
                      slot_of_key: np.ndarray) -> None:
         """Record each unique row's slot (first key occurrence wins via

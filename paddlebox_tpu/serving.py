@@ -101,22 +101,10 @@ class ServingModel:
         """[n] uint64 → [n, 3+mf] pull values (show, clk, w, embedx…);
         unknown keys → zeros. Serves from a cached host mirror of the
         table (invalidated by load_base/apply_delta)."""
-        from paddlebox_tpu.ps.table import FIELD_COL, NUM_FIXED
-        keys = np.ascontiguousarray(keys, np.uint64)
-        rows, inv = self.table.index.lookup_unique(keys, self.table.capacity)
         if self._host_data is None:
             self._host_data = np.asarray(
                 jax.device_get(self.table.state.data))
-        data = self._host_data
-        rows_c = np.minimum(rows, self.table.capacity)  # OOB pads clamp
-        vals = data[rows_c]
-        gate = (vals[:, FIELD_COL["mf_size"]:FIELD_COL["mf_size"] + 1] > 0)
-        mf_end = NUM_FIXED + self.table.mf_dim
-        out = np.concatenate(
-            [vals[:, FIELD_COL["show"]:FIELD_COL["clk"] + 1],
-             vals[:, FIELD_COL["embed_w"]:FIELD_COL["embed_w"] + 1],
-             vals[:, NUM_FIXED:mf_end] * gate], axis=1)
-        return out[inv]
+        return self.table.host_pull(keys, data=self._host_data)
 
     def predict(self, batch: SlotBatch,
                 return_valid: bool = False):

@@ -4,8 +4,10 @@ from paddlebox_tpu.train.dense_modes import AsyncDenseTable, KStepParamSync
 from paddlebox_tpu.train.device_pass import (PassPreloader, ResidentPass,
                                              ResidentPassRunner)
 from paddlebox_tpu.train.checkpoint import CheckpointManager
+from paddlebox_tpu.train.multi_mf_step import (MultiMfTrainStep,
+                                               MultiMfTrainer)
 
 __all__ = ["TrainStep", "DeviceBatch", "make_device_batch", "Trainer",
            "AsyncDenseTable", "KStepParamSync",
            "PassPreloader", "ResidentPass", "ResidentPassRunner",
-           "CheckpointManager"]
+           "CheckpointManager", "MultiMfTrainStep", "MultiMfTrainer"]

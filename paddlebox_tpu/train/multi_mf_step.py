@@ -1,0 +1,189 @@
+"""Fused train step + trainer for multi_mf (per-slot embedding dims).
+
+One jit step per batch, same shape as train/step.py's TrainStep but with
+C dim classes: per class pull → fused_seqpool_cvm over the class's slots,
+then the pooled blocks concatenate in CANONICAL slot order (the
+pull_gpups_sparse + seqpool + concat contract with per-slot widths,
+feature_value.h:42-185 / ps_gpu_wrapper.cc multi-mf build) before the
+dense model; the backward push applies per class table. Gather/scatter on
+TPU costs per index, so the class split adds no device cost beyond C
+small dispatch chains inside one XLA program."""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from paddlebox_tpu.config import FLAGS
+from paddlebox_tpu.metrics import auc_compute, init_auc_state
+from paddlebox_tpu.ops import fused_seqpool_cvm
+from paddlebox_tpu.ps.multi_mf import MultiMfEmbeddingTable
+from paddlebox_tpu.ps.table import (apply_push, expand_pull,
+                                    gather_full_rows, pull_values)
+from paddlebox_tpu.train.step import StepState, make_device_batch
+from paddlebox_tpu.metrics import auc_add_batch
+from paddlebox_tpu.utils.logging import get_logger
+from paddlebox_tpu.utils.timer import Timer
+
+log = get_logger(__name__)
+
+
+class MultiMfTrainStep:
+    """Jitted multi-class CTR step over a MultiMfEmbeddingTable."""
+
+    def __init__(self, model, tx: optax.GradientTransformation,
+                 table: MultiMfEmbeddingTable, batch_size: int,
+                 use_cvm: bool = True, cvm_offset: int = 2,
+                 rng_seed: int = 0) -> None:
+        self.model = model
+        self.tx = tx
+        self.table = table
+        self.batch_size = batch_size
+        self.use_cvm = use_cvm
+        self.cvm_offset = cvm_offset
+        self.rng = jax.random.PRNGKey(rng_seed)
+        self.class_slots = [len(s) for s in table.class_slots]
+        self.dims = table.dims
+        # canonical reassembly order: (class, rank) per global slot
+        self.slot_route = [(int(table.class_of_slot[s]),
+                            int(table.slot_rank[s]))
+                           for s in range(table.num_slots)]
+        self._jit = jax.jit(self._step, donate_argnums=(0,))
+
+    def init_params(self, dense_dim: int) -> Any:
+        width = self.table.pooled_width(self.cvm_offset, self.use_cvm)
+        flat = jnp.zeros((self.batch_size, width))
+        dense = jnp.zeros((self.batch_size, dense_dim))
+        return self.model.init(jax.random.PRNGKey(0), flat, dense)
+
+    def init_state(self, params: Any) -> StepState:
+        return StepState(
+            table=tuple(t.state for t in self.table.tables),
+            params=params, opt_state=self.tx.init(params),
+            auc=init_auc_state(), step=jnp.zeros((), jnp.int32))
+
+    # ---- traced ----
+    def _pooled(self, vals_list, devs, batch_show_clk):
+        parts = []
+        for c, dev in enumerate(devs):
+            values_k = expand_pull(vals_list[c], dev.gather_idx)
+            parts.append(fused_seqpool_cvm(
+                values_k, dev.segments, batch_show_clk,
+                self.batch_size, self.class_slots[c],
+                self.use_cvm, self.cvm_offset))
+        # canonical slot order with per-slot widths
+        flat = [parts[c][:, r, :] for c, r in self.slot_route]
+        return jnp.concatenate(flat, axis=1)
+
+    def _step(self, state: StepState, devs, rng
+              ) -> Tuple[StepState, Dict[str, jax.Array]]:
+        d0 = devs[0]
+        batch_show_clk = jnp.stack([d0.show, d0.clk], axis=1)
+        ins_w = (d0.show > 0).astype(jnp.float32)
+        rows_fulls = [gather_full_rows(t, dev.unique_rows)
+                      for t, dev in zip(state.table, devs)]
+        vals_list = [pull_values(rf, t.mf_dim)
+                     for rf, t in zip(rows_fulls, state.table)]
+
+        def loss_fn(params, vals_list):
+            x = self._pooled(vals_list, devs, batch_show_clk)
+            logits = self.model.apply(params, x, d0.dense)
+            ls = optax.sigmoid_binary_cross_entropy(logits, d0.label)
+            loss = jnp.sum(ls * ins_w) / jnp.maximum(jnp.sum(ins_w), 1.0)
+            return loss, logits
+
+        (loss, logits), (g_params, g_vals) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1), has_aux=True)(state.params, vals_list)
+
+        new_tables = []
+        for c, (t, dev, rf, g) in enumerate(
+                zip(state.table, devs, rows_fulls, g_vals)):
+            g = jnp.concatenate(
+                [g[:, :2], g[:, 2:] * (-1.0 * self.batch_size)], axis=1)
+            new_tables.append(apply_push(
+                t, dev.unique_rows, g, self.table.tables[c].cfg,
+                jax.random.fold_in(rng, c), rows_full=rf))
+
+        updates, opt_state = self.tx.update(g_params, state.opt_state,
+                                            state.params)
+        params = optax.apply_updates(state.params, updates)
+        pred = jax.nn.sigmoid(logits)
+        auc = auc_add_batch(state.auc, pred, d0.label, ins_w)
+        return StepState(table=tuple(new_tables), params=params,
+                         opt_state=opt_state, auc=auc,
+                         step=state.step + 1), \
+            {"loss": loss, "pred": pred}
+
+    def __call__(self, state, devs, rng):
+        return self._jit(state, devs, rng)
+
+
+class MultiMfTrainer:
+    """Streaming trainer over a MultiMfEmbeddingTable (the BoxPSTrainer
+    role for mixed-dim tables). Same pass contract as train.Trainer."""
+
+    def __init__(self, model, table: MultiMfEmbeddingTable, desc,
+                 tx=None, use_cvm: bool = True, seed: int = 0,
+                 prefetch: int = 4) -> None:
+        self.table = table
+        self.desc = desc
+        self.tx = tx or optax.adam(1e-3)
+        self.step_fn = MultiMfTrainStep(model, self.tx, table,
+                                        desc.batch_size, use_cvm=use_cvm,
+                                        rng_seed=seed)
+        self.state = self.step_fn.init_state(
+            self.step_fn.init_params(desc.dense_dim))
+        self._rng = jax.random.PRNGKey(seed + 1)
+        self.global_step = 0
+        self.prefetch = prefetch
+
+    def train_pass(self, dataset, log_prefix: str = "") -> Dict[str, float]:
+        from paddlebox_tpu.utils.prefetch import prefetch_iter
+
+        def do_prep(b):
+            cbs = self.table.prepare(b)
+            devs = []
+            for cb in cbs:
+                devs.append(make_device_batch(
+                    cb.batch, cb.index,
+                    floats=devs[0].floats if devs else None))
+            return b, tuple(devs)
+
+        timer = Timer()
+        timer.start()
+        nb = 0
+        n_ex = 0
+        stats = None
+        for batch, devs in prefetch_iter(dataset.batches(), do_prep,
+                                         capacity=self.prefetch):
+            n_ex += int((batch.show > 0).sum())
+            self.global_step += 1
+            rng = jax.random.fold_in(self._rng, self.global_step)
+            self.state, stats = self.step_fn(self.state, devs, rng)
+            nb += 1
+            if FLAGS.check_nan_inf:
+                loss = float(stats["loss"])
+                if math.isnan(loss) or math.isinf(loss):
+                    raise RuntimeError(
+                        f"nan/inf loss at step {self.global_step}")
+        timer.pause()
+        self.sync_table()
+        res = auc_compute(self.state.auc)
+        out = res.as_dict()
+        out.update(batches=nb, elapsed_sec=timer.elapsed_sec(),
+                   examples_per_sec=n_ex / max(timer.elapsed_sec(), 1e-9))
+        log.info("%smulti-mf pass done: %d batches, %.0f ex/s, auc=%.4f",
+                 log_prefix, nb, out["examples_per_sec"], res.auc)
+        return out
+
+    def reset_metrics(self) -> None:
+        self.state = self.state._replace(auc=init_auc_state())
+
+    def sync_table(self) -> None:
+        for t, st in zip(self.table.tables, self.state.table):
+            t.state = st

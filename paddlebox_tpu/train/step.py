@@ -166,7 +166,11 @@ class DeviceBatch(NamedTuple):
         return unpack_floats(self.floats)[3]
 
 
-def make_device_batch(batch: SlotBatch, idx: PullIndex) -> DeviceBatch:
+def make_device_batch(batch: SlotBatch, idx: PullIndex,
+                      floats: Optional[jax.Array] = None) -> DeviceBatch:
+    """``floats`` reuses an already-staged float block (multi-mf class
+    sub-batches share one — the step reads only class 0's copy, so the
+    others must not re-pack and re-ship it)."""
     u_pad = idx.unique_rows.shape[0]
     ints_u = np.empty(u_pad + 2, np.int32)
     ints_u[:u_pad] = idx.unique_rows
@@ -176,10 +180,12 @@ def make_device_batch(batch: SlotBatch, idx: PullIndex) -> DeviceBatch:
         ints_k = np.ascontiguousarray(idx.gather_idx[None, :])
     else:
         ints_k = np.stack([idx.gather_idx, batch.segments.astype(np.int32)])
-    floats = pack_floats(batch.dense, batch.label, batch.show, batch.clk)
+    if floats is None:
+        floats = jnp.asarray(pack_floats(batch.dense, batch.label,
+                                         batch.show, batch.clk))
     return DeviceBatch(ints_u=jnp.asarray(ints_u),
                        ints_k=jnp.asarray(ints_k),
-                       floats=jnp.asarray(floats))
+                       floats=floats)
 
 
 def ctr_forward(table: TableState, params: Any, model, batch,
