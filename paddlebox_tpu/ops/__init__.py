@@ -2,7 +2,8 @@ from paddlebox_tpu.ops.seqpool_cvm import (
     fused_seqpool_cvm, fused_seqpool_cvm_with_conv, fused_seqpool_concat,
 )
 from paddlebox_tpu.ops.cvm import cvm, cvm_grad_passthrough
-from paddlebox_tpu.ops.rank_attention import rank_attention
+from paddlebox_tpu.ops.rank_attention import (rank_attention,
+                                              rank_attention2)
 from paddlebox_tpu.ops.batch_fc import batch_fc
 from paddlebox_tpu.ops.shuffle_batch import shuffle_batch, unshuffle_batch
 from paddlebox_tpu.ops.partial_ops import partial_concat, partial_sum
@@ -22,6 +23,7 @@ from paddlebox_tpu.ops.seq_tensor import fused_seq_tensor
 __all__ = [
     "fused_seqpool_cvm", "fused_seqpool_cvm_with_conv",
     "fused_seqpool_concat", "cvm", "cvm_grad_passthrough", "rank_attention",
+    "rank_attention2",
     "batch_fc", "shuffle_batch", "unshuffle_batch", "partial_concat",
     "partial_sum", "DataNormSummary", "data_norm", "data_norm_update",
     "init_data_norm_summary", "cross_norm_hadamard", "cross_norm_update",

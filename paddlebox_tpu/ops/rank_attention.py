@@ -50,3 +50,19 @@ def rank_attention(x: jax.Array, rank_offset: jax.Array,
     # x_k is already zeroed for invalid (i,k), so the param gather needs no
     # mask — the einsum contribution and the param cotangent are both 0
     return jnp.einsum("nkd,nkdp->np", x_k, param[block])
+
+
+def rank_attention2(x: jax.Array, rank_offset: jax.Array,
+                    rank_param: jax.Array, max_rank: int = 3) -> jax.Array:
+    """rank_attention2 (rank_attention_op.cc:179-308).
+
+    Same attention sum as :func:`rank_attention`
+    (kernel_rank_feed_forward, rank_attention_op.cu:216-254 — identical
+    block math) but the op registers gradients ONLY for RankParam: the
+    grad kernel (kernel_rank_back_propagate :257-294) accumulates
+    out_para_grad and the X/RankOffset inputs are declared "not use
+    data". Equivalent to the v1 path with input backprop disabled, minus
+    the expanded-helper buffers the CUDA v1 materializes (irrelevant
+    here — XLA never materializes them)."""
+    return rank_attention(x, rank_offset, rank_param, max_rank,
+                          enable_input_bp=False)

@@ -29,7 +29,9 @@ from paddlebox_tpu.ops.pallas_kernels import segment_sum
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11, 12))
+    jax.custom_vjp,
+    nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17,
+                     18))
 def fused_seqpool_cvm(
     values: jax.Array,          # [K, D] pulled embeddings (D includes cvm dims)
     segments: jax.Array,        # [K] int32, ins*S + slot; pad rows → B*S
@@ -44,18 +46,84 @@ def fused_seqpool_cvm(
     clk_coeff: float = 1.0,
     threshold: float = 0.96,
     quant_ratio: int = 0,
+    clk_filter: bool = False,
+    embed_threshold_filter: bool = False,
+    embed_threshold: float = 0.0,
+    embed_thres_size: int = 0,
+    embedx_concate_size: int = 1,
+    embedx_concate_filter: bool = False,
+    key_valid: jax.Array = None,
 ) -> jax.Array:
-    """Returns [B, S, D] if use_cvm else [B, S, D - cvm_offset]."""
+    """Full attr surface of fused_seqpool_cvm_op.cc:124-146.
+
+    Output width per slot (InferShape :77-98), with k =
+    ``embedx_concate_size``:
+      use_cvm, clk_filter  → (D-1)*k    [log(show+1), embedx…] per block
+      use_cvm              → D          [log(show+1), log(clk+1)-…, …]
+                             (k is IGNORED here — the reference has no
+                             concate kernel for the plain-CVM case and
+                             InferShape keeps width D)
+      no cvm               → (D - cvm_offset - embed_thres_size)*k
+
+    ``embed_threshold_filter`` additionally drops keys whose embed
+    magnitude |e0| + ||e1..ets-1|| falls below ``embed_threshold``
+    (KernelEmbedQuantFilter :134-176). ``embedx_concate_size`` k > 1
+    emits the first k keys of each (ins, slot) sequence individually
+    instead of sum-pooling (…EmbedxConcate kernels); filtered keys leave
+    pad_value blocks when ``embedx_concate_filter``.
+
+    ``key_valid`` (float [K], 1.0 = real key) masks batch padding in the
+    backward when ``segments`` is None (the trivial layout has no pad
+    bin to route pads into; without it, callers must guarantee pad
+    positions' gather_idx point at masked rows)."""
     out, _ = _fwd(values, segments, batch_show_clk, batch_size, num_slots,
                   use_cvm, cvm_offset, pad_value, need_filter, show_coeff,
-                  clk_coeff, threshold, quant_ratio)
+                  clk_coeff, threshold, quant_ratio, clk_filter,
+                  embed_threshold_filter, embed_threshold,
+                  embed_thres_size, embedx_concate_size,
+                  embedx_concate_filter, key_valid)
     return out
+
+
+def _keep_mask(v, cvm_offset, need_filter, show_coeff, clk_coeff, threshold,
+               embed_threshold_filter, embed_threshold, embed_thres_size):
+    """Key keep flags: show/clk significance (QuantFilter :93-133) and
+    the embed-magnitude test (KernelEmbedQuantFilter :134-176)."""
+    k, d = v.shape
+    if not (need_filter or embed_threshold_filter):
+        return jnp.ones((k,), dtype=bool)
+    show, clk = v[:, 0], v[:, 1]
+    keep = ((show - clk) * show_coeff + clk * clk_coeff) >= threshold
+    if embed_threshold_filter:
+        ets = embed_thres_size if embed_thres_size > 0 else d - cvm_offset
+        e = v[:, cvm_offset:cvm_offset + ets]
+        score = jnp.sqrt(jnp.sum(e[:, 1:] * e[:, 1:], axis=1)) \
+            + jnp.abs(e[:, 0])
+        keep = keep & (score >= embed_threshold)
+    return keep
+
+
+def _segment_ranks(segments):
+    """Occurrence index of each key within its segment (stable)."""
+    k = segments.shape[0]
+    pos = jnp.arange(k, dtype=jnp.int32)
+    ss, order = jax.lax.sort((segments, pos), num_keys=1)
+    is_start = jnp.concatenate([jnp.ones(1, bool), ss[1:] != ss[:-1]])
+    run_start = jax.lax.cummax(jnp.where(is_start, pos, 0))
+    rank_sorted = pos - run_start
+    return jnp.zeros(k, jnp.int32).at[order].set(rank_sorted,
+                                                 unique_indices=True)
 
 
 def _fwd(values, segments, batch_show_clk, batch_size, num_slots, use_cvm,
          cvm_offset, pad_value, need_filter, show_coeff, clk_coeff,
-         threshold, quant_ratio):
+         threshold, quant_ratio, clk_filter, embed_threshold_filter,
+         embed_threshold, embed_thres_size, embedx_concate_size,
+         embedx_concate_filter, key_valid):
     d = values.shape[1]
+    kk = embedx_concate_size
+    if use_cvm and not clk_filter:
+        kk = 1  # reference has no concate kernel for plain CVM
     v = values
     if quant_ratio > 0:
         # quantize embedx dims only; cvm dims pass through (:78-90) — safe
@@ -63,59 +131,127 @@ def _fwd(values, segments, batch_show_clk, batch_size, num_slots, use_cvm,
         q = jnp.floor(v * quant_ratio + 0.5) / quant_ratio
         col = jnp.arange(d) >= cvm_offset
         v = jnp.where(col[None, :], q, v)
-    # filter: FusedSeqpoolKernelQuantFilter :93-133 — drop items failing the
-    # show/clk significance test
-    pooled, keep = _filtered_pool(v, segments, batch_size, num_slots,
-                                  pad_value, need_filter, show_coeff,
-                                  clk_coeff, threshold)
-    if use_cvm:
-        # FusedCVMKernelWithCVM :276: [log(show+1), log(clk+1)-log(show+1), …]
-        show_l = jnp.log1p(pooled[..., 0:1])
-        ctr = jnp.log1p(pooled[..., 1:2]) - show_l
-        out = jnp.concatenate([show_l, ctr, pooled[..., cvm_offset:]], axis=-1)
+    keep = _keep_mask(v, cvm_offset, need_filter, show_coeff, clk_coeff,
+                      threshold, embed_threshold_filter, embed_threshold,
+                      embed_thres_size)
+    rank = None
+    if kk == 1:
+        pooled = _pool_core(v, segments, batch_size, num_slots, keep,
+                            pad_value)                    # [B, S, D]
     else:
-        out = pooled[..., cvm_offset:]
+        # …EmbedxConcate kernels: the j-th block is the (start+j)-th key
+        # of the sequence, NOT sum-pooled; keys at rank ≥ k drop
+        if segments is None:
+            # trivial layout: one key per segment — every rank is 0
+            segs = jnp.arange(v.shape[0], dtype=jnp.int32)
+            rank = jnp.zeros(v.shape[0], jnp.int32)
+        else:
+            segs = segments
+            rank = _segment_ranks(segs)
+        drop = rank >= kk
+        if embedx_concate_filter:
+            drop = drop | ~keep
+        n2 = batch_size * num_slots * kk
+        seg2 = jnp.where(drop | (segs >= batch_size * num_slots),
+                         n2, segs * kk + rank)
+        vv = jnp.where(drop[:, None], 0.0, v)
+        pooled = segment_sum(vv, seg2, n2 + 1)[:-1]
+        if pad_value:
+            # pad_value fills EMPTY blocks only; emitted keys are verbatim
+            cnt = segment_sum(jnp.where(drop, 0.0, 1.0)[:, None], seg2,
+                              n2 + 1)[:-1]
+            pooled = jnp.where(cnt > 0, pooled, pad_value)
+        pooled = pooled.reshape(batch_size, num_slots, kk, d)
+    if use_cvm:
+        show_l = jnp.log1p(pooled[..., 0:1])
+        if clk_filter:
+            # FusedCVMKernelWithShow :301: [log(show+1), embedx…] — the
+            # click column is skipped entirely
+            out = jnp.concatenate([show_l, pooled[..., cvm_offset:]],
+                                  axis=-1)
+        else:
+            # FusedCVMKernelWithCVM :276: [log(show+1),
+            # log(clk+1)-log(show+1), …]
+            ctr = jnp.log1p(pooled[..., 1:2]) - show_l
+            out = jnp.concatenate([show_l, ctr, pooled[..., cvm_offset:]],
+                                  axis=-1)
+    else:
+        # FusedCVMKernelNoCVM :355: additionally skip the first
+        # embed_thres_size embed dims (InferShape width contract :95)
+        out = pooled[..., cvm_offset + embed_thres_size:]
+    if kk > 1:
+        out = out.reshape(batch_size, num_slots, -1)
     # zero-size token carries the primal dtype/width through residuals
     vtoken = jnp.zeros((0, values.shape[1]), values.dtype)
-    return out, (segments, keep, vtoken, batch_show_clk)
+    return out, (segments, keep, vtoken, batch_show_clk, rank, key_valid)
 
 
 def _bwd(batch_size, num_slots, use_cvm, cvm_offset, pad_value, need_filter,
-         show_coeff, clk_coeff, threshold, quant_ratio, res, g):
-    segments, keep, vtoken, batch_show_clk = res
+         show_coeff, clk_coeff, threshold, quant_ratio, clk_filter,
+         embed_threshold_filter, embed_threshold, embed_thres_size,
+         embedx_concate_size, embedx_concate_filter, res, g):
+    segments, keep, vtoken, batch_show_clk, rank, key_valid = res
     d = vtoken.shape[1]
+    kk = embedx_concate_size
     vdtype = vtoken.dtype
-    # Reference backward (:634-657): embedx dims broadcast the output grad to
-    # every surviving sequence item; the first cvm_offset dims carry the
-    # *batch CVM values* (show/clk) so the sparse push learns counters.
-    # Quant and the log transform are straight-through, exactly as the CUDA
-    # grad kernel ignores them.
-    embedx_g = g[..., cvm_offset:] if use_cvm else g
-    flat = embedx_g.reshape(batch_size * num_slots, d - cvm_offset)
-    if segments is None:
-        # trivial layout: key j ↔ segment j — the gather is a pad/slice
-        k = keep.shape[0]
-        n = batch_size * num_slots
-        if k > n:
-            flat = jnp.concatenate(
-                [flat, jnp.zeros((k - n, d - cvm_offset), flat.dtype)])
-        g_embedx = flat[:k]
-        seg_ids = jnp.arange(k, dtype=jnp.int32)
-        pad = seg_ids >= n
-        ins = jnp.minimum(seg_ids // num_slots, batch_size - 1)
-    else:
+    # Reference backward (:634-716): embedx dims broadcast the output grad
+    # to every surviving sequence item; the first cvm_offset dims carry
+    # the *batch CVM values* (show/clk) so the sparse push learns
+    # counters. Quant and the log transform are straight-through, exactly
+    # as the CUDA grad kernels ignore them.
+    kk = 1 if (use_cvm and not clk_filter) else kk
+    n_head = (1 if clk_filter else cvm_offset) if use_cvm else 0
+    ets = 0 if use_cvm else embed_thres_size
+    w = d - cvm_offset - ets          # embedx dims receiving real grads
+    if kk > 1:
+        g = g.reshape(batch_size, num_slots, kk, n_head + w)
+    embedx_g = g[..., n_head:]
+    k_keys = keep.shape[0]
+    n = batch_size * num_slots
+    if kk > 1:
         flat = jnp.concatenate(
-            [flat, jnp.zeros((1, d - cvm_offset), flat.dtype)], axis=0)
-        g_embedx = flat[segments]                          # [K, D-cvm]
-        ins = jnp.minimum(segments // num_slots, batch_size - 1)
-        pad = segments >= batch_size * num_slots
-    g_cvm = batch_show_clk[ins]                            # [K, cvm_offset]
+            [embedx_g.reshape(n * kk, w), jnp.zeros((1, w), g.dtype)])
+        segs = (jnp.arange(k_keys, dtype=jnp.int32) if segments is None
+                else segments)
+        drop = rank >= kk
+        if embedx_concate_filter:
+            drop = drop | ~keep
+        idx = jnp.where(drop | (segs >= n), n * kk, segs * kk + rank)
+        g_embedx = flat[idx]
+        ins = jnp.minimum(segs // num_slots, batch_size - 1)
+        pad = segs >= n
+        contrib = ~drop
+    else:
+        if segments is None:
+            # trivial layout: key j ↔ segment j — the gather is a slice
+            flat = embedx_g.reshape(n, w)
+            if k_keys > n:
+                flat = jnp.concatenate(
+                    [flat, jnp.zeros((k_keys - n, w), flat.dtype)])
+            g_embedx = flat[:k_keys]
+            seg_ids = jnp.arange(k_keys, dtype=jnp.int32)
+            pad = seg_ids >= n
+            ins = jnp.minimum(seg_ids // num_slots, batch_size - 1)
+        else:
+            flat = jnp.concatenate(
+                [embedx_g.reshape(n, w), jnp.zeros((1, w), g.dtype)])
+            g_embedx = flat[segments]                      # [K, w]
+            ins = jnp.minimum(segments // num_slots, batch_size - 1)
+            pad = segments >= n
+        contrib = keep
+    if key_valid is not None:
+        pad = pad | (key_valid <= 0)
+    g_cvm = batch_show_clk[ins].astype(g_embedx.dtype)     # [K, cvm_offset]
+    parts = [g_cvm]
+    if ets:
+        parts.append(jnp.zeros((k_keys, ets), g_embedx.dtype))
+    parts.append(g_embedx)
     g_values = jnp.where(
-        (keep & ~pad)[:, None],
-        jnp.concatenate([g_cvm.astype(g_embedx.dtype), g_embedx], axis=-1),
+        (contrib & ~pad)[:, None],
+        jnp.concatenate(parts, axis=-1),
         0.0,
     ).astype(vdtype)
-    return (g_values, None, None)
+    return (g_values, None, None, None)
 
 
 fused_seqpool_cvm.defvjp(_fwd, _bwd)

@@ -203,7 +203,8 @@ def ctr_forward(table: TableState, params: Any, model, batch,
     segs = getattr(batch, "pool_segments", batch.segments)
     pooled = fused_seqpool_cvm(
         values_k, segs, batch_show_clk, batch_size, num_slots,
-        use_cvm, cvm_offset, 0.0, need_filter, 0.2, 1.0, 0.96, quant_ratio)
+        use_cvm, cvm_offset, 0.0, need_filter, 0.2, 1.0, 0.96, quant_ratio,
+        key_valid=batch.key_valid)
     logits = model.apply(params, pooled, batch.dense)
     ins_w = (batch.show > 0).astype(jnp.float32)
     return jax.nn.sigmoid(logits), ins_w
@@ -278,7 +279,8 @@ class TrainStep:
             pooled = fused_seqpool_cvm(
                 values_k, pool_segs, batch_show_clk, b, s,
                 self.use_cvm, self.cvm_offset, 0.0, self.need_filter,
-                0.2, 1.0, 0.96, self.quant_ratio)
+                0.2, 1.0, 0.96, self.quant_ratio,
+                key_valid=batch.key_valid)
             logits = self.model.apply(params, pooled, batch.dense)
             ls = optax.sigmoid_binary_cross_entropy(logits, batch.label)
             loss = jnp.sum(ls * ins_w) / jnp.maximum(jnp.sum(ins_w), 1.0)

@@ -10,7 +10,8 @@ from paddlebox_tpu.ops import (
     batch_fc, cross_norm_hadamard, cross_norm_update, data_norm,
     data_norm_update, fused_seqpool_cvm_with_conv, init_cross_norm_summary,
     init_data_norm_summary, partial_concat, partial_sum, rank_attention,
-    scaled_fc, scaled_int8fc, shuffle_batch, unshuffle_batch,
+    rank_attention2, scaled_fc, scaled_int8fc, shuffle_batch,
+    unshuffle_batch,
 )
 
 
@@ -49,6 +50,50 @@ def test_rank_attention_matches_reference():
                                     jnp.asarray(param), mr))
     np.testing.assert_allclose(got, ref_rank_attention(x, ro, param, mr),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_rank_attention2_param_only_grads():
+    """rank_attention2 (rank_attention_op.cc:179): forward identical to
+    v1; gradients flow ONLY to RankParam (kernel_rank_back_propagate
+    accumulates out_para_grad, X gets none)."""
+    rng = np.random.default_rng(4)
+    n, d, p, mr = 6, 4, 3, 3
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    param = rng.normal(size=(mr * mr * d, p)).astype(np.float32)
+    ro = np.zeros((n, 1 + 2 * mr), np.int32)
+    for i in range(n):
+        ro[i, 0] = rng.integers(0, mr + 1)
+        for k in range(mr):
+            if rng.random() < 0.7:
+                ro[i, 1 + 2 * k] = rng.integers(1, mr + 1)
+                ro[i, 2 + 2 * k] = rng.integers(0, n)
+    got = np.asarray(rank_attention2(jnp.asarray(x), jnp.asarray(ro),
+                                     jnp.asarray(param), mr))
+    np.testing.assert_allclose(got, ref_rank_attention(x, ro, param, mr),
+                               rtol=1e-5, atol=1e-6)
+
+    def loss(xx, pp):
+        return jnp.sum(rank_attention2(xx, jnp.asarray(ro), pp, mr) ** 2)
+
+    gx, gp = jax.grad(loss, argnums=(0, 1))(jnp.asarray(x),
+                                            jnp.asarray(param))
+    np.testing.assert_allclose(np.asarray(gx), 0.0)  # X gets NO grads
+    # param grads match the transcription of kernel_rank_back_propagate
+    out = ref_rank_attention(x, ro, param, mr)
+    g_out = 2.0 * out
+    ref_gp = np.zeros_like(param.reshape(mr * mr, d, p))
+    for i in range(n):
+        own = ro[i, 0] - 1
+        if own < 0:
+            continue
+        for k in range(mr):
+            faster = ro[i, 1 + 2 * k] - 1
+            idx = ro[i, 2 + 2 * k]
+            if faster < 0:
+                continue
+            ref_gp[own * mr + faster] += np.outer(x[idx], g_out[i])
+    np.testing.assert_allclose(np.asarray(gp).reshape(mr * mr, d, p),
+                               ref_gp, rtol=1e-4, atol=1e-5)
 
 
 def test_batch_fc_modes():

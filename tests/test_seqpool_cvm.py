@@ -145,3 +145,163 @@ def test_cvm_op():
         lambda v: jnp.sum(cvm(v, jnp.asarray(bcvm), True)))(jnp.asarray(x)))
     np.testing.assert_allclose(g[:, :2], bcvm, rtol=1e-6)
     np.testing.assert_allclose(g[:, 2:], 1.0)
+
+
+def ref_full_attrs(values, segments, lens, B, S, use_cvm, cvm_offset=2,
+                   need_filter=False, show_coeff=0.2, clk_coeff=1.0,
+                   threshold=0.96, clk_filter=False,
+                   embed_threshold_filter=False, embed_threshold=0.0,
+                   embed_thres_size=0, embedx_concate_size=1,
+                   embedx_concate_filter=False):
+    """Numpy transcription of the attr-complete kernels
+    (fused_seqpool_cvm_op.cu:134-176 filter, :301-352 WithShow[Concate],
+    :355-405 NoCVM[Concate])."""
+    D = values.shape[1]
+    kk = embedx_concate_size
+
+    def keep_of(v):
+        ok = True
+        if need_filter or embed_threshold_filter:
+            ok = (v[0] - v[1]) * show_coeff + v[1] * clk_coeff >= threshold
+        if ok and embed_threshold_filter:
+            ets = embed_thres_size if embed_thres_size > 0 else D - cvm_offset
+            e = v[cvm_offset:cvm_offset + ets]
+            score = np.sqrt((e[1:] ** 2).sum()) + abs(e[0])
+            ok = score >= embed_threshold
+        return ok
+
+    # group keys per (ins, slot) in order
+    groups = [[] for _ in range(B * S)]
+    ki = 0
+    for i in range(B):
+        for s in range(S):
+            for _ in range(lens[i, s]):
+                groups[i * S + s].append(values[ki])
+                ki += 1
+    if use_cvm and not clk_filter:
+        kk = 1  # reference has no concate kernel for plain CVM
+    pooled = np.zeros((B * S, kk, D), np.float32)
+    for gidx, grp in enumerate(groups):
+        if kk == 1:
+            for v in grp:
+                if keep_of(v):
+                    pooled[gidx, 0] += v
+        else:
+            for j in range(min(kk, len(grp))):
+                v = grp[j]
+                if embedx_concate_filter and not keep_of(v):
+                    continue
+                pooled[gidx, j] += v
+    if use_cvm:
+        show_l = np.log1p(pooled[..., 0:1])
+        if clk_filter:
+            out = np.concatenate([show_l, pooled[..., cvm_offset:]], axis=-1)
+        else:
+            ctr = np.log1p(pooled[..., 1:2]) - show_l
+            out = np.concatenate([show_l, ctr, pooled[..., cvm_offset:]],
+                                 axis=-1)
+    else:
+        out = pooled[..., cvm_offset + embed_thres_size:]
+    return out.reshape(B, S, -1)
+
+
+@pytest.mark.parametrize("use_cvm,clk_filter,ets,kk", [
+    (True, True, 0, 1),      # clk_filter output head
+    (False, False, 1, 1),    # embed_thres_size no-cvm width cut
+    (True, False, 0, 2),     # concate IGNORED in plain-CVM mode
+    (True, True, 0, 3),      # clk_filter + concate
+    (False, False, 1, 2),    # no-cvm + thres + concate
+])
+def test_seqpool_new_attrs_forward(use_cvm, clk_filter, ets, kk):
+    B, S, D = 3, 2, 5
+    values, segments, lens = make_batch(B, S, D, max_len=4, seed=7)
+    show_clk = np.random.default_rng(1).uniform(
+        0, 2, size=(B, 2)).astype(np.float32)
+    out = fused_seqpool_cvm(
+        jnp.asarray(values), jnp.asarray(segments), jnp.asarray(show_clk),
+        B, S, use_cvm, 2, 0.0, False, 0.2, 1.0, 0.96, 0,
+        clk_filter, False, 0.0, ets, kk, False)
+    ref = ref_full_attrs(values[:int(lens.sum())], segments, lens, B, S,
+                         use_cvm, clk_filter=clk_filter,
+                         embed_thres_size=ets, embedx_concate_size=kk)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_seqpool_embed_threshold_filter():
+    B, S, D = 2, 2, 5
+    values, segments, lens = make_batch(B, S, D, max_len=3, seed=3)
+    nk = int(lens.sum())
+    # make every key pass the show/clk test, differ on embed magnitude
+    values[:nk, 0] = 5.0
+    values[:nk, 1] = 1.0
+    show_clk = np.ones((B, 2), np.float32)
+    thr = 1.5
+    out = fused_seqpool_cvm(
+        jnp.asarray(values), jnp.asarray(segments), jnp.asarray(show_clk),
+        B, S, True, 2, 0.0, False, 0.2, 1.0, 0.0, 0,
+        False, True, thr, 0, 1, False)
+    ref = ref_full_attrs(values[:nk], segments, lens, B, S, True,
+                         embed_threshold_filter=True, embed_threshold=thr,
+                         threshold=0.0)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_seqpool_concate_backward_contract():
+    """Concate mode (clk_filter head — the combination the reference
+    kernels support): only the first k keys of a sequence receive embedx
+    grads (their own block); cvm dims still carry batch show/clk."""
+    B, S, D, kk = 2, 2, 4, 2
+    values, segments, lens = make_batch(B, S, D, max_len=3, seed=9)
+    nk = int(lens.sum())
+    show_clk = np.arange(B * 2, dtype=np.float32).reshape(B, 2) + 1
+
+    def f(v):
+        out = fused_seqpool_cvm(
+            v, jnp.asarray(segments), jnp.asarray(show_clk),
+            B, S, True, 2, 0.0, False, 0.2, 1.0, 0.96, 0,
+            True, False, 0.0, 0, kk, False)
+        return jnp.sum(out * jnp.arange(out.size).reshape(out.shape))
+
+    g = np.asarray(jax.grad(f)(jnp.asarray(values)))
+    # per-key rank within its group
+    ranks = []
+    for i in range(B):
+        for s in range(S):
+            ranks += list(range(lens[i, s]))
+    up = np.asarray(jax.grad(f)(jnp.asarray(values)))  # determinism
+    np.testing.assert_allclose(g, up)
+    for ki in range(nk):
+        seg = segments[ki]
+        ins = seg // S
+        if ranks[ki] >= kk:
+            np.testing.assert_allclose(g[ki], 0.0)
+        else:
+            # cvm dims = batch show/clk (the push-counters contract)
+            np.testing.assert_allclose(g[ki, :2], show_clk[ins])
+    # padding rows get zero grads
+    np.testing.assert_allclose(g[nk:], 0.0)
+
+
+def test_seqpool_trivial_backward_masks_pads_with_key_valid():
+    """ADVICE fix: the trivial (segments=None) backward must mask batch
+    padding locally when key_valid is given, instead of relying on the
+    caller's gather-idx invariant."""
+    B, S, D = 2, 2, 4
+    n = B * S
+    k_pad = 8  # > n: positions [n, 8) are key pads
+    values = np.random.default_rng(0).uniform(
+        0, 1, size=(k_pad, D)).astype(np.float32)
+    show_clk = np.ones((B, 2), np.float32)
+    key_valid = np.zeros(k_pad, np.float32)
+    key_valid[:3] = 1.0  # only 3 real keys; position 3 is padding too
+
+    def f(v):
+        out = fused_seqpool_cvm(
+            v, None, jnp.asarray(show_clk), B, S, True, 2, 0.0,
+            False, 0.2, 1.0, 0.96, 0, False, False, 0.0, 0, 1, False,
+            jnp.asarray(key_valid))
+        return jnp.sum(out)
+
+    g = np.asarray(jax.grad(f)(jnp.asarray(values)))
+    np.testing.assert_allclose(g[3:], 0.0)   # ALL pads masked
+    assert (np.abs(g[:3]).sum(axis=1) > 0).all()
