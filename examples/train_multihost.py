@@ -34,7 +34,9 @@ def worker(args) -> None:
 
     from paddlebox_tpu.config import FLAGS
     from paddlebox_tpu.data import DataFeedDesc, DatasetFactory
+    from paddlebox_tpu.distributed.collective import TcpCollective
     from paddlebox_tpu.distributed.shuffle import TcpShuffler
+    from paddlebox_tpu.metrics import auc_compute_global
     from paddlebox_tpu.models import DeepFM
     from paddlebox_tpu.ps import EmbeddingTable, SparseSGDConfig
     from paddlebox_tpu.train import Trainer
@@ -63,9 +65,19 @@ def worker(args) -> None:
                  tx=optax.adam(1e-2), seed=rank)
     for _ in range(args.passes):
         res = tr.train_pass(ds, log_prefix=f"[rank {rank}] ")
+    # ONE global AUC across all workers (metrics.cc:288-304): allreduce
+    # the bucket tables over the host collective plane
+    coll_eps = os.environ.get("COLLECTIVE_ENDPOINTS")
+    global_auc = None
+    if coll_eps:
+        coll = TcpCollective(rank, world, coll_eps.split(","))
+        global_auc = round(float(
+            auc_compute_global(tr.state.auc, coll).auc), 4)
+        coll.close()
     print(json.dumps(dict(rank=rank, loaded=loaded,
                           after_shuffle=len(ds.records),
                           auc=round(float(res["auc"]), 4),
+                          global_auc=global_auc,
                           features=int(table.feature_count))))
 
 
@@ -91,10 +103,12 @@ def main() -> None:
                               rows_per_file=args.rows // (2 * args.workers),
                               vocab_per_slot=200, seed=1)
 
-    socks = [socket.socket() for _ in range(args.workers)]
+    socks = [socket.socket() for _ in range(2 * args.workers)]
     for s in socks:
         s.bind(("127.0.0.1", 0))
-    endpoints = ",".join(f"127.0.0.1:{s.getsockname()[1]}" for s in socks)
+    ports = [s.getsockname()[1] for s in socks]
+    endpoints = ",".join(f"127.0.0.1:{p}" for p in ports[:args.workers])
+    coll_eps = ",".join(f"127.0.0.1:{p}" for p in ports[args.workers:])
     for s in socks:
         s.close()
 
@@ -102,7 +116,8 @@ def main() -> None:
     for r in range(args.workers):
         env = dict(os.environ, PBOX_RANK=str(r),
                    PBOX_WORLD_SIZE=str(args.workers),
-                   SHUFFLE_ENDPOINTS=endpoints, JAX_PLATFORMS="cpu")
+                   SHUFFLE_ENDPOINTS=endpoints,
+                   COLLECTIVE_ENDPOINTS=coll_eps, JAX_PLATFORMS="cpu")
         procs.append(subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--worker",
              "--data", data, "--rows", str(args.rows),

@@ -99,69 +99,85 @@ def _recv_exact(conn: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
-class TcpShuffler(Shuffler):
-    """Full-mesh TCP record exchange: rank i sends partition j to rank j
-    and returns its own partition plus everything received. One exchange
-    per call; the listener stays up for reuse across passes.
+class TcpMesh:
+    """Full-mesh TCP byte exchange — the host-side data/metrics plane
+    shared by the record shuffler (global shuffle) and the host
+    collective (cross-worker metric allreduce, metrics.cc:288-304 role).
 
-    ``endpoints`` — "host:port" per rank, index == rank. Every rank must
-    call :meth:`exchange` once per pass (the call is a data barrier, like
-    the reference's shuffler wait, data_set.cc:2681)."""
+    ``endpoints`` — "host:port" per rank, index == rank. One
+    :meth:`exchange_bytes` per round on every rank (a data barrier, like
+    the reference's shuffler wait, data_set.cc:2681). A PERSISTENT
+    listener thread drains peers continuously, so inter-round skew is
+    bounded only by ``timeout``, never by socket buffers."""
 
     def __init__(self, rank: int, world: int, endpoints: Sequence[str],
-                 seed: int = 0,
-                 route_fn: Optional[Callable[[SlotRecord, int, int], int]]
-                 = None, timeout: float = 120.0) -> None:
+                 timeout: float = 120.0) -> None:
         if len(endpoints) != world:
             raise ValueError("need one endpoint per rank")
         self.rank, self.world = rank, world
         self.endpoints = [(e.rsplit(":", 1)[0], int(e.rsplit(":", 1)[1]))
                           for e in endpoints]
-        self.seed = seed
-        self.route_fn = route_fn or default_route
         self.timeout = timeout
         self._round = 0
-        # payloads from peers that already advanced to round r+1 while we
-        # are still collecting round r (no global barrier between passes)
-        self._early: Dict[Tuple[int, int], bytes] = {}
+        # payloads stashed by (round, src). A PERSISTENT listener thread
+        # accepts and drains continuously, so a fast peer's sendall never
+        # blocks on our socket buffers while we are still training the
+        # previous pass — inter-pass skew is bounded only by ``timeout``
+        # against a genuinely dead peer, not by buffer sizes.
+        self._stash: Dict[Tuple[int, int], bytes] = {}
+        self._cv = threading.Condition()
+        self._listen_err: Optional[BaseException] = None
+        self._closed = False
         host, port = self.endpoints[rank]
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, port))
         self._srv.listen(world)
+        self._listener = threading.Thread(target=self._listen_loop,
+                                          daemon=True,
+                                          name=f"shuffler-r{rank}")
+        self._listener.start()
 
     @property
     def bound_port(self) -> int:
         return self._srv.getsockname()[1]
 
     def close(self) -> None:
-        self._srv.close()
-
-    def _serve(self, inbox: Dict[int, bytes], errors: List[BaseException],
-               expect: int) -> None:
+        self._closed = True
         try:
-            self._srv.settimeout(self.timeout)
-            got = 0
-            while got < expect:
+            self._srv.close()
+        except OSError:
+            pass
+
+    def _listen_loop(self) -> None:
+        while not self._closed:
+            try:
                 conn, _ = self._srv.accept()
+            except OSError:
+                return  # socket closed
+            try:
                 with conn:
                     conn.settimeout(self.timeout)
                     src, rnd, nbytes = struct.unpack(
                         "<iiq", _recv_exact(conn, 16))
                     payload = _recv_exact(conn, nbytes)
-                    if rnd == self._round + 1:
-                        # fast peer already in its next exchange — stash
-                        # for our next round instead of failing the pass
-                        self._early[(rnd, src)] = payload
-                    elif rnd != self._round:
-                        raise RuntimeError(
-                            f"shuffle round mismatch: got {rnd} from "
-                            f"rank {src}, at {self._round}")
-                    else:
-                        inbox[src] = payload
-                        got += 1
-        except BaseException as e:
-            errors.append(e)
+            except (OSError, ConnectionError, struct.error) as e:
+                # stray probes / aborted sends are DROPPED, not fatal:
+                # the listener lives for the whole process, and a health
+                # check must not kill the next round (a genuinely lost
+                # payload surfaces as that round's TimeoutError naming
+                # the silent rank)
+                log.warning("mesh listener: dropped bad connection (%s)",
+                            e)
+                continue
+            with self._cv:
+                if rnd < self._round:
+                    self._listen_err = RuntimeError(
+                        f"shuffle round mismatch: got stale round {rnd} "
+                        f"from rank {src}, at {self._round}")
+                else:
+                    self._stash[(rnd, src)] = payload
+                self._cv.notify_all()
 
     def _send_to(self, dst: int, payload: bytes,
                  errors: List[BaseException]) -> None:
@@ -191,37 +207,70 @@ class TcpShuffler(Shuffler):
         except BaseException as e:
             errors.append(e)
 
-    def exchange(self, records: List[SlotRecord]) -> List[SlotRecord]:
-        parts: List[List[SlotRecord]] = [[] for _ in range(self.world)]
-        for r in records:
-            parts[self.route_fn(r, self.world, self.seed)].append(r)
-        inbox: Dict[int, bytes] = {}
+    def exchange_bytes(self, payloads: Dict[int, bytes]
+                       ) -> Dict[int, bytes]:
+        """One full-mesh round: send payloads[dst] to each peer, return
+        {src: payload} for every other rank. All ranks must call once
+        per round."""
         errors: List[BaseException] = []
-        # payloads that arrived early during the previous round
-        for (rnd, src) in list(self._early):
-            if rnd == self._round:
-                inbox[src] = self._early.pop((rnd, src))
-        srv = threading.Thread(
-            target=self._serve,
-            args=(inbox, errors, self.world - 1 - len(inbox)),
-            daemon=True)
-        srv.start()
         senders = []
         for dst in range(self.world):
             if dst == self.rank:
                 continue
             t = threading.Thread(
-                target=self._send_to,
-                args=(dst, serialize_records(parts[dst]), errors),
+                target=self._send_to, args=(dst, payloads[dst], errors),
                 daemon=True)
             t.start()
             senders.append(t)
         for t in senders:
             t.join()
-        srv.join()
+        # collect this round's payloads from the background listener
+        want = [(self._round, src) for src in range(self.world)
+                if src != self.rank]
+        deadline = time.monotonic() + self.timeout
+        inbox: Dict[int, bytes] = {}
+        with self._cv:
+            while True:
+                if self._listen_err is not None:
+                    err, self._listen_err = self._listen_err, None
+                    raise err
+                for key in want:
+                    if key in self._stash and key[1] not in inbox:
+                        inbox[key[1]] = self._stash.pop(key)
+                if len(inbox) == len(want):
+                    break
+                left = deadline - time.monotonic()
+                if left <= 0 or not self._cv.wait(timeout=left):
+                    missing = [k[1] for k in want if k[1] not in inbox]
+                    raise TimeoutError(
+                        f"mesh round {self._round}: no payload from "
+                        f"ranks {missing} within {self.timeout}s")
+            self._round += 1
         if errors:
             raise errors[0]
-        self._round += 1
+        return inbox
+
+
+class TcpShuffler(TcpMesh, Shuffler):
+    """Record global shuffle over the TCP mesh: rank i sends partition j
+    to rank j and returns its own partition plus everything received —
+    PadBoxSlotDataset::ShuffleData / ReceiveSuffleData."""
+
+    def __init__(self, rank: int, world: int, endpoints: Sequence[str],
+                 seed: int = 0,
+                 route_fn: Optional[Callable[[SlotRecord, int, int], int]]
+                 = None, timeout: float = 120.0) -> None:
+        super().__init__(rank, world, endpoints, timeout=timeout)
+        self.seed = seed
+        self.route_fn = route_fn or default_route
+
+    def exchange(self, records: List[SlotRecord]) -> List[SlotRecord]:
+        parts: List[List[SlotRecord]] = [[] for _ in range(self.world)]
+        for r in records:
+            parts[self.route_fn(r, self.world, self.seed)].append(r)
+        inbox = self.exchange_bytes(
+            {dst: serialize_records(parts[dst])
+             for dst in range(self.world) if dst != self.rank})
         out = list(parts[self.rank])
         kept = len(out)
         for src in sorted(inbox):

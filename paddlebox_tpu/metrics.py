@@ -73,6 +73,17 @@ def auc_add_batch(state: AucState, pred: jax.Array, label: jax.Array,
     )
 
 
+def auc_compute_global(state: AucState, collective) -> AucResult:
+    """Cross-worker AUC (BasicAucCalculator's MPI reduce,
+    metrics.cc:288-304): allreduce the bucket tables and scalar error
+    sums over the host collective (distributed.collective.TcpCollective)
+    and compute ONE global AUC, identical on every rank. Uses the f64
+    host compute path regardless of FLAGS.auc_device_reduce."""
+    host = [np.asarray(jax.device_get(x)) for x in state]
+    reduced = collective.allreduce_sum(host)
+    return auc_compute(AucState(*reduced))
+
+
 @dataclasses.dataclass
 class AucResult:
     auc: float

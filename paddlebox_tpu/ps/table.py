@@ -593,6 +593,44 @@ class EmbeddingTable:
         np.savez_compressed(path, keys=keys, **data)
         return len(keys)
 
+    def _assign_file_rows(self, keys: np.ndarray,
+                          slots_b: np.ndarray) -> np.ndarray:
+        """Assign rows for a save-file's keys — slotted when the arena is
+        on and the file's slots fit, so the compact wire stays available
+        after a restore. Caller holds host_lock."""
+        if (getattr(self.index, "arena_enabled", False)
+                and (0 <= slots_b).all()
+                and (slots_b < (self.arena_slots or 0)).all()):
+            rows, _ = self.index.assign_slotted(
+                keys, slots_b.astype(np.uint16))
+        else:
+            rows = self.index.assign(keys)
+        self.slot_host[rows] = slots_b
+        return rows
+
+    def _insert_file_rows(self, data: np.ndarray, rows: np.ndarray,
+                          blob, sel=slice(None)) -> None:
+        """Write a save-file's field blocks (all but slot, which is host
+        metadata) into the logical data matrix at ``rows``; ``sel``
+        restricts to a subset of the file's rows (merge_model)."""
+        mf_end = NUM_FIXED + self.mf_dim
+        for f in FIELDS:
+            if f == "slot":
+                continue
+            if f == "embedx_w":
+                data[rows, NUM_FIXED:mf_end] = blob[f][sel]
+            else:
+                field_assign(data, rows, f, blob[f][sel])
+        if self.opt_ext:
+            if "opt_ext" in blob \
+                    and blob["opt_ext"].shape[1] == self.opt_ext:
+                data[rows, mf_end:mf_end + self.opt_ext] = \
+                    blob["opt_ext"][sel]
+            else:
+                log.warning("load: file has no matching opt_ext block; "
+                            "optimizer state starts fresh for loaded "
+                            "rows")
+
     def load(self, path: str, merge: bool = False) -> int:
         """Load a save_base/save_delta file; merge=True keeps existing rows
         (delta apply), else resets the table first."""
@@ -608,35 +646,49 @@ class EmbeddingTable:
                                               ext=self.opt_ext)
                 self._touched[:] = False
                 self.slot_host[:] = 0
-            slots_b = blob["slot"].astype(np.int16)
-            if (getattr(self.index, "arena_enabled", False)
-                    and (0 <= slots_b).all()
-                    and (slots_b < (self.arena_slots or 0)).all()):
-                # keep loaded rows in their slot arenas so the compact
-                # wire stays available after a restore
-                rows, _ = self.index.assign_slotted(
-                    keys, slots_b.astype(np.uint16))
-            else:
-                rows = self.index.assign(keys)
-            self.slot_host[rows] = slots_b
+            rows = self._assign_file_rows(keys,
+                                          blob["slot"].astype(np.int16))
         data = np.asarray(jax.device_get(self.state.data)).copy()
-        mf_end = NUM_FIXED + self.mf_dim
-        for f in FIELDS:
-            if f == "slot":
-                continue  # host metadata (slot_host); device col stays 0
-            if f == "embedx_w":
-                data[np.ix_(rows, range(NUM_FIXED, mf_end))] = blob[f]
-            else:
-                field_assign(data, rows, f, blob[f])
-        if self.opt_ext:
-            if "opt_ext" in blob and blob["opt_ext"].shape[1] == self.opt_ext:
-                data[np.ix_(rows, range(mf_end, mf_end + self.opt_ext))] = \
-                    blob["opt_ext"]
-            else:
-                log.warning("load: file has no matching opt_ext block; "
-                            "optimizer state starts fresh for loaded rows")
+        self._insert_file_rows(data, rows, blob)
         self.state = TableState.from_logical(data, self.capacity,
                                              ext=self.opt_ext)
+        return len(keys)
+
+    def merge_model(self, path: str) -> int:
+        """MergeModel (box_wrapper.h:801-803, bound at box_helper_py.cc):
+        fold another saved model's rows into the LIVE table — unlike
+        ``load(merge=True)``, which OVERWRITES rows from a delta file,
+        this MERGES statistics:
+
+        - keys present in both: show/clk/delta_score ACCUMULATE (the
+          other model's traffic counts add to ours); embedding weights
+          and optimizer state keep the live values (the live model is
+          the training continuation);
+        - unseen keys: inserted wholesale (all fields from the file).
+
+        Returns the number of rows merged."""
+        blob = np.load(path)
+        keys = blob["keys"]
+        if len(keys) == 0:
+            return 0
+        slots_b = blob["slot"].astype(np.int16)
+        with self.host_lock:
+            existing = self.index.lookup(keys) >= 0
+            rows_new = self._assign_file_rows(keys[~existing],
+                                              slots_b[~existing])
+            rows_all = self.index.lookup(keys)
+            data = np.asarray(jax.device_get(self.state.data)).copy()
+            # new rows: full insert (shared with load)
+            self._insert_file_rows(data, rows_new, blob, sel=~existing)
+            # existing rows: statistics accumulate
+            rows_old = rows_all[existing]
+            for f in ("show", "clk", "delta_score"):
+                data[rows_old, FIELD_COL[f]] += blob[f][existing]
+            self.state = TableState.from_logical(data, self.capacity,
+                                                 ext=self.opt_ext)
+            self._touched[rows_all] = True
+        log.info("merge_model: %d rows (%d new, %d stat-merged) from %s",
+                 len(keys), len(rows_new), int(existing.sum()), path)
         return len(keys)
 
     def shrink(self, delete_threshold: Optional[float] = None,

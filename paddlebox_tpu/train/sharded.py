@@ -92,6 +92,28 @@ def init_sharded_auc(n: int, nbins: Optional[int] = None) -> AucState:
                       for l in s])
 
 
+def _assert_elementwise_tx(tx: optax.GradientTransformation) -> None:
+    """ZeRO-1 applies ``tx`` to each device's flat param CHUNK, which is
+    only correct when the transform is elementwise (update of element i
+    depends on grad/param element i alone — adam/adagrad/sgd/…). Probe:
+    the update of a half-vector must equal the first half of the update
+    of the full vector; transforms with global reductions
+    (clip_by_global_norm, scale_by_trust_ratio, …) fail it."""
+    g = jnp.linspace(0.5, 4.0, 8)
+    p = jnp.ones(8)
+    u_full, _ = tx.update(g, tx.init(p), p)
+    u_half, _ = tx.update(g[:4], tx.init(p[:4]), p[:4])
+    if not np.allclose(np.asarray(u_full)[:4], np.asarray(u_half),
+                       rtol=1e-6, atol=1e-12):
+        raise ValueError(
+            "zero1=True requires an ELEMENTWISE optax transform: the "
+            "optimizer runs on per-device param chunks, and this tx "
+            "computes cross-element statistics (e.g. "
+            "clip_by_global_norm), which would silently become "
+            "per-chunk statistics. Apply such transforms before the "
+            "reduce-scatter, or disable zero1.")
+
+
 class ShardedTrainStep:
     """Builds the jitted multi-chip step for a mesh."""
 
@@ -124,6 +146,10 @@ class ShardedTrainStep:
         # sgd/…) — it is applied per flat per-device chunk, so transforms
         # needing a global reduction over the whole param tree (e.g.
         # clip_by_global_norm) would compute per-chunk statistics instead.
+        # Enforced by probe: updating a half-vector must equal the first
+        # half of updating the full vector.
+        if zero1:
+            _assert_elementwise_tx(tx)
         self.zero1 = zero1
         self._chunk = 0           # set at init_state
         self._unravel = None

@@ -219,3 +219,55 @@ def test_slot_host_recorded_on_all_paths(tmp_path):
     assert te.base.slot_host[rb] == 1
     re_ = te.extend.index.lookup(np.array([12], np.uint64))[0]
     assert re_ >= 0 and te.extend.slot_host[re_] == 1
+
+
+def test_merge_model_accumulates_stats(tmp_path):
+    """merge_model (box_wrapper.h:801): overlapping keys accumulate
+    show/clk/delta_score and keep live weights; new keys insert
+    wholesale — unlike load(merge=True), which overwrites."""
+    import jax
+    from paddlebox_tpu.ps import EmbeddingTable, SparseSGDConfig
+    cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=0.0)
+    a = EmbeddingTable(mf_dim=2, capacity=256, cfg=cfg)
+    b = EmbeddingTable(mf_dim=2, capacity=256, cfg=cfg)
+
+    def seed(table, keys, show, w):
+        rows = table.index.assign(keys)
+        data = np.asarray(jax.device_get(table.state.data)).copy()
+        data[rows, 0] = show       # show
+        data[rows, 1] = show / 2   # clk
+        data[rows, 4] = w          # embed_w
+        from paddlebox_tpu.ps.table import TableState
+        table.state = TableState.from_logical(data, table.capacity)
+        table.slot_host[rows] = 1
+
+    k_a = np.array([1, 2, 3], np.uint64)
+    k_b = np.array([2, 3, 4], np.uint64)
+    seed(a, k_a, 10.0, 0.5)
+    seed(b, k_b, 4.0, 0.9)
+    path = str(tmp_path / "other.npz")
+    b.save_base(path)
+    merged = a.merge_model(path)
+    assert merged == 3
+    data = np.asarray(jax.device_get(a.state.data))
+    rows = a.index.lookup(np.array([1, 2, 3, 4], np.uint64))
+    assert (rows >= 0).all()          # key 4 inserted
+    np.testing.assert_allclose(data[rows, 0], [10.0, 14.0, 14.0, 4.0])
+    np.testing.assert_allclose(data[rows, 1], [5.0, 7.0, 7.0, 2.0])
+    # overlapping keys KEEP live weights; the new key takes the file's
+    np.testing.assert_allclose(data[rows, 4], [0.5, 0.5, 0.5, 0.9])
+    assert a.slot_host[rows[3]] == 1
+    # merged rows are flagged for the next delta save (key 1 was not in
+    # the merge file, so it stays unflagged)
+    assert a._touched[rows[1:]].all()
+    assert not a._touched[rows[0]]
+
+
+def test_zero1_rejects_non_elementwise_tx():
+    import optax
+    from paddlebox_tpu.train.sharded import _assert_elementwise_tx
+    _assert_elementwise_tx(optax.adam(1e-3))       # fine
+    _assert_elementwise_tx(optax.sgd(0.1))         # fine
+    with pytest.raises(ValueError, match="ELEMENTWISE"):
+        _assert_elementwise_tx(optax.chain(
+            optax.clip_by_global_norm(1.0), optax.sgd(0.1)))

@@ -25,7 +25,9 @@ WORKER = textwrap.dedent("""
 
     from paddlebox_tpu.config import FLAGS
     from paddlebox_tpu.data import DataFeedDesc, DatasetFactory
+    from paddlebox_tpu.distributed.collective import TcpCollective
     from paddlebox_tpu.distributed.shuffle import TcpShuffler
+    from paddlebox_tpu.metrics import auc_compute_global
     from paddlebox_tpu.models import DeepFM
     from paddlebox_tpu.ps import EmbeddingTable, SparseSGDConfig
     from paddlebox_tpu.train import Trainer
@@ -33,6 +35,7 @@ WORKER = textwrap.dedent("""
     rank = int(os.environ["PBOX_RANK"])
     world = int(os.environ["PBOX_WORLD_SIZE"])
     endpoints = os.environ["SHUFFLE_ENDPOINTS"].split(",")
+    coll_eps = os.environ["COLLECTIVE_ENDPOINTS"].split(",")
     data_dir, out_dir = sys.argv[1], sys.argv[2]
 
     desc = DataFeedDesc.criteo(batch_size=64)
@@ -60,11 +63,20 @@ WORKER = textwrap.dedent("""
     for _ in range(3):
         res = tr.train_pass(ds)
 
+    # ONE global AUC across workers (metrics.cc:288-304 role)
+    coll = TcpCollective(rank, world, coll_eps)
+    gres = auc_compute_global(tr.state.auc, coll)
+    coll.close()
+
     out = dict(rank=rank, loaded=n_loaded, after_shuffle=n_after,
-               auc=float(res["auc"]),
+               auc=float(res["auc"]), global_auc=float(gres.auc),
+               global_ins=float(gres.ins_num),
                features=int(table.feature_count))
     with open(os.path.join(out_dir, f"r{rank}.json"), "w") as fh:
         json.dump(out, fh)
+    np.savez(os.path.join(out_dir, f"auc_r{rank}.npz"),
+             **{f: np.asarray(x) for f, x in
+                zip(tr.state.auc._fields, tr.state.auc)})
 """)
 
 
@@ -90,8 +102,9 @@ def test_two_process_shuffle_and_train(tmp_path):
     out_dir.mkdir()
     worker = tmp_path / "worker.py"
     worker.write_text(WORKER)
-    ports = _free_ports(world)
-    endpoints = ",".join(f"127.0.0.1:{p}" for p in ports)
+    ports = _free_ports(2 * world)
+    endpoints = ",".join(f"127.0.0.1:{p}" for p in ports[:world])
+    coll_endpoints = ",".join(f"127.0.0.1:{p}" for p in ports[world:])
 
     procs = []
     for r in range(world):
@@ -99,6 +112,7 @@ def test_two_process_shuffle_and_train(tmp_path):
         env = dict(os.environ, PBOX_RANK=str(r),
                    PBOX_WORLD_SIZE=str(world),
                    SHUFFLE_ENDPOINTS=endpoints,
+                   COLLECTIVE_ENDPOINTS=coll_endpoints,
                    JAX_PLATFORMS="cpu",
                    PYTHONPATH=repo + os.pathsep
                    + os.environ.get("PYTHONPATH", ""))
@@ -124,3 +138,18 @@ def test_two_process_shuffle_and_train(tmp_path):
     for r in res:
         assert np.isfinite(r["auc"]) and r["auc"] > 0.55, res
         assert r["features"] > 0
+    # the global AUC is identical on every rank and covers ALL instances
+    assert res[0]["global_auc"] == pytest.approx(res[1]["global_auc"],
+                                                 abs=1e-9)
+    # 3 passes over 1200 records — the allreduced total, on EVERY rank
+    for r in res:
+        assert r["global_ins"] == 3 * 1200
+    # and it equals a single-process AUC over the UNION of both ranks'
+    # accumulated prediction histograms (the metrics.cc:288-304 merge)
+    from paddlebox_tpu.metrics import AucState, auc_compute
+    blobs = [np.load(out_dir / f"auc_r{r}.npz") for r in range(world)]
+    merged = AucState(*[
+        sum(np.asarray(b[f], np.float64) for b in blobs)
+        for f in AucState._fields])
+    union = auc_compute(merged)
+    assert res[0]["global_auc"] == pytest.approx(union.auc, abs=1e-12)
