@@ -83,13 +83,20 @@ def pipeline_run(stage_fn: Callable, stage_params, x_micros: jax.Array,
 
     Tick t: stage i computes microbatch m = t − i (when 0 ≤ m < M), then
     activations ppermute one hop down the ring — the scope-queue handoff
-    of section_worker.cc as a single traced collective."""
+    of section_worker.cc as a single traced collective.
+
+    DIFFERENTIABLE: the tick loop is a ``lax.scan`` (reverse-mode
+    support; fori_loop has none) and every primitive inside — ppermute,
+    masked writes — has a transpose rule, so ``jax.grad`` through
+    pipeline_run runs the backward pipeline automatically (cotangents
+    ppermute the ring in reverse — the 1B1F phase of section_worker
+    without hand-scheduling). See pipeline_train_step."""
     s = jax.lax.psum(1, axis)
     i = jax.lax.axis_index(axis)
     m_count = x_micros.shape[0]
     ticks = m_count + s - 1
 
-    def tick(t, carry):
+    def tick(carry, t):
         act, out = carry
         inp = jnp.where(i == 0, x_micros[jnp.clip(t, 0, m_count - 1)], act)
         y = stage_fn(stage_params, inp)
@@ -99,13 +106,39 @@ def pipeline_run(stage_fn: Callable, stage_params, x_micros: jax.Array,
                         out.at[jnp.clip(m, 0, m_count - 1)].set(y), out)
         perm = [(j, (j + 1) % s) for j in range(s)]
         act = jax.lax.ppermute(y, axis, perm)
-        return act, out
+        return (act, out), None
 
     # the loop body makes the carry vary over the pipe axis (ppermute /
     # per-stage writes); mark the zero-init carry as varying to match
     pvary = getattr(jax.lax, "pvary", lambda x, names: x)
     act0 = pvary(jnp.zeros_like(x_micros[0]), (axis,))
     out0 = pvary(jnp.zeros_like(x_micros), (axis,))
-    _, out = jax.lax.fori_loop(0, ticks, tick, (act0, out0))
+    (_, out), _ = jax.lax.scan(tick, (act0, out0),
+                               jnp.arange(ticks, dtype=jnp.int32))
     # only the last stage holds real outputs; mask so callers can psum
     return out * (i == s - 1).astype(out.dtype)
+
+
+def pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
+                        stage_params, x_micros: jax.Array,
+                        y_micros: jax.Array,
+                        axis: str = PIPE_AXIS):
+    """One TRAINING step through the pipeline: forward GPipe schedule,
+    loss on the last stage's microbatch outputs, backward through the
+    scanned schedule (grads ppermute the ring in reverse — the
+    PipelineTrainer/section_worker training loop, section_worker.cc).
+
+    stage_fn(params, act) -> act; loss_fn(out, y) -> scalar PER-
+    microbatch mean loss. Returns (loss, stage_grads) where stage_grads
+    matches this device's ``stage_params`` — feed any optax optimizer.
+    Mathematically identical to sequential training on the concatenated
+    microbatches (GPipe has no weight staleness inside a step)."""
+    def objective(params):
+        out = pipeline_run(stage_fn, params, x_micros, axis)
+        # out is masked to the last stage; the mean over microbatches on
+        # that stage is the step loss (psum makes it global so every
+        # stage's grads see the same scalar)
+        loss = loss_fn(out, y_micros)
+        return jax.lax.psum(loss, axis)
+
+    return jax.value_and_grad(objective)(stage_params)

@@ -127,3 +127,78 @@ def test_hierarchical_allreduce_matches_flat_psum():
         check_vma=False))(jnp.asarray(x))
     np.testing.assert_allclose(np.asarray(h), np.asarray(f), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(h)[0], x.sum(axis=0), rtol=1e-4)
+
+
+def test_pipeline_training_matches_sequential():
+    """The pipeline must TRAIN, not just infer: several optimizer steps
+    through pipeline_train_step must track sequential training of the
+    same stacked model on the same data (GPipe is mathematically
+    identical to sequential — grads accumulate over microbatches inside
+    one step)."""
+    import optax
+    from paddlebox_tpu.parallel.layers import pipeline_train_step
+
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, ("pp",))
+    rng = np.random.default_rng(5)
+    m, mb, d = 4, 6, 8
+    x = rng.normal(size=(m, mb, d)).astype(np.float32)
+    y = rng.normal(size=(m, mb, d)).astype(np.float32)
+    ws0 = (rng.normal(size=(4, d, d)).astype(np.float32) * 0.3)
+
+    def stage(w, a):
+        return jnp.tanh(a @ w)
+
+    def loss_fn(out, y_micros):
+        # mean over the last stage's microbatch outputs (out is zero
+        # off the last stage, so the psum in pipeline_train_step makes
+        # this the global loss)
+        i = jax.lax.axis_index("pp")
+        s = jax.lax.psum(1, "pp")
+        diff = (out - y_micros * (i == s - 1)) * (i == s - 1)
+        # zero off the last stage; the psum in pipeline_train_step
+        # yields exactly the last stage's mse
+        return jnp.mean(diff * diff)
+
+    tx = optax.sgd(0.2)
+
+    def train_step(ws_sharded, opt_state, x_micros, y_micros):
+        def body(w_local, o_local):
+            loss, g = pipeline_train_step(stage, loss_fn, w_local[0],
+                                          x_micros, y_micros, axis="pp")
+            up, o2 = tx.update(g, o_local, w_local[0])
+            return loss, (optax.apply_updates(w_local[0], up)[None], o2)
+
+        return shard_map(
+            body, mesh=mesh,
+            in_specs=(P("pp", None, None), P("pp")),
+            out_specs=(P(), (P("pp", None, None), P("pp"))))(
+                ws_sharded, opt_state)
+
+    # sequential reference: same model stacked, full-batch mse
+    def seq_loss(ws, xx, yy):
+        a = xx
+        for i in range(4):
+            a = jnp.tanh(a @ ws[i])
+        return jnp.mean((a - yy) ** 2)
+
+    ws_pipe = jnp.asarray(ws0)
+    opt_pipe = jax.vmap(tx.init)(ws_pipe)
+    ws_seq = jnp.asarray(ws0)
+    opt_seq = tx.init(ws_seq)
+    xx = x.reshape(m * mb, d)
+    yy = y.reshape(m * mb, d)
+    losses_p, losses_s = [], []
+    for step in range(5):
+        lp, (ws_pipe, opt_pipe) = train_step(ws_pipe, opt_pipe,
+                                             jnp.asarray(x),
+                                             jnp.asarray(y))
+        ls, gs = jax.value_and_grad(seq_loss)(ws_seq, xx, yy)
+        up, opt_seq = tx.update(gs, opt_seq, ws_seq)
+        ws_seq = optax.apply_updates(ws_seq, up)
+        losses_p.append(float(lp))
+        losses_s.append(float(ls))
+    np.testing.assert_allclose(losses_p, losses_s, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ws_pipe), np.asarray(ws_seq),
+                               rtol=1e-4, atol=1e-5)
+    assert losses_p[-1] < losses_p[0] * 0.98  # it actually learns
