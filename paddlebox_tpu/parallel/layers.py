@@ -128,17 +128,21 @@ def pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
     scanned schedule (grads ppermute the ring in reverse — the
     PipelineTrainer/section_worker training loop, section_worker.cc).
 
-    stage_fn(params, act) -> act; loss_fn(out, y) -> scalar PER-
-    microbatch mean loss. Returns (loss, stage_grads) where stage_grads
-    matches this device's ``stage_params`` — feed any optax optimizer.
-    Mathematically identical to sequential training on the concatenated
-    microbatches (GPipe has no weight staleness inside a step)."""
+    stage_fn(params, act) -> act; loss_fn(out, y) -> scalar mean loss
+    over the microbatch outputs — written as if single-device (e.g.
+    ``jnp.mean((out - y) ** 2)``); the last-stage masking happens HERE,
+    so off-stage devices contribute exactly zero to the reported loss.
+    Returns (loss, stage_grads) where stage_grads matches this device's
+    ``stage_params`` — feed any optax optimizer. Mathematically
+    identical to sequential training on the concatenated microbatches
+    (GPipe has no weight staleness inside a step)."""
     def objective(params):
         out = pipeline_run(stage_fn, params, x_micros, axis)
-        # out is masked to the last stage; the mean over microbatches on
-        # that stage is the step loss (psum makes it global so every
-        # stage's grads see the same scalar)
-        loss = loss_fn(out, y_micros)
+        last = jax.lax.axis_index(axis) == jax.lax.psum(1, axis) - 1
+        # out is zero off the last stage; mask the loss there too so a
+        # plain mean-style loss_fn reports the true loss (constant
+        # mean(y**2) terms from zero outputs must not psum in)
+        loss = jnp.where(last, loss_fn(out, y_micros), 0.0)
         return jax.lax.psum(loss, axis)
 
     return jax.value_and_grad(objective)(stage_params)
