@@ -71,15 +71,40 @@ def main() -> None:
         return d
 
     cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=1e-3)
-    # slot-arena allocation → the resident path ships the COMPACT wire
-    # (per-key ~17-bit slot-local rows, no dedup streams); set
-    # BENCH_ARENA=0 to measure the host-dedup wire instead
-    arena = int(os.environ.get("BENCH_ARENA", "1"))
-    table = EmbeddingTable(mf_dim=mf_dim, capacity=1 << 23, cfg=cfg,
-                           unique_bucket_min=1 << 12,
-                           arena_slots=26 if arena else None)
-    tr = Trainer(DeepFM(hidden=(512, 256, 128)), table, desc,
-                 tx=optax.adam(1e-3), prefetch=8)
+    metric = "deepfm_ctr_examples_per_sec_per_chip"
+    chips = 1
+
+    if mode == "sharded":
+        # mesh-mode benchmark: the SHARDED trainer (key%N all_to_all
+        # embedding routing + psum dense + sharded AUC) over a mesh of
+        # every visible device — 1 real chip here, or a virtual CPU mesh
+        # under JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_
+        # device_count=N. Reported value stays PER-CHIP for a comparable
+        # vs_baseline.
+        import jax
+        from paddlebox_tpu.parallel import make_mesh
+        from paddlebox_tpu.ps.sharded import ShardedEmbeddingTable
+        from paddlebox_tpu.train.sharded import ShardedTrainer
+        chips = len(jax.devices())
+        metric += "_sharded"
+        mesh = make_mesh(chips)
+        table = ShardedEmbeddingTable(
+            chips, mf_dim=mf_dim, capacity_per_shard=(1 << 23) // chips,
+            cfg=cfg, req_bucket_min=1 << 12, serve_bucket_min=1 << 12)
+        tr = ShardedTrainer(DeepFM(hidden=(512, 256, 128)), table,
+                            desc, mesh, tx=optax.adam(1e-3))
+        build_fn = tr.build_resident_pass
+    else:
+        # slot-arena allocation → the resident path ships the COMPACT
+        # wire (per-key ~17-bit slot-local rows, no dedup streams); set
+        # BENCH_ARENA=0 to measure the host-dedup wire instead
+        arena = int(os.environ.get("BENCH_ARENA", "1"))
+        table = EmbeddingTable(mf_dim=mf_dim, capacity=1 << 23, cfg=cfg,
+                               unique_bucket_min=1 << 12,
+                               arena_slots=26 if arena else None)
+        tr = Trainer(DeepFM(hidden=(512, 256, 128)), table, desc,
+                     tx=optax.adam(1e-3), prefetch=8)
+        build_fn = None
 
     if mode == "streaming":
         ds = make_ds(0)
@@ -106,7 +131,9 @@ def main() -> None:
         import jax.numpy as jnp
         wire = os.environ.get("BENCH_FLOAT_WIRE", "q8")
         wire = {"bf16": jnp.bfloat16, "f32": np.float32}.get(wire, wire)
-        pre = PassPreloader(datasets, table, floats_dtype=wire)
+        pre = (PassPreloader(datasets, build_fn=build_fn)
+               if build_fn is not None else
+               PassPreloader(datasets, table, floats_dtype=wire))
         pre.start_next()
         rp = pre.wait()
         pre.start_next()
@@ -132,10 +159,10 @@ def main() -> None:
                 print(f"pass: wait={t_wait:.3f}s train={t_train:.3f}s",
                       file=sys.stderr)
             per_pass.append(rp.num_records / (time.perf_counter() - t0))
-        value = float(np.median(per_pass))
+        value = float(np.median(per_pass)) / chips
     baseline_per_chip = 1_000_000 / 16  # v5p-32 north-star / chips
     print(json.dumps({
-        "metric": "deepfm_ctr_examples_per_sec_per_chip",
+        "metric": metric,
         "value": round(value, 1),
         "unit": "examples/sec/chip",
         "vs_baseline": round(value / baseline_per_chip, 4),
