@@ -94,6 +94,10 @@ def main() -> None:
         tr = ShardedTrainer(DeepFM(hidden=(512, 256, 128)), table,
                             desc, mesh, tx=optax.adam(1e-3))
         build_fn = tr.build_resident_pass
+        for knob in ("BENCH_FLOAT_WIRE", "BENCH_ARENA"):
+            if knob in os.environ:
+                print(f"warning: {knob} is ignored in sharded mode",
+                      file=sys.stderr)
     else:
         # slot-arena allocation → the resident path ships the COMPACT
         # wire (per-key ~17-bit slot-local rows, no dedup streams); set

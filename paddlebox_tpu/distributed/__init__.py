@@ -1,11 +1,13 @@
 from paddlebox_tpu.distributed.elastic import (
     ElasticLevel, ElasticManager, FileKVStore, KVStore,
 )
+from paddlebox_tpu.distributed.kv_server import KVServer, TcpKVStore
 from paddlebox_tpu.distributed.launch import (
     LaunchConfig, init_runtime_env, launch_local, main,
 )
 
 __all__ = [
     "ElasticLevel", "ElasticManager", "FileKVStore", "KVStore",
+    "KVServer", "TcpKVStore",
     "LaunchConfig", "init_runtime_env", "launch_local", "main",
 ]

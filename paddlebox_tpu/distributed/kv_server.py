@@ -1,0 +1,207 @@
+"""Network KV backend for the elastic manager — the etcd stand-in.
+
+Reference: fleet elastic uses an etcd cluster for host leases, scale
+events and the checkpoint pointer (fleet/elastic/manager.py:131 lease +
+watch, :248-250 endpoints). The TPU framework's ElasticManager speaks
+the tiny :class:`~paddlebox_tpu.distributed.elastic.KVStore` interface
+(put/get/delete/list_prefix/mtime — leases are heartbeat keys + mtime,
+watches are polls), so a single-process TCP server covers the whole
+contract without a shared filesystem: run :class:`KVServer` anywhere
+reachable (e.g. alongside rank 0 or a scheduler), point every host's
+:class:`TcpKVStore` at it.
+
+Wire protocol (length-framed, one request per connection round):
+  request : op u8 | klen u32 | key | vlen u64 | value
+  response: ok u8 | vlen u64 | value
+ops: 1=PUT 2=GET 3=DEL 4=LIST(prefix) 5=MTIME. LIST value = repeated
+[klen u32 | key | vlen u64 | value]; MTIME value = the entry's AGE in
+seconds as f64 (server now − write stamp) — ages, not absolute
+timestamps, so lease liveness is immune to cross-host clock skew."""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from paddlebox_tpu.distributed.elastic import KVStore
+from paddlebox_tpu.distributed.shuffle import _recv_exact
+from paddlebox_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+_PUT, _GET, _DEL, _LIST, _MTIME = 1, 2, 3, 4, 5
+_MAX_KEY = 1 << 16   # sanity caps: elastic keys/payloads are tiny;
+_MAX_VAL = 1 << 26   # anything bigger is a stray/garbage connection
+_VERY_OLD = 1e12     # age reported for missing keys
+
+
+class KVServer:
+    """Threaded in-memory KV server (one handler thread per connection;
+    dict + lock — elastic traffic is heartbeats, not a datastore)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._data: Dict[str, Tuple[bytes, float]] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(64)
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name="kv-server")
+        self._thread.start()
+
+    @property
+    def endpoint(self) -> str:
+        h, p = self._srv.getsockname()
+        return f"{h}:{p}"
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                conn.settimeout(30.0)
+                while True:
+                    hdr = conn.recv(1)
+                    if not hdr:
+                        return
+                    op = hdr[0]
+                    (klen,) = struct.unpack("<I", _recv_exact(conn, 4))
+                    if not _PUT <= op <= _MTIME or klen > _MAX_KEY:
+                        raise ValueError(f"bad kv request op={op}")
+                    key = _recv_exact(conn, klen).decode("utf-8")
+                    (vlen,) = struct.unpack("<Q", _recv_exact(conn, 8))
+                    if vlen > _MAX_VAL:
+                        raise ValueError(f"kv value too large ({vlen})")
+                    value = _recv_exact(conn, vlen) if vlen else b""
+                    resp = self._apply(op, key, value)
+                    conn.sendall(b"\x01" + struct.pack("<Q", len(resp))
+                                 + resp)
+        except (OSError, ConnectionError, struct.error, ValueError,
+                UnicodeDecodeError) as e:
+            # garbage connections are dropped, never crash the handler
+            log.warning("kv server: dropped bad connection (%s)", e)
+
+    def _apply(self, op: int, key: str, value: bytes) -> bytes:
+        with self._lock:
+            if op == _PUT:
+                self._data[key] = (value, time.time())
+                return b""
+            if op == _GET:
+                ent = self._data.get(key)
+                return b"\x00" if ent is None else b"\x01" + ent[0]
+            if op == _DEL:
+                self._data.pop(key, None)
+                return b""
+            if op == _LIST:
+                parts = []
+                for k, (v, _) in self._data.items():
+                    if k.startswith(key):
+                        kb = k.encode("utf-8")
+                        parts.append(struct.pack("<I", len(kb)) + kb
+                                     + struct.pack("<Q", len(v)) + v)
+                return b"".join(parts)
+            if op == _MTIME:
+                ent = self._data.get(key)
+                age = (time.time() - ent[1]) if ent else _VERY_OLD
+                return struct.pack("<d", age)
+        raise ValueError(f"bad kv op {op}")
+
+
+class TcpKVStore(KVStore):
+    """KVStore client against a :class:`KVServer` endpoint — drop-in for
+    FileKVStore, no shared filesystem needed. One persistent connection
+    per store (heartbeat cadence), reconnects on failure."""
+
+    def __init__(self, endpoint: str, timeout: float = 10.0) -> None:
+        host, port = endpoint.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self._timeout = timeout
+        self._conn: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        with self._lock:
+            self._close_locked()
+
+    def _close_locked(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = None
+
+    def _request(self, op: int, key: str, value: bytes = b"") -> bytes:
+        kb = key.encode("utf-8")
+        msg = (bytes([op]) + struct.pack("<I", len(kb)) + kb
+               + struct.pack("<Q", len(value)) + value)
+        with self._lock:
+            for attempt in (0, 1):  # one reconnect on a stale socket
+                try:
+                    if self._conn is None:
+                        self._conn = socket.create_connection(
+                            self._addr, timeout=self._timeout)
+                    self._conn.sendall(msg)
+                    ok = _recv_exact(self._conn, 1)
+                    (vlen,) = struct.unpack(
+                        "<Q", _recv_exact(self._conn, 8))
+                    body = _recv_exact(self._conn, vlen) if vlen else b""
+                    if ok != b"\x01":
+                        raise ConnectionError("kv server error")
+                    return body
+                except (OSError, ConnectionError):
+                    self._close_locked()
+                    if attempt:
+                        raise
+        raise ConnectionError("unreachable")  # pragma: no cover
+
+    def put(self, key: str, value: bytes) -> None:
+        self._request(_PUT, key, value)
+
+    def get(self, key: str) -> Optional[bytes]:
+        body = self._request(_GET, key)
+        return None if body[:1] == b"\x00" else body[1:]
+
+    def delete(self, key: str) -> None:
+        self._request(_DEL, key)
+
+    def list_prefix(self, prefix: str) -> Dict[str, bytes]:
+        body = self._request(_LIST, prefix)
+        out: Dict[str, bytes] = {}
+        pos = 0
+        while pos < len(body):
+            (klen,) = struct.unpack_from("<I", body, pos)
+            pos += 4
+            k = body[pos:pos + klen].decode("utf-8")
+            pos += klen
+            (vlen,) = struct.unpack_from("<Q", body, pos)
+            pos += 8
+            out[k] = body[pos:pos + vlen]
+            pos += vlen
+        return out
+
+    def mtime(self, key: str) -> float:
+        """Write time in THIS host's clock: the server reports the
+        entry's AGE and we subtract locally, so lease checks
+        (now − mtime ≤ ttl) are immune to cross-host clock skew."""
+        (age,) = struct.unpack("<d", self._request(_MTIME, key))
+        return max(time.time() - age, 0.0)

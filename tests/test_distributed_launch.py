@@ -122,3 +122,82 @@ def test_launch_elastic_restart_resumes_from_checkpoint(tmp_path):
     assert rc == 0
     # two attempts, both saw the checkpoint pointer
     assert (marker / "1").read_text().endswith("ckpt_pass3")
+
+
+def test_tcp_kv_store_matches_file_kv(tmp_path):
+    """TcpKVStore speaks the full KVStore contract against a KVServer —
+    drop-in for FileKVStore with no shared filesystem."""
+    from paddlebox_tpu.distributed import KVServer, TcpKVStore
+    srv = KVServer()
+    try:
+        kv = TcpKVStore(srv.endpoint)
+        assert kv.get("a") is None
+        assert kv.mtime("a") == 0.0
+        kv.put("a", b"1")
+        kv.put("jobs/x", b"xx")
+        kv.put("jobs/y", b"yy")
+        assert kv.get("a") == b"1"
+        assert kv.mtime("a") > 0.0
+        assert kv.list_prefix("jobs/") == {"jobs/x": b"xx",
+                                           "jobs/y": b"yy"}
+        t0 = kv.mtime("a")
+        time.sleep(0.01)
+        kv.put("a", b"2")   # overwrite bumps mtime
+        assert kv.get("a") == b"2" and kv.mtime("a") > t0
+        kv.delete("a")
+        assert kv.get("a") is None
+        # a second client sees the same state (it's a server, not files)
+        kv2 = TcpKVStore(srv.endpoint)
+        assert kv2.get("jobs/x") == b"xx"
+        kv.close()
+        kv2.close()
+    finally:
+        srv.close()
+
+
+def test_elastic_kill_and_rejoin_over_tcp_kv():
+    """ElasticManager over the NETWORK KV: two hosts register; one dies
+    (lease expires); the survivor sees the scale-down event; the host
+    rejoins and the world converges back — the etcd lease/watch flow of
+    fleet/elastic/manager.py:131 without a shared filesystem."""
+    from paddlebox_tpu.distributed import (ElasticManager, KVServer,
+                                           TcpKVStore)
+    srv = KVServer()
+    try:
+        kv_a = TcpKVStore(srv.endpoint)
+        kv_b = TcpKVStore(srv.endpoint)
+        mk = lambda kv, h: ElasticManager(
+            kv, "jobk", h, np=2, min_np=1, max_np=2, ttl=0.4)
+        m_a = mk(kv_a, "hostA")
+        m_b = mk(kv_b, "hostB")
+        m_a.register()
+        m_b.register()
+        assert sorted(m_a.wait_for_np(timeout=10)) == ["hostA", "hostB"]
+        assert m_a.scale_event() is None  # steady state
+        # hostB dies WITHOUT deregistering (kill): its lease expires
+        m_b._stop.set()
+        m_b._hb_thread.join()
+        deadline = time.time() + 10
+        ev = None
+        while time.time() < deadline and ev is None:
+            time.sleep(0.1)
+            ev = m_a.scale_event()
+        assert ev == ["hostA"], ev            # scale-down observed
+        assert m_a.world_ok()                 # min_np=1 keeps the job up
+        # hostB rejoins through a FRESH store/manager (process restart);
+        # the survivor sees the scale-UP event (wait_for_np would consume
+        # it — the rendezvous updates the watch baseline by design)
+        kv_b2 = TcpKVStore(srv.endpoint)
+        m_b2 = mk(kv_b2, "hostB")
+        m_b2.register()
+        deadline = time.time() + 10
+        ev2 = None
+        while time.time() < deadline and ev2 is None:
+            time.sleep(0.1)
+            ev2 = m_a.scale_event()
+        assert ev2 == ["hostA", "hostB"]      # scale-up observed
+        assert sorted(m_a.wait_for_np(timeout=10)) == ["hostA", "hostB"]
+        m_a.deregister()
+        m_b2.deregister()
+    finally:
+        srv.close()
