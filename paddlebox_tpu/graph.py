@@ -13,6 +13,19 @@ gather: uniform neighbor draws are ``indptr[n] + floor(u * deg)`` with
 isolated nodes padded to -1 (static shapes, no host sync), so a sampling
 step fuses into the surrounding training step instead of being a
 separate RPC to a graph server. Walks are ``lax.scan`` over hops.
+
+Depth matching graph_gpu_ps_table.h:128-140 / graph_sampler.h:
+- edge WEIGHTS: weighted with-replacement draws are one searchsorted
+  over the per-node cumulative-weight spans (WeightedSampleKernel role);
+- WITHOUT-replacement (uniform or weighted) via the Gumbel top-k trick
+  over a bounded neighbor window — the TPU-shaped equivalent of the
+  reference's per-node shuffles (static shapes, one top_k);
+- typed graphs + METAPATH walks (HeteroGraphStore.metapath_walk — the
+  graph_sampler walk schedules over edge types);
+- mesh SHARDING by node %% N with all_to_all query routing inside
+  shard_map (ShardedGraphStore — the multi-GPU table's partition);
+- node feature pull through the embedding PS
+  (features_for_nodes == get_feature_of_nodes, graph_gpu_ps_table.h:141).
 """
 
 from __future__ import annotations
@@ -25,23 +38,53 @@ import numpy as np
 
 
 class GraphStore:
-    """CSR graph with dense node ids [0, n_nodes)."""
+    """CSR graph with dense node ids [0, n_nodes); optional per-edge
+    weights (cumulative sums precomputed for weighted draws)."""
 
-    def __init__(self, indptr: np.ndarray, indices: np.ndarray) -> None:
+    def __init__(self, indptr: np.ndarray, indices: np.ndarray,
+                 weights: Optional[np.ndarray] = None) -> None:
         self.indptr = np.asarray(indptr, np.int32)
         self.indices = np.asarray(indices, np.int32)
         self.n_nodes = self.indptr.size - 1
-        self._dev: Optional[Tuple[jax.Array, jax.Array]] = None
+        if weights is not None:
+            weights = np.asarray(weights, np.float32)
+            if weights.shape != self.indices.shape:
+                raise ValueError("one weight per edge required")
+            if (weights < 0).any():
+                raise ValueError("edge weights must be non-negative")
+        self.weights = weights
+        # Global cumulative weights: monotone, so a per-node weighted
+        # draw is ONE searchsorted into its [indptr[n], indptr[n+1]) span.
+        # Weights are NORMALIZED to mean 1 before the (f64) cumsum so the
+        # f32 device copy's total ≈ edge count: f32 spacing stays below
+        # the smallest normalized span while edges-per-store < ~2^24.
+        # Larger graphs must shard (ShardedGraphStore cumsum is
+        # per-shard), which also matches the reference's partitioning.
+        if weights is not None and weights.size:
+            mean_w = float(weights.mean())
+            if mean_w <= 0:
+                raise ValueError("edge weights must not all be zero")
+            self.cumw = np.cumsum(weights / mean_w,
+                                  dtype=np.float64).astype(np.float32)
+        else:
+            self.cumw = None
+        self._dev = None
+        self._dev_cumw = None
 
     @classmethod
     def from_edges(cls, src: np.ndarray, dst: np.ndarray,
                    n_nodes: Optional[int] = None,
-                   symmetric: bool = False) -> "GraphStore":
+                   symmetric: bool = False,
+                   weights: Optional[np.ndarray] = None) -> "GraphStore":
         src = np.asarray(src, np.int64)
         dst = np.asarray(dst, np.int64)
+        if weights is not None:
+            weights = np.asarray(weights, np.float32)
         if symmetric:
             src, dst = (np.concatenate([src, dst]),
                         np.concatenate([dst, src]))
+            if weights is not None:
+                weights = np.concatenate([weights, weights])
         n = int(n_nodes if n_nodes is not None
                 else (max(src.max(), dst.max()) + 1 if src.size else 0))
         if src.size and (src.min() < 0 or dst.min() < 0
@@ -55,7 +98,8 @@ class GraphStore:
         counts = np.bincount(src, minlength=n)
         indptr = np.zeros(n + 1, np.int64)
         np.cumsum(counts, out=indptr[1:])
-        return cls(indptr, dst)
+        return cls(indptr, dst,
+                   weights[order] if weights is not None else None)
 
     def degree(self, nodes: Optional[np.ndarray] = None) -> np.ndarray:
         deg = np.diff(self.indptr)
@@ -65,6 +109,13 @@ class GraphStore:
         if self._dev is None:
             self._dev = (jnp.asarray(self.indptr), jnp.asarray(self.indices))
         return self._dev
+
+    def to_device_weighted(self):
+        if self.cumw is None:
+            raise ValueError("graph has no edge weights")
+        if self._dev_cumw is None:
+            self._dev_cumw = jnp.asarray(self.cumw)
+        return (*self.to_device(), self._dev_cumw)
 
 
 def sample_neighbors(indptr: jax.Array, indices: jax.Array,
@@ -122,3 +173,215 @@ class GraphDataGenerator:
                 self._rng, sub = jax.random.split(self._rng)
                 yield random_walk(indptr, indices, jnp.asarray(chunk),
                                   self.walk_len, sub)
+
+
+def sample_neighbors_weighted(indptr: jax.Array, indices: jax.Array,
+                              cumw: jax.Array, nodes: jax.Array, k: int,
+                              rng: jax.Array) -> jax.Array:
+    """Weight-proportional with-replacement k-sample per node → [N, k];
+    isolated / zero-weight nodes yield -1. One vectorized searchsorted
+    into each node's cumulative-weight span (the WeightedSampleKernel of
+    the reference's sampler, without per-thread rejection loops)."""
+    start = indptr[nodes]
+    end = indptr[nodes + 1]
+    lo = jnp.where(start > 0, cumw[jnp.maximum(start - 1, 0)], 0.0)
+    hi = cumw[jnp.maximum(end - 1, 0)]
+    total = jnp.where(end > start, hi - lo, 0.0)
+    u = jax.random.uniform(rng, (nodes.shape[0], k))
+    # strictly inside the span: searchsorted returns the owning edge
+    target = lo[:, None] + u * jnp.maximum(total, 1e-30)[:, None]
+    idx = jnp.searchsorted(cumw, target, side="left").astype(jnp.int32)
+    idx = jnp.clip(idx, start[:, None], jnp.maximum(end[:, None] - 1, 0))
+    neigh = indices[idx]
+    return jnp.where(total[:, None] > 0, neigh, -1)
+
+
+def sample_neighbors_without_replacement(
+        indptr: jax.Array, indices: jax.Array, nodes: jax.Array, k: int,
+        rng: jax.Array, max_degree: int = 128,
+        cumw: jax.Array = None) -> jax.Array:
+    """WITHOUT-replacement k-sample per node → [N, k] (uniform, or
+    weight-proportional when ``cumw`` is given) — the Gumbel top-k
+    trick: per candidate edge key = log(w) + Gumbel noise, take top-k
+    (exactly Plackett-Luce sequential sampling without replacement).
+
+    TPU-shaped: gathers a bounded [N, max_degree] neighbor window and
+    runs ONE lax.top_k — no per-node shuffles or rejection loops.
+    Nodes with degree > max_degree sample from their first max_degree
+    edges (CSR build order); raise ``max_degree`` for hub-heavy graphs.
+    Slots beyond a node's degree (or beyond k available) are -1, as in
+    the reference's padded NeighborSampleResult."""
+    n = nodes.shape[0]
+    start = indptr[nodes]
+    deg = jnp.minimum(indptr[nodes + 1] - start, max_degree)
+    pos = jnp.arange(max_degree, dtype=jnp.int32)[None, :]
+    edge = jnp.minimum(start[:, None] + pos,
+                       jnp.maximum(indices.shape[0] - 1, 0))
+    valid = pos < deg[:, None]
+    if cumw is not None:
+        w_hi = cumw[edge]
+        w_lo = jnp.where(edge > 0, cumw[jnp.maximum(edge - 1, 0)], 0.0)
+        span = (w_hi - w_lo).astype(jnp.float32)
+        # zero-weight edges are NOT sampleable (matches the
+        # with-replacement sampler's zero-total -> -1 contract)
+        logw = jnp.where(span > 0, jnp.log(jnp.maximum(span, 1e-30)),
+                         -jnp.inf)
+    else:
+        logw = jnp.zeros((n, max_degree))
+    g = -jnp.log(-jnp.log(
+        jax.random.uniform(rng, (n, max_degree), minval=1e-12,
+                           maxval=1.0)))
+    keys = jnp.where(valid, logw + g, -jnp.inf)
+    top, arg = jax.lax.top_k(keys, min(k, max_degree))
+    neigh = jnp.take_along_axis(
+        indices[edge], arg, axis=1)                       # [N, <=k]
+    neigh = jnp.where(jnp.isfinite(top), neigh, -1)
+    if neigh.shape[1] < k:                                # k > max_degree
+        pad = jnp.full((n, k - neigh.shape[1]), -1, neigh.dtype)
+        neigh = jnp.concatenate([neigh, pad], axis=1)
+    return neigh
+
+
+class HeteroGraphStore:
+    """Typed-edge graph: one CSR per edge type over a SHARED node id
+    space (the reference's per-type graph index, idx arg of
+    graph_neighbor_sample_v2 / add_graph_table)."""
+
+    def __init__(self, stores) -> None:
+        self.stores = dict(stores)
+        if not self.stores:
+            raise ValueError("need at least one edge type")
+
+    def edge_types(self):
+        return sorted(self.stores)
+
+    def metapath_walk(self, metapath, starts: jax.Array,
+                      rng: jax.Array) -> jax.Array:
+        """Walk following the given edge-type sequence (graph_sampler
+        metapath schedules): hop i samples one neighbor through
+        ``metapath[i]``'s CSR. Stalls at dead ends. → [N, len+1]."""
+        cur = starts
+        cols = [starts]
+        for i, et in enumerate(metapath):
+            indptr, indices = self.stores[et].to_device()
+            rng, sub = jax.random.split(rng)
+            nxt = sample_neighbors(indptr, indices, cur, 1, sub)[:, 0]
+            cur = jnp.where(nxt < 0, cur, nxt)
+            cols.append(cur)
+        return jnp.stack(cols, axis=1)
+
+
+class ShardedGraphStore:
+    """Mesh-sharded graph table: node n lives on shard n % S (the
+    multi-GPU GpuPsGraphTable partition, heter_comm key%N routing).
+
+    Shards are stacked, padded CSR arrays ([S, ...] leading mesh axis);
+    sampling runs INSIDE shard_map: queries all_to_all to their owner
+    shard, sample locally, all_to_all back — the same two-collective
+    route as the sharded embedding pull (train/sharded.py)."""
+
+    def __init__(self, store: GraphStore, n_shards: int) -> None:
+        self.n = n_shards
+        self.n_nodes = store.n_nodes
+        indptrs, indices_l = [], []
+        all_deg = np.diff(store.indptr)
+        for s in range(n_shards):
+            own = np.arange(s, store.n_nodes, n_shards)
+            deg = all_deg[own] if own.size else np.zeros(0, np.int64)
+            ip = np.zeros(own.size + 1, np.int64)
+            np.cumsum(deg, out=ip[1:])
+            # one vectorized gather per shard (no per-node python):
+            # edge position j of the shard belongs to owned node
+            # searchsorted(ip, j, 'right')-1 at offset j - ip[node]
+            total = int(ip[-1])
+            if total:
+                node_of = np.repeat(np.arange(own.size), deg)
+                off = np.arange(total) - ip[node_of]
+                idx = store.indices[store.indptr[own][node_of] + off]
+            else:
+                idx = np.zeros(0, np.int32)
+            indptrs.append(ip)
+            indices_l.append(idx.astype(np.int32, copy=False))
+        ip_pad = max(a.size for a in indptrs)
+        ix_pad = max(max(a.size for a in indices_l), 1)
+        self.indptr = np.zeros((n_shards, ip_pad), np.int32)
+        self.indices = np.zeros((n_shards, ix_pad), np.int32)
+        for s in range(n_shards):
+            # pad indptr by repeating the tail: padded local nodes read
+            # degree 0
+            self.indptr[s, :indptrs[s].size] = indptrs[s]
+            self.indptr[s, indptrs[s].size:] = indptrs[s][-1] \
+                if indptrs[s].size else 0
+            self.indices[s, :indices_l[s].size] = indices_l[s]
+
+    def make_sampler(self, mesh, k: int, q_per_shard: int,
+                     axis: str = "dp"):
+        """Jitted mesh sampler: (queries [S, Q] global node ids,
+        rng [S, 2]) → [S, Q, k] neighbors (global ids; -1 pads).
+        Queries land on their shard row arbitrarily — routing is inside.
+        ``q_per_shard`` is the per-owner bucket capacity; Q must not
+        exceed it (checked), since overflow would silently route
+        queries to the wrong shard."""
+        from jax.sharding import PartitionSpec as P
+        n = self.n
+
+        def local(indptr, indices, queries, rng):
+            # shard_map keeps the sharded leading axis at size 1
+            indptr, indices = indptr[0], indices[0]
+            queries, rng = queries[0], rng[0]
+            me = jax.lax.axis_index(axis)
+            owner = queries % n
+            # bucket queries by owner (stable sort → positions to undo)
+            order = jnp.argsort(owner, stable=True)
+            routed = queries[order]
+            # equal-split all_to_all needs uniform buckets: count per
+            # owner and scatter into [n, cap] slots
+            cap = q_per_shard
+            dest = owner[order]
+            rank_in = jnp.cumsum(
+                jnp.ones_like(dest)) - 1 - jnp.searchsorted(
+                    dest, dest, side="left").astype(dest.dtype)
+            slots = jnp.clip(dest * cap + rank_in, 0, n * cap - 1)
+            buf = jnp.full((n * cap,), -1, queries.dtype)
+            buf = buf.at[slots].set(routed)
+            buf = buf.reshape(n, cap)
+            # route to owners; local ids = node // n
+            inbox = jax.lax.all_to_all(buf, axis, 0, 0, tiled=False)
+            flat = inbox.reshape(-1)
+            ok = flat >= 0
+            local_ids = jnp.where(ok, flat // n, 0)
+            # rng arrives as raw uint32 [2] key data per shard; fold in
+            # the shard index so shards draw independent streams
+            key = jax.random.fold_in(jax.random.wrap_key_data(rng), me)
+            got = sample_neighbors(indptr, indices,
+                                   local_ids.astype(jnp.int32), k, key)
+            got = jnp.where(ok[:, None], got, -1)
+            # send answers back
+            back = jax.lax.all_to_all(
+                got.reshape(n, cap, k), axis, 0, 0, tiled=False)
+            back = back.reshape(n * cap, k)
+            # un-bucket: answer for routed[i] sits at slots[i]
+            ans_sorted = back[slots]
+            out = jnp.zeros((queries.shape[0], k), jnp.int32)
+            out = out.at[order].set(ans_sorted, unique_indices=True)
+            return out[None]
+
+        def run(indptr_s, indices_s, queries_s, rng_s):
+            if queries_s.shape[1] > q_per_shard:
+                raise ValueError(
+                    f"{queries_s.shape[1]} queries/shard exceeds the "
+                    f"bucket capacity q_per_shard={q_per_shard}")
+            return jax.shard_map(
+                local, mesh=mesh,
+                in_specs=(P(axis), P(axis), P(axis), P(axis)),
+                out_specs=P(axis),
+            )(indptr_s, indices_s, queries_s, rng_s)
+
+        return jax.jit(run)
+
+
+def features_for_nodes(table, nodes: np.ndarray) -> np.ndarray:
+    """get_feature_of_nodes (graph_gpu_ps_table.h:141): pull the
+    embedding-PS feature rows for (walk) node ids — node id == feature
+    key. Unknown nodes read zeros. → [n, 3 + mf]."""
+    return table.host_pull(np.asarray(nodes, np.uint64).ravel())
