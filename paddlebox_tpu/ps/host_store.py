@@ -39,14 +39,19 @@ class HostStore:
     """All-features host table; thread-safe for one writer at a time."""
 
     def __init__(self, mf_dim: int, capacity: Optional[int] = None,
-                 init_rows: int = 1 << 16) -> None:
+                 init_rows: int = 1 << 16, opt_ext: int = 0) -> None:
+        """``opt_ext`` — width of the per-row optimizer extension block
+        (ps/sgd.opt_ext_width) persisted alongside the base fields, so
+        pass-scoped tables keep SparseAdam state across pass windows."""
         self.mf_dim = mf_dim
+        self.opt_ext = opt_ext
+        self.fields = tuple(FIELDS) + (("opt_ext",) if opt_ext else ())
         self.capacity = capacity or FLAGS.host_store_capacity
         self.index = make_kv(self.capacity)
         self._alloc = min(init_rows, self.capacity)
         self._arr: Dict[str, np.ndarray] = {
             f: np.zeros(self._shape(f, self._alloc), np.float32)
-            for f in FIELDS
+            for f in self.fields
         }
         self._touched = np.zeros(self._alloc, dtype=bool)
         self._lock = threading.Lock()
@@ -54,6 +59,8 @@ class HostStore:
         self._spill_keys: Dict[str, np.ndarray] = {}  # path → spilled keys
 
     def _shape(self, field: str, n: int) -> Tuple[int, ...]:
+        if field == "opt_ext":
+            return (n, self.opt_ext)
         return (n, self.mf_dim) if field in _2D_FIELDS else (n,)
 
     def _ensure(self, max_row: int) -> None:
@@ -63,7 +70,7 @@ class HostStore:
         while new <= max_row:
             new *= 2
         new = min(new, self.capacity)
-        for f in FIELDS:
+        for f in self.fields:
             a = np.zeros(self._shape(f, new), np.float32)
             a[:self._alloc] = self._arr[f]
             self._arr[f] = a
@@ -97,7 +104,7 @@ class HostStore:
             rows = self.index.lookup(keys_u64)
             known = rows >= 0
             out = {}
-            for f in FIELDS:
+            for f in self.fields:
                 a = np.zeros(self._shape(f, len(keys)), np.float32)
                 a[known] = self._arr[f][rows[known]]
                 out[f] = a
@@ -109,7 +116,7 @@ class HostStore:
             rows = self.index.assign(np.ascontiguousarray(keys, np.uint64))
             if len(rows):
                 self._ensure(int(rows.max()))
-            for f in FIELDS:
+            for f in self.fields:
                 self._arr[f][rows] = data[f]
             self._touched[rows] = True
 
@@ -123,7 +130,7 @@ class HostStore:
     def _free(self, keys: np.ndarray) -> np.ndarray:
         """Release keys and zero their rows; returns freed row ids."""
         freed = self.index.release(keys)
-        for f in FIELDS:
+        for f in self.fields:
             self._arr[f][freed] = 0
         self._touched[freed] = False
         return freed
@@ -134,10 +141,10 @@ class HostStore:
               ) -> int:
         """npz dump of rows; ``extra`` appends out-of-RAM rows (spilled
         tiers) as {field: values} with their own key array."""
-        blobs = {f: self._arr[f][rows] for f in FIELDS}
+        blobs = {f: self._arr[f][rows] for f in self.fields}
         if extra:
             keys = np.concatenate([keys, extra["keys"]])
-            for f in FIELDS:
+            for f in self.fields:
                 blobs[f] = np.concatenate([blobs[f], extra[f]])
         np.savez_compressed(path, keys=keys, mf_dim=np.int32(self.mf_dim),
                             **blobs)
@@ -166,7 +173,7 @@ class HostStore:
         """Rows living only in spill files (for complete base exports)."""
         if not self._spill_files:
             return None
-        out = {f: [] for f in FIELDS}
+        out = {f: [] for f in self.fields}
         out_keys = []
         for p in list(self._spill_files):
             blob = np.load(p)
@@ -176,7 +183,7 @@ class HostStore:
                 np.ascontiguousarray(dkeys, np.uint64)) < 0
             sel = dead & np.isin(dkeys, reg)
             out_keys.append(dkeys[sel])
-            for f in FIELDS:
+            for f in self.fields:
                 out[f].append(blob[f][sel])
         res = {f: np.concatenate(v) for f, v in out.items()}
         res["keys"] = np.concatenate(out_keys)
@@ -202,13 +209,28 @@ class HostStore:
         log.info("save_delta: %d rows -> %s", n, path)
         return n
 
+    def _write_field(self, f: str, rows, blob, who: str,
+                     sel=slice(None)) -> None:
+        """Write one field from a save file, tolerating files written
+        WITHOUT (or with a different-width) opt_ext block — optimizer
+        state then starts fresh for those rows, with a warning (the
+        EmbeddingTable.load degradation contract)."""
+        if f == "opt_ext" and (f not in blob
+                               or blob[f].shape[1] != self.opt_ext):
+            log.warning("%s: file has no matching opt_ext block; "
+                        "optimizer state starts fresh for loaded rows",
+                        who)
+            self._arr[f][rows] = 0.0
+            return
+        self._arr[f][rows] = blob[f][sel]
+
     def load(self, path: str, merge: bool = False) -> int:
         blob = np.load(path)
         keys = blob["keys"]
         with self._lock:
             if not merge:
                 self.index = make_kv(self.capacity)
-                for f in FIELDS:
+                for f in self.fields:
                     self._arr[f][:] = 0
                 self._touched[:] = False
                 self._spill_files = []  # old model's tiers don't carry over
@@ -216,8 +238,8 @@ class HostStore:
             rows = self.index.assign(keys)
             if len(rows):
                 self._ensure(int(rows.max()))
-            for f in FIELDS:
-                self._arr[f][rows] = blob[f]
+            for f in self.fields:
+                self._write_field(f, rows, blob, "load")
         return len(keys)
 
     # ---- disk tier (SSD role: LoadSSD2Mem, box_wrapper.cc:1415) ----
@@ -286,8 +308,9 @@ class HostStore:
             rows = self.index.assign(lk)
             if len(rows):
                 self._ensure(int(rows.max()))
-            for f in FIELDS:
-                self._arr[f][rows] = blob[f][sel]
+            for f in self.fields:
+                self._write_field(f, rows, blob, "load_from_disk",
+                                  sel=sel)
             reg = self._spill_keys.get(path)
             if reg is not None:
                 gone = dkeys[sel | live]

@@ -50,13 +50,21 @@ class PassScopedTable(EmbeddingTable):
                  cfg: Optional[SparseSGDConfig] = None, seed: int = 0,
                  unique_bucket_min: int = 1024) -> None:
         from paddlebox_tpu.ps.sgd import opt_ext_width
-        if cfg is not None and opt_ext_width(cfg, host.mf_dim):
+        need = opt_ext_width(cfg, host.mf_dim) if cfg is not None else 0
+        have = getattr(host, "opt_ext", 0)
+        if need > have:
             raise ValueError(
-                "PassScopedTable persists rows through the HostStore "
-                "field schema, which has no optimizer-extension block — "
-                "Adam state would silently reset every pass. Use the "
-                "resident EmbeddingTable for SparseAdam, or extend "
-                "HostStore FIELDS first.")
+                f"optimizer needs a {need}-wide extension block but the "
+                f"HostStore persists {have} — construct "
+                f"HostStore(mf_dim=..., opt_ext={need}) so SparseAdam "
+                "state survives pass windows.")
+        if need < have:
+            raise ValueError(
+                f"the HostStore carries a {have}-wide optimizer "
+                f"extension but this table's optimizer uses {need} — "
+                "pass the matching SparseAdamConfig (rebuilding the "
+                "store with a smaller block would DISCARD the persisted "
+                "optimizer state).")
         super().__init__(mf_dim=host.mf_dim,
                          capacity=pass_capacity or
                          FLAGS.table_capacity_per_shard,
@@ -137,9 +145,12 @@ class PassScopedTable(EmbeddingTable):
         self.index = make_kv(self.capacity)
         rows = self.index.assign(st.keys)
         c1 = self.capacity + 1
-        data = np.zeros((c1, NUM_FIXED + self.mf_dim), np.float32)
+        mf_end = NUM_FIXED + self.mf_dim
+        data = np.zeros((c1, mf_end + self.opt_ext), np.float32)
         for f in FIELDS:
             field_assign(data, rows, f, st.values[f])
+        if self.opt_ext:
+            data[rows, mf_end:] = st.values["opt_ext"]
         # slot is HOST metadata (_gather_host reads slot_host, never the
         # device column) and the index was just rebuilt (make_kv
         # reassigns row ids) — reset it wholesale, then seed the staged
@@ -148,7 +159,8 @@ class PassScopedTable(EmbeddingTable):
         # (eval-only passes, staged key supersets)
         self.slot_host[:] = 0
         self.slot_host[rows] = st.values["slot"].astype(np.int16)
-        self.state = TableState.from_logical(data, self.capacity)
+        self.state = TableState.from_logical(data, self.capacity,
+                                             ext=self.opt_ext)
         self._touched[:] = False
         self.in_pass = True
         log.info("begin_pass: %d working-set rows in HBM", len(st.keys))
@@ -160,7 +172,7 @@ class PassScopedTable(EmbeddingTable):
             raise RuntimeError("end_pass without begin_pass")
         keys, rows = self.index.items()
         data = self._gather_host(rows)
-        self.host.update(keys, {f: data[f] for f in FIELDS})
+        self.host.update(keys, {f: data[f] for f in self.host.fields})
         self.in_pass = False
         log.info("end_pass: %d rows written back to host store", len(keys))
         return len(keys)

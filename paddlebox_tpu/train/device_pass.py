@@ -126,7 +126,8 @@ class ResidentPass:
     @classmethod
     def build_streamed(cls, dataset: Dataset, table,
                        floats_dtype=np.float32,
-                       threads: int = 4) -> "ResidentPass":
+                       threads: int = 4,
+                       block: bool = True) -> "ResidentPass":
         """Build with the upload IN FLIGHT. ``jax.device_put`` is async
         on this runtime (measured: the H2D transfer streams while the
         host packs; per-array forced fetches cost a ~0.25 s round-trip
@@ -148,7 +149,8 @@ class ResidentPass:
                             if qmeta is None else qmeta)
         if getattr(table.index, "arena_enabled", False):
             rp = cls._compact_tail(per_batch, floats, qmeta, trivial,
-                                   nrec, table, floats_t, qm)
+                                   nrec, table, floats_t, qm,
+                                   block=block)
             if rp is not None:
                 return rp
             log.warning("compact wire unavailable for this pass "
@@ -165,13 +167,19 @@ class ResidentPass:
         rp = cls(uniq, gidx, floats, meta, segs, nrec, qmeta=qmeta)
         rp.dev = (uniq_t, gidx_t, floats_t, jax.device_put(meta),
                   segs_t, qm)
-        jax.block_until_ready(list(jax.tree.leaves(rp.dev)))
+        if block:
+            jax.block_until_ready(list(jax.tree.leaves(rp.dev)))
+        # block=False: transfers are ISSUED (device_put is eager/async)
+        # and the consuming execution will wait on them — the caller's
+        # thread is free to start the NEXT pass's host build while this
+        # pass's bytes are still on the wire (PassPreloader does this,
+        # overlapping host build k+2 with transfer k+1 and training k)
         return rp
 
     @classmethod
     def _compact_tail(cls, per_batch, floats, qmeta, trivial: bool,
-                      nrec: int, table, floats_t, qm
-                      ) -> Optional["ResidentPass"]:
+                      nrec: int, table, floats_t, qm,
+                      block: bool = True) -> Optional["ResidentPass"]:
         """COMPACT wire for slot-arena tables: ship per-key slot-LOCAL
         rows (≈17 bits at CTR scale — at/near the wire's entropy floor)
         plus the tiny arena chunk map; the device rebuilds global rows
@@ -234,7 +242,8 @@ class ResidentPass:
         rp.chunk_bits = int(table.arena_chunk_bits)
         rp.dev = (loc_t, (jax.device_put(cmap),), floats_t,
                   jax.device_put(meta), segs_t, qm)
-        jax.block_until_ready(list(jax.tree.leaves(rp.dev)))
+        if block:
+            jax.block_until_ready(list(jax.tree.leaves(rp.dev)))
         return rp
 
     @staticmethod
@@ -650,7 +659,8 @@ class PassPreloader:
     builds + uploads pass k+1 in a background thread while pass k trains."""
 
     def __init__(self, datasets: Iterator[Dataset], table=None,
-                 floats_dtype=np.float32, build_fn=None) -> None:
+                 floats_dtype=np.float32, build_fn=None,
+                 block_transfers: bool = False) -> None:
         """``build_fn(dataset) -> pass`` overrides the default single-chip
         ResidentPass builder — e.g.
         ``build_fn=sharded_trainer.build_resident_pass`` double-buffers
@@ -661,6 +671,7 @@ class PassPreloader:
         self._table = table
         self._floats_dtype = floats_dtype
         self._build_fn = build_fn
+        self._block = block_transfers
         self._next = None
         self._thread: Optional[threading.Thread] = None
         self._err: Optional[BaseException] = None
@@ -675,9 +686,13 @@ class PassPreloader:
                 # serialize into k+1's first step
                 rp.upload(materialize=True)
             else:
-                # build+upload overlapped AND forced (same rationale)
+                # build+upload overlapped; transfers stay IN FLIGHT
+                # (block=False) so this thread can start the next
+                # pass's host build immediately — the training step
+                # consuming the pass waits on its own args
                 rp = ResidentPass.build_streamed(
-                    ds, self._table, floats_dtype=self._floats_dtype)
+                    ds, self._table, floats_dtype=self._floats_dtype,
+                    block=self._block)
             self._next = rp
         except BaseException as e:  # surfaces on next()
             self._err = e

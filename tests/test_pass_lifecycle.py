@@ -282,3 +282,35 @@ def test_slot_survives_pass_roundtrip_without_prepare():
     t.end_pass()
     np.testing.assert_allclose(hs.fetch(k2)["slot"], 0.0)
     np.testing.assert_allclose(hs.fetch(keys)["slot"], [3.0, 4.0, 5.0])
+
+
+def test_pass_scoped_table_sparse_adam_state_survives():
+    """SparseAdam through the pass lifecycle: the optimizer extension
+    block (moments, beta powers) round-trips HostStore -> HBM ->
+    HostStore, so Adam state is NOT reset at pass boundaries."""
+    from paddlebox_tpu.ps import SparseAdamConfig
+    from paddlebox_tpu.ps.sgd import opt_ext_width
+    cfg = SparseAdamConfig(mf_create_thresholds=0.0, mf_initial_range=0.0)
+    ext = opt_ext_width(cfg, 4)
+    hs = HostStore(mf_dim=4, capacity=1 << 12, opt_ext=ext)
+    t = PassScopedTable(hs, pass_capacity=64, cfg=cfg)
+    keys = np.array([7, 8, 9], np.uint64)
+    t.begin_pass(keys)
+    import jax
+    rows = t.index.lookup(keys)
+    st = t.state
+    d = np.asarray(jax.device_get(st.data)).copy()
+    mf_end = 8 + 4
+    d[rows, mf_end + 1] = 0.81   # embed beta1 power after 2 steps
+    t.state = type(st).from_logical(d, st.capacity, ext=ext)
+    t.end_pass()
+    # next pass sees the persisted optimizer state
+    t.begin_pass(keys)
+    d2 = np.asarray(jax.device_get(t.state.data))
+    rows2 = t.index.lookup(keys)
+    np.testing.assert_allclose(d2[rows2, mf_end + 1], 0.81)
+    t.end_pass()
+    # mismatched store is rejected loudly
+    hs2 = HostStore(mf_dim=4, capacity=1 << 12)
+    with pytest.raises(ValueError, match="extension block"):
+        PassScopedTable(hs2, pass_capacity=64, cfg=cfg)
