@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Per-slot embedding dims (multi_mf_dim) end to end.
+
+Production CTR tables mix embedding widths per slot (a user-id slot may
+carry 64 dims while a tiny categorical carries 4 — feature_value.h:42,
+ps_gpu_wrapper.cc multi-mf build). This example trains DeepFM-style CTR
+with three dim classes through MultiMfEmbeddingTable / MultiMfTrainer,
+then saves and reloads the class tables.
+
+Run:  python examples/train_multi_mf.py
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import optax
+
+from paddlebox_tpu.data import DataFeedDesc, DatasetFactory
+from paddlebox_tpu.data.criteo import generate_criteo_files
+from paddlebox_tpu.models import CtrDnn
+from paddlebox_tpu.ps import MultiMfEmbeddingTable, SparseSGDConfig
+from paddlebox_tpu.train import MultiMfTrainer
+
+
+def main() -> None:
+    data_dir = tempfile.mkdtemp(prefix="mmf_")
+    files = generate_criteo_files(data_dir, num_files=2,
+                                  rows_per_file=4000,
+                                  vocab_per_slot=200, seed=7)
+    desc = DataFeedDesc.criteo(batch_size=256)
+    ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+    ds.set_filelist(files)
+    ds.set_thread(2)
+    ds.load_into_memory()
+
+    # 26 criteo slots: 10 narrow, 10 medium, 6 wide
+    slot_dims = [4] * 10 + [8] * 10 + [16] * 6
+    cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=1e-3)
+    table = MultiMfEmbeddingTable(slot_dims, capacity=1 << 15, cfg=cfg)
+    tr = MultiMfTrainer(CtrDnn(hidden=(64, 32)), table, desc,
+                        tx=optax.adam(1e-3))
+
+    for p in range(3):
+        res = tr.train_pass(ds, log_prefix=f"[pass {p}] ")
+    print(f"final auc={res['auc']:.4f} over dim classes "
+          f"{table.dims} ({table.feature_count} features)")
+
+    # save one artifact per dim class, reload, spot-check a pull
+    path = os.path.join(data_dir, "mmf_base")
+    n = table.save_base(path)
+    t2 = MultiMfEmbeddingTable(slot_dims, capacity=1 << 15, cfg=cfg)
+    assert t2.load(path) == n
+    ds.columnarize()   # no-op on the native fast path; builds otherwise
+    col = ds.columnar
+    keys, slots = col.keys[:8].astype(np.uint64), col.key_slot[:8]
+    np.testing.assert_allclose(t2.pull(keys, slots),
+                               table.pull(keys, slots), rtol=1e-6)
+    print(f"save/load roundtrip ok ({n} rows across "
+          f"{len(table.dims)} class files)")
+
+
+if __name__ == "__main__":
+    main()
