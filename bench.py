@@ -91,13 +91,18 @@ def main() -> None:
         table = ShardedEmbeddingTable(
             chips, mf_dim=mf_dim, capacity_per_shard=(1 << 23) // chips,
             cfg=cfg, req_bucket_min=1 << 12, serve_bucket_min=1 << 12)
+        swire = os.environ.get("BENCH_FLOAT_WIRE", "q8")
+        if swire not in ("q8", "f32"):
+            print(f"warning: BENCH_FLOAT_WIRE={swire} unsupported in "
+                  "sharded mode, using f32", file=sys.stderr)
+            swire = "f32"
         tr = ShardedTrainer(DeepFM(hidden=(512, 256, 128)), table,
-                            desc, mesh, tx=optax.adam(1e-3))
+                            desc, mesh, tx=optax.adam(1e-3),
+                            float_wire=swire)
         build_fn = tr.build_resident_pass
-        for knob in ("BENCH_FLOAT_WIRE", "BENCH_ARENA"):
-            if knob in os.environ:
-                print(f"warning: {knob} is ignored in sharded mode",
-                      file=sys.stderr)
+        if "BENCH_ARENA" in os.environ:
+            print("warning: BENCH_ARENA is ignored in sharded mode",
+                  file=sys.stderr)
     else:
         # slot-arena allocation → the resident path ships the COMPACT
         # wire (per-key ~17-bit slot-local rows, no dedup streams); set

@@ -27,8 +27,11 @@ from paddlebox_tpu.ops import fused_seqpool_cvm
 from paddlebox_tpu.parallel.mesh import DATA_AXIS
 from paddlebox_tpu.ps.sgd import SparseSGDConfig
 from paddlebox_tpu.ps.sharded import ShardedEmbeddingTable, ShardedPullIndex
+from paddlebox_tpu.ops.bitpack import (pack_u16m, pack_u24, unpack_u16m,
+                                       unpack_u24)
 from paddlebox_tpu.ps.table import (TableState, apply_push,
                                     gather_full_rows, pull_values)
+from paddlebox_tpu.train.step import quantize_floats
 
 
 class GlobalBatch(NamedTuple):
@@ -46,10 +49,11 @@ class GlobalBatch(NamedTuple):
     clk: jax.Array          # f32   [N, B]
 
 
-def make_global_batch(batches: List[SlotBatch],
-                      idx: ShardedPullIndex) -> GlobalBatch:
-    """Stack N local batches + routing plan into device-ready arrays.
-    Local batches may have landed in different key buckets; re-pad to max."""
+def make_global_arrays(batches: List[SlotBatch],
+                       idx: ShardedPullIndex) -> Dict[str, np.ndarray]:
+    """Stack N local batches + routing plan into HOST arrays (the
+    resident builder consumes these directly — never round-trip the
+    plan through device arrays)."""
     k_pad = max(b.keys.shape[0] for b in batches)
     segs, dense, label, show, clk = [], [], [], [], []
     for b in batches:
@@ -64,18 +68,85 @@ def make_global_batch(batches: List[SlotBatch],
     if gi.shape[1] < k_pad:
         pad = ((0, 0), (0, k_pad - gi.shape[1]))
         gi = np.pad(gi, pad, constant_values=gi.max())
-    return GlobalBatch(
-        resp_idx=jnp.asarray(idx.resp_idx),
-        serve_rows=jnp.asarray(idx.serve_rows),
-        serve_valid=jnp.asarray(idx.serve_valid),
-        serve_slot=jnp.asarray(idx.serve_slot),
-        gather_idx=jnp.asarray(gi),
-        segments=jnp.asarray(np.stack(segs)),
-        dense=jnp.asarray(np.stack(dense)),
-        label=jnp.asarray(np.stack(label)),
-        show=jnp.asarray(np.stack(show)),
-        clk=jnp.asarray(np.stack(clk)),
-    )
+    return dict(
+        resp_idx=idx.resp_idx, serve_rows=idx.serve_rows,
+        serve_valid=idx.serve_valid, serve_slot=idx.serve_slot,
+        gather_idx=gi, segments=np.stack(segs),
+        dense=np.stack(dense), label=np.stack(label),
+        show=np.stack(show), clk=np.stack(clk))
+
+
+def make_global_batch(batches: List[SlotBatch],
+                      idx: ShardedPullIndex) -> GlobalBatch:
+    """make_global_arrays staged to device (streaming step path)."""
+    host = make_global_arrays(batches, idx)
+    return GlobalBatch(**{f: jnp.asarray(host[f])
+                          for f in GlobalBatch._fields})
+
+
+def _wire_spec(name: str, ndim: int) -> P:
+    """Sharding spec for a packed-wire leaf: [nb, N, ...] with the
+    device dim sharded; qmeta is pass-global (replicated)."""
+    if name == "qmeta":
+        return P()
+    return P(*([None, DATA_AXIS] + [None] * (ndim - 2)))
+
+
+class _LazyJit:
+    """Defers jit construction until the wire pytree's structure is
+    known (specs depend on it)."""
+
+    def __init__(self, factory) -> None:
+        self._factory = factory
+        self._jit = None
+
+    def __call__(self, state, wire, start, rng):
+        if self._jit is None:
+            self._jit = self._factory(wire)
+        return self._jit(state, wire, start, rng)
+
+
+def _decode_wire_step(wire, fmt, i, capacity: int) -> GlobalBatch:
+    """Reassemble step i's GlobalBatch from the packed resident wire
+    (in-trace; see ShardedResidentPass._encode_wire for the encodings)."""
+    def dec_int(name):
+        f = fmt[name]
+        t = wire[name]
+        if f == "u18":
+            return unpack_u16m(t[0][i], t[1][i], 2)
+        if f == "u24":
+            return unpack_u24(t[0][i], t[1][i])
+        return t[0][i]
+
+    resp_idx = dec_int("resp_idx")
+    serve_rows = dec_int("serve_rows")
+    gather_idx = dec_int("gather_idx")
+    if fmt["serve_valid"] == "derive":
+        serve_valid = (serve_rows <= capacity).astype(jnp.float32)
+    else:
+        serve_valid = wire["serve_valid"][0][i]
+    serve_slot = wire["serve_slot"][0][i].astype(jnp.float32)
+    if fmt["segments"] == "trivial":
+        meta = wire["meta"][0][i]                     # [N_local, 2]
+        k = gather_idx.shape[-1]
+        pos = jnp.arange(k, dtype=jnp.int32)[None, :]
+        segments = jnp.where(pos < meta[:, 0:1], pos, meta[:, 1:2])
+    else:
+        segments = dec_int("segments")
+    if fmt["dense"] == "q8":
+        qm = wire["qmeta"][0]                         # [2, Dd] replicated
+        d = wire["dense"][0][i].astype(jnp.float32)
+        dense = d * qm[0][None, None, :] + qm[1][None, None, :]
+    else:
+        dense = wire["dense"][0][i]
+    lsc = {}
+    for f in ("label", "show", "clk"):
+        a = wire[f][0][i]
+        lsc[f] = a.astype(jnp.float32)
+    return GlobalBatch(resp_idx=resp_idx, serve_rows=serve_rows,
+                       serve_valid=serve_valid, serve_slot=serve_slot,
+                       gather_idx=gather_idx, segments=segments,
+                       dense=dense, **lsc)
 
 
 class ShardedStepState(NamedTuple):
@@ -346,28 +417,23 @@ class ShardedTrainStep:
         return self._eval_jit(table_st, params, auc_st, batch)
 
     # ---- resident pass: the whole loop inside one shard_map program ----
-    def _resident_runner(self, n_steps: int):
-        key = ("resident", n_steps)
+    def _resident_runner(self, n_steps: int, fmt=None, capacity=0):
+        key = ("resident", n_steps, fmt, capacity)
         cached = getattr(self, "_resident_cache", None)
         if cached is None:
             cached = self._resident_cache = {}
         if key not in cached:
             rep = P()
             state_spec = self._state_spec
+            fmt_d = dict(fmt) if fmt else None
 
-            def pass_spec(name):
-                nd = {"resp_idx": 4, "serve_rows": 3, "serve_valid": 3,
-                      "serve_slot": 3, "gather_idx": 3, "segments": 3,
-                      "dense": 4, "label": 3, "show": 3, "clk": 3}[name]
-                return P(*([None, DATA_AXIS] + [None] * (nd - 2)))
 
-            batch_spec = GlobalBatch(
-                *[pass_spec(f) for f in GlobalBatch._fields])
-
-            def run(state, pass_gb, start, rng):
+            def run(state, wire, start, rng):
                 def body(i, carry):
                     st, r = carry
-                    gb = GlobalBatch(*[leaf[i] for leaf in pass_gb])
+                    gb = (GlobalBatch(*[leaf[i] for leaf in wire])
+                          if fmt_d is None else
+                          _decode_wire_step(wire, fmt_d, i, capacity))
                     # per-step rng matching the streaming trainer exactly:
                     # it folds the PRE-incremented global_step (1-based)
                     st, _ = self._device_step(
@@ -378,11 +444,25 @@ class ShardedTrainStep:
                     start, start + n_steps, body, (state, rng))
                 return state
 
-            cached[key] = jax.jit(
-                jax.shard_map(run, mesh=self.mesh,
-                              in_specs=(state_spec, batch_spec, rep, rep),
-                              out_specs=state_spec, check_vma=False),
-                donate_argnums=(0,))
+            def make_specs(we):
+                if isinstance(we, dict):
+                    return {name: tuple(_wire_spec(name, a.ndim)
+                                        for a in arrs)
+                            for name, arrs in we.items()}
+                return jax.tree.map(
+                    lambda a: _wire_spec("", a.ndim), we)
+
+            def jit_for(wire_example):
+                return jax.jit(
+                    jax.shard_map(run, mesh=self.mesh,
+                                  in_specs=(state_spec,
+                                            make_specs(wire_example),
+                                            rep, rep),
+                                  out_specs=state_spec, check_vma=False),
+                    donate_argnums=(0,))
+
+            # resolved lazily at first call (needs the wire pytree)
+            cached[key] = _LazyJit(jit_for)
         return cached[key]
 
     def run_resident(self, state: ShardedStepState, rp, rng: jax.Array,
@@ -390,11 +470,14 @@ class ShardedTrainStep:
         """Run every staged global batch of a ShardedResidentPass."""
         rp.upload()
         nb = rp.num_batches
+        fmt = getattr(rp, "fmt", None)
+        fmt_key = tuple(sorted(fmt.items())) if fmt else None
         c = chunk or nb
         i = 0
         while i < nb:
             n = min(c, nb - i)
-            state = self._resident_runner(n)(
+            state = self._resident_runner(
+                n, fmt_key, getattr(rp, "capacity", 0) or 0)(
                 state, rp.dev, jnp.asarray(i, jnp.int32), rng)
             i += n
         return state
@@ -408,8 +491,11 @@ class ShardedTrainer:
     def __init__(self, model, table: ShardedEmbeddingTable, desc, mesh: Mesh,
                  tx: Optional[optax.GradientTransformation] = None,
                  use_cvm: bool = True, prefetch: int = 4, seed: int = 0,
-                 zero1: bool = False) -> None:
+                 zero1: bool = False, float_wire: str = "f32") -> None:
+        """``float_wire="q8"`` ships resident-pass dense/label/show/clk
+        as the int8 affine wire (opt-in: ~1e-2 dense rounding)."""
         import threading as _threading
+        self.float_wire = float_wire
         self.model = model
         self.table = table
         self.desc = desc
@@ -582,11 +668,21 @@ class ShardedResidentPass:
     owner*A + j, so A must match across the staged pass)."""
 
     def __init__(self, arrays: Dict[str, np.ndarray], num_records: int,
-                 mesh: Mesh) -> None:
+                 mesh: Mesh, capacity: Optional[int] = None,
+                 trivial: bool = False,
+                 float_wire: str = "f32") -> None:
         self.arrays = arrays
         self.num_records = num_records
         self.mesh = mesh
-        self.dev: Optional[GlobalBatch] = None
+        self.dev = None
+        # packed wire (same bit-diet as the single-chip ResidentPass —
+        # the tunnel/DCN H2D is the scarce resource): fmt maps each
+        # GlobalBatch field to its encoding, wire holds the host arrays
+        self.fmt: Optional[Dict[str, str]] = None
+        self.wire: Optional[Dict[str, tuple]] = None
+        self.capacity = capacity
+        if capacity is not None:
+            self._encode_wire(capacity, trivial, float_wire)
 
     @property
     def num_batches(self) -> int:
@@ -608,8 +704,8 @@ class ShardedResidentPass:
                  else table.prepare_global(g, req_capacity=a,
                                            serve_capacity=a2)
                  for g, p in zip(groups, plans)]
-        gbs = [make_global_batch(g, p) for g, p in zip(groups, plans)]
-        k = max(gb.gather_idx.shape[1] for gb in gbs)
+        gbs = [make_global_arrays(g, p) for g, p in zip(groups, plans)]
+        k = max(gb["gather_idx"].shape[1] for gb in gbs)
         # pad values that stay inert: gather_idx pads → the recv sentinel
         # slot (n*A - 1, zero values), segments pads → the discarded
         # pooling bin (bs * num_slots)
@@ -620,14 +716,98 @@ class ShardedResidentPass:
         for f in GlobalBatch._fields:
             parts = []
             for gb in gbs:
-                arr = np.asarray(getattr(gb, f))
+                arr = gb[f]
                 if f in pad_of and arr.shape[1] < k:
                     arr = np.pad(arr, ((0, 0), (0, k - arr.shape[1])),
                                  constant_values=pad_of[f])
                 parts.append(arr)
             arrays[f] = np.stack(parts)
         n_rec = sum(int((b.show > 0).sum()) for g in groups for b in g)
-        return cls(arrays, n_rec, trainer.mesh)
+        trivial = all(getattr(b, "segments_trivial", False)
+                      for g in groups for b in g)
+        if trivial:
+            # num_keys/pad_segment per (step, device) — segments then
+            # derive on device instead of shipping [nb, N, K] int32
+            arrays["meta"] = np.stack([
+                np.array([[b.num_keys, b.pad_segment] for b in g],
+                         np.int32) for g in groups])
+        return cls(arrays, n_rec, trainer.mesh,
+                   capacity=trainer.table.capacity, trivial=trivial,
+                   float_wire=getattr(trainer, "float_wire", "f32"))
+
+    def _encode_wire(self, capacity: int, trivial: bool,
+                     float_wire: str) -> None:
+        """Bit-pack the staged pass (ops/bitpack ladders): index arrays
+        to 18/24-bit forms, serve_valid derived from the fill_oob_pads
+        contract, slot ids to u16, floats to the q8 wire when exact —
+        ~3x fewer bytes over the tunnel/DCN per pass."""
+        fmt: Dict[str, str] = {}
+        wire: Dict[str, tuple] = {}
+
+        def enc_int(name, arr):
+            vmax = int(arr.max(initial=0))
+            if int(arr.min(initial=0)) >= 0 and vmax < (1 << 18) \
+                    and arr.shape[-1] % 4 == 0:
+                fmt[name] = "u18"
+                wire[name] = pack_u16m(arr, 2)
+            elif int(arr.min(initial=0)) >= 0 and vmax < (1 << 24):
+                fmt[name] = "u24"
+                wire[name] = pack_u24(arr)
+            else:
+                fmt[name] = "raw"
+                wire[name] = (arr,)
+
+        a = self.arrays
+        enc_int("resp_idx", a["resp_idx"])
+        enc_int("serve_rows", a["serve_rows"])
+        enc_int("gather_idx", a["gather_idx"])
+        derived = (a["serve_rows"] <= capacity).astype(np.float32)
+        if np.array_equal(derived, a["serve_valid"]):
+            fmt["serve_valid"] = "derive"
+        else:
+            fmt["serve_valid"] = "raw"
+            wire["serve_valid"] = (a["serve_valid"],)
+        sl = a["serve_slot"]
+        if (sl >= 0).all() and (sl < 65536).all() \
+                and (sl == np.rint(sl)).all():
+            fmt["serve_slot"] = "u16"
+            wire["serve_slot"] = (sl.astype(np.uint16),)
+        else:
+            fmt["serve_slot"] = "raw"
+            wire["serve_slot"] = (sl,)
+        if trivial:
+            fmt["segments"] = "trivial"
+            wire["meta"] = (a["meta"],)
+        else:
+            enc_int("segments", a["segments"])
+        nbk, n, b, dd = a["dense"].shape
+        q = None
+        if float_wire == "q8":  # opt-in, as on the single-chip wire
+            q = quantize_floats(
+                a["dense"].reshape(-1, dd),
+                a["label"].reshape(-1), a["show"].reshape(-1),
+                a["clk"].reshape(-1),
+                valid=a["show"].reshape(-1) > 0)
+        if q is not None:
+            block, qmeta = q
+            fmt["dense"] = "q8"
+            wire["dense"] = (block[:, :-3].reshape(nbk, n, b, dd),)
+            wire["qmeta"] = (qmeta,)
+            for j, f in enumerate(("label", "show", "clk")):
+                fmt[f] = "u8"
+                wire[f] = (block[:, dd + j].reshape(nbk, n, b),)
+        else:
+            for f in ("dense", "label", "show", "clk"):
+                fmt[f] = "raw"
+                wire[f] = (a[f],)
+        self.fmt = fmt
+        self.wire = wire
+        # the packed wire supersedes the unpacked host arrays — keep only
+        # what post-pass hooks read (mark_trained_rows, num_batches);
+        # under the double-buffered preloader the dead copies would
+        # double host memory per staged pass
+        self.arrays = {"serve_rows": a["serve_rows"],
+                       "label": a["label"]}
 
     def mark_trained_rows(self, table: ShardedEmbeddingTable) -> None:
         """Per-shard touched flags for this pass's served rows, set AFTER
@@ -644,9 +824,22 @@ class ShardedResidentPass:
         ``materialize=True`` forces the transfers now (see
         ResidentPass.upload — lazy uploads serialize into the first
         consuming step on tunneled runtimes)."""
-        if self.dev is None:
+        if self.dev is not None:
+            pass
+        elif self.wire is not None:
             put = {}
-            for f, arr in self.arrays.items():
+            for f, arrs in self.wire.items():
+                put[f] = tuple(
+                    jax.device_put(
+                        jnp.asarray(a),
+                        NamedSharding(self.mesh,
+                                      _wire_spec(f, a.ndim)))
+                    for a in arrs)
+            self.dev = put
+        else:
+            put = {}
+            for f in GlobalBatch._fields:
+                arr = self.arrays[f]
                 spec = P(*([None, DATA_AXIS] + [None] * (arr.ndim - 2)))
                 put[f] = jax.device_put(
                     jnp.asarray(arr), NamedSharding(self.mesh, spec))

@@ -397,3 +397,39 @@ def test_sharded_resident_scale(mesh, tmp_path):
     # continued passes keep learning with finite metrics
     assert all(np.isfinite(r["auc"]) for r in results)
     assert results[-1]["auc"] > 0.55
+
+
+def test_sharded_resident_q8_wire_learns(mesh, tmp_path):
+    """The sharded q8 float wire (dense int8 affine + u8 lsc, decoded in
+    _decode_wire_step) trains and tracks the f32 wire's AUC."""
+    files = generate_criteo_files(str(tmp_path), num_files=2,
+                                  rows_per_file=1200, vocab_per_slot=40,
+                                  seed=5)
+    desc = DataFeedDesc.criteo(batch_size=32)
+    desc.key_bucket_min = 1024
+    ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+
+    def mk(wire):
+        cfg = SparseSGDConfig(mf_create_thresholds=0.0,
+                              mf_initial_range=0.0,
+                              learning_rate=0.1, mf_learning_rate=0.1)
+        table = ShardedEmbeddingTable(N, mf_dim=4,
+                                      capacity_per_shard=4096, cfg=cfg,
+                                      req_bucket_min=256,
+                                      serve_bucket_min=256)
+        with flags_scope(log_period_steps=10 ** 6):
+            return ShardedTrainer(DeepFM(hidden=(32, 32)), table, desc,
+                                  mesh, tx=optax.adam(2e-3),
+                                  float_wire=wire)
+
+    tr_a = mk("f32")
+    tr_b = mk("q8")
+    for _ in range(3):
+        ra = tr_a.train_pass_resident(ds)
+        rb = tr_b.train_pass_resident(ds)
+    assert rb["batches"] == ra["batches"]
+    assert np.isclose(rb["auc"], ra["auc"], atol=5e-3), (rb["auc"],
+                                                         ra["auc"])
+    assert rb["auc"] > 0.55
