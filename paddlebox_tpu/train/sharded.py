@@ -845,5 +845,7 @@ class ShardedResidentPass:
                     jnp.asarray(arr), NamedSharding(self.mesh, spec))
             self.dev = GlobalBatch(**put)
         if materialize:
-            for a in jax.tree.leaves(self.dev):
-                jax.device_get(a.ravel()[0])
+            # ONE blocking wait for every in-flight transfer — per-leaf
+            # forced fetches cost a ~0.25 s round-trip EACH on tunneled
+            # runtimes
+            jax.block_until_ready(list(jax.tree.leaves(self.dev)))
