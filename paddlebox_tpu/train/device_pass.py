@@ -70,7 +70,8 @@ class ResidentPass:
                  floats: np.ndarray,
                  meta: np.ndarray, segs: Optional[np.ndarray],
                  num_records: int,
-                 qmeta: Optional[np.ndarray] = None) -> None:
+                 qmeta: Optional[np.ndarray] = None,
+                 side: Optional[Dict] = None) -> None:
         self.uniq = uniq
         self.gidx = gidx
         self.floats = floats
@@ -86,6 +87,8 @@ class ResidentPass:
         # rows and dedups in-trace (ops/device_unique.py).
         self.wire = "dedup"
         self.chunk_bits: Optional[int] = None
+        # columnar side channels for the post-pass metric feed (or None)
+        self.side = side
 
     @property
     def num_batches(self) -> int:
@@ -115,13 +118,13 @@ class ResidentPass:
         build and training would clear the flags and lose the pass's
         updates from the next delta. The trainer marks the pass's rows
         touched AFTER the pass runs (mark_trained_rows)."""
-        per_batch, floats, qmeta, trivial, nrec = cls._front(
+        per_batch, floats, qmeta, trivial, nrec, side = cls._front(
             dataset, floats_dtype)
         dedup, u_pad, k_max = cls._dedup_phase(per_batch, table)
         host = cls._pack_chunk(per_batch, dedup, u_pad, k_max, trivial,
                                table.capacity)
         return cls(host[0], host[1], floats, host[2], host[3], nrec,
-                   qmeta=qmeta)
+                   qmeta=qmeta, side=side)
 
     @classmethod
     def build_streamed(cls, dataset: Dataset, table,
@@ -142,7 +145,7 @@ class ResidentPass:
         The only blocking wait is one ``block_until_ready`` at the end.
         Wire format matches upload() exactly; the returned pass is
         already staged (dev set)."""
-        per_batch, floats, qmeta, trivial, nrec = cls._front(
+        per_batch, floats, qmeta, trivial, nrec, side = cls._front(
             dataset, floats_dtype)
         floats_t = jax.device_put(floats)
         qm = jax.device_put(np.zeros((2, 0), np.float32)
@@ -150,7 +153,7 @@ class ResidentPass:
         if getattr(table.index, "arena_enabled", False):
             rp = cls._compact_tail(per_batch, floats, qmeta, trivial,
                                    nrec, table, floats_t, qm,
-                                   block=block)
+                                   block=block, side=side)
             if rp is not None:
                 return rp
             log.warning("compact wire unavailable for this pass "
@@ -164,7 +167,8 @@ class ResidentPass:
         gidx_t = tuple(jax.device_put(a) for a in cls._encode_gidx(gidx))
         segs_t = jax.device_put(np.zeros((1, 1), np.int32)
                                 if segs is None else segs)
-        rp = cls(uniq, gidx, floats, meta, segs, nrec, qmeta=qmeta)
+        rp = cls(uniq, gidx, floats, meta, segs, nrec, qmeta=qmeta,
+                 side=side)
         rp.dev = (uniq_t, gidx_t, floats_t, jax.device_put(meta),
                   segs_t, qm)
         if block:
@@ -179,7 +183,9 @@ class ResidentPass:
     @classmethod
     def _compact_tail(cls, per_batch, floats, qmeta, trivial: bool,
                       nrec: int, table, floats_t, qm,
-                      block: bool = True) -> Optional["ResidentPass"]:
+                      block: bool = True,
+                      side: Optional[Dict] = None
+                      ) -> Optional["ResidentPass"]:
         """COMPACT wire for slot-arena tables: ship per-key slot-LOCAL
         rows (≈17 bits at CTR scale — at/near the wire's entropy floor)
         plus the tiny arena chunk map; the device rebuilds global rows
@@ -237,7 +243,8 @@ class ResidentPass:
                       for a in cls._encode_locals(locs, bits))
         segs_t = jax.device_put(np.zeros((1, 1), np.int32)
                                 if segs is None else segs)
-        rp = cls(rows_g, locs, floats, meta, segs, nrec, qmeta=qmeta)
+        rp = cls(rows_g, locs, floats, meta, segs, nrec, qmeta=qmeta,
+                 side=side)
         rp.wire = "compact"
         rp.chunk_bits = int(table.arena_chunk_bits)
         rp.dev = (loc_t, (jax.device_put(cmap),), floats_t,
@@ -291,7 +298,7 @@ class ResidentPass:
         qmeta = None
         if floats_dtype == "q8":
             floats, qmeta = cls._encode_floats(floats, floats_dtype)
-        return per_batch, floats, qmeta, trivial, nrec
+        return per_batch, floats, qmeta, trivial, nrec, None
 
     @classmethod
     def _front_columnar(cls, dataset: Dataset, col, floats_dtype):
@@ -338,7 +345,14 @@ class ResidentPass:
             floats_full = padded
         floats = floats_full.reshape(nb, bs, d3)
         floats, qmeta = cls._encode_floats(floats, floats_dtype)
-        return per_batch, floats, qmeta, trivial, int((col.show > 0).sum())
+        front = (per_batch, floats, qmeta, trivial,
+                 int((col.show > 0).sum()))
+        # side channels for the post-pass metric registry feed (record j
+        # of batch i == columnar row i*bs + j); references, not copies
+        side = {"label": col.label, "show": col.show, "uid": col.uid,
+                "rank": col.rank, "cmatch": col.cmatch,
+                "batch_size": bs, "num_records": r}
+        return front + (side,)
 
     @staticmethod
     def _encode_floats(floats: np.ndarray, floats_dtype):
@@ -611,12 +625,13 @@ class ResidentPassRunner:
             dense=dense, label=label, show=show, clk=clk,
             segments_trivial=self.trivial)
 
-    def _run(self, n_steps: int):
-        if n_steps not in self._jit:
+    def _run(self, n_steps: int, collect: bool = False):
+        key = (n_steps, collect)
+        if key not in self._jit:
             def run(state, uniq_t, gidx_t, floats_p, meta_p,
                     segs_p, qmeta, start, rng):
                 def body(i, carry):
-                    state, rng = carry
+                    state, rng, preds = carry
                     # compact wire: gidx slot carries the PASS-global
                     # arena chunk map, not per-batch data — don't index
                     gi = (gidx_t if self.wire == "compact"
@@ -627,29 +642,45 @@ class ResidentPassRunner:
                     # 1-based like Trainer.train_pass's fold of the
                     # pre-incremented global_step
                     rng_i = jax.random.fold_in(rng, state.step + 1)
-                    state, _ = self.step._step(state, view, rng_i)
-                    return state, rng
+                    state, stats = self.step._step(state, view, rng_i)
+                    if collect:
+                        # per-batch predictions stay resident for the
+                        # metric registry feed (AddAucMonitor role)
+                        preds = jax.lax.dynamic_update_index_in_dim(
+                            preds, stats["pred"], i - start, 0)
+                    return state, rng, preds
 
-                state, _ = jax.lax.fori_loop(
-                    start, start + n_steps, body, (state, rng))
-                return state
+                preds0 = (jnp.zeros((n_steps, floats_p.shape[1]),
+                                    jnp.float32) if collect
+                          else jnp.zeros((), jnp.float32))
+                state, _, preds = jax.lax.fori_loop(
+                    start, start + n_steps, body, (state, rng, preds0))
+                return state, preds
 
-            self._jit[n_steps] = jax.jit(run, donate_argnums=(0,))
-        return self._jit[n_steps]
+            self._jit[key] = jax.jit(run, donate_argnums=(0,))
+        return self._jit[key]
 
     def run_pass(self, state, rp: ResidentPass, rng: jax.Array,
-                 chunk: Optional[int] = None):
-        """Run every batch of the staged pass; returns the new state."""
+                 chunk: Optional[int] = None, collect_preds: bool = False):
+        """Run every batch of the staged pass → (state, preds or None);
+        ``collect_preds`` returns [nb, B] per-batch device predictions
+        (the post-pass metric registry feed)."""
         rp.upload()
         nb = rp.num_batches
         c = chunk if chunk is not None else (self.chunk or nb)
         i = 0
+        chunks = []
         while i < nb:
             n = min(c, nb - i)
-            state = self._run(n)(state, *rp.dev,
-                                 jnp.asarray(i, jnp.int32), rng)
+            state, preds = self._run(n, collect_preds)(
+                state, *rp.dev, jnp.asarray(i, jnp.int32), rng)
+            if collect_preds:
+                chunks.append(preds)
             i += n
-        return state
+        if not collect_preds:
+            return state, None
+        return state, (chunks[0] if len(chunks) == 1
+                       else jnp.concatenate(chunks, axis=0))
 
 
 class PassPreloader:

@@ -174,6 +174,25 @@ class Trainer:
             self.stage_timers.report(log_prefix)  # PrintSyncTimer role
         return out
 
+    def _feed_registry_resident(self, rp, preds) -> None:
+        """Post-pass metric registry feed (the per-batch AddAucMonitor
+        hook, replayed from resident predictions + the dataset's
+        columnar side channels)."""
+        sd = rp.side
+        bs = sd["batch_size"]
+        r = sd["num_records"]
+        preds_h = np.asarray(preds)               # ONE D2H fetch
+        for i in range(rp.num_batches):
+            a, b = i * bs, min((i + 1) * bs, r)
+            m = b - a  # ≥ 1: nb is ceil(r/bs) by construction
+            ins_w = (sd["show"][a:b] > 0).astype(np.float32)
+            self.metrics.add_batch(
+                preds_h[i, :m], sd["label"][a:b], ins_w,
+                uid=None if sd["uid"] is None else sd["uid"][a:b],
+                rank=None if sd["rank"] is None else sd["rank"][a:b],
+                cmatch=(None if sd["cmatch"] is None
+                        else sd["cmatch"][a:b]))
+
     def train_pass_resident(self, pass_or_dataset,
                             log_prefix: str = "") -> Dict[str, float]:
         """One pass in device-resident mode (train/device_pass.py): the
@@ -197,11 +216,7 @@ class Trainer:
             log.warning("dump configured: falling back to streaming "
                         "train_pass for this pass")
             return self.train_pass(pass_or_dataset, log_prefix)
-        if len(self.metrics):
-            log.warning(
-                "registry metrics do not accumulate in resident mode "
-                "(no per-batch host hook) — use train_pass for metric "
-                "variants; the built-in AUC still accumulates in-state")
+        want_metrics = len(self.metrics) > 0
         timer = Timer()
         timer.start()
         rp = (pass_or_dataset if isinstance(pass_or_dataset, ResidentPass)
@@ -216,9 +231,19 @@ class Trainer:
                 num_slots=self.step_fn.num_slots,
                 chunk_bits=getattr(rp, "chunk_bits", None))
             self._resident_runners[key] = runner
-        self.state = runner.run_pass(self.state, rp, self._rng)
+        self.state, preds = runner.run_pass(
+            self.state, rp, self._rng,
+            collect_preds=want_metrics and rp.side is not None)
         jax.block_until_ready(self.state.step)
         rp.mark_trained_rows(self.table)
+        if want_metrics:
+            if rp.side is None:
+                log.warning(
+                    "registry metrics need columnar side channels — "
+                    "this pass was built from a non-columnar dataset; "
+                    "use train_pass for metric variants here")
+            else:
+                self._feed_registry_resident(rp, preds)
         self.global_step += rp.num_batches
         timer.pause()
         self.sync_table()

@@ -109,7 +109,7 @@ def test_resident_chunked_equals_whole(criteo_files):
     rp_b = ResidentPass.build(ds, tr_b.table)
     runner = ResidentPassRunner(tr_b.step_fn, tr_b.table.capacity,
                                 rp_b.segs is None, chunk=3)
-    tr_b.state = runner.run_pass(tr_b.state, rp_b, tr_b._rng)
+    tr_b.state, _ = runner.run_pass(tr_b.state, rp_b, tr_b._rng)
     tr_b.sync_table()
     pa = jax.tree.leaves(tr_a.state.params)
     pb = jax.tree.leaves(tr_b.state.params)
@@ -463,3 +463,23 @@ def test_compact_falls_back_after_slotless_assign(criteo_files):
     assert rp.wire == "dedup"
     res = tr.train_pass_resident(rp)
     assert np.isfinite(res["auc"])
+
+
+def test_resident_metric_registry_accumulates(criteo_files):
+    """Registry metric variants now accumulate in RESIDENT mode too: the
+    runner collects per-batch predictions and the trainer replays the
+    AddAucMonitor feed from the dataset's columnar side channels —
+    matching the streaming pass's registry results."""
+    tr_a, ds = _make(criteo_files)
+    tr_b, _ = _make(criteo_files)
+    for tr in (tr_a, tr_b):
+        tr.metrics.init_metric("auc2", method="auc")
+        tr.metrics.init_metric("wu", method="wuauc")
+    ra = tr_a.train_pass(ds)
+    rb = tr_b.train_pass_resident(ds)
+    ma = tr_a.metrics.get_metric_msg("auc2")
+    mb = tr_b.metrics.get_metric_msg("auc2")
+    assert np.isclose(mb["auc"], ma["auc"], atol=2e-3), (ma, mb)
+    wa = tr_a.metrics.get_metric_msg("wu")
+    wb = tr_b.metrics.get_metric_msg("wu")
+    assert np.isclose(wb["wuauc"], wa["wuauc"], atol=5e-3), (wa, wb)
