@@ -483,3 +483,63 @@ def test_resident_metric_registry_accumulates(criteo_files):
     wa = tr_a.metrics.get_metric_msg("wu")
     wb = tr_b.metrics.get_metric_msg("wu")
     assert np.isclose(wb["wuauc"], wa["wuauc"], atol=5e-3), (wa, wb)
+
+
+def test_compact_wire_non_trivial_segments():
+    """Compact wire with multi-key slots (non-trivial segments): the
+    wire ships segments and the device derives slots from segment % S —
+    must match the dedup wire's training exactly."""
+    from paddlebox_tpu.data import DataFeedDesc, InMemoryDataset, SlotDef
+    slots = [SlotDef("label", "float", 1), SlotDef("d", "float", 3)]
+    slots += [SlotDef(f"S{i}", "uint64") for i in range(4)]
+    desc = DataFeedDesc(slots=slots, label_slot="label", batch_size=64,
+                        key_bucket_min=512)
+    # slot-DISJOINT key spaces (CTR feasigns are globally unique, so a
+    # key's slot is stable — the arena relies on that)
+    from paddlebox_tpu.data.record import SlotRecord
+    rng = np.random.default_rng(11)
+    recs = []
+    for i in range(512):
+        counts = rng.integers(0, 3, size=4)
+        counts[rng.integers(0, 4)] += 1
+        offs = np.zeros(5, np.int32)
+        np.cumsum(counts, out=offs[1:])
+        keys = np.concatenate([
+            rng.integers(s * 1000, (s + 1) * 1000, size=counts[s])
+            for s in range(4)]).astype(np.uint64)
+        recs.append(SlotRecord(
+            keys=keys, slot_offsets=offs,
+            dense=rng.normal(size=3).astype(np.float32),
+            label=float(i % 2), show=1.0, clk=float(i % 2)))
+
+    def mk(arena):
+        ds = InMemoryDataset(desc)
+        ds.records = list(recs)
+        ds.columnarize()
+        cfg = SparseSGDConfig(mf_create_thresholds=0.0,
+                              mf_initial_range=0.0,
+                              learning_rate=0.05, mf_learning_rate=0.05)
+        table = EmbeddingTable(mf_dim=4, capacity=1 << 13, cfg=cfg,
+                               unique_bucket_min=512,
+                               arena_slots=4 if arena else None,
+                               arena_chunk_bits=6)
+        tr = Trainer(DeepFM(hidden=(16, 8)), table, desc,
+                     tx=optax.adam(1e-2), seed=3)
+        return tr, ds
+
+    tr_a, ds_a = mk(False)
+    tr_b, ds_b = mk(True)
+    for _ in range(2):
+        rp_a = ResidentPass.build_streamed(ds_a, tr_a.table)
+        assert rp_a.wire == "dedup" and rp_a.segs is not None
+        ra = tr_a.train_pass_resident(rp_a)
+        rp_b = ResidentPass.build_streamed(ds_b, tr_b.table)
+        assert rp_b.wire == "compact" and rp_b.segs is not None
+        rb = tr_b.train_pass_resident(rp_b)
+    assert np.isclose(rb["auc"], ra["auc"], atol=2e-3), (ra["auc"],
+                                                         rb["auc"])
+    pa = jax.tree.leaves(tr_a.state.params)
+    pb = jax.tree.leaves(tr_b.state.params)
+    for a, b in zip(pa, pb):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-2, atol=2e-3)
