@@ -165,8 +165,10 @@ class ResidentPass:
         uniq_t = tuple(jax.device_put(a)
                        for a in cls._encode_uniq(uniq, meta))
         gidx_t = tuple(jax.device_put(a) for a in cls._encode_gidx(gidx))
-        segs_t = jax.device_put(np.zeros((1, 1), np.int32)
-                                if segs is None else segs)
+        segs_t = ((jax.device_put(np.zeros((1, 1), np.int32)),)
+                  if segs is None else
+                  tuple(jax.device_put(a)
+                        for a in cls._encode_gidx(segs)))
         rp = cls(uniq, gidx, floats, meta, segs, nrec, qmeta=qmeta,
                  side=side)
         rp.dev = (uniq_t, gidx_t, floats_t, jax.device_put(meta),
@@ -241,8 +243,10 @@ class ResidentPass:
             np.nonzero(valid)[0].astype(np.int32)
         loc_t = tuple(jax.device_put(a)
                       for a in cls._encode_locals(locs, bits))
-        segs_t = jax.device_put(np.zeros((1, 1), np.int32)
-                                if segs is None else segs)
+        segs_t = ((jax.device_put(np.zeros((1, 1), np.int32)),)
+                  if segs is None else
+                  tuple(jax.device_put(a)
+                        for a in cls._encode_gidx(segs)))
         rp = cls(rows_g, locs, floats, meta, segs, nrec, qmeta=qmeta,
                  side=side)
         rp.wire = "compact"
@@ -457,8 +461,9 @@ class ResidentPass:
                          self._encode_uniq(self.uniq, self.meta))
             gidx = tuple(jnp.asarray(a) for a in
                          self._encode_gidx(self.gidx))
-            segs = (jnp.zeros((1, 1), jnp.int32) if self.segs is None
-                    else jnp.asarray(self.segs))
+            segs = ((jnp.zeros((1, 1), jnp.int32),) if self.segs is None
+                    else tuple(jnp.asarray(a)
+                               for a in self._encode_gidx(self.segs)))
             qm = (jnp.zeros((2, 0), jnp.float32) if self.qmeta is None
                   else jnp.asarray(self.qmeta))
             self.dev = (uniq, gidx, jnp.asarray(self.floats),
@@ -553,8 +558,19 @@ class ResidentPassRunner:
         self.chunk_bits = chunk_bits
         self._jit: Dict[int, object] = {}  # n_steps → compiled runner
 
+    @staticmethod
+    def _decode_segs(segs):
+        """segments arrive raw, as a u18-packed pair (ops/bitpack), or
+        as a bare array (hand-built passes / direct test calls)."""
+        if isinstance(segs, tuple):
+            if len(segs) == 2:
+                return unpack_u16m(segs[0], segs[1], 2)
+            return segs[0]
+        return segs
+
     def _make_view(self, uniq_t, gidx_t, floats, meta,
                    segs, qmeta) -> _BatchView:
+        segs = self._decode_segs(segs)
         if self.wire == "compact":
             return self._make_view_compact(uniq_t, gidx_t[0], floats,
                                            meta, segs, qmeta)
@@ -636,9 +652,14 @@ class ResidentPassRunner:
                     # arena chunk map, not per-batch data — don't index
                     gi = (gidx_t if self.wire == "compact"
                           else tuple(a[i] for a in gidx_t))
+                    # one shared index: the packed pair's leading
+                    # dims are equal; the modulo only serves the
+                    # [1, 1] dummy of the trivial layout
+                    si = i % segs_p[0].shape[0]
+                    sg = tuple(a[si] for a in segs_p)
                     view = self._make_view(
                         tuple(a[i] for a in uniq_t), gi, floats_p[i],
-                        meta_p[i], segs_p[i % segs_p.shape[0]], qmeta)
+                        meta_p[i], sg, qmeta)
                     # 1-based like Trainer.train_pass's fold of the
                     # pre-incremented global_step
                     rng_i = jax.random.fold_in(rng, state.step + 1)
