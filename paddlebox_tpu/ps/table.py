@@ -691,6 +691,22 @@ class EmbeddingTable:
                  len(keys), len(rows_new), int(existing.sum()), path)
         return len(keys)
 
+    def merge_models(self, paths, update_type: str = "stats") -> int:
+        """MergeMultiModels (box_wrapper.h:812-815): fold several saved
+        models into the live table in order. ``update_type`` mirrors the
+        closed-core knob's observable surface: "stats" accumulates
+        show/clk/delta_score for shared keys and keeps live weights
+        (merge_model semantics per file); "overwrite" applies each file
+        as a delta (load(merge=True) — later files win). Returns total
+        rows merged."""
+        if update_type not in ("stats", "overwrite"):
+            raise ValueError(f"unknown update_type {update_type!r}")
+        total = 0
+        for p in paths:
+            total += (self.merge_model(p) if update_type == "stats"
+                      else self.load(p, merge=True))
+        return total
+
     def shrink(self, delete_threshold: Optional[float] = None,
                decay: Optional[float] = None) -> int:
         """Age features: decay show/clk/delta_score, then drop rows whose

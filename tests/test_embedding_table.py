@@ -271,3 +271,40 @@ def test_zero1_rejects_non_elementwise_tx():
     with pytest.raises(ValueError, match="ELEMENTWISE"):
         _assert_elementwise_tx(optax.chain(
             optax.clip_by_global_norm(1.0), optax.sgd(0.1)))
+
+
+def test_merge_multi_models(tmp_path):
+    """MergeMultiModels (box_wrapper.h:812): several files fold in order;
+    update_type selects stat-merge vs delta-overwrite."""
+    import jax
+    from paddlebox_tpu.ps import EmbeddingTable, SparseSGDConfig
+    from paddlebox_tpu.ps.table import TableState
+    cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=0.0)
+
+    def seed(keys, show):
+        t = EmbeddingTable(mf_dim=2, capacity=256, cfg=cfg)
+        rows = t.index.assign(keys)
+        d = np.asarray(jax.device_get(t.state.data)).copy()
+        d[rows, 0] = show
+        t.state = TableState.from_logical(d, t.capacity)
+        return t
+
+    a = seed(np.array([1, 2], np.uint64), 10.0)
+    b = seed(np.array([2, 3], np.uint64), 4.0)
+    c = seed(np.array([3, 4], np.uint64), 2.0)
+    pb, pc = str(tmp_path / "b.npz"), str(tmp_path / "c.npz")
+    b.save_base(pb)
+    c.save_base(pc)
+    assert a.merge_models([pb, pc]) == 4
+    data = np.asarray(jax.device_get(a.state.data))
+    rows = a.index.lookup(np.array([1, 2, 3, 4], np.uint64))
+    # stats accumulate: key2 10+4, key3 4+2 (b inserted, c merged), key4 2
+    np.testing.assert_allclose(data[rows, 0], [10.0, 14.0, 6.0, 2.0])
+    with pytest.raises(ValueError):
+        a.merge_models([pb], update_type="bogus")
+    # overwrite mode applies files as deltas
+    a2 = seed(np.array([2], np.uint64), 10.0)
+    a2.merge_models([pb], update_type="overwrite")
+    d2 = np.asarray(jax.device_get(a2.state.data))
+    r2 = a2.index.lookup(np.array([2], np.uint64))
+    np.testing.assert_allclose(d2[r2, 0], 4.0)  # overwritten, not summed
