@@ -70,10 +70,12 @@ class Flags:
 
     # --- metrics (reference: metrics.h:46 table_size 1e6+1) ---
     auc_num_buckets: int = 1_000_000
-    # reduce the AUC bucket tables to scalars ON DEVICE and fetch ~8 floats
-    # instead of pulling [2, nbins] to host each pass (the pull is dead
-    # weight on a tunneled/remote device). False = exact f64 host compute.
-    auc_device_reduce: bool = True
+    # False (default) = exact f64 host finalize — BasicAucCalculator::compute
+    # semantics (metrics.cc:288-304). True = reduce the AUC bucket tables to
+    # scalars ON DEVICE in f32 (~1e-5 AUC drift) and fetch ~8 floats instead
+    # of pulling [2, nbins] to host each pass — an optimization for
+    # tunneled/remote devices where the bucket pull is dead weight.
+    auc_device_reduce: bool = False
 
     # --- runtime ---
     profile: bool = False

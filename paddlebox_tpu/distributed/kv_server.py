@@ -78,11 +78,30 @@ class KVServer:
     def _handle(self, conn: socket.socket) -> None:
         try:
             with conn:
-                conn.settimeout(30.0)
+                # reap half-open dead peers without an idle cap: keepalive
+                # probes detect a power-failed/partitioned client, while a
+                # quiet-but-alive TcpKVStore connection (poll cadence can
+                # exceed any fixed idle timeout) is never dropped
+                conn.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+                if hasattr(socket, "TCP_KEEPIDLE"):
+                    conn.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_KEEPIDLE, 60)
+                    conn.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_KEEPINTVL, 15)
+                    conn.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_KEEPCNT, 4)
                 while True:
+                    # idle between requests: no fixed timeout — keepalive
+                    # (above) owns dead-peer reaping; a timeout here would
+                    # drop the persistent connection and force
+                    # failed-sendall + reconnect churn on every later op
+                    conn.settimeout(None)
                     hdr = conn.recv(1)
                     if not hdr:
                         return
+                    # mid-request: a short timeout so a half-written
+                    # request can't wedge the handler thread
+                    conn.settimeout(30.0)
                     op = hdr[0]
                     (klen,) = struct.unpack("<I", _recv_exact(conn, 4))
                     if not _PUT <= op <= _MTIME or klen > _MAX_KEY:

@@ -180,7 +180,10 @@ class TcpMesh:
                 self._cv.notify_all()
 
     def _send_to(self, dst: int, payload: bytes,
-                 errors: List[BaseException]) -> None:
+                 errors: List[BaseException], rnd: int) -> None:
+        # ``rnd`` is captured at spawn: exchange_bytes may advance
+        # self._round (inbox complete) while a slow sender is still
+        # writing — the stamp must stay this round's
         try:
             deadline = time.monotonic() + self.timeout
             delay = 0.05
@@ -201,11 +204,15 @@ class TcpMesh:
                     time.sleep(delay)
                     delay = min(delay * 2, 1.0)
             with c:
-                c.sendall(struct.pack("<iiq", self.rank, self._round,
+                c.sendall(struct.pack("<iiq", self.rank, rnd,
                                       len(payload)))
                 c.sendall(payload)
         except BaseException as e:
             errors.append(e)
+            # wake exchange_bytes' inbox wait so a dead peer aborts the
+            # round immediately instead of burning the full timeout
+            with self._cv:
+                self._cv.notify_all()
 
     def exchange_bytes(self, payloads: Dict[int, bytes]
                        ) -> Dict[int, bytes]:
@@ -218,13 +225,13 @@ class TcpMesh:
             if dst == self.rank:
                 continue
             t = threading.Thread(
-                target=self._send_to, args=(dst, payloads[dst], errors),
+                target=self._send_to,
+                args=(dst, payloads[dst], errors, self._round),
                 daemon=True)
             t.start()
             senders.append(t)
-        for t in senders:
-            t.join()
-        # collect this round's payloads from the background listener
+        # collect this round's payloads from the background listener while
+        # the sender threads run; a send failure wakes the wait and aborts
         want = [(self._round, src) for src in range(self.world)
                 if src != self.rank]
         deadline = time.monotonic() + self.timeout
@@ -234,6 +241,8 @@ class TcpMesh:
                 if self._listen_err is not None:
                     err, self._listen_err = self._listen_err, None
                     raise err
+                if errors:
+                    raise errors[0]
                 for key in want:
                     if key in self._stash and key[1] not in inbox:
                         inbox[key[1]] = self._stash.pop(key)
@@ -246,6 +255,8 @@ class TcpMesh:
                         f"mesh round {self._round}: no payload from "
                         f"ranks {missing} within {self.timeout}s")
             self._round += 1
+        for t in senders:
+            t.join()
         if errors:
             raise errors[0]
         return inbox

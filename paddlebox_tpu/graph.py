@@ -207,15 +207,24 @@ def sample_neighbors_without_replacement(
 
     TPU-shaped: gathers a bounded [N, max_degree] neighbor window and
     runs ONE lax.top_k — no per-node shuffles or rejection loops.
-    Nodes with degree > max_degree sample from their first max_degree
-    edges (CSR build order); raise ``max_degree`` for hub-heavy graphs.
-    Slots beyond a node's degree (or beyond k available) are -1, as in
-    the reference's padded NeighborSampleResult."""
+    Nodes with degree > max_degree sample from a max_degree-wide window
+    whose offset is drawn uniformly at random PER CALL — every edge of a
+    hub node is sampleable across calls (no permanently-invisible tail,
+    unlike a fixed first-window truncation); raise ``max_degree`` for
+    hub-heavy graphs to remove the bias within one call. Slots beyond a
+    node's degree (or beyond k available) are -1, as in the reference's
+    padded NeighborSampleResult."""
     n = nodes.shape[0]
     start = indptr[nodes]
-    deg = jnp.minimum(indptr[nodes + 1] - start, max_degree)
+    full_deg = indptr[nodes + 1] - start
+    deg = jnp.minimum(full_deg, max_degree)
+    rng, rng_off = jax.random.split(rng)
+    over = jnp.maximum(full_deg - max_degree, 0)
+    # exact integer draw: an f32 uniform*span would quantize offsets for
+    # hubs with >2^24 excess edges, re-hiding the tail
+    off = jax.random.randint(rng_off, (n,), 0, over + 1)
     pos = jnp.arange(max_degree, dtype=jnp.int32)[None, :]
-    edge = jnp.minimum(start[:, None] + pos,
+    edge = jnp.minimum(start[:, None] + off[:, None] + pos,
                        jnp.maximum(indices.shape[0] - 1, 0))
     valid = pos < deg[:, None]
     if cumw is not None:

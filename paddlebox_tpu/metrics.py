@@ -113,9 +113,10 @@ def _auc_reduce(state: AucState) -> jax.Array:
 
 def auc_compute(state: AucState) -> AucResult:
     """Final compute (BasicAucCalculator::compute, metrics.cc: bucket scan
-    → area / (pos_total * neg_total)). Default path reduces on device and
-    fetches 8 scalars (FLAGS.auc_device_reduce); the f64 host path pulls
-    the full tables."""
+    → area / (pos_total * neg_total)). Default = exact f64 host compute
+    (pulls the full tables). Set FLAGS.auc_device_reduce=True to reduce on
+    device and fetch 8 scalars instead — the tunneled/remote-device
+    optimization (~1e-5 AUC drift in f32)."""
     if FLAGS.auc_device_reduce and isinstance(state.pos, jax.Array):
         (area, tot_pos, tot_neg, abs_err, sqr_err, pred_sum, label_sum,
          ins) = (float(x) for x in np.asarray(
@@ -127,21 +128,24 @@ def auc_compute(state: AucState) -> AucResult:
             auc=auc, actual_ctr=label_sum / ins_safe,
             predicted_ctr=pred_sum / ins_safe, mae=abs_err / ins_safe,
             rmse=float(np.sqrt(sqr_err / ins_safe)), ins_num=ins)
-    pos = np.asarray(jax.device_get(state.pos), np.float64)
-    neg = np.asarray(jax.device_get(state.neg), np.float64)
+    # ONE batched pull for all 7 leaves — per-leaf device_get costs a
+    # ~0.25 s roundtrip EACH on tunneled runtimes
+    h = AucState(*jax.device_get(tuple(state)))
+    pos = np.asarray(h.pos, np.float64)
+    neg = np.asarray(h.neg, np.float64)
     tot_pos, tot_neg = pos.sum(), neg.sum()
     cum_neg_below = np.concatenate([[0.0], np.cumsum(neg)[:-1]])
     # P(pos-bucket > neg-bucket) + 0.5 P(tie), summed per bucket
     area = np.sum(pos * (cum_neg_below + 0.5 * neg))
     auc = float(area / (tot_pos * tot_neg)) if tot_pos > 0 and tot_neg > 0 else 0.5
-    ins = float(jax.device_get(state.ins_num))
+    ins = float(h.ins_num)
     ins_safe = max(ins, 1e-12)
     return AucResult(
         auc=auc,
-        actual_ctr=float(jax.device_get(state.label_sum)) / ins_safe,
-        predicted_ctr=float(jax.device_get(state.pred_sum)) / ins_safe,
-        mae=float(jax.device_get(state.abs_err)) / ins_safe,
-        rmse=float(np.sqrt(float(jax.device_get(state.sqr_err)) / ins_safe)),
+        actual_ctr=float(h.label_sum) / ins_safe,
+        predicted_ctr=float(h.pred_sum) / ins_safe,
+        mae=float(h.abs_err) / ins_safe,
+        rmse=float(np.sqrt(float(h.sqr_err) / ins_safe)),
         ins_num=ins,
     )
 

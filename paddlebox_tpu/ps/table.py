@@ -375,7 +375,12 @@ def apply_push(
     segment op is spent on it."""
     g = unique_grads
     if touched is None:
-        touched = unique_rows <= state.capacity
+        # strictly < capacity: real rows are always below the sentinel.
+        # The compact wire maps pad keys to row == capacity and dedup_rows
+        # emits that as an in-bounds unique entry — the optimizer must
+        # never run on it (lazy mf creation would seed it from RNG before
+        # the trailing re-zero).
+        touched = unique_rows < state.capacity
     if rows_full is None:
         rows_full = gather_full_rows(state, unique_rows)
     mf_dim = state.mf_dim

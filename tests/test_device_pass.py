@@ -465,6 +465,38 @@ def test_compact_falls_back_after_slotless_assign(criteo_files):
     assert np.isfinite(res["auc"])
 
 
+def test_compact_wire_sentinel_row_stays_zero(criteo_files):
+    """The compact wire maps pad keys to the sentinel row (== capacity)
+    and device dedup emits it as an in-bounds unique entry. With lazy mf
+    creation active (mf_create_thresholds<=0) and a nonzero
+    mf_initial_range, the in-table optimizer must NOT seed the sentinel's
+    embedx from RNG — unknown keys read zeros (host_pull / ServingModel
+    contract)."""
+    desc = DataFeedDesc.criteo(batch_size=128)
+    desc.key_bucket_min = 4096
+    ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+    ds.set_filelist(criteo_files)
+    ds.set_thread(2)
+    ds.load_into_memory()
+    cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=0.5,
+                          learning_rate=0.05, mf_learning_rate=0.05)
+    table = EmbeddingTable(mf_dim=4, capacity=1 << 13, cfg=cfg,
+                           unique_bucket_min=4096, arena_slots=26,
+                           arena_chunk_bits=6)
+    tr = Trainer(DeepFM(hidden=(16, 8)), table, desc, tx=optax.adam(1e-2),
+                 seed=3)
+    rp = ResidentPass.build_streamed(ds, tr.table)
+    assert rp.wire == "compact"
+    tr.train_pass_resident(rp)
+    from paddlebox_tpu.ps.table import gather_full_rows
+    sent = np.asarray(jax.device_get(gather_full_rows(
+        tr.state.table, jnp.asarray([table.capacity], jnp.int32))))
+    assert not np.any(sent), sent
+    # and host_pull of an unknown key reads zeros
+    vals = tr.table.host_pull(np.array([0xdeadbeefcafe], dtype=np.uint64))
+    assert not np.any(vals)
+
+
 def test_resident_metric_registry_accumulates(criteo_files):
     """Registry metric variants now accumulate in RESIDENT mode too: the
     runner collects per-batch predictions and the trainer replays the

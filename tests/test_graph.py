@@ -156,6 +156,31 @@ def test_without_replacement_weighted_prefers_heavy():
     assert hits > 150  # ~50/57 probability of the heavy edge first
 
 
+def test_without_replacement_hub_tail_reachable():
+    """Hub nodes with degree > max_degree: the sampling window offset is
+    randomized per call, so edges beyond the first max_degree CSR entries
+    are NOT permanently unsampleable (advisor r2 finding)."""
+    from paddlebox_tpu.graph import (GraphStore,
+                                     sample_neighbors_without_replacement)
+    deg = 64
+    src = np.zeros(deg, np.int64)
+    dst = np.arange(1, deg + 1)
+    g = GraphStore.from_edges(src, dst, n_nodes=deg + 1)
+    indptr, indices = g.to_device()
+    seen = set()
+    for t in range(60):
+        out = np.asarray(sample_neighbors_without_replacement(
+            indptr, indices, jnp.zeros(1, jnp.int32), 8,
+            jax.random.PRNGKey(t), max_degree=16))
+        real = out[out >= 0]
+        assert len(set(real.tolist())) == len(real)
+        seen.update(real.tolist())
+    # the tail beyond the first 16 CSR entries must appear
+    assert any(v > 16 for v in seen), sorted(seen)
+    # and coverage should span most of the neighborhood
+    assert len(seen) > deg * 0.8, sorted(seen)
+
+
 def test_metapath_walk_follows_types():
     from paddlebox_tpu.graph import GraphStore, HeteroGraphStore
     # type "a": i -> i+10; type "b": i -> i+100 (deterministic chains)

@@ -29,6 +29,36 @@ def ref_auc(label, pred):
     return (ranks[l > 0].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
 
 
+def test_default_auc_bitmatches_f64_reference_calculator():
+    """FLAGS.auc_device_reduce defaults to False: the default AUC path is
+    the exact f64 host finalize — BasicAucCalculator::compute semantics
+    (metrics.cc:288-304). Assert bit-equality against an independent numpy
+    transcription of the bucket scan."""
+    from paddlebox_tpu.config import FLAGS
+    from paddlebox_tpu.metrics import (auc_add_batch, auc_compute,
+                                       init_auc_state)
+    assert FLAGS.auc_device_reduce is False  # parity by default
+    rng = np.random.default_rng(7)
+    nb = 4096
+    st = init_auc_state(nb)
+    for _ in range(3):
+        pred = rng.random(512).astype(np.float32)
+        label = (rng.random(512) < pred).astype(np.float32)
+        st = auc_add_batch(st, jnp.asarray(pred), jnp.asarray(label),
+                           jnp.ones(512, jnp.float32))
+    got = auc_compute(st).auc
+    # independent f64 bucket scan (metrics.cc BasicAucCalculator::compute)
+    pos = np.asarray(st.pos, np.float64)
+    neg = np.asarray(st.neg, np.float64)
+    area = 0.0
+    cum_neg = 0.0
+    for i in range(nb):
+        area += pos[i] * (cum_neg + 0.5 * neg[i])
+        cum_neg += neg[i]
+    want = area / (pos.sum() * neg.sum())
+    assert got == want  # bit-exact, not approx
+
+
 def test_parse_cmatch_rank_group():
     assert parse_cmatch_rank_group("401:0,402:1") == [(401, 0), (402, 1)]
     assert parse_cmatch_rank_group("7, 8") == [(7, 0), (8, 0)]
