@@ -9,10 +9,13 @@ from paddlebox_tpu.ps.pass_table import PassScopedTable
 from paddlebox_tpu.ps.box_helper import BoxPSHelper
 from paddlebox_tpu.ps.extended import ExtendedEmbeddingTable
 from paddlebox_tpu.ps.replica_cache import InputTable, ReplicaCache
+from paddlebox_tpu.ps.sharded import ShardedEmbeddingTable
+from paddlebox_tpu.ps.tiered import TieredShardedEmbeddingTable
 
 __all__ = ["SparseSGDConfig", "SparseAdamConfig", "EmbeddingTable",
            "MultiMfEmbeddingTable",
            "TableState", "PullIndex", "pull_rows", "expand_pull",
            "apply_push", "merge_push", "push_stats", "init_table_state",
            "HostStore", "PassScopedTable", "BoxPSHelper",
-           "ExtendedEmbeddingTable", "InputTable", "ReplicaCache"]
+           "ExtendedEmbeddingTable", "InputTable", "ReplicaCache",
+           "ShardedEmbeddingTable", "TieredShardedEmbeddingTable"]

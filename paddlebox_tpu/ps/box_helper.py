@@ -30,12 +30,20 @@ log = get_logger(__name__)
 
 
 class BoxPSHelper:
-    """Couples a PassScopedTable (+ optional Trainer) to the pass protocol."""
+    """Couples a pass-scoped table (+ optional trainer) to the pass
+    protocol. Works with both ``PassScopedTable`` (single chip, backing
+    store at ``table.host``) and ``TieredShardedEmbeddingTable`` (mesh,
+    per-shard host stores with lifecycle methods on the table itself)."""
 
-    def __init__(self, table: PassScopedTable, trainer=None) -> None:
+    def __init__(self, table, trainer=None) -> None:
         self.table = table
         self.trainer = trainer
         self.pass_id = 0
+
+    def _store(self):
+        """The full-model lifecycle surface: the single HostStore behind a
+        PassScopedTable, or the tiered sharded table itself."""
+        return getattr(self.table, "host", self.table)
 
     # ---- dataset attachment (Paddle-style ds.begin_pass() hooks) ----
     def attach(self, ds: PaddleBoxDataset) -> PaddleBoxDataset:
@@ -79,22 +87,25 @@ class BoxPSHelper:
         n = self.table.end_pass()
         if need_save_delta:
             path = delta_path or f"xbox_delta_pass{self.pass_id}.npz"
-            self.table.host.save_delta(path)
+            self._store().save_delta(path)
         return n
 
     # ---- model lifecycle (box_helper_py.cc:70-165) ----
     def save_base(self, path: str) -> int:
-        return self.table.host.save_base(path)
+        return self._store().save_base(path)
 
     def save_delta(self, path: str) -> int:
-        return self.table.host.save_delta(path)
+        return self._store().save_delta(path)
 
     def load_model(self, path: str, merge: bool = False) -> int:
-        return self.table.host.load(path, merge=merge)
+        return self._store().load(path, merge=merge)
 
     def shrink_table(self, **kw) -> int:
+        store = self._store()
+        if store is self.table:  # tiered: scores with its own cfg coeffs
+            return store.shrink(**kw)
         # score with the table's optimizer coefficients so host- and
         # device-side shrink agree on what to drop
         kw.setdefault("nonclk_coeff", self.table.cfg.nonclk_coeff)
         kw.setdefault("clk_coeff", self.table.cfg.clk_coeff)
-        return self.table.host.shrink(**kw)
+        return store.shrink(**kw)

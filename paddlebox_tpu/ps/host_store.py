@@ -200,6 +200,71 @@ class HostStore:
         log.info("save_base: %d rows -> %s", n, path)
         return n
 
+    # ---- in-memory export/import (sharded single-file save format) ----
+    def export_rows(self, delta: bool = False
+                    ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+        """(keys, {field: values}) snapshot — base includes disk-spilled
+        rows so the export is the COMPLETE model; ``delta`` restricts to
+        rows touched since the last export/save and clears their flags."""
+        with self._lock:
+            keys, rows = self.index.items()
+            if delta:
+                m = self._touched[rows]
+                keys, rows = keys[m], rows[m]
+            out = {f: self._arr[f][rows].copy() for f in self.fields}
+            if not delta:
+                extra = self._spilled_not_in_ram()
+                if extra is not None:
+                    keys = np.concatenate([keys, extra["keys"]])
+                    for f in self.fields:
+                        out[f] = np.concatenate([out[f], extra[f]])
+                self._touched[:] = False
+            else:
+                self._touched[rows] = False
+        return keys, out
+
+    def import_rows(self, keys: np.ndarray, fields: Dict[str, np.ndarray],
+                    merge: bool = False) -> int:
+        """Write rows wholesale (load semantics); merge=False resets the
+        store first. Missing/mismatched opt_ext starts fresh."""
+        with self._lock:
+            if not merge:
+                self.index = make_kv(self.capacity)
+                for f in self.fields:
+                    self._arr[f][:] = 0
+                self._touched[:] = False
+                self._spill_files = []
+                self._spill_keys = {}
+            rows = self.index.assign(np.ascontiguousarray(keys, np.uint64))
+            if len(rows):
+                self._ensure(int(rows.max()))
+            for f in self.fields:
+                self._write_field(f, rows, fields, "import_rows")
+        return len(keys)
+
+    def merge_model_rows(self, keys: np.ndarray,
+                         fields: Dict[str, np.ndarray]) -> int:
+        """MergeModel semantics (box_wrapper.h:801-803) on the host tier:
+        keys present in both ACCUMULATE show/clk/delta_score and keep the
+        live weights/optimizer state; unseen keys insert wholesale."""
+        if len(keys) == 0:
+            return 0
+        keys = np.ascontiguousarray(keys, np.uint64)
+        with self._lock:
+            existing = self.index.lookup(keys) >= 0
+        new_keys = keys[~existing]
+        self.import_rows(new_keys,
+                         {f: v[~existing] for f, v in fields.items()},
+                         merge=True)
+        with self._lock:
+            rows_old = self.index.lookup(keys[existing])
+            for f in ("show", "clk", "delta_score"):
+                self._arr[f][rows_old] += fields[f][existing]
+            self._touched[rows_old] = True
+            rows_new = self.index.lookup(new_keys)
+            self._touched[rows_new] = True
+        return len(keys)
+
     def save_delta(self, path: str) -> int:
         with self._lock:
             keys, rows = self.index.items()
@@ -254,6 +319,8 @@ class HostStore:
         stay in RAM): a spilled row is on disk in BOTH the spill file and
         the last base, so no save_delta update can be lost, and
         ``save_base`` merges spill files in so exports stay complete."""
+        if not path.endswith(".npz"):
+            path += ".npz"  # savez appends it; the registry must match
         with self._lock:
             if path in self._spill_files:
                 raise ValueError(

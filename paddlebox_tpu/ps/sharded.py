@@ -47,8 +47,8 @@ import numpy as np
 from paddlebox_tpu.config import FLAGS
 from paddlebox_tpu.data.batch import SlotBatch
 from paddlebox_tpu.ps.sgd import SparseSGDConfig
-from paddlebox_tpu.ps.table import (FIELDS, NUM_FIXED, HostKV, TableState,
-                                    field_assign, field_slice,
+from paddlebox_tpu.ps.table import (FIELD_COL, FIELDS, NUM_FIXED, HostKV,
+                                    TableState, field_assign, field_slice,
                                     fill_oob_pads, init_table_state,
                                     next_bucket)
 from paddlebox_tpu.utils.logging import get_logger
@@ -244,6 +244,7 @@ class ShardedEmbeddingTable:
 
     def _dump(self, path: str, row_filter) -> int:
         data = np.asarray(jax.device_get(self.state.data))
+        mf_end = NUM_FIXED + self.mf_dim
         blobs = {}
         total = 0
         for s in range(self.n):
@@ -255,8 +256,15 @@ class ShardedEmbeddingTable:
                 # for the next delta
                 self._touched[s][rows] = False
             blobs[f"keys_{s}"] = keys
+            sub = data[s][rows]
             for f in FIELDS:
-                blobs[f"{f}_{s}"] = field_slice(data[s][rows], f)
+                # embedx sliced to mf_dim explicitly — field_slice's tail
+                # is unbounded and would duplicate opt_ext into embedx_w
+                blobs[f"{f}_{s}"] = (sub[:, NUM_FIXED:mf_end]
+                                     if f == "embedx_w"
+                                     else field_slice(sub, f))
+            if self.opt_ext:
+                blobs[f"opt_ext_{s}"] = sub[:, mf_end:]
             total += len(keys)
         np.savez_compressed(path, n=self.n, **blobs)
         return total
@@ -277,7 +285,6 @@ class ShardedEmbeddingTable:
         """Load a base/delta dump; merge=True applies on top of the live
         table, else the table (host index AND device rows) is reset first."""
         blob = np.load(path)
-        assert int(blob["n"]) == self.n, "shard count mismatch"
         if merge:
             data = np.asarray(jax.device_get(self.state.data)).copy()
         else:
@@ -288,12 +295,123 @@ class ShardedEmbeddingTable:
             self.indexes = [HostKV(self.capacity) for _ in range(self.n)]
             self._touched[:] = False
         total = 0
-        for s in range(self.n):
-            keys = blob[f"keys_{s}"]
+        mf_end = NUM_FIXED + self.mf_dim
+        for s, (keys, fields) in enumerate(self._file_per_shard(blob)):
             rows = self.indexes[s].assign(keys)
             for f in FIELDS:
-                field_assign(data[s], rows, f, blob[f"{f}_{s}"])
+                field_assign(data[s], rows, f, fields[f])
+            if self.opt_ext:
+                if "opt_ext" in fields \
+                        and fields["opt_ext"].shape[1] == self.opt_ext:
+                    data[s][rows, mf_end:mf_end + self.opt_ext] = \
+                        fields["opt_ext"]
+                elif len(keys):
+                    log.warning("load: file has no matching opt_ext block "
+                                "for shard %d; optimizer state starts "
+                                "fresh", s)
             total += len(keys)
         self.state = TableState.from_logical(data, self.capacity,
                                              ext=self.opt_ext)
+        return total
+
+    # ---- lifecycle: shrink / merge (box_wrapper.h:638-640,801-815) ----
+    def shrink(self, delete_threshold: Optional[float] = None,
+               decay: Optional[float] = None) -> int:
+        """ShrinkTable over every HBM shard: decay show/clk/delta_score,
+        drop rows whose decayed score falls below threshold — the same
+        accessor rules as EmbeddingTable.shrink (ps/table.py), applied
+        shard-parallel on the stacked state."""
+        thr = (FLAGS.shrink_delete_threshold
+               if delete_threshold is None else delete_threshold)
+        dk = FLAGS.show_click_decay_rate if decay is None else decay
+        freed_total = 0
+        with self.host_lock:
+            data = np.asarray(jax.device_get(self.state.data)).copy()
+            data[:, :, 0:3] *= dk
+            for s in range(self.n):
+                keys, rows = self.indexes[s].items()
+                if len(keys) == 0:
+                    continue
+                show, clk = data[s][rows, 0], data[s][rows, 1]
+                score = (self.cfg.nonclk_coeff * (show - clk)
+                         + self.cfg.clk_coeff * clk)
+                drop = score < thr
+                freed = self.indexes[s].release(keys[drop])
+                data[s][freed] = 0.0
+                self._touched[s][freed] = False
+                freed_total += len(freed)
+            self.state = TableState.from_logical(data, self.capacity,
+                                                 ext=self.opt_ext)
+        log.info("sharded shrink: freed %d rows across %d shards",
+                 freed_total, self.n)
+        return freed_total
+
+    def _file_per_shard(self, blob):
+        """(keys, fields-dict) per owner shard from a save file — fast
+        path when the file's shard count matches; otherwise (different
+        mesh size, or a single-table EmbeddingTable/HostStore save) keys
+        re-split by key % N."""
+        want = list(FIELDS) + (["opt_ext"] if self.opt_ext else [])
+        if "n" in blob and int(blob["n"]) == self.n:
+            for s in range(self.n):
+                fields = {f: blob[f"{f}_{s}"] for f in want
+                          if f"{f}_{s}" in blob}
+                yield blob[f"keys_{s}"], fields
+            return
+        if "n" in blob:
+            fn = int(blob["n"])
+            keys = np.concatenate([blob[f"keys_{s}"] for s in range(fn)])
+            fields = {f: np.concatenate([blob[f"{f}_{s}"]
+                                         for s in range(fn)])
+                      for f in want if f"{f}_0" in blob}
+        else:
+            keys = blob["keys"]
+            fields = {f: blob[f] for f in want if f in blob}
+        owners = (np.ascontiguousarray(keys, np.uint64)
+                  % np.uint64(self.n)).astype(np.int64)
+        for s in range(self.n):
+            m = owners == s
+            yield keys[m], {f: v[m] for f, v in fields.items()}
+
+    def merge_model(self, path: str) -> int:
+        """MergeModel (box_wrapper.h:801-803) shard-parallel: keys present
+        in both ACCUMULATE show/clk/delta_score and keep live weights /
+        optimizer state; unseen keys insert wholesale. Accepts sharded
+        saves (any shard count) and single-table saves (split by key%N)."""
+        blob = np.load(path)
+        mf_end = NUM_FIXED + self.mf_dim
+        total = 0
+        with self.host_lock:
+            data = np.asarray(jax.device_get(self.state.data)).copy()
+            for s, (keys, fields) in enumerate(self._file_per_shard(blob)):
+                if len(keys) == 0:
+                    continue
+                existing = self.indexes[s].lookup(keys) >= 0
+                rows_new = self.indexes[s].assign(keys[~existing])
+                for f in FIELDS:
+                    field_assign(data[s], rows_new, f, fields[f][~existing])
+                if self.opt_ext and "opt_ext" in fields \
+                        and fields["opt_ext"].shape[1] == self.opt_ext:
+                    data[s][rows_new, mf_end:] = fields["opt_ext"][~existing]
+                rows_old = self.indexes[s].lookup(keys[existing])
+                for f in ("show", "clk", "delta_score"):
+                    data[s][rows_old, FIELD_COL[f]] += fields[f][existing]
+                rows_all = self.indexes[s].lookup(keys)
+                self._touched[s][rows_all] = True
+                total += len(keys)
+            self.state = TableState.from_logical(data, self.capacity,
+                                                 ext=self.opt_ext)
+        log.info("sharded merge_model: %d rows from %s", total, path)
+        return total
+
+    def merge_models(self, paths, update_type: str = "stats") -> int:
+        """MergeMultiModels (box_wrapper.h:812-815): "stats" accumulates
+        per file (merge_model); "overwrite" applies each file as a delta
+        (load(merge=True) — later files win)."""
+        if update_type not in ("stats", "overwrite"):
+            raise ValueError(f"unknown update_type {update_type!r}")
+        total = 0
+        for p in paths:
+            total += (self.merge_model(p) if update_type == "stats"
+                      else self.load(p, merge=True))
         return total

@@ -1,0 +1,274 @@
+"""Tiered sharded PS: HostStore-backed pass windows per HBM shard.
+
+The reference's core capability — a table BIGGER than device memory on a
+multi-device PS: per pass, ``BuildPull`` fetches the pass's values from
+the CPU store (ps_gpu_wrapper.cc:337), ``BuildGPUTask`` fills the per-GPU
+HBM pools (:684), training hits only the resident working set, and
+``EndPass`` dumps updated values back to the CPU store (:983); the SSD
+tier promotes via ``LoadSSD2Mem`` (box_wrapper.cc:1415).
+
+TPU-native composition: ``ShardedEmbeddingTable`` keeps its whole routing
+machinery (key%N owner shards, two all_to_alls in the jit step) but its
+per-shard HBM slice becomes a PASS WINDOW — each shard fronted by a
+``HostStore`` (host RAM + disk spill) holding the full model. The pass
+lifecycle mirrors ``PassScopedTable``:
+
+    table.stage(ds.pass_keys())     # BuildPull: host fetch per shard
+    table.begin_pass()              # BuildGPUTask: scatter → HBM shards
+    trainer.adopt_table()
+    ...train (streaming or resident)...
+    trainer.sync_table(); table.end_pass()   # EndPass: HBM → host
+
+Contract (same as the reference's pass windows): the staged key set must
+cover every key the pass's batches touch — keys outside it allocate fresh
+zero rows in the window and would overwrite their host values at
+end_pass. ``ds.pass_keys()`` provides exactly that set.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from paddlebox_tpu.ps.host_store import HostStore
+from paddlebox_tpu.ps.sharded import ShardedEmbeddingTable
+from paddlebox_tpu.ps.sgd import SparseSGDConfig
+from paddlebox_tpu.ps.table import (FIELDS, NUM_FIXED, HostKV, TableState,
+                                    field_assign, field_slice)
+from paddlebox_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class _ShardStage:
+    def __init__(self, keys: List[np.ndarray],
+                 values: List[Dict[str, np.ndarray]]) -> None:
+        self.keys = keys        # per shard
+        self.values = values    # per shard
+
+
+class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
+    """ShardedEmbeddingTable whose HBM shards hold one pass's working set;
+    the full model lives in N per-shard HostStores (+ disk spill)."""
+
+    def __init__(self, num_shards: int, mf_dim: int = 8,
+                 capacity_per_shard: Optional[int] = None,
+                 cfg: Optional[SparseSGDConfig] = None,
+                 host_capacity: Optional[int] = None,
+                 host_init_rows: int = 1 << 14,
+                 req_bucket_min: int = 512,
+                 serve_bucket_min: int = 1024) -> None:
+        super().__init__(num_shards, mf_dim=mf_dim,
+                         capacity_per_shard=capacity_per_shard, cfg=cfg,
+                         req_bucket_min=req_bucket_min,
+                         serve_bucket_min=serve_bucket_min)
+        self.hosts = [HostStore(mf_dim, capacity=host_capacity,
+                                init_rows=host_init_rows,
+                                opt_ext=self.opt_ext)
+                      for _ in range(self.n)]
+        self.in_pass = False
+        self._stage: Optional[_ShardStage] = None
+        self._stage_thread: Optional[threading.Thread] = None
+        self._stage_exc: Optional[BaseException] = None
+
+    # ------------------------------------------------------------------
+    def _split_by_owner(self, keys: np.ndarray) -> List[np.ndarray]:
+        keys = np.unique(np.ascontiguousarray(keys, np.uint64))
+        owners = (keys % np.uint64(self.n)).astype(np.int64)
+        return [keys[owners == s] for s in range(self.n)]
+
+    # ---- feed-pass staging (BuildPull, ps_gpu_wrapper.cc:337) ----
+    def stage(self, pass_keys: np.ndarray, background: bool = True) -> None:
+        """Fetch the pass working set from every shard's host store. Only
+        legal between end_pass and the next begin_pass (staged values must
+        reflect the previous pass's write-back)."""
+        if self.in_pass:
+            raise RuntimeError(
+                "stage() while a pass is open — end_pass first")
+        if self._stage_thread is not None:
+            raise RuntimeError("a feed pass is already staging")
+        per_shard = self._split_by_owner(pass_keys)
+        for s, ks in enumerate(per_shard):
+            if len(ks) > self.capacity:
+                raise ValueError(
+                    f"shard {s} working set ({len(ks)}) exceeds "
+                    f"capacity_per_shard ({self.capacity})")
+        self._stage_exc = None
+
+        def run() -> None:
+            try:
+                vals = [self.hosts[s].fetch(per_shard[s])
+                        for s in range(self.n)]
+                self._stage = _ShardStage(per_shard, vals)
+            except BaseException as e:
+                self._stage_exc = e
+
+        if background:
+            self._stage_thread = threading.Thread(target=run, daemon=True)
+            self._stage_thread.start()
+        else:
+            run()
+            if self._stage_exc is not None:
+                raise self._stage_exc
+
+    def wait_stage_done(self) -> None:
+        if self._stage_thread is not None:
+            self._stage_thread.join()
+            self._stage_thread = None
+        if self._stage_exc is not None:
+            exc, self._stage_exc = self._stage_exc, None
+            raise exc
+
+    # ---- pass window (BuildGPUTask/EndPass, ps_gpu_wrapper.cc:684,983) --
+    def begin_pass(self, pass_keys: Optional[np.ndarray] = None) -> int:
+        """Promote the staged (or given) working set into the HBM shards.
+        Returns the number of working-set rows across shards."""
+        if self.in_pass:
+            raise RuntimeError("begin_pass while a pass is open")
+        if pass_keys is not None:
+            if self._stage_thread is not None or self._stage is not None:
+                self.wait_stage_done()
+                want = self._split_by_owner(pass_keys)
+                if (self._stage is None
+                        or not all(np.array_equal(a, b) for a, b in
+                                   zip(self._stage.keys, want))):
+                    raise RuntimeError(
+                        "begin_pass keys differ from the staged key set")
+            else:
+                self.stage(pass_keys, background=False)
+        self.wait_stage_done()
+        st = self._stage
+        if st is None:
+            raise RuntimeError("begin_pass with nothing staged")
+        self._stage = None
+
+        mf_end = NUM_FIXED + self.mf_dim
+        data = np.zeros((self.n, self.capacity + 1, mf_end + self.opt_ext),
+                        np.float32)
+        total = 0
+        with self.host_lock:
+            for s in range(self.n):
+                self.indexes[s] = HostKV(self.capacity)
+                rows = self.indexes[s].assign(st.keys[s])
+                for f in FIELDS:
+                    field_assign(data[s], rows, f, st.values[s][f])
+                if self.opt_ext:
+                    data[s][rows, mf_end:] = st.values[s]["opt_ext"]
+                total += len(rows)
+            self._touched[:] = False
+        self.state = TableState.from_logical(data, self.capacity,
+                                             ext=self.opt_ext)
+        self.in_pass = True
+        log.info("begin_pass: %d working-set rows across %d HBM shards",
+                 total, self.n)
+        return total
+
+    def end_pass(self) -> int:
+        """Write the (jit-updated) working set back to the host stores."""
+        if not self.in_pass:
+            raise RuntimeError("end_pass without begin_pass")
+        data = np.asarray(jax.device_get(self.state.data))
+        mf_end = NUM_FIXED + self.mf_dim
+        total = 0
+        with self.host_lock:
+            for s in range(self.n):
+                keys, rows = self.indexes[s].items()
+                sub = data[s][rows]
+                # embedx sliced to mf_dim explicitly: field_slice's tail is
+                # unbounded and would leak the opt_ext columns into the
+                # host store's (k, mf_dim) array (EmbeddingTable.
+                # _gather_host does the same)
+                vals = {f: (sub[:, NUM_FIXED:mf_end] if f == "embedx_w"
+                            else field_slice(sub, f)) for f in FIELDS}
+                if self.opt_ext:
+                    vals["opt_ext"] = sub[:, mf_end:]
+                self.hosts[s].update(keys, vals)
+                total += len(keys)
+        self.in_pass = False
+        log.info("end_pass: %d rows written back to %d host stores",
+                 total, self.n)
+        return total
+
+    def _no_pass(self, what: str) -> None:
+        if self.in_pass:
+            raise RuntimeError(
+                f"{what} while a pass is open — the window's updates are "
+                "not in the host stores yet; end_pass first")
+
+    # ---- lifecycle on the FULL (host-tier) model ------------------------
+    def feature_count(self) -> int:
+        return sum(len(h) for h in self.hosts)
+
+    def save_base(self, path: str) -> int:
+        """Full model dump, single file, ShardedEmbeddingTable._dump
+        format (n + keys_s/field_s blocks, + opt_ext_s) — includes
+        disk-spilled rows (SaveBase, box_wrapper.cc:1383)."""
+        self._no_pass("save_base")
+        blobs: Dict[str, np.ndarray] = {}
+        total = 0
+        for s, hs in enumerate(self.hosts):
+            keys, fields = hs.export_rows()
+            blobs[f"keys_{s}"] = keys
+            for f, v in fields.items():
+                blobs[f"{f}_{s}"] = v
+            total += len(keys)
+        np.savez_compressed(path, n=self.n, **blobs)
+        log.info("tiered save_base: %d rows -> %s", total, path)
+        return total
+
+    def save_delta(self, path: str) -> int:
+        """Rows written back since the last save ("xbox delta")."""
+        self._no_pass("save_delta")
+        blobs: Dict[str, np.ndarray] = {}
+        total = 0
+        for s, hs in enumerate(self.hosts):
+            keys, fields = hs.export_rows(delta=True)
+            blobs[f"keys_{s}"] = keys
+            for f, v in fields.items():
+                blobs[f"{f}_{s}"] = v
+            total += len(keys)
+        np.savez_compressed(path, n=self.n, **blobs)
+        log.info("tiered save_delta: %d rows -> %s", total, path)
+        return total
+
+    def load(self, path: str, merge: bool = False) -> int:
+        self._no_pass("load")
+        blob = np.load(path)
+        total = 0
+        # shard-splitting shared with the parent (same file formats)
+        for s, (keys, fields) in enumerate(self._file_per_shard(blob)):
+            total += self.hosts[s].import_rows(keys, fields, merge=merge)
+        return total
+
+    def merge_model(self, path: str) -> int:
+        """MergeModel on the full host tier (box_wrapper.h:801-803):
+        shared keys accumulate show/clk/delta_score, keep live weights;
+        unseen keys insert wholesale. merge_models is inherited — the
+        parent loop dispatches back to these overrides."""
+        self._no_pass("merge_model")
+        blob = np.load(path)
+        total = 0
+        for s, (keys, fields) in enumerate(self._file_per_shard(blob)):
+            total += self.hosts[s].merge_model_rows(keys, fields)
+        return total
+
+    def shrink(self, delete_threshold: Optional[float] = None,
+               decay: Optional[float] = None) -> int:
+        """ShrinkTable over every shard's host store (box_wrapper.h:638)."""
+        self._no_pass("shrink")
+        return sum(h.shrink(delete_threshold=delete_threshold, decay=decay,
+                            nonclk_coeff=self.cfg.nonclk_coeff,
+                            clk_coeff=self.cfg.clk_coeff)
+                   for h in self.hosts)
+
+    def spill_cold(self, path_prefix: str, threshold: float) -> int:
+        """Move cold rows of every shard to disk-tier files
+        ``{path_prefix}.s{K}.npz`` (the host-RAM ↔ SSD boundary)."""
+        self._no_pass("spill_cold")
+        return sum(h.spill_cold(f"{path_prefix}.s{s}.npz", threshold,
+                                nonclk_coeff=self.cfg.nonclk_coeff,
+                                clk_coeff=self.cfg.clk_coeff)
+                   for s, h in enumerate(self.hosts))

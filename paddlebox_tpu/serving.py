@@ -31,7 +31,8 @@ from paddlebox_tpu.data.batch import SlotBatch
 from paddlebox_tpu.data.schema import DataFeedDesc
 from paddlebox_tpu.ops import fused_seqpool_cvm
 from paddlebox_tpu.ps.sgd import SparseSGDConfig
-from paddlebox_tpu.ps.table import EmbeddingTable
+from paddlebox_tpu.ps.table import (EmbeddingTable, expand_pull,
+                                    gather_full_rows, pull_values)
 from paddlebox_tpu.train.step import (DeviceBatch, make_device_batch,
                                       unpack_floats)
 from paddlebox_tpu.utils.logging import get_logger
@@ -119,6 +120,100 @@ class ServingModel:
         idx = self.table.prepare_eval(batch)
         dev = make_device_batch(batch, idx)
         pred, ins_w = self._fwd(self.table.state, self.params, dev)
+        if return_valid:
+            return np.asarray(pred), np.asarray(ins_w)
+        return np.asarray(pred)
+
+
+class MultiMfServingModel:
+    """Read-only base+delta consumer for MULTI-MF saves (per-slot
+    embedding dims, feature_value.h:42-185): loads the per-dim-class
+    artifacts written by ``MultiMfEmbeddingTable.save_base/save_delta``
+    (``{path}.mf{D}.npz``), answers per-slot-width lookups and full CTR
+    predictions through the canonical slot-ordered pooled concat — the
+    same forward as ``MultiMfTrainStep``."""
+
+    def __init__(self, model, desc: DataFeedDesc, slot_mf_dims,
+                 capacity: int = 1 << 20, use_cvm: bool = True,
+                 cvm_offset: int = 2) -> None:
+        from paddlebox_tpu.ps.multi_mf import MultiMfEmbeddingTable
+        self.model = model
+        self.desc = desc
+        self.use_cvm = use_cvm
+        self.cvm_offset = cvm_offset
+        self.table = MultiMfEmbeddingTable(
+            slot_mf_dims, capacity=capacity, cfg=SparseSGDConfig())
+        self.params = None
+        t = self.table
+        route = tuple((int(t.class_of_slot[s]), int(t.slot_rank[s]))
+                      for s in range(t.num_slots))
+        class_slots = tuple(len(s) for s in t.class_slots)
+        mf_dims = tuple(t.dims)
+
+        @jax.jit
+        def _fwd(table_states, params, devs):
+            # per-class pull → seqpool over the class's slots →
+            # canonical slot-order concat — MultiMfTrainStep._pooled's
+            # forward, compiled once per batch bucket
+            d0 = devs[0]
+            show_clk = jnp.stack([d0.show, d0.clk], axis=1)
+            parts = []
+            for c, (st, dev) in enumerate(zip(table_states, devs)):
+                vals_u = pull_values(
+                    gather_full_rows(st, dev.unique_rows), mf_dims[c])
+                values_k = expand_pull(vals_u, dev.gather_idx)
+                parts.append(fused_seqpool_cvm(
+                    values_k, dev.segments, show_clk,
+                    d0.label.shape[0], class_slots[c],
+                    self.use_cvm, self.cvm_offset))
+            flat = jnp.concatenate(
+                [parts[c][:, r, :] for c, r in route], axis=1)
+            logits = self.model.apply(params, flat, d0.dense)
+            return (jax.nn.sigmoid(logits),
+                    (d0.show > 0).astype(jnp.float32))
+
+        self._fwd = _fwd
+
+    # ---- artifact loading (multi-mf save format) ----
+    def load_base(self, path: str) -> int:
+        """Load a MultiMfEmbeddingTable.save_base artifact set."""
+        n = self.table.load(path, merge=False)
+        log.info("serving: loaded multi-mf base %s (%d rows)", path, n)
+        return n
+
+    def apply_delta(self, path: str) -> int:
+        n = self.table.load(path, merge=True)
+        log.info("serving: applied multi-mf delta %s (%d rows)", path, n)
+        return n
+
+    load_dense = ServingModel.load_dense
+
+    # ---- queries ----
+    def embed_lookup(self, keys: np.ndarray,
+                     slots: np.ndarray) -> np.ndarray:
+        """[n] keys + their slot ids → [n, 3 + max_mf] pull values with
+        PER-SLOT widths (columns beyond the key's slot width are zero) —
+        the dy_mf CopyForPull contract. Unknown keys read zeros."""
+        return self.table.pull(keys, slots)
+
+    def slot_width(self, slot: int) -> int:
+        """Embedding width (3 + mf_dim) served for a slot."""
+        return 3 + int(self.table.slot_mf_dims[slot])
+
+    def predict(self, batch: SlotBatch, return_valid: bool = False):
+        """CTR predictions via the jitted multi-mf forward (eval
+        semantics: unknown keys zeros, nothing trains)."""
+        if self.params is None:
+            raise RuntimeError("load_dense first")
+        subs, _ = self.table.split_batch(batch)
+        devs = []
+        for sub, t in zip(subs, self.table.tables):
+            idx = t.prepare_eval(sub)
+            devs.append(make_device_batch(
+                sub, idx, floats=devs[0].floats if devs else None))
+        pred, ins_w = self._fwd(
+            tuple(t.state for t in self.table.tables),
+            self.params, tuple(devs))
         if return_valid:
             return np.asarray(pred), np.asarray(ins_w)
         return np.asarray(pred)

@@ -575,6 +575,12 @@ class ShardedTrainer:
     def sync_table(self) -> None:
         self.table.state = self.state.table
 
+    def adopt_table(self) -> None:
+        """Point the jit state at the table's (re)built device state —
+        called after a tiered table's begin_pass promotes a new pass
+        window into the HBM shards."""
+        self.state = self.state._replace(table=self.table.state)
+
     def restore_state(self, params, opt_state, auc, step: int) -> None:
         self.state = ShardedStepState(
             table=self.table.state, params=params, opt_state=opt_state,

@@ -100,6 +100,61 @@ def test_multi_mf_pull_per_slot_widths(criteo_files):
     assert (vals[:, 0] > 0).all()
 
 
+def test_multi_mf_serving_consumes_save(criteo_files, tmp_path):
+    """MultiMfServingModel loads the multi-mf save format, serves
+    per-slot-width lookups identical to the live table, and predicts."""
+    import pickle
+    from paddlebox_tpu.serving import MultiMfServingModel
+    tr, ds = _make(criteo_files)
+    for _ in range(4):
+        tr.train_pass(ds)
+    base = str(tmp_path / "srv_base")
+    n = tr.table.save_base(base)
+    dense = str(tmp_path / "dense.pkl")
+    with open(dense, "wb") as fh:
+        pickle.dump(jax.device_get(tr.state.params), fh)
+
+    srv = MultiMfServingModel(CtrDnn(hidden=(16, 8)), tr.desc, _dims(),
+                              capacity=1 << 12)
+    assert srv.load_base(base) == n
+    srv.load_dense(dense)
+
+    col = ds.columnar
+    keys = col.keys[:80].astype(np.uint64)
+    slots = col.key_slot[:80]
+    vals = srv.embed_lookup(keys, slots)
+    np.testing.assert_allclose(vals, tr.table.pull(keys, slots),
+                               rtol=1e-6, atol=1e-8)
+    dims = np.asarray(_dims())
+    for i in range(80):
+        np.testing.assert_allclose(vals[i, 3 + dims[slots[i]]:], 0.0)
+    assert srv.slot_width(0) == 3 + 2 and srv.slot_width(25) == 3 + 8
+
+    # predictions: finite, batch-shaped, and predictive on trained data
+    from paddlebox_tpu.metrics import init_auc_state, auc_add_batch, \
+        auc_compute
+    import jax.numpy as jnp
+    auc = init_auc_state(4096)
+    for i, batch in enumerate(ds.batches()):
+        preds, valid = srv.predict(batch, return_valid=True)
+        assert np.isfinite(preds).all()
+        auc = auc_add_batch(auc, jnp.asarray(preds),
+                            jnp.asarray(batch.label), jnp.asarray(valid))
+        if i >= 5:
+            break
+    assert auc_compute(auc).auc > 0.55  # the loaded model predicts
+
+    # delta application keeps serving in sync with further training
+    tr.train_pass(ds)
+    delta = str(tmp_path / "srv_delta")
+    nd = tr.table.save_delta(delta)
+    assert nd > 0
+    assert srv.apply_delta(delta) == nd
+    np.testing.assert_allclose(
+        srv.embed_lookup(keys, slots), tr.table.pull(keys, slots),
+        rtol=1e-6, atol=1e-8)
+
+
 def test_multi_mf_save_load_roundtrip(criteo_files, tmp_path):
     tr, ds = _make(criteo_files)
     tr.train_pass(ds)

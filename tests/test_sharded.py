@@ -166,6 +166,136 @@ def test_sharded_save_load_roundtrip(mesh, tmp_path):
             keys.astype(np.float32) * 2)
 
 
+def test_sharded_shrink_ages_features(mesh):
+    """ShrinkTable on the stacked shards: decay + threshold drop, same
+    accessor rules as EmbeddingTable.shrink (box_wrapper.h:638)."""
+    from paddlebox_tpu.ps.table import FIELD_COL
+    cfg = SparseSGDConfig(mf_create_thresholds=1e9)
+    table = ShardedEmbeddingTable(N, mf_dim=2, capacity_per_shard=64,
+                                  cfg=cfg, req_bucket_min=8,
+                                  serve_bucket_min=8)
+    batches = make_batches(N, seed=31)
+    table.prepare_global(batches)
+    before = table.feature_count()
+    assert before > 0
+    # plant heat on HALF the keys of shard 0; rest stay cold (show=0)
+    data = np.asarray(jax.device_get(table.state.data)).copy()
+    hot_per_shard = {}
+    for s in range(N):
+        keys, rows = table.indexes[s].items()
+        half = rows[: len(rows) // 2]
+        data[s][half, FIELD_COL["show"]] = 10.0
+        data[s][half, FIELD_COL["clk"]] = 5.0
+        hot_per_shard[s] = keys[: len(rows) // 2]
+    table.state = type(table.state).from_logical(data, table.capacity)
+    freed = table.shrink(delete_threshold=0.5, decay=0.9)
+    assert freed == before - sum(len(v) for v in hot_per_shard.values())
+    for s in range(N):
+        keys, rows = table.indexes[s].items()
+        assert set(keys.tolist()) == set(hot_per_shard[s].tolist())
+        # decay applied to survivors
+        np.testing.assert_allclose(
+            np.asarray(table.state.data)[s][rows, FIELD_COL["show"]], 9.0)
+
+
+def test_sharded_merge_model_and_merge_models(mesh, tmp_path):
+    """merge_model accumulates stats for shared keys / inserts new ones;
+    merge_models folds multiple files; single-table-format files split by
+    key%N (box_wrapper.h:801-815)."""
+    from paddlebox_tpu.ps.table import FIELD_COL
+    cfg = SparseSGDConfig(mf_create_thresholds=1e9)
+
+    def seeded_table(keys, w):
+        t = ShardedEmbeddingTable(N, mf_dim=2, capacity_per_shard=64,
+                                  cfg=cfg, req_bucket_min=8,
+                                  serve_bucket_min=8)
+        data = np.asarray(jax.device_get(t.state.data)).copy()
+        owners = (keys % np.uint64(N)).astype(np.int64)
+        for s in range(N):
+            ks = keys[owners == s]
+            rows = t.indexes[s].assign(ks)
+            data[s][rows, FIELD_COL["embed_w"]] = w
+            data[s][rows, FIELD_COL["show"]] = 3.0
+            data[s][rows, FIELD_COL["clk"]] = 1.0
+        t.state = type(t.state).from_logical(data, t.capacity)
+        return t
+
+    live = seeded_table(np.arange(1, 33, dtype=np.uint64), 1.0)
+    other = seeded_table(np.arange(17, 49, dtype=np.uint64), -5.0)
+    p1 = str(tmp_path / "other.npz")
+    other.save_base(p1)
+
+    assert live.merge_model(p1) == 32
+    assert live.feature_count() == 48
+    data = np.asarray(jax.device_get(live.state.data))
+    # shared key 17: stats accumulate, live weight kept
+    s17 = 17 % N
+    r = live.indexes[s17].lookup(np.array([17], np.uint64))[0]
+    assert data[s17][r, FIELD_COL["show"]] == 6.0
+    assert data[s17][r, FIELD_COL["embed_w"]] == 1.0
+    # new key 48: inserted wholesale
+    s48 = 48 % N
+    r = live.indexes[s48].lookup(np.array([48], np.uint64))[0]
+    assert data[s48][r, FIELD_COL["embed_w"]] == -5.0
+
+    # merge_models overwrite mode: later file wins on shared keys
+    live2 = seeded_table(np.arange(1, 33, dtype=np.uint64), 1.0)
+    assert live2.merge_models([p1], update_type="overwrite") == 32
+    data2 = np.asarray(jax.device_get(live2.state.data))
+    r = live2.indexes[s17].lookup(np.array([17], np.uint64))[0]
+    assert data2[s17][r, FIELD_COL["embed_w"]] == -5.0
+
+    # single-table-format file (no "n" block) splits by key%N
+    st_keys = np.arange(100, 110, dtype=np.uint64)
+    np.savez(str(tmp_path / "single.npz"), keys=st_keys,
+             show=np.ones(10, np.float32), clk=np.zeros(10, np.float32),
+             delta_score=np.zeros(10, np.float32),
+             slot=np.zeros(10, np.float32),
+             embed_w=np.full(10, 9.0, np.float32),
+             embed_g2sum=np.zeros(10, np.float32),
+             embedx_w=np.zeros((10, 2), np.float32),
+             embedx_g2sum=np.zeros(10, np.float32),
+             mf_size=np.zeros(10, np.float32))
+    assert live.merge_model(str(tmp_path / "single.npz")) == 10
+    s100 = 100 % N
+    r = live.indexes[s100].lookup(np.array([100], np.uint64))[0]
+    assert np.asarray(jax.device_get(
+        live.state.data))[s100][r, FIELD_COL["embed_w"]] == 9.0
+
+
+def test_sharded_opt_ext_survives_save_load(mesh, tmp_path):
+    """SparseAdam per-row state (opt_ext block) persists through sharded
+    save_base/load — the optimizer resumes, not restarts."""
+    from paddlebox_tpu.ps.sgd import SparseAdamConfig
+    cfg = SparseAdamConfig(mf_create_thresholds=1e9)
+    table = ShardedEmbeddingTable(N, mf_dim=2, capacity_per_shard=64,
+                                  cfg=cfg, req_bucket_min=8,
+                                  serve_bucket_min=8)
+    assert table.opt_ext > 0
+    batches = make_batches(N, seed=41)
+    table.prepare_global(batches)
+    from paddlebox_tpu.ps.table import NUM_FIXED
+    mf_end = NUM_FIXED + table.mf_dim
+    data = np.asarray(jax.device_get(table.state.data)).copy()
+    for s in range(N):
+        _, rows = table.indexes[s].items()
+        data[s][rows, mf_end:] = 0.25 * (s + 1)
+    table.state = type(table.state).from_logical(data, table.capacity,
+                                                 ext=table.opt_ext)
+    path = str(tmp_path / "adam.npz")
+    n = table.save_base(path)
+    t2 = ShardedEmbeddingTable(N, mf_dim=2, capacity_per_shard=64,
+                               cfg=cfg, req_bucket_min=8,
+                               serve_bucket_min=8)
+    assert t2.load(path) == n
+    d2 = np.asarray(jax.device_get(t2.state.data))
+    for s in range(N):
+        _, rows = t2.indexes[s].items()
+        if len(rows):
+            np.testing.assert_allclose(d2[s][rows, mf_end:],
+                                       0.25 * (s + 1))
+
+
 def test_sharded_save_delta_and_reset_load(mesh, tmp_path):
     """load(merge=False) must reset device rows not covered by the dump;
     save_delta only dumps touched-since-last-save rows."""

@@ -1,0 +1,367 @@
+"""Tiered sharded PS (ps/tiered.py): HostStore-backed pass windows per
+HBM shard on the 8-device CPU mesh — capacity beyond HBM composed with
+the mesh trainer (BuildPull/BuildGPUTask/EndPass, ps_gpu_wrapper.cc:337,
+684,983; LoadSSD2Mem, box_wrapper.cc:1415)."""
+
+import numpy as np
+import jax
+import optax
+import pytest
+
+from paddlebox_tpu.config import flags_scope
+from paddlebox_tpu.data import DataFeedDesc, DatasetFactory
+from paddlebox_tpu.data.criteo import generate_criteo_files
+from paddlebox_tpu.models import DeepFM
+from paddlebox_tpu.parallel import make_mesh
+from paddlebox_tpu.ps import (BoxPSHelper, SparseSGDConfig,
+                              TieredShardedEmbeddingTable)
+from paddlebox_tpu.ps.sharded import ShardedEmbeddingTable
+from paddlebox_tpu.train.sharded import ShardedTrainer
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= N
+    return make_mesh(N)
+
+
+def _cfg(**kw):
+    kw.setdefault("mf_create_thresholds", 0.0)
+    kw.setdefault("mf_initial_range", 0.0)
+    kw.setdefault("learning_rate", 0.1)
+    kw.setdefault("mf_learning_rate", 0.1)
+    return SparseSGDConfig(**kw)
+
+
+def _make_ds(tmp_path, seed, vocab=40, rows=1200, name="p"):
+    files = generate_criteo_files(str(tmp_path / f"{name}{seed}"),
+                                  num_files=2, rows_per_file=rows,
+                                  vocab_per_slot=vocab, seed=seed)
+    desc = DataFeedDesc.criteo(batch_size=32)
+    desc.key_bucket_min = 1024
+    ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    return ds, desc
+
+
+def _write_offset_pass(tmp_path, pass_id, vocab=60, rows=800):
+    """Criteo-format files whose categorical values live in a PER-PASS
+    disjoint range [pass_id*vocab, (pass_id+1)*vocab) — models day-k data
+    with fresh features, so pass windows are disjoint key sets."""
+    import os
+    rng = np.random.default_rng(100 + pass_id)
+    d = tmp_path / f"off{pass_id}"
+    os.makedirs(str(d), exist_ok=True)
+    path = str(d / "part.txt")
+    base = pass_id * vocab
+    with open(path, "w") as fh:
+        for _ in range(rows):
+            dense = rng.integers(0, 100, size=13)
+            cats = base + rng.integers(0, vocab, size=26)
+            label = int(rng.random() < 0.5)
+            dense_s = "\t".join(str(int(v)) for v in dense)
+            cat_s = "\t".join(format(int(c), "x") for c in cats)
+            fh.write(f"{label}\t{dense_s}\t{cat_s}\n")
+    desc = DataFeedDesc.criteo(batch_size=32)
+    desc.key_bucket_min = 1024
+    ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+    ds.set_filelist([path])
+    ds.load_into_memory()
+    return ds, desc
+
+
+def test_tiered_window_smaller_than_model(mesh, tmp_path):
+    """Train 3 passes over DIFFERENT datasets with capacity_per_shard far
+    below the total feature count: each pass window fits, the union does
+    not — the host tier must carry the full model across windows."""
+    built = [_write_offset_pass(tmp_path, p) for p in range(3)]
+    datasets = [b[0] for b in built]
+    desc = built[0][1]
+    # each pass touches ≤ 26*60 = 1560 uniques (≈195/shard);
+    # capacity_per_shard=256 cannot hold the 3-pass union (disjoint
+    # per-pass value ranges)
+    table = TieredShardedEmbeddingTable(
+        N, mf_dim=4, capacity_per_shard=256, cfg=_cfg(),
+        req_bucket_min=256, serve_bucket_min=256)
+    with flags_scope(log_period_steps=10000):
+        tr = ShardedTrainer(DeepFM(hidden=(16, 16)), table, desc, mesh,
+                            tx=optax.adam(2e-3))
+    helper = BoxPSHelper(table, trainer=tr)
+    for ds in datasets:
+        helper.begin_pass(ds)
+        tr.train_pass(ds)
+        helper.end_pass(ds)
+    total = table.feature_count()
+    assert total > N * table.capacity, (
+        f"host tier must exceed HBM window: {total} <= {N * table.capacity}")
+    # a pass window only ever held its own working set
+    for s in range(N):
+        assert len(table.indexes[s]) <= table.capacity
+
+
+def test_tiered_matches_untired_sharded(mesh, tmp_path):
+    """Tiering must be TRANSPARENT: when everything happens to fit, a
+    tiered table trained over 2 pass windows equals a plain
+    ShardedEmbeddingTable trained straight through — same AUC, same dense
+    params, same per-key embeddings."""
+    ds, desc = _make_ds(tmp_path, 13)
+
+    with flags_scope(log_period_steps=10000):
+        plain = ShardedEmbeddingTable(N, mf_dim=4, capacity_per_shard=4096,
+                                      cfg=_cfg(), req_bucket_min=256,
+                                      serve_bucket_min=256)
+        tr_a = ShardedTrainer(DeepFM(hidden=(32, 32)), plain, desc, mesh,
+                              tx=optax.adam(2e-3))
+        tiered = TieredShardedEmbeddingTable(
+            N, mf_dim=4, capacity_per_shard=4096, cfg=_cfg(),
+            req_bucket_min=256, serve_bucket_min=256)
+        tr_b = ShardedTrainer(DeepFM(hidden=(32, 32)), tiered, desc, mesh,
+                              tx=optax.adam(2e-3))
+    helper = BoxPSHelper(tiered, trainer=tr_b)
+    ra = rb = None
+    for _ in range(2):
+        ra = tr_a.train_pass(ds)
+        helper.begin_pass(ds)
+        rb = tr_b.train_pass(ds)
+        helper.end_pass(ds)
+    assert rb["ins_num"] == ra["ins_num"]
+    assert np.isclose(rb["auc"], ra["auc"], atol=1e-6), (rb["auc"], ra["auc"])
+    for x, y in zip(jax.tree.leaves(tr_a.state.params),
+                    jax.tree.leaves(tr_b.state.params)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=1e-5, atol=1e-7)
+    # per-key embed_w parity: read via host tier vs plain device rows
+    for s in range(N):
+        keys, rows = plain.indexes[s].items()
+        w_plain = np.asarray(plain.state.embed_w)[s][rows]
+        got = tiered.hosts[s].fetch(keys)["embed_w"]
+        np.testing.assert_allclose(got, w_plain, rtol=1e-5, atol=1e-7)
+
+
+def test_tiered_resident_matches_streaming(mesh, tmp_path):
+    """Resident mesh passes inside tiered windows == streaming passes."""
+    ds, desc = _make_ds(tmp_path, 17)
+
+    def mk():
+        t = TieredShardedEmbeddingTable(
+            N, mf_dim=4, capacity_per_shard=4096, cfg=_cfg(),
+            req_bucket_min=256, serve_bucket_min=256)
+        with flags_scope(log_period_steps=10000):
+            tr = ShardedTrainer(DeepFM(hidden=(32, 32)), t, desc, mesh,
+                                tx=optax.adam(2e-3))
+        return t, tr, BoxPSHelper(t, trainer=tr)
+
+    ta, tr_a, ha = mk()
+    tb, tr_b, hb = mk()
+    ra = rb = None
+    for _ in range(2):
+        ha.begin_pass(ds)
+        ra = tr_a.train_pass(ds)
+        ha.end_pass(ds)
+        hb.begin_pass(ds)
+        rb = tr_b.train_pass_resident(ds)
+        hb.end_pass(ds)
+    assert rb["ins_num"] == ra["ins_num"]
+    assert np.isclose(rb["auc"], ra["auc"], atol=2e-3), (rb["auc"], ra["auc"])
+    for x, y in zip(jax.tree.leaves(tr_a.state.params),
+                    jax.tree.leaves(tr_b.state.params)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=2e-2, atol=2e-3)
+
+
+def test_tiered_save_load_roundtrips_through_tiers(mesh, tmp_path):
+    """save_base after a spill to the disk tier still exports the
+    COMPLETE model; a fresh tiered table restores it and continues."""
+    ds, desc = _make_ds(tmp_path, 23, vocab=30, rows=600)
+    table = TieredShardedEmbeddingTable(
+        N, mf_dim=4, capacity_per_shard=1024, cfg=_cfg(),
+        req_bucket_min=256, serve_bucket_min=256)
+    with flags_scope(log_period_steps=10000):
+        tr = ShardedTrainer(DeepFM(hidden=(16, 16)), table, desc, mesh,
+                            tx=optax.adam(2e-3))
+    helper = BoxPSHelper(table, trainer=tr)
+    helper.begin_pass(ds)
+    tr.train_pass(ds)
+    helper.end_pass(ds)
+    n_feat = table.feature_count()
+
+    delta = str(tmp_path / "delta.npz")
+    nd = table.save_delta(delta)
+    assert nd == n_feat  # everything written back this window
+
+    # spill EVERYTHING cold (threshold high), then save_base: the export
+    # must still carry the full model (spilled rows merge in)
+    spilled = table.spill_cold(str(tmp_path / "spill"), threshold=1e9)
+    assert spilled > 0
+    base = str(tmp_path / "base.npz")
+    assert table.save_base(base) == n_feat
+
+    t2 = TieredShardedEmbeddingTable(
+        N, mf_dim=4, capacity_per_shard=1024, cfg=_cfg(),
+        req_bucket_min=256, serve_bucket_min=256)
+    assert t2.load(base) == n_feat
+    for s in range(N):
+        keys, _ = table.hosts[s].index.items()
+        if len(keys) == 0:
+            continue
+        a = table.hosts[s].fetch(keys)
+        b = t2.hosts[s].fetch(keys)
+        np.testing.assert_allclose(b["embed_w"], a["embed_w"],
+                                   rtol=1e-6, atol=1e-8)
+        np.testing.assert_allclose(b["show"], a["show"], rtol=1e-6)
+    # restored table trains another window
+    with flags_scope(log_period_steps=10000):
+        tr2 = ShardedTrainer(DeepFM(hidden=(16, 16)), t2, desc, mesh,
+                             tx=optax.adam(2e-3))
+    h2 = BoxPSHelper(t2, trainer=tr2)
+    h2.begin_pass(ds)
+    r = tr2.train_pass(ds)
+    h2.end_pass(ds)
+    assert np.isfinite(r["last_loss"])
+
+
+def test_tiered_spilled_rows_promote_on_stage(mesh, tmp_path):
+    """A key whose row lives only in a disk-tier spill file must come
+    back with its trained value when a later pass stages it
+    (LoadSSD2Mem, box_wrapper.cc:1415)."""
+    ds, desc = _make_ds(tmp_path, 29, vocab=20, rows=400)
+    table = TieredShardedEmbeddingTable(
+        N, mf_dim=4, capacity_per_shard=1024, cfg=_cfg(),
+        req_bucket_min=256, serve_bucket_min=256)
+    with flags_scope(log_period_steps=10000):
+        tr = ShardedTrainer(DeepFM(hidden=(16, 16)), table, desc, mesh,
+                            tx=optax.adam(2e-3))
+    helper = BoxPSHelper(table, trainer=tr)
+    helper.begin_pass(ds)
+    tr.train_pass(ds)
+    helper.end_pass(ds)
+    # snapshot one trained key's value, spill everything, re-stage
+    s0 = next(s for s in range(N) if len(table.hosts[s]) > 0)
+    keys0, _ = table.hosts[s0].index.items()
+    probe = keys0[:5]
+    before = table.hosts[s0].fetch(probe)["embed_w"].copy()
+    assert np.any(before != 0)
+    table.save_base(str(tmp_path / "b.npz"))  # spill requires saved rows
+    assert table.spill_cold(str(tmp_path / "sp"), threshold=1e9) > 0
+    assert len(table.hosts[s0]) == 0  # gone from RAM
+    helper.begin_pass(ds)  # stage promotes from the disk tier
+    rows = table.indexes[s0].lookup(probe)
+    assert (rows >= 0).all()
+    w = np.asarray(jax.device_get(table.state.embed_w))[s0][rows]
+    np.testing.assert_allclose(w, before, rtol=1e-6)
+    helper.end_pass(ds)
+
+
+def test_tiered_lifecycle_shrink_and_merge(mesh, tmp_path):
+    """shrink ages the host tier; merge_model folds a single-table-format
+    save (split by key%N) with stat accumulation."""
+    table = TieredShardedEmbeddingTable(
+        N, mf_dim=2, capacity_per_shard=64, cfg=_cfg())
+    # seed host rows directly through a pass-less write-back
+    keys = np.arange(1, 41, dtype=np.uint64)
+    per = table._split_by_owner(keys)
+    for s in range(N):
+        ks = per[s]
+        f = {"show": np.full(len(ks), 4.0, np.float32),
+             "clk": np.full(len(ks), 2.0, np.float32),
+             "delta_score": np.zeros(len(ks), np.float32),
+             "slot": np.zeros(len(ks), np.float32),
+             "embed_w": ks.astype(np.float32),
+             "embed_g2sum": np.zeros(len(ks), np.float32),
+             "embedx_w": np.zeros((len(ks), 2), np.float32),
+             "embedx_g2sum": np.zeros(len(ks), np.float32),
+             "mf_size": np.zeros(len(ks), np.float32)}
+        table.hosts[s].update(ks, f)
+    assert table.feature_count() == 40
+
+    # merge a single-table-format file: 20 overlapping keys (stats
+    # accumulate, embed_w keeps live), 10 new (insert wholesale)
+    mkeys = np.arange(21, 51, dtype=np.uint64)
+    np.savez(str(tmp_path / "m.npz"), keys=mkeys,
+             show=np.full(30, 10.0, np.float32),
+             clk=np.full(30, 5.0, np.float32),
+             delta_score=np.zeros(30, np.float32),
+             slot=np.zeros(30, np.float32),
+             embed_w=np.full(30, -7.0, np.float32),
+             embed_g2sum=np.zeros(30, np.float32),
+             embedx_w=np.zeros((30, 2), np.float32),
+             embedx_g2sum=np.zeros(30, np.float32),
+             mf_size=np.zeros(30, np.float32))
+    assert table.merge_model(str(tmp_path / "m.npz")) == 30
+    assert table.feature_count() == 50
+    s21 = int(21) % N
+    got = table.hosts[s21].fetch(np.array([21], np.uint64))
+    assert got["show"][0] == 14.0          # 4 + 10 accumulated
+    assert got["embed_w"][0] == 21.0       # live weight kept
+    s50 = int(50) % N
+    got = table.hosts[s50].fetch(np.array([50], np.uint64))
+    assert got["embed_w"][0] == -7.0       # new key inserted wholesale
+
+    # shrink: decay 0.5 → score of old-only keys (show 4→2) drops below
+    # threshold while merged keys survive
+    freed = table.shrink(delete_threshold=3.0, decay=0.5)
+    assert freed > 0
+    assert table.feature_count() < 50
+    assert table.hosts[s21].index.lookup(
+        np.array([21], np.uint64))[0] >= 0  # hot key survives
+
+
+def test_tiered_adam_opt_ext_roundtrips(mesh):
+    """SparseAdam per-row state (opt_ext block) survives the pass window:
+    begin_pass → device mutation → end_pass → host store → next window
+    (the reviewer-found embedx/opt_ext slicing hazard)."""
+    from paddlebox_tpu.ps.sgd import SparseAdamConfig
+    from paddlebox_tpu.ps.table import NUM_FIXED
+    cfg = SparseAdamConfig(mf_create_thresholds=0.0, mf_initial_range=0.0)
+    table = TieredShardedEmbeddingTable(N, mf_dim=2, capacity_per_shard=32,
+                                        cfg=cfg)
+    assert table.opt_ext > 0
+    keys = np.arange(1, 25, dtype=np.uint64)
+    table.begin_pass(keys)
+    # simulate a jit update: plant distinct embedx and opt_ext values
+    mf_end = NUM_FIXED + table.mf_dim
+    data = np.asarray(jax.device_get(table.state.data)).copy()
+    for s in range(N):
+        _, rows = table.indexes[s].items()
+        data[s][rows, NUM_FIXED:mf_end] = 2.0
+        data[s][rows, mf_end:] = 0.5
+    table.state = type(table.state).from_logical(data, table.capacity,
+                                                 ext=table.opt_ext)
+    table.end_pass()
+    # embedx stayed mf_dim-wide and opt_ext persisted separately
+    for s in range(N):
+        ks, _ = table.hosts[s].index.items()
+        if not len(ks):
+            continue
+        got = table.hosts[s].fetch(ks)
+        assert got["embedx_w"].shape[1] == 2
+        np.testing.assert_allclose(got["embedx_w"], 2.0)
+        np.testing.assert_allclose(got["opt_ext"], 0.5)
+    # next window sees both back
+    table.begin_pass(keys)
+    d2 = np.asarray(jax.device_get(table.state.data))
+    for s in range(N):
+        _, rows = table.indexes[s].items()
+        np.testing.assert_allclose(d2[s][rows, NUM_FIXED:mf_end], 2.0)
+        np.testing.assert_allclose(d2[s][rows, mf_end:], 0.5)
+    table.end_pass()
+
+
+def test_tiered_guards(mesh):
+    table = TieredShardedEmbeddingTable(N, mf_dim=2, capacity_per_shard=16)
+    with pytest.raises(RuntimeError):
+        table.end_pass()
+    table.begin_pass(np.arange(8, dtype=np.uint64))
+    with pytest.raises(RuntimeError):
+        table.begin_pass(np.arange(8, dtype=np.uint64))
+    with pytest.raises(RuntimeError):
+        table.save_base("/tmp/never.npz")
+    with pytest.raises(RuntimeError):
+        table.stage(np.arange(8, dtype=np.uint64))
+    table.end_pass()
+    # per-shard capacity guard
+    with pytest.raises(ValueError):
+        table.stage(np.arange(N * 64, dtype=np.uint64), background=False)
