@@ -24,22 +24,61 @@ import numpy as np
 
 
 def build_records(num_records: int, num_slots: int = 26,
-                  vocab_per_slot: int = 100_000, seed: int = 0):
-    """Synthetic criteo-shaped records, built columnar-fast."""
+                  vocab_per_slot: int = 100_000, seed: int = 0,
+                  avg_keys_per_slot: float = 1.0):
+    """Synthetic criteo-shaped records, built columnar-fast.
+
+    ``avg_keys_per_slot > 1`` produces RAGGED slots: per-(record, slot)
+    key counts ~ 1 + Poisson(avg-1) — variable-length multi-key slots,
+    the real PaddleBox feed-log shape (data_feed.h:2066-2287) that
+    stresses the segment stream and the non-trivial seqpool path."""
     from paddlebox_tpu.data.record import SlotRecord
     rng = np.random.default_rng(seed)
-    keys_all = rng.integers(0, vocab_per_slot, size=(num_records, num_slots))
-    keys_all = (keys_all + np.arange(num_slots) * vocab_per_slot).astype(np.uint64)
     dense_all = rng.normal(size=(num_records, 13)).astype(np.float32)
     labels = (rng.random(num_records) < 0.25).astype(np.float32)
-    offsets = np.arange(num_slots + 1, dtype=np.int32)
-    recs = [
-        SlotRecord(keys=keys_all[i], slot_offsets=offsets,
-                   dense=dense_all[i], label=float(labels[i]), show=1.0,
-                   clk=float(labels[i]))
+    slot_base = (np.arange(num_slots) * vocab_per_slot).astype(np.uint64)
+    if avg_keys_per_slot <= 1.0:
+        keys_all = rng.integers(0, vocab_per_slot,
+                                size=(num_records, num_slots))
+        keys_all = (keys_all + slot_base).astype(np.uint64)
+        offsets = np.arange(num_slots + 1, dtype=np.int32)
+        return [
+            SlotRecord(keys=keys_all[i], slot_offsets=offsets,
+                       dense=dense_all[i], label=float(labels[i]),
+                       show=1.0, clk=float(labels[i]))
+            for i in range(num_records)
+        ]
+    counts = 1 + rng.poisson(avg_keys_per_slot - 1.0,
+                             size=(num_records, num_slots))
+    offs = np.zeros((num_records, num_slots + 1), np.int32)
+    np.cumsum(counts, axis=1, out=offs[:, 1:])
+    total = offs[:, -1]
+    flat = rng.integers(0, vocab_per_slot, size=int(total.sum()))
+    flat_base = np.repeat(
+        np.tile(slot_base, num_records),
+        counts.reshape(-1))
+    flat = (flat + flat_base).astype(np.uint64)
+    starts = np.concatenate([[0], np.cumsum(total)[:-1]])
+    return [
+        SlotRecord(keys=flat[starts[i]:starts[i] + total[i]],
+                   slot_offsets=offs[i],
+                   dense=dense_all[i], label=float(labels[i]),
+                   show=1.0, clk=float(labels[i]))
         for i in range(num_records)
     ]
-    return recs
+
+
+def dense_flops_per_example(params) -> float:
+    """Analytic train-step FLOPs/example of the DENSE net: 2·in·out per
+    matmul kernel forward, ×3 for fwd+bwd (the embedding path is
+    bandwidth-bound — gathers/scatters, ~0 FLOPs). Used for the MFU
+    line; the denominator is the chip's matmul peak."""
+    import jax
+    f = 0.0
+    for leaf in jax.tree.leaves(params):
+        if getattr(leaf, "ndim", 0) >= 2:
+            f += 2.0 * float(np.prod(leaf.shape))
+    return 3.0 * f
 
 
 def main() -> None:
@@ -50,28 +89,47 @@ def main() -> None:
     from paddlebox_tpu.ps import EmbeddingTable, SparseSGDConfig
     from paddlebox_tpu.train import PassPreloader, Trainer
 
-    bs = int(os.environ.get("BENCH_BATCH_SIZE", 8192))
-    num_records = int(os.environ.get("BENCH_RECORDS", 262_144))
+    # workload shape (BASELINE.json ladder): "uniform" = 26 slots, one
+    # key each (rung 2 steady state); "ragged" = 26 slots, avg 5
+    # variable keys/slot (the feed-log shape, data_feed.h:2066-2287);
+    # "thousand" = 1000+ sparse slots, one key each (rung 4)
+    shape = os.environ.get("BENCH_SHAPE", "uniform")
+    shape_slots = {"uniform": 26, "ragged": 26, "thousand": 1000}[shape]
+    shape_avg = {"uniform": 1.0, "ragged": 5.0, "thousand": 1.0}[shape]
+    bs_default = {"uniform": 8192, "ragged": 4096, "thousand": 512}[shape]
+    rec_default = {"uniform": 262_144, "ragged": 131_072,
+                   "thousand": 32_768}[shape]
+    bs = int(os.environ.get("BENCH_BATCH_SIZE", bs_default))
+    num_records = int(os.environ.get("BENCH_RECORDS", rec_default))
     mf_dim = int(os.environ.get("BENCH_MF_DIM", 8))
-    num_passes = int(os.environ.get("BENCH_PASSES", 3))
+    num_passes = int(os.environ.get("BENCH_PASSES", 5))
     mode = os.environ.get("BENCH_MODE", "resident")
     FLAGS.log_period_steps = 10 ** 9
+    # the exact f64 host AUC finalize pulls the [2, 1e6] bucket tables
+    # over the tunnel per pass; the bench opts into the device reduce
+    # (documented tunnel optimization, ~1e-5 f32 drift)
+    FLAGS.auc_device_reduce = True
 
     slots = [SlotDef("label", "float", 1), SlotDef("dense", "float", 13)]
-    slots += [SlotDef(f"C{i}", "uint64") for i in range(1, 27)]
-    # one key per slot → exact key bucket (bs*26): zero padding waste and
-    # a single compile variant
+    slots += [SlotDef(f"C{i}", "uint64") for i in range(1, shape_slots + 1)]
+    # uniform: one key per slot → exact key bucket (bs*S), zero padding
+    # waste and a single compile variant; ragged: bucket rides the max
     desc = DataFeedDesc(slots=slots, batch_size=bs, label_slot="label",
-                        key_bucket_min=bs * 26)
+                        key_bucket_min=(bs * shape_slots
+                                        if shape_avg <= 1.0 else 4096))
 
     def make_ds(seed: int) -> InMemoryDataset:
         d = InMemoryDataset(desc)
-        d.records = build_records(num_records, seed=seed)
+        d.records = build_records(num_records, num_slots=shape_slots,
+                                  seed=seed,
+                                  avg_keys_per_slot=shape_avg)
         d.columnarize()
         return d
 
     cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=1e-3)
     metric = "deepfm_ctr_examples_per_sec_per_chip"
+    if shape != "uniform":
+        metric += f"_{shape}"
     chips = 1
 
     if mode == "sharded":
@@ -110,15 +168,20 @@ def main() -> None:
         arena = int(os.environ.get("BENCH_ARENA", "1"))
         table = EmbeddingTable(mf_dim=mf_dim, capacity=1 << 23, cfg=cfg,
                                unique_bucket_min=1 << 12,
-                               arena_slots=26 if arena else None)
+                               arena_slots=shape_slots if arena else None)
         tr = Trainer(DeepFM(hidden=(512, 256, 128)), table, desc,
                      tx=optax.adam(1e-3), prefetch=8)
         build_fn = None
 
+    extras = {"mode": mode, "shape": shape, "batch_size": bs,
+              "records_per_pass": num_records, "num_slots": shape_slots,
+              "avg_keys_per_slot": shape_avg}
     if mode == "streaming":
         ds = make_ds(0)
         warm = InMemoryDataset(desc)
-        warm.records = build_records(bs * 3, seed=99)
+        warm.records = build_records(bs * 3, num_slots=shape_slots,
+                                     seed=99,
+                                     avg_keys_per_slot=shape_avg)
         warm.columnarize()
         tr.train_pass(warm)
         res = tr.train_pass(ds)
@@ -152,7 +215,7 @@ def main() -> None:
         # per-pass wall includes that pass's preload wait; the
         # steady-state estimate below drops the single worst pass and
         # uses total records / total remaining wall
-        per_pass = []
+        walls_l, waits_l, trains_l, rates_l, wire_l = [], [], [], [], []
         debug = os.environ.get("BENCH_DEBUG", "0") == "1"
         no_overlap = os.environ.get("BENCH_NO_OVERLAP", "0") == "1"
         for _ in range(num_passes):
@@ -166,23 +229,56 @@ def main() -> None:
             t_train = time.perf_counter() - t1
             if no_overlap:
                 pre.start_next()
+            wall = time.perf_counter() - t0
             if debug:
                 print(f"pass: wait={t_wait:.3f}s train={t_train:.3f}s",
                       file=sys.stderr)
-            per_pass.append(rp.num_records / (time.perf_counter() - t0))
+            walls_l.append(wall)
+            waits_l.append(t_wait)
+            trains_l.append(t_train)
+            rates_l.append(rp.num_records / wall)
+            if hasattr(rp, "nbytes"):
+                wire_l.append(rp.nbytes())
         # steady-state estimate: drop the single worst pass (one-off
         # tunnel stalls are environment noise), then TOTAL-based rate —
         # a plain median can overstate when pass walls alternate
-        walls = sorted(num_records / r for r in per_pass)
+        walls = sorted(walls_l)
         if len(walls) > 1:
             walls = walls[:-1]
         value = num_records * len(walls) / sum(walls) / chips
+        # evidence block: per-pass arrays + duty cycle + wire + MFU
+        # (PrintSyncTimer per-stage reporting, box_wrapper.cc:1182)
+        params = (tr.state.params if hasattr(tr.state, "params")
+                  else None)
+        fpe = dense_flops_per_example(params) if params is not None else 0
+        peak = float(os.environ.get("BENCH_PEAK_TFLOPS", "459")) * 1e12
+        extras.update(
+            passes=num_passes,
+            per_pass_wall_sec=[round(w, 3) for w in walls_l],
+            per_pass_wait_sec=[round(w, 3) for w in waits_l],
+            per_pass_train_sec=[round(w, 3) for w in trains_l],
+            per_pass_ex_per_sec=[round(r, 1) for r in rates_l],
+            # fraction of the measured wall the device spent inside the
+            # resident pass program (vs waiting on preload/upload)
+            device_busy_frac=round(sum(trains_l) / max(sum(walls_l),
+                                                       1e-9), 4),
+            flops_per_example_dense=round(fpe),
+            # per-chip rate over one chip's peak (value is already /chips)
+            mfu_dense=round(value * fpe / peak, 6),
+            peak_tflops_assumed=peak / 1e12,
+        )
+        if wire_l:
+            extras.update(
+                wire_mb_per_pass=round(np.mean(wire_l) / 1e6, 2),
+                wire_mb_per_sec=round(
+                    sum(wire_l) / 1e6 / max(sum(walls_l), 1e-9), 2))
     baseline_per_chip = 1_000_000 / 16  # v5p-32 north-star / chips
     print(json.dumps({
         "metric": metric,
         "value": round(value, 1),
         "unit": "examples/sec/chip",
         "vs_baseline": round(value / baseline_per_chip, 4),
+        **extras,
     }))
 
 

@@ -394,3 +394,169 @@ def features_for_nodes(table, nodes: np.ndarray) -> np.ndarray:
     embedding-PS feature rows for (walk) node ids — node id == feature
     key. Unknown nodes read zeros. → [n, 3 + mf]."""
     return table.host_pull(np.asarray(nodes, np.uint64).ravel())
+
+
+class BfsSampler:
+    """Batched BFS frontier sampler — the BasicBfsGraphSampler role
+    (graph_sampler.h:77-110: per-level neighbor sampling from a seed
+    frontier with per-node and per-level budgets, filling sample buffers
+    level by level).
+
+    TPU-shaped: each level is ONE ``sample_neighbors`` gather over the
+    current frontier ([budget] static shape, -1 pads); the next frontier
+    is a dedup + budget-clip of the sampled nodes. Returns per-level node
+    arrays and the sampled (src, dst) edges — a subgraph batch ready for
+    a GNN layer stack."""
+
+    def __init__(self, store: GraphStore, k_per_level=(10, 5),
+                 node_budget: int = 4096) -> None:
+        self.store = store
+        self.k_per_level = tuple(k_per_level)
+        self.node_budget = node_budget
+
+    def sample(self, seeds: np.ndarray, rng: jax.Array):
+        """→ {"levels": [seeds, l1, l2, ...], "edges": (src, dst)}.
+        Levels past the seeds are FIXED-BUDGET (-1-padded to
+        ``node_budget``): every level's sample_neighbors dispatch keeps
+        one static shape, so the background service never accumulates
+        per-frontier-size recompiles. Edges are the sampled adjacency
+        (every dst in level i+1 came from a src in level i)."""
+        indptr, indices = self.store.to_device()
+        levels = [np.asarray(seeds, np.int32)]
+        srcs, dsts = [], []
+        frontier = jnp.asarray(levels[0])
+        for li, k in enumerate(self.k_per_level):
+            rng, sub = jax.random.split(rng)
+            neigh = sample_neighbors(indptr, indices,
+                                     jnp.maximum(frontier, 0), k, sub)
+            neigh = jnp.where(frontier[:, None] >= 0, neigh, -1)
+            src = jnp.broadcast_to(frontier[:, None], neigh.shape)
+            m = np.asarray(neigh).ravel() >= 0
+            srcs.append(np.asarray(src).ravel()[m])
+            dsts.append(np.asarray(neigh).ravel()[m])
+            nxt = np.unique(dsts[-1])[:self.node_budget]  # budget clip
+            pad = np.full(self.node_budget, -1, np.int32)
+            pad[:len(nxt)] = nxt
+            levels.append(pad)
+            frontier = jnp.asarray(pad)
+        return {"levels": levels,
+                "edges": (np.concatenate(srcs) if srcs else
+                          np.zeros(0, np.int32),
+                          np.concatenate(dsts) if dsts else
+                          np.zeros(0, np.int32))}
+
+
+class GraphSamplerService:
+    """Background sampling service — the graph_sampler.h:25-110 role:
+    a thread continuously drives a sampler (random walks or BFS
+    subgraphs) into a bounded channel feeding the training loop, with
+    SAMPLE-RATE control (max batches/sec; the reference's sample-rate
+    knob, test_sample_rate.cu).
+
+    The trainer consumes via ``batches()`` — a generator that blocks on
+    the channel, so sampling overlaps training exactly like the
+    reference's background sampler filling device buffers."""
+
+    def __init__(self, store: GraphStore, mode: str = "walk",
+                 batch_size: int = 256, walk_len: int = 5,
+                 k_per_level=(10, 5), rate: Optional[float] = None,
+                 capacity: int = 8, seed: int = 0) -> None:
+        if mode not in ("walk", "bfs"):
+            raise ValueError(f"unknown sampler mode {mode!r}")
+        from paddlebox_tpu.utils.channel import Channel
+        self.store = store
+        self.mode = mode
+        self.batch_size = batch_size
+        self.walk_len = walk_len
+        self.bfs = (BfsSampler(store, k_per_level=k_per_level)
+                    if mode == "bfs" else None)
+        self.rate = rate
+        self.chan = Channel(capacity=capacity)
+        self._rng = jax.random.PRNGKey(seed)
+        self._thread = None
+        self._stop = False
+        self._err: Optional[BaseException] = None
+        self.produced = 0
+
+    # ---- producer thread ----
+    def _run(self, max_batches: Optional[int]) -> None:
+        import time as _time
+        try:
+            indptr, indices = self.store.to_device()
+            n = self.store.n_nodes
+            perm = None
+            pos = 0
+            t0 = _time.monotonic()
+            while not self._stop:
+                if max_batches is not None \
+                        and self.produced >= max_batches:
+                    break
+                if self.rate is not None:
+                    # token-bucket pacing: never exceed rate batches/sec
+                    budget = (_time.monotonic() - t0) * self.rate
+                    if self.produced >= budget:
+                        _time.sleep(min(0.05,
+                                        (self.produced - budget + 1)
+                                        / self.rate))
+                        continue
+                if perm is None or pos + self.batch_size > n:
+                    self._rng, sub = jax.random.split(self._rng)
+                    perm = np.asarray(jax.random.permutation(sub, n))
+                    pos = 0
+                seeds = perm[pos:pos + self.batch_size]
+                if seeds.size < self.batch_size:
+                    seeds = np.pad(seeds,
+                                   (0, self.batch_size - seeds.size),
+                                   mode="edge")
+                pos += self.batch_size
+                self._rng, sub = jax.random.split(self._rng)
+                if self.mode == "walk":
+                    out = np.asarray(random_walk(
+                        indptr, indices, jnp.asarray(seeds),
+                        self.walk_len, sub))
+                else:
+                    out = self.bfs.sample(seeds, sub)
+                try:
+                    self.chan.put(out)
+                except Exception:  # ChannelClosed: stop() raced us
+                    break
+                self.produced += 1
+        except BaseException as e:
+            self._err = e
+        finally:
+            self.chan.close()
+
+    # ---- service surface ----
+    def start(self, max_batches: Optional[int] = None
+              ) -> "GraphSamplerService":
+        import threading
+        if self._thread is not None:
+            raise RuntimeError("service already started")
+        self._thread = threading.Thread(
+            target=self._run, args=(max_batches,), daemon=True)
+        self._thread.start()
+        return self
+
+    def batches(self):
+        """Drain the channel until the producer finishes/stops; raises
+        the producer's error, if any."""
+        from paddlebox_tpu.utils.channel import ChannelClosed
+        while True:
+            try:
+                item = self.chan.get()
+            except ChannelClosed:
+                break
+            yield item
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+
+    def stop(self) -> None:
+        self._stop = True
+        self.chan.close()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err

@@ -42,20 +42,12 @@ class ClassBatch:
     index: PullIndex
 
 
-class MultiMfEmbeddingTable:
-    """Facade over one EmbeddingTable per distinct slot mf_dim.
+class SlotClassMap:
+    """Slot → dim-class routing metadata shared by every multi-mf table
+    (single-chip, sharded, serving): ``slot_mf_dims[i]`` is the embedx
+    width of sparse slot i; slots with equal widths form a class."""
 
-    ``slot_mf_dims[i]`` is the embedx width of desc.sparse_slots[i].
-    Keys are routed by their slot's class; each class table sees a
-    synthetic batch over only its slots, with segments renumbered to
-    ``record * S_c + rank_of_slot_in_class``."""
-
-    def __init__(self, slot_mf_dims: Sequence[int],
-                 capacity_per_class: Optional[Dict[int, int]] = None,
-                 capacity: Optional[int] = None,
-                 cfg: Optional[SparseSGDConfig] = None, seed: int = 0,
-                 unique_bucket_min: int = 1024,
-                 arena_chunk_bits: Optional[int] = None) -> None:
+    def __init__(self, slot_mf_dims: Sequence[int]) -> None:
         self.slot_mf_dims = np.asarray(slot_mf_dims, np.int32)
         if (self.slot_mf_dims <= 0).any():
             raise ValueError("slot mf dims must be positive")
@@ -70,25 +62,10 @@ class MultiMfEmbeddingTable:
             idx = np.nonzero(self.class_of_slot == c)[0]
             self.slot_rank[idx] = np.arange(len(idx), dtype=np.int32)
             self.class_slots.append(idx.astype(np.int32))
-        caps = capacity_per_class or {}
-        self.tables: List[EmbeddingTable] = []
-        for c, d in enumerate(self.dims):
-            n_slots_c = len(self.class_slots[c])
-            self.tables.append(EmbeddingTable(
-                mf_dim=d, capacity=caps.get(d, capacity), cfg=cfg,
-                seed=seed + c, unique_bucket_min=unique_bucket_min,
-                arena_slots=(n_slots_c if arena_chunk_bits is not None
-                             else None),
-                arena_chunk_bits=arena_chunk_bits or 12))
 
-    # ------------------------------------------------------------------
     @property
     def num_classes(self) -> int:
         return len(self.dims)
-
-    @property
-    def feature_count(self) -> int:
-        return sum(t.feature_count for t in self.tables)
 
     def class_dim(self, c: int) -> int:
         return self.dims[c]
@@ -98,7 +75,11 @@ class MultiMfEmbeddingTable:
         per = (cvm_offset if use_cvm else 0) + 1
         return int(sum(per + d for d in self.slot_mf_dims))
 
-    # ------------------------------------------------------------------
+    def slot_route(self):
+        """Canonical reassembly order: (class, rank) per global slot."""
+        return [(int(self.class_of_slot[s]), int(self.slot_rank[s]))
+                for s in range(self.num_slots)]
+
     def split_batch(self, batch: SlotBatch
                     ) -> Tuple[List[SlotBatch], List[np.ndarray]]:
         """Route keys to per-class synthetic SlotBatches (the multi-mf
@@ -134,6 +115,37 @@ class MultiMfEmbeddingTable:
                 num_slots=s_c,
                 segments_trivial=batch.segments_trivial))
         return out, gslots
+
+
+class MultiMfEmbeddingTable(SlotClassMap):
+    """Facade over one EmbeddingTable per distinct slot mf_dim.
+
+    Keys are routed by their slot's class; each class table sees a
+    synthetic batch over only its slots, with segments renumbered to
+    ``record * S_c + rank_of_slot_in_class``."""
+
+    def __init__(self, slot_mf_dims: Sequence[int],
+                 capacity_per_class: Optional[Dict[int, int]] = None,
+                 capacity: Optional[int] = None,
+                 cfg: Optional[SparseSGDConfig] = None, seed: int = 0,
+                 unique_bucket_min: int = 1024,
+                 arena_chunk_bits: Optional[int] = None) -> None:
+        super().__init__(slot_mf_dims)
+        caps = capacity_per_class or {}
+        self.tables: List[EmbeddingTable] = []
+        for c, d in enumerate(self.dims):
+            n_slots_c = len(self.class_slots[c])
+            self.tables.append(EmbeddingTable(
+                mf_dim=d, capacity=caps.get(d, capacity), cfg=cfg,
+                seed=seed + c, unique_bucket_min=unique_bucket_min,
+                arena_slots=(n_slots_c if arena_chunk_bits is not None
+                             else None),
+                arena_chunk_bits=arena_chunk_bits or 12))
+
+    # ------------------------------------------------------------------
+    @property
+    def feature_count(self) -> int:
+        return sum(t.feature_count for t in self.tables)
 
     def prepare(self, batch: SlotBatch) -> List[ClassBatch]:
         """Per-class dedup + row assignment (DedupKeysAndFillIdx per dim

@@ -1,0 +1,146 @@
+"""Multi-mf × sharded: per-slot embedding dims on the mesh PS.
+
+Reference: the dynamic-mf accessor IS the sharded multi-GPU PS's value
+layout — ``CommonFeatureValueAccessor`` (feature_value.h:42-185) with the
+multi-mf build pipeline running per dim class across GPUs
+(ps_gpu_wrapper.cc BuildGPUTask multi_mf paths).
+
+TPU-native composition: one :class:`ShardedEmbeddingTable` per dim class
+(each with its static row width and its own key%N shard layout over the
+SAME mesh), routed by the shared :class:`SlotClassMap`. A global batch
+yields C per-class routing plans; the mesh train step runs C pull/push
+all_to_all pairs inside one jit program and concatenates the pooled
+blocks in canonical slot order (train/multi_mf_sharded.py)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddlebox_tpu.data.batch import SlotBatch
+from paddlebox_tpu.ps.multi_mf import SlotClassMap
+from paddlebox_tpu.ps.sgd import SparseSGDConfig
+from paddlebox_tpu.ps.sharded import ShardedEmbeddingTable, ShardedPullIndex
+from paddlebox_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class MultiMfShardedTable(SlotClassMap):
+    """One ShardedEmbeddingTable per distinct slot mf_dim, same mesh."""
+
+    def __init__(self, num_shards: int, slot_mf_dims: Sequence[int],
+                 capacity_per_shard: Optional[int] = None,
+                 capacity_per_class: Optional[Dict[int, int]] = None,
+                 cfg: Optional[SparseSGDConfig] = None,
+                 req_bucket_min: int = 512,
+                 serve_bucket_min: int = 1024) -> None:
+        super().__init__(slot_mf_dims)
+        self.n = num_shards
+        self.cfg = cfg or SparseSGDConfig()
+        caps = capacity_per_class or {}
+        self.tables: List[ShardedEmbeddingTable] = [
+            ShardedEmbeddingTable(
+                num_shards, mf_dim=d,
+                capacity_per_shard=caps.get(d, capacity_per_shard),
+                cfg=cfg, req_bucket_min=req_bucket_min,
+                serve_bucket_min=serve_bucket_min)
+            for d in self.dims]
+
+    # ------------------------------------------------------------------
+    def prepare_global(self, batches: List[SlotBatch], assign: bool = True,
+                       req_capacities: Optional[List[int]] = None,
+                       serve_capacities: Optional[List[int]] = None
+                       ) -> List[ShardedPullIndex]:
+        """[N] device batches → per-class routing plans. serve_slot is
+        remapped from class-local slot ranks (the sub-batch numbering)
+        back to GLOBAL slot ids, so the persisted FeatureValue slot field
+        stays globally meaningful (feature_value.h:570)."""
+        subs = [self.split_batch(b)[0] for b in batches]   # [N][C]
+        return self.prepare_global_from_subs(
+            subs, assign=assign, req_capacities=req_capacities,
+            serve_capacities=serve_capacities)
+
+    def prepare_global_from_subs(self, subs, assign: bool = True,
+                                 req_capacities=None,
+                                 serve_capacities=None
+                                 ) -> List[ShardedPullIndex]:
+        """prepare_global over ALREADY-SPLIT per-class sub-batches
+        (``subs[d][c]`` from split_batch) — callers that also need the
+        sub-batches (segments) split once, not twice."""
+        plans = []
+        for c, t in enumerate(self.tables):
+            plan = t.prepare_global(
+                [subs[d][c] for d in range(len(subs))], assign=assign,
+                req_capacity=(req_capacities[c] if req_capacities
+                              else None),
+                serve_capacity=(serve_capacities[c] if serve_capacities
+                                else None))
+            gslot = self.class_slots[c][
+                plan.serve_slot.astype(np.int32)].astype(np.float32)
+            plans.append(plan._replace(serve_slot=gslot))
+        return plans
+
+    def prepare_global_eval(self, batches: List[SlotBatch]
+                            ) -> List[ShardedPullIndex]:
+        return self.prepare_global(batches, assign=False)
+
+    # ---- lifecycle: delegate per class (multi-mf save format) ----
+    def feature_count(self) -> int:
+        return sum(t.feature_count() for t in self.tables)
+
+    def save_base(self, path: str) -> int:
+        return sum(t.save_base(f"{path}.mf{d}.npz")
+                   for t, d in zip(self.tables, self.dims))
+
+    def save_delta(self, path: str) -> int:
+        return sum(t.save_delta(f"{path}.mf{d}.npz")
+                   for t, d in zip(self.tables, self.dims))
+
+    def load(self, path: str, merge: bool = False) -> int:
+        return sum(t.load(f"{path}.mf{d}.npz", merge=merge)
+                   for t, d in zip(self.tables, self.dims))
+
+    def shrink(self, **kw) -> int:
+        return sum(t.shrink(**kw) for t in self.tables)
+
+    def merge_model(self, path: str) -> int:
+        return sum(t.merge_model(f"{path}.mf{d}.npz")
+                   for t, d in zip(self.tables, self.dims))
+
+    def pull(self, keys: np.ndarray, slots: np.ndarray) -> np.ndarray:
+        """Host-side per-key pull padded to the MAX class width — the
+        dy_mf CopyForPull contract; routes each key to its slot's class
+        table, then to its owner shard inside it. Unknown keys zeros."""
+        import jax
+        keys = np.ascontiguousarray(keys, np.uint64)
+        slots = np.asarray(slots, np.int32)
+        out = np.zeros((len(keys), 3 + max(self.dims)), np.float32)
+        from paddlebox_tpu.ps.table import FIELD_COL, NUM_FIXED
+        for c, t in enumerate(self.tables):
+            m = self.class_of_slot[slots] == c
+            if not m.any():
+                continue
+            kc = keys[m]
+            data = np.asarray(jax.device_get(t.state.data))
+            vals = np.zeros((len(kc), 3 + t.mf_dim), np.float32)
+            owners = (kc % np.uint64(t.n)).astype(np.int64)
+            for s in range(t.n):
+                sm = owners == s
+                if not sm.any():
+                    continue
+                rows = t.indexes[s].lookup(kc[sm])
+                known = rows >= 0
+                sub = data[s][rows[known]]
+                block = np.concatenate(
+                    [sub[:, FIELD_COL["show"]:FIELD_COL["clk"] + 1],
+                     sub[:, FIELD_COL["embed_w"]:FIELD_COL["embed_w"] + 1],
+                     sub[:, NUM_FIXED:NUM_FIXED + t.mf_dim]
+                     * (sub[:, FIELD_COL["mf_size"]:FIELD_COL["mf_size"]
+                            + 1] > 0)], axis=1)
+                tmp = np.zeros((int(sm.sum()), 3 + t.mf_dim), np.float32)
+                tmp[known] = block
+                vals[np.nonzero(sm)[0]] = tmp
+            out[np.nonzero(m)[0], :vals.shape[1]] = vals
+        return out

@@ -1,0 +1,294 @@
+"""Mesh train step + trainer for multi-mf (per-slot embedding dims).
+
+The sharded analogue of train/multi_mf_step.py: C dim classes, each a
+ShardedEmbeddingTable over the same mesh. One jit shard_map program per
+global batch runs C pull all_to_alls → per-class fused_seqpool_cvm →
+canonical slot-order concat → dense net → backward → C push all_to_alls
+→ per-class in-table optimizer + dense psum. Reference:
+feature_value.h:42-185 (the dy-mf accessor IS the sharded PS layout),
+ps_gpu_wrapper.cc multi-mf BuildGPUTask.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddlebox_tpu.data.batch import SlotBatch
+from paddlebox_tpu.metrics import AucState, auc_add_batch, auc_compute
+from paddlebox_tpu.ops import fused_seqpool_cvm
+from paddlebox_tpu.parallel.mesh import DATA_AXIS
+from paddlebox_tpu.ps.multi_mf_sharded import MultiMfShardedTable
+from paddlebox_tpu.ps.sharded import ShardedPullIndex
+from paddlebox_tpu.ps.table import (TableState, apply_push,
+                                    gather_full_rows, pull_values)
+from paddlebox_tpu.train.sharded import init_sharded_auc
+from paddlebox_tpu.utils.logging import get_logger
+from paddlebox_tpu.utils.timer import Timer
+
+log = get_logger(__name__)
+
+
+class ClassPlan(NamedTuple):
+    """One dim class's routing plan for a global batch (leading dim =
+    device, sharded over the mesh axis)."""
+
+    resp_idx: jax.Array     # int32 [N, N, A_c]
+    serve_rows: jax.Array   # int32 [N, A2_c]
+    serve_valid: jax.Array  # f32   [N, A2_c]
+    serve_slot: jax.Array   # f32   [N, A2_c] (GLOBAL slot ids)
+    gather_idx: jax.Array   # int32 [N, K_c]
+    segments: jax.Array     # int32 [N, K_c] (class-local renumbering)
+
+
+class MmfGlobalBatch(NamedTuple):
+    plans: Tuple[ClassPlan, ...]
+    dense: jax.Array        # f32 [N, B, Dd]
+    label: jax.Array        # f32 [N, B]
+    show: jax.Array         # f32 [N, B]
+    clk: jax.Array          # f32 [N, B]
+
+
+class MmfShardedState(NamedTuple):
+    tables: Tuple[TableState, ...]   # per class, leaves [N, L, 128]
+    params: Any
+    opt_state: Any
+    auc: AucState                    # leaves [N, ...]
+    step: jax.Array
+
+
+class MultiMfShardedTrainStep:
+    """Jitted multi-class mesh step over a MultiMfShardedTable."""
+
+    def __init__(self, model, tx: optax.GradientTransformation,
+                 table: MultiMfShardedTable, mesh: Mesh,
+                 batch_size_per_device: int, use_cvm: bool = True,
+                 cvm_offset: int = 2) -> None:
+        self.model = model
+        self.tx = tx
+        self.table = table
+        self.mesh = mesh
+        self.n = mesh.shape[DATA_AXIS]
+        self.batch_size = batch_size_per_device
+        self.use_cvm = use_cvm
+        self.cvm_offset = cvm_offset
+        self.dims = table.dims
+        self.class_slots = [len(s) for s in table.class_slots]
+        self.route = table.slot_route()
+
+        shard0 = P(DATA_AXIS)
+        rep = P()
+        # tree-prefix specs: shard0 broadcasts over the tables tuple and
+        # every plan leaf (all carry a leading device dim)
+        state_spec = MmfShardedState(
+            tables=shard0, params=rep, opt_state=rep,
+            auc=AucState(*([shard0] * len(AucState._fields))),
+            step=rep)
+        self._state_spec = state_spec
+        batch_spec = MmfGlobalBatch(
+            plans=shard0, dense=shard0, label=shard0, show=shard0,
+            clk=shard0)
+        self._sharded = jax.jit(
+            jax.shard_map(self._device_step, mesh=mesh,
+                          in_specs=(state_spec, batch_spec, rep),
+                          out_specs=(state_spec, rep),
+                          check_vma=False),
+            donate_argnums=(0,))
+
+    def init_params(self, dense_dim: int) -> Any:
+        width = self.table.pooled_width(self.cvm_offset, self.use_cvm)
+        flat = jnp.zeros((self.batch_size, width))
+        dense = jnp.zeros((self.batch_size, dense_dim))
+        return self.model.init(jax.random.PRNGKey(0), flat, dense)
+
+    def init_state(self, params: Any) -> MmfShardedState:
+        return MmfShardedState(
+            tables=tuple(t.state for t in self.table.tables),
+            params=params, opt_state=self.tx.init(params),
+            auc=init_sharded_auc(self.n), step=jnp.zeros((), jnp.int32))
+
+    # ---- per-device block program (runs under shard_map) ----
+    def _device_step(self, state: MmfShardedState, batch: MmfGlobalBatch,
+                     rng: jax.Array):
+        n, b = self.n, self.batch_size
+        me = jax.lax.axis_index(DATA_AXIS)
+        tables = [st.with_packed(st.packed[0]) for st in state.tables]
+        auc = AucState(*[l[0] for l in state.auc])
+        dense = batch.dense[0]
+        label = batch.label[0]
+        show = batch.show[0]
+        clk = batch.clk[0]
+        ins_w = (show > 0).astype(jnp.float32)
+        wsum_global = jax.lax.psum(jnp.sum(ins_w), DATA_AXIS)
+        show_clk = jnp.stack([show, clk], axis=1)
+
+        # ---- per-class pull: serve, exchange, flatten ----
+        rows_fulls, vals_flats, plan_views = [], [], []
+        for c, tbl in enumerate(tables):
+            p = batch.plans[c]
+            resp_idx = p.resp_idx[0]
+            serve_rows = p.serve_rows[0]
+            a = resp_idx.shape[1]
+            d = 3 + tbl.mf_dim
+            rows_full = gather_full_rows(tbl, serve_rows)
+            serve_vals = pull_values(rows_full, tbl.mf_dim)
+            resp = serve_vals[resp_idx]
+            recv = jax.lax.all_to_all(resp, DATA_AXIS, 0, 0, tiled=True)
+            rows_fulls.append(rows_full)
+            vals_flats.append(recv.reshape(n * a, d))
+            plan_views.append((resp_idx, serve_rows, p.serve_valid[0],
+                               p.serve_slot[0], p.gather_idx[0],
+                               p.segments[0]))
+
+        def loss_fn(params, vals_flats):
+            parts = []
+            for c in range(len(tables)):
+                _, _, _, _, gather_idx, segments = plan_views[c]
+                values_k = vals_flats[c][gather_idx]
+                parts.append(fused_seqpool_cvm(
+                    values_k, segments, show_clk, b, self.class_slots[c],
+                    self.use_cvm, self.cvm_offset))
+            flat = jnp.concatenate(
+                [parts[c][:, r, :] for c, r in self.route], axis=1)
+            logits = self.model.apply(params, flat, dense)
+            ls = optax.sigmoid_binary_cross_entropy(logits, label)
+            loss_local = jnp.sum(ls * ins_w) / jnp.maximum(wsum_global, 1.0)
+            return loss_local, logits
+
+        (loss_local, logits), (g_params, g_vals) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1), has_aux=True)(
+                state.params, tuple(vals_flats))
+
+        # ---- per-class push: route back, merge, in-table optimizer ----
+        new_tables = []
+        for c, tbl in enumerate(tables):
+            resp_idx, serve_rows, serve_valid, serve_slot, _, _ = \
+                plan_views[c]
+            a = resp_idx.shape[1]
+            a2 = serve_rows.shape[0]
+            d = 3 + tbl.mf_dim
+            g_back = jax.lax.all_to_all(
+                g_vals[c].reshape(n, a, d), DATA_AXIS, 0, 0, tiled=True)
+            g_serve = jax.ops.segment_sum(
+                g_back.reshape(n * a, d), resp_idx.reshape(n * a),
+                num_segments=a2)
+            gb = jnp.concatenate(
+                [g_serve[:, :2], g_serve[:, 2:] * (-1.0 * b * n)], axis=1)
+            tbl = apply_push(tbl, serve_rows, gb, self.table.cfg,
+                             jax.random.fold_in(rng, me * 131 + c),
+                             rows_full=rows_fulls[c],
+                             touched=serve_valid > 0,
+                             slot_val=serve_slot)
+            new_tables.append(tbl.with_packed(tbl.packed[None]))
+
+        g_params = jax.lax.psum(g_params, DATA_AXIS)
+        updates, opt_state = self.tx.update(g_params, state.opt_state,
+                                            state.params)
+        params = optax.apply_updates(state.params, updates)
+
+        pred = jax.nn.sigmoid(logits)
+        auc = auc_add_batch(auc, pred, label, ins_w)
+        loss = jax.lax.psum(loss_local, DATA_AXIS)
+        new_state = MmfShardedState(
+            tables=tuple(new_tables), params=params, opt_state=opt_state,
+            auc=AucState(*[l[None] for l in auc]), step=state.step + 1)
+        return new_state, {"loss": loss}
+
+    def __call__(self, state, batch, rng):
+        return self._sharded(state, batch, rng)
+
+
+class MultiMfShardedTrainer:
+    """Streaming mesh trainer over a MultiMfShardedTable (the
+    PSGPUTrainer role for mixed-dim tables at pod scale)."""
+
+    def __init__(self, model, table: MultiMfShardedTable, desc, mesh: Mesh,
+                 tx: Optional[optax.GradientTransformation] = None,
+                 use_cvm: bool = True, prefetch: int = 4,
+                 seed: int = 0) -> None:
+        self.model = model
+        self.table = table
+        self.desc = desc
+        self.mesh = mesh
+        self.n = mesh.shape[DATA_AXIS]
+        self.tx = tx or optax.adam(1e-3)
+        self.step_fn = MultiMfShardedTrainStep(
+            model, self.tx, table, mesh, desc.batch_size, use_cvm=use_cvm)
+        self.state = self.step_fn.init_state(
+            self.step_fn.init_params(desc.dense_dim))
+        self._rng = jax.random.PRNGKey(seed + 1)
+        self.global_step = 0
+        self.prefetch = prefetch
+
+    def _group_iter(self, batches):
+        from paddlebox_tpu.train.sharded import ShardedTrainer
+        return ShardedTrainer._group_iter(self, batches)
+
+    def _prep(self, group):
+        # one split serves both the routing plans and the segments —
+        # prepare_global_from_subs avoids re-running the key-class
+        # routing on the prefetch critical path
+        subs = [self.table.split_batch(b)[0] for b in group]
+        plans = self.table.prepare_global_from_subs(subs)
+        cps = []
+        for c, p in enumerate(plans):
+            k_c = p.gather_idx.shape[1]
+            segs = []
+            for d in range(len(group)):
+                sb = subs[d][c]
+                s = np.full(k_c, sb.pad_segment, np.int32)
+                m = min(sb.segments.shape[0], k_c)
+                s[:m] = sb.segments[:m]
+                segs.append(s)
+            cps.append(ClassPlan(
+                resp_idx=jnp.asarray(p.resp_idx),
+                serve_rows=jnp.asarray(p.serve_rows),
+                serve_valid=jnp.asarray(p.serve_valid),
+                serve_slot=jnp.asarray(p.serve_slot),
+                gather_idx=jnp.asarray(p.gather_idx),
+                segments=jnp.asarray(np.stack(segs))))
+        return MmfGlobalBatch(
+            plans=tuple(cps),
+            dense=jnp.asarray(np.stack([b.dense for b in group])),
+            label=jnp.asarray(np.stack([b.label for b in group])),
+            show=jnp.asarray(np.stack([b.show for b in group])),
+            clk=jnp.asarray(np.stack([b.clk for b in group])))
+
+    def train_pass(self, dataset, log_prefix: str = "") -> Dict[str, float]:
+        from paddlebox_tpu.utils.prefetch import prefetch_iter
+        timer = Timer()
+        timer.start()
+        nb = 0
+        stats = None
+        for gb in prefetch_iter(self._group_iter(dataset.batches()),
+                                self._prep, capacity=self.prefetch):
+            self.global_step += 1
+            rng = jax.random.fold_in(self._rng, self.global_step)
+            self.state, stats = self.step_fn(self.state, gb, rng)
+            nb += 1
+        timer.pause()
+        self.sync_table()
+        auc_host = AucState(*[jnp.sum(l, axis=0) for l in self.state.auc])
+        res = auc_compute(auc_host)
+        out = res.as_dict()
+        out.update(
+            batches=nb, elapsed_sec=timer.elapsed_sec(),
+            examples_per_sec=res.ins_num / max(timer.elapsed_sec(), 1e-9),
+            last_loss=float(stats["loss"]) if stats is not None
+            else float("nan"))
+        log.info("%smulti-mf sharded pass: %d global batches, %.0f ex/s, "
+                 "auc=%.4f", log_prefix, nb, out["examples_per_sec"],
+                 res.auc)
+        return out
+
+    def reset_metrics(self) -> None:
+        self.state = self.state._replace(auc=init_sharded_auc(self.n))
+
+    def sync_table(self) -> None:
+        for t, st in zip(self.table.tables, self.state.tables):
+            t.state = st

@@ -122,6 +122,18 @@ class MultiMfTrainStep:
     def __call__(self, state, devs, rng):
         return self._jit(state, devs, rng)
 
+    # ---- resident pass runner (whole pass as one fori_loop) ----
+    def run_resident(self, state, rp: "MultiMfResidentPass", rng):
+        cache = getattr(self, "_resident_cache", None)
+        if cache is None:
+            cache = self._resident_cache = {}
+        nb = rp.num_batches
+        if nb not in cache:
+            cache[nb] = _mmf_resident_runner(self, nb)
+        class_wires, floats = rp.dev
+        return cache[nb](state, class_wires, floats,
+                         jnp.zeros((), jnp.int32), rng)
+
 
 class MultiMfTrainer:
     """Streaming trainer over a MultiMfEmbeddingTable (the BoxPSTrainer
@@ -187,3 +199,121 @@ class MultiMfTrainer:
     def sync_table(self) -> None:
         for t, st in zip(self.table.tables, self.state.table):
             t.state = st
+
+    # ---- device-resident pass (BeginPass staging, multi-mf flavor) ----
+    def build_resident_pass(self, dataset) -> "MultiMfResidentPass":
+        return MultiMfResidentPass.build(dataset, self.table)
+
+    def train_pass_resident(self, pass_or_dataset,
+                            log_prefix: str = "") -> Dict[str, float]:
+        """The whole pass staged to HBM and run as ONE lax.fori_loop —
+        per-step host work and H2D hops are zero (the multi-mf analogue
+        of Trainer.train_pass_resident)."""
+        rp = (pass_or_dataset
+              if isinstance(pass_or_dataset, MultiMfResidentPass)
+              else self.build_resident_pass(pass_or_dataset))
+        timer = Timer()
+        timer.start()
+        rp.upload()
+        self.state = self.step_fn.run_resident(self.state, rp, self._rng)
+        jax.block_until_ready(self.state.step)
+        self.global_step += rp.num_batches
+        timer.pause()
+        self.sync_table()
+        res = auc_compute(self.state.auc)
+        out = res.as_dict()
+        out.update(batches=rp.num_batches, elapsed_sec=timer.elapsed_sec(),
+                   examples_per_sec=rp.num_records /
+                   max(timer.elapsed_sec(), 1e-9))
+        log.info("%smulti-mf resident pass: %d batches, %.0f ex/s, "
+                 "auc=%.4f", log_prefix, rp.num_batches,
+                 out["examples_per_sec"], res.auc)
+        return out
+
+
+class MultiMfResidentPass:
+    """One pass's per-class DeviceBatch streams stacked on a leading step
+    axis: per class ``ints_u [nb, U_c+2]`` and ``ints_k [nb, r, K_c]``,
+    plus ONE shared float block ``[nb, B, Dd+3]`` (class sub-batches
+    share their floats, as in the streaming path)."""
+
+    def __init__(self, class_ints, floats: np.ndarray,
+                 num_records: int) -> None:
+        self.class_ints = class_ints      # [(iu, ik)] per class, host
+        self.floats = floats
+        self.num_records = num_records
+        self.dev = None
+
+    @property
+    def num_batches(self) -> int:
+        return self.floats.shape[0]
+
+    @classmethod
+    def build(cls, dataset, table: MultiMfEmbeddingTable
+              ) -> "MultiMfResidentPass":
+        from paddlebox_tpu.ps.table import fill_oob_pads
+        from paddlebox_tpu.train.step import pack_floats
+        per_class: List[List] = [[] for _ in range(table.num_classes)]
+        floats = []
+        n_rec = 0
+        for b in dataset.batches():
+            n_rec += int((b.show > 0).sum())
+            floats.append(pack_floats(b.dense, b.label, b.show, b.clk))
+            for c, cb in enumerate(table.prepare(b)):
+                per_class[c].append(cb)
+        if not floats:
+            raise ValueError("empty pass")
+        nb = len(floats)
+        class_ints = []
+        for c, cbs in enumerate(per_class):
+            cap = table.tables[c].capacity
+            u_max = max(cb.index.unique_rows.shape[0] for cb in cbs)
+            k_max = max(cb.index.gather_idx.shape[0] for cb in cbs)
+            trivial = all(cb.batch.segments_trivial for cb in cbs)
+            iu = np.empty((nb, u_max + 2), np.int32)
+            ik = np.empty((nb, 1 if trivial else 2, k_max), np.int32)
+            for i, cb in enumerate(cbs):
+                idx, sb = cb.index, cb.batch
+                u = idx.num_unique
+                iu[i, :idx.unique_rows.shape[0]] = idx.unique_rows
+                fill_oob_pads(iu[i, :u_max], u, cap)
+                iu[i, u_max] = sb.num_keys
+                iu[i, u_max + 1] = sb.pad_segment
+                ik[i, 0, :idx.gather_idx.shape[0]] = idx.gather_idx
+                ik[i, 0, idx.gather_idx.shape[0]:] = u
+                if not trivial:
+                    k = min(sb.segments.shape[0], k_max)
+                    ik[i, 1, :k] = sb.segments[:k]
+                    ik[i, 1, k:] = sb.pad_segment
+            class_ints.append((iu, ik))
+        return cls(class_ints, np.stack(floats), n_rec)
+
+    def upload(self) -> None:
+        if self.dev is not None:
+            return
+        import jax.numpy as _jnp
+        self.dev = (
+            tuple((jax.device_put(_jnp.asarray(iu)),
+                   jax.device_put(_jnp.asarray(ik)))
+                  for iu, ik in self.class_ints),
+            jax.device_put(_jnp.asarray(self.floats)))
+
+
+def _mmf_resident_runner(step: MultiMfTrainStep, n_steps: int):
+    from paddlebox_tpu.train.step import DeviceBatch
+
+    def run(state, class_wires, floats, start, rng):
+        def body(i, carry):
+            st, r = carry
+            devs = tuple(
+                DeviceBatch(ints_u=iu[i], ints_k=ik[i], floats=floats[i])
+                for iu, ik in class_wires)
+            st, _ = step._step(st, devs,
+                               jax.random.fold_in(r, st.step + 1))
+            return st, r
+
+        state, _ = jax.lax.fori_loop(start, start + n_steps, body,
+                                     (state, rng))
+        return state
+
+    return jax.jit(run, donate_argnums=(0,))

@@ -815,6 +815,13 @@ class ShardedResidentPass:
         self.arrays = {"serve_rows": a["serve_rows"],
                        "label": a["label"]}
 
+    def nbytes(self) -> int:
+        """Wire bytes of the staged pass (after upload packing)."""
+        if self.dev is not None:
+            return sum(a.nbytes for a in jax.tree.leaves(self.dev))
+        src = self.wire if self.wire is not None else self.arrays
+        return sum(a.nbytes for a in jax.tree.leaves(src))
+
     def mark_trained_rows(self, table: ShardedEmbeddingTable) -> None:
         """Per-shard touched flags for this pass's served rows, set AFTER
         training (same delta-save rationale as ResidentPass)."""

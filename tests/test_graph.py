@@ -253,3 +253,123 @@ def test_features_for_nodes_pulls_embedding_rows():
     np.testing.assert_allclose(out[:2, 0], 7.0)
     np.testing.assert_allclose(out[:2, 2], 0.25)
     np.testing.assert_allclose(out[2], 0.0)  # unknown node reads zeros
+
+
+def _two_cliques(m=20, cross=3, seed=0):
+    from paddlebox_tpu.graph import GraphStore
+    rng = np.random.default_rng(seed)
+    src, dst = [], []
+    for base in (0, m):
+        for i in range(m):
+            for j in range(m):
+                if i != j:
+                    src.append(base + i)
+                    dst.append(base + j)
+    for _ in range(cross):
+        a, b = rng.integers(0, m), m + rng.integers(0, m)
+        src += [a, b]
+        dst += [b, a]
+    return GraphStore.from_edges(np.array(src), np.array(dst),
+                                 n_nodes=2 * m)
+
+
+def test_bfs_sampler_levels_and_edges():
+    """BfsSampler (BasicBfsGraphSampler role): sampled edges are true
+    graph edges; each level's nodes were sampled from the previous."""
+    from paddlebox_tpu.graph import BfsSampler
+    g = _two_cliques()
+    adj = {u: set(g.indices[g.indptr[u]:g.indptr[u + 1]].tolist())
+           for u in range(g.n_nodes)}
+    s = BfsSampler(g, k_per_level=(5, 3), node_budget=64)
+    out = s.sample(np.array([0, 1, 25], np.int32), jax.random.PRNGKey(0))
+    assert len(out["levels"]) == 3
+    src, dst = out["edges"]
+    assert len(src) > 0
+    for u, v in zip(src, dst):
+        assert int(v) in adj[int(u)], (u, v)
+    lvl_sets = [set(l[l >= 0].tolist()) for l in out["levels"]]
+    for u in lvl_sets[1]:
+        assert any(u in adj[s0] for s0 in lvl_sets[0])
+
+
+def test_sampler_service_rate_control_and_feed():
+    """GraphSamplerService: background thread feeds the channel; the
+    sample-rate knob bounds production (test_sample_rate.cu role)."""
+    import time
+    from paddlebox_tpu.graph import GraphSamplerService
+    g = _two_cliques()
+    svc = GraphSamplerService(g, mode="walk", batch_size=8, walk_len=3,
+                              rate=20.0, capacity=64, seed=1)
+    svc.start()
+    it = svc.batches()
+    first = next(it)                     # absorbs the jit compile
+    assert first.shape == (8, 4)
+    t0 = time.monotonic()
+    base = svc.produced
+    got = 0
+    for walks in it:
+        assert walks.shape == (8, 4)
+        got += 1
+        if time.monotonic() - t0 > 1.0:
+            break
+    produced_window = svc.produced - base
+    elapsed = time.monotonic() - t0
+    svc.stop()
+    assert got >= 3                      # it actually produced
+    # rate control: production in the window stays within the budget
+    assert produced_window <= 20 * elapsed + 3, (produced_window, elapsed)
+
+
+def test_gnn_trains_from_service():
+    """E2e: a small GraphSAGE-style classifier trained CONTINUOUSLY from
+    the background BFS service separates two communities."""
+    import jax.numpy as jnp
+    import optax
+    from paddlebox_tpu.graph import GraphSamplerService
+    m = 20
+    g = _two_cliques(m=m)
+    rng = np.random.default_rng(3)
+    feats = rng.normal(size=(2 * m, 8)).astype(np.float32)
+    labels = (np.arange(2 * m) >= m).astype(np.float32)
+
+    svc = GraphSamplerService(g, mode="bfs", batch_size=16,
+                              k_per_level=(6,), capacity=16, seed=2)
+    svc.start(max_batches=120)
+
+    w = jnp.asarray(rng.normal(size=(16,)) * 0.1)
+    tx = optax.adam(5e-2)
+    opt = tx.init(w)
+
+    @jax.jit
+    def step(w, opt, x_seed, x_neigh, y):
+        def loss_fn(w):
+            h = jnp.concatenate([x_seed, x_neigh], axis=1)
+            logit = h @ w
+            return jnp.mean(optax.sigmoid_binary_cross_entropy(logit, y))
+        loss, gr = jax.value_and_grad(loss_fn)(w)
+        up, opt = tx.update(gr, opt, w)
+        return optax.apply_updates(w, up), opt, loss
+
+    nb = 0
+    for batch in svc.batches():
+        seeds = batch["levels"][0]
+        src, dst = batch["edges"]
+        x_seed = feats[seeds]
+        x_neigh = np.zeros_like(x_seed)
+        for i, sd in enumerate(seeds):
+            nb_mask = src == sd
+            if nb_mask.any():
+                x_neigh[i] = feats[dst[nb_mask]].mean(axis=0)
+        w, opt, loss = step(w, opt, jnp.asarray(x_seed),
+                            jnp.asarray(x_neigh),
+                            jnp.asarray(labels[seeds]))
+        nb += 1
+    svc.stop()
+    assert nb == 120
+    # accuracy over all nodes using full-neighborhood means
+    x_neigh_all = np.stack([
+        feats[g.indices[g.indptr[u]:g.indptr[u + 1]]].mean(axis=0)
+        for u in range(2 * m)])
+    logits = np.concatenate([feats, x_neigh_all], axis=1) @ np.asarray(w)
+    acc = ((logits > 0) == (labels > 0.5)).mean()
+    assert acc > 0.9, acc

@@ -100,6 +100,35 @@ def test_multi_mf_pull_per_slot_widths(criteo_files):
     assert (vals[:, 0] > 0).all()
 
 
+def test_multi_mf_resident_matches_streaming(criteo_files):
+    """Device-resident multi-mf pass (whole pass in one lax.fori_loop)
+    == streaming pass: same AUC, same dense params, same per-key values
+    (mf_initial_range=0 so rng paths can't diverge)."""
+    tr_a, ds = _make(criteo_files)
+    tr_b, _ = _make(criteo_files)
+    ra = rb = None
+    for _ in range(2):
+        ra = tr_a.train_pass(ds)
+        rb = tr_b.train_pass_resident(ds)
+    assert rb["batches"] == ra["batches"]
+    assert rb["ins_num"] == ra["ins_num"]
+    assert np.isclose(rb["auc"], ra["auc"], atol=2e-3), (rb["auc"], ra["auc"])
+    for x, y in zip(jax.tree.leaves(tr_a.state.params),
+                    jax.tree.leaves(tr_b.state.params)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=2e-2, atol=2e-3)
+    col = ds.columnar
+    keys = col.keys[:100].astype(np.uint64)
+    slots = col.key_slot[:100]
+    np.testing.assert_allclose(tr_b.table.pull(keys, slots),
+                               tr_a.table.pull(keys, slots),
+                               rtol=2e-2, atol=2e-3)
+    # a further resident pass keeps training
+    tr_b.reset_metrics()
+    rb2 = tr_b.train_pass_resident(ds)
+    assert rb2["auc"] > rb["auc"] - 0.02
+
+
 def test_multi_mf_serving_consumes_save(criteo_files, tmp_path):
     """MultiMfServingModel loads the multi-mf save format, serves
     per-slot-width lookups identical to the live table, and predicts."""

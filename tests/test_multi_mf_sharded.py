@@ -1,0 +1,146 @@
+"""Multi-mf × sharded: per-slot embedding dims on the 8-device CPU mesh
+(feature_value.h:42-185 — the dy-mf accessor as the sharded PS layout;
+ps_gpu_wrapper.cc multi-mf BuildGPUTask)."""
+
+import numpy as np
+import jax
+import optax
+import pytest
+
+from paddlebox_tpu.config import flags_scope
+from paddlebox_tpu.data import DataFeedDesc, DatasetFactory
+from paddlebox_tpu.data.criteo import generate_criteo_files
+from paddlebox_tpu.models import CtrDnn
+from paddlebox_tpu.parallel import make_mesh
+from paddlebox_tpu.ps import MultiMfEmbeddingTable, SparseSGDConfig
+from paddlebox_tpu.ps.multi_mf_sharded import MultiMfShardedTable
+from paddlebox_tpu.train import MultiMfTrainer
+from paddlebox_tpu.train.multi_mf_sharded import MultiMfShardedTrainer
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= N
+    return make_mesh(N)
+
+
+def _dims():
+    return [2] * 10 + [4] * 10 + [8] * 6   # three dim classes
+
+
+@pytest.fixture(scope="module")
+def criteo_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("criteo_mmfs")
+    return generate_criteo_files(str(d), num_files=2, rows_per_file=1500,
+                                 vocab_per_slot=40, seed=19)
+
+
+def _ds(files, bs=32):
+    desc = DataFeedDesc.criteo(batch_size=bs)
+    desc.key_bucket_min = 1024
+    ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    return ds, desc
+
+
+def _cfg():
+    return SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=0.0,
+                           learning_rate=0.05, mf_learning_rate=0.05)
+
+
+def test_mmf_sharded_routing_and_slot_field(mesh, criteo_files):
+    """Keys route to their slot's class table and, inside it, to their
+    key%N owner shard; serve_slot carries GLOBAL slot ids."""
+    ds, desc = _ds(criteo_files)
+    table = MultiMfShardedTable(N, _dims(), capacity_per_shard=2048,
+                                cfg=_cfg(), req_bucket_min=64,
+                                serve_bucket_min=64)
+    group = []
+    for b in ds.batches():
+        group.append(b)
+        if len(group) == N:
+            break
+    plans = table.prepare_global(group)
+    assert len(plans) == 3
+    dims = np.asarray(_dims())
+    for d, b in enumerate(group):
+        segs = b.segments[:b.num_keys]
+        slots = segs % b.num_slots
+        for k, sl in zip(b.keys[:b.num_keys], slots):
+            c = table.class_of_slot[sl]
+            s = int(k) % N
+            assert table.tables[c].indexes[s].lookup(
+                np.array([k], np.uint64))[0] >= 0
+    # serve_slot values are valid GLOBAL slot ids of the right class
+    for c, p in enumerate(plans):
+        valid = p.serve_slot[p.serve_valid > 0].astype(int)
+        assert np.isin(valid, table.class_slots[c]).all()
+
+
+def test_mmf_sharded_e2e_learns_and_matches_single_chip(
+        mesh, criteo_files):
+    """8-dev mesh multi-mf training with 3 dim classes learns the same
+    planted signal as the single-chip MultiMfTrainer on the same data,
+    and per-key pulled values keep per-slot widths."""
+    ds, desc = _ds(criteo_files)
+    with flags_scope(log_period_steps=10000):
+        sh_table = MultiMfShardedTable(N, _dims(), capacity_per_shard=2048,
+                                       cfg=_cfg(), req_bucket_min=256,
+                                       serve_bucket_min=256)
+        tr_m = MultiMfShardedTrainer(CtrDnn(hidden=(16, 8)), sh_table,
+                                     desc, mesh, tx=optax.adam(1e-2),
+                                     seed=3)
+        sc_table = MultiMfEmbeddingTable(_dims(), capacity=1 << 12,
+                                         cfg=_cfg(),
+                                         unique_bucket_min=1024)
+        tr_s = MultiMfTrainer(CtrDnn(hidden=(16, 8)), sc_table, desc,
+                              tx=optax.adam(1e-2), seed=3)
+    rm = rs = None
+    for _ in range(4):
+        rs = tr_s.train_pass(ds)
+    # the mesh takes N-batch global steps (12/pass vs 94/pass single
+    # chip) — give it more passes to reach the same optimizer-step count
+    for _ in range(8):
+        rm = tr_m.train_pass(ds)
+    assert np.isfinite(rm["last_loss"])
+    # both learn the planted signal; mesh quality tracks single-chip
+    assert rs["auc"] > 0.60, rs["auc"]
+    assert rm["auc"] > 0.60, rm["auc"]
+    assert abs(rm["auc"] - rs["auc"]) < 0.08, (rm["auc"], rs["auc"])
+    # every class table holds features on the mesh
+    assert all(t.feature_count() > 0 for t in sh_table.tables)
+    # per-slot width contract on the mesh pull
+    col = ds.columnar
+    keys = col.keys[:100].astype(np.uint64)
+    slots = col.key_slot[:100]
+    vals = sh_table.pull(keys, slots)
+    assert vals.shape == (100, 3 + 8)
+    dims = np.asarray(_dims())
+    for i in range(100):
+        np.testing.assert_allclose(vals[i, 3 + dims[slots[i]]:], 0.0)
+    assert (vals[:, 0] > 0).all()  # show counters accumulated
+
+
+def test_mmf_sharded_save_load_roundtrip(mesh, criteo_files, tmp_path):
+    ds, desc = _ds(criteo_files)
+    with flags_scope(log_period_steps=10000):
+        table = MultiMfShardedTable(N, _dims(), capacity_per_shard=2048,
+                                    cfg=_cfg(), req_bucket_min=256,
+                                    serve_bucket_min=256)
+        tr = MultiMfShardedTrainer(CtrDnn(hidden=(16, 8)), table, desc,
+                                   mesh, tx=optax.adam(1e-2))
+        tr.train_pass(ds)
+    path = str(tmp_path / "mmf_sharded")
+    n = table.save_base(path)
+    assert n == table.feature_count() > 0
+    t2 = MultiMfShardedTable(N, _dims(), capacity_per_shard=2048,
+                             cfg=_cfg())
+    assert t2.load(path) == n
+    col = ds.columnar
+    keys = col.keys[:50].astype(np.uint64)
+    slots = col.key_slot[:50]
+    np.testing.assert_allclose(t2.pull(keys, slots),
+                               table.pull(keys, slots), rtol=1e-6)
