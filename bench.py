@@ -99,6 +99,10 @@ def main() -> None:
     bs_default = {"uniform": 8192, "ragged": 4096, "thousand": 512}[shape]
     rec_default = {"uniform": 262_144, "ragged": 131_072,
                    "thousand": 32_768}[shape]
+    # per-slot vocab: thousand-slot workloads share the key budget (1000
+    # slots x 100k would overflow the 2^23-row table)
+    shape_vocab = {"uniform": 100_000, "ragged": 100_000,
+                   "thousand": 4_000}[shape]
     bs = int(os.environ.get("BENCH_BATCH_SIZE", bs_default))
     num_records = int(os.environ.get("BENCH_RECORDS", rec_default))
     mf_dim = int(os.environ.get("BENCH_MF_DIM", 8))
@@ -121,7 +125,7 @@ def main() -> None:
     def make_ds(seed: int) -> InMemoryDataset:
         d = InMemoryDataset(desc)
         d.records = build_records(num_records, num_slots=shape_slots,
-                                  seed=seed,
+                                  vocab_per_slot=shape_vocab, seed=seed,
                                   avg_keys_per_slot=shape_avg)
         d.columnarize()
         return d
@@ -180,7 +184,7 @@ def main() -> None:
         ds = make_ds(0)
         warm = InMemoryDataset(desc)
         warm.records = build_records(bs * 3, num_slots=shape_slots,
-                                     seed=99,
+                                     vocab_per_slot=shape_vocab, seed=99,
                                      avg_keys_per_slot=shape_avg)
         warm.columnarize()
         tr.train_pass(warm)

@@ -45,6 +45,9 @@ class LaunchConfig:
     coordinator: str = "127.0.0.1:8476"
     job_id: str = "default"
     elastic_root: Optional[str] = None  # KV dir; enables elastic restarts
+    # network KV (host:port of a KVServer) — elastic restarts with NO
+    # shared filesystem (TcpKVStore; overrides elastic_root)
+    elastic_endpoint: Optional[str] = None
     max_restarts: int = 3
     stop_grace_sec: float = 5.0
 
@@ -94,9 +97,14 @@ def launch_local(cmd: Sequence[str], cfg: LaunchConfig) -> int:
     gang (from the latest checkpoint pointer) on failure when elastic is
     enabled. Returns the final exit code (0 = all ranks clean)."""
     manager: Optional[ElasticManager] = None
-    if cfg.elastic_root:
+    if cfg.elastic_endpoint or cfg.elastic_root:
+        if cfg.elastic_endpoint:
+            from paddlebox_tpu.distributed.kv_server import TcpKVStore
+            kv = TcpKVStore(cfg.elastic_endpoint)
+        else:
+            kv = FileKVStore(cfg.elastic_root)
         manager = ElasticManager(
-            FileKVStore(cfg.elastic_root), cfg.job_id,
+            kv, cfg.job_id,
             host=f"local-{os.getpid()}", np=1, ttl=10.0)
         manager.register()
 

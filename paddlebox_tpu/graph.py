@@ -532,6 +532,10 @@ class GraphSamplerService:
         import threading
         if self._thread is not None:
             raise RuntimeError("service already started")
+        if self._stop:
+            raise RuntimeError(
+                "service was stopped (its channel is closed) — create a "
+                "new GraphSamplerService instead of restarting this one")
         self._thread = threading.Thread(
             target=self._run, args=(max_batches,), daemon=True)
         self._thread.start()

@@ -114,10 +114,10 @@ class MultiMfShardedTable(SlotClassMap):
         dy_mf CopyForPull contract; routes each key to its slot's class
         table, then to its owner shard inside it. Unknown keys zeros."""
         import jax
+        from paddlebox_tpu.ps.table import host_pull_block
         keys = np.ascontiguousarray(keys, np.uint64)
         slots = np.asarray(slots, np.int32)
         out = np.zeros((len(keys), 3 + max(self.dims)), np.float32)
-        from paddlebox_tpu.ps.table import FIELD_COL, NUM_FIXED
         for c, t in enumerate(self.tables):
             m = self.class_of_slot[slots] == c
             if not m.any():
@@ -132,13 +132,7 @@ class MultiMfShardedTable(SlotClassMap):
                     continue
                 rows = t.indexes[s].lookup(kc[sm])
                 known = rows >= 0
-                sub = data[s][rows[known]]
-                block = np.concatenate(
-                    [sub[:, FIELD_COL["show"]:FIELD_COL["clk"] + 1],
-                     sub[:, FIELD_COL["embed_w"]:FIELD_COL["embed_w"] + 1],
-                     sub[:, NUM_FIXED:NUM_FIXED + t.mf_dim]
-                     * (sub[:, FIELD_COL["mf_size"]:FIELD_COL["mf_size"]
-                            + 1] > 0)], axis=1)
+                block = host_pull_block(data[s][rows[known]], t.mf_dim)
                 tmp = np.zeros((int(sm.sum()), 3 + t.mf_dim), np.float32)
                 tmp[known] = block
                 vals[np.nonzero(sm)[0]] = tmp

@@ -238,6 +238,34 @@ def next_bucket(minimum: int, need: int) -> int:
     return cap
 
 
+def next_bucket_fine(minimum: int, need: int) -> int:
+    """FINE bucket ladder for resident whole-pass shapes: round ``need``
+    up to a step of ~1/16 its magnitude (pow2 steps, ≥512). A resident
+    pass compiles one runner for its uniform shape either way, so the
+    pow2 ladder's ≤100% padding is pure wire waste — this caps it at
+    ~6% while steps stay coarse enough that successive passes of one
+    workload almost always land on the same rung (bounded recompiles).
+    Steps are multiples of 512, preserving the wire packers' alignment
+    (pack_u18/pack_u16m need length % 4 == 0)."""
+    if need <= minimum:
+        return minimum  # exactly-tuned minimums stay padding-free
+    step = max(512, 1 << max(need.bit_length() - 5, 0))
+    return -(-need // step) * step
+
+
+def host_pull_block(vals: np.ndarray, mf_dim: int) -> np.ndarray:
+    """[k, F] gathered logical rows → [k, 3+mf] pull values (show, clk,
+    embed_w, mf_size-gated embedx) — THE host-side CopyForPull block
+    assembly, shared by every host pull (EmbeddingTable.host_pull,
+    MultiMfShardedTable.pull)."""
+    mf_end = NUM_FIXED + mf_dim
+    gate = vals[:, FIELD_COL["mf_size"]:FIELD_COL["mf_size"] + 1] > 0
+    return np.concatenate(
+        [vals[:, FIELD_COL["show"]:FIELD_COL["clk"] + 1],
+         vals[:, FIELD_COL["embed_w"]:FIELD_COL["embed_w"] + 1],
+         vals[:, NUM_FIXED:mf_end] * gate], axis=1)
+
+
 def fill_oob_pads(unique_rows: np.ndarray, u: int, capacity: int) -> None:
     """Fill positions [u:] with DISTINCT out-of-bounds row ids (> capacity).
 
@@ -497,13 +525,7 @@ class EmbeddingTable:
         if data is None:
             data = np.asarray(jax.device_get(self.state.data))
         vals = data[np.minimum(rows, self.capacity)]  # OOB pads clamp
-        mf_end = NUM_FIXED + self.mf_dim
-        gate = vals[:, FIELD_COL["mf_size"]:FIELD_COL["mf_size"] + 1] > 0
-        out = np.concatenate(
-            [vals[:, FIELD_COL["show"]:FIELD_COL["clk"] + 1],
-             vals[:, FIELD_COL["embed_w"]:FIELD_COL["embed_w"] + 1],
-             vals[:, NUM_FIXED:mf_end] * gate], axis=1)
-        return out[inv]
+        return host_pull_block(vals, self.mf_dim)[inv]
 
     def record_slots(self, rows: np.ndarray, inv: np.ndarray,
                      slot_of_key: np.ndarray) -> None:

@@ -320,7 +320,11 @@ class ResidentPass:
         offsets = col.offsets
         bounds = offsets[np.minimum(np.arange(nb + 1) * bs, r)]
         nk_arr = np.diff(bounds)
-        k_max = desc.key_capacity(int(nk_arr.max()))
+        # resident pass = ONE uniform shape: the fine ladder pads ≤ ~6%
+        # instead of the streaming pow2 bucket's ≤ 100% (pure wire waste
+        # on ragged passes whose max-K lands just past a pow2 rung)
+        from paddlebox_tpu.ps.table import next_bucket_fine
+        k_max = next_bucket_fine(desc.key_bucket_min, int(nk_arr.max()))
         counts = np.diff(offsets)
         # trivial layout = exactly one key per slot per record, slot-order:
         # segments are then derivable on device (DeviceBatch.segments)
@@ -385,7 +389,6 @@ class ResidentPass:
         under the table lock (deterministic row order), the sort/rank
         work fans out over a thread pool (numpy releases the GIL).
         Returns ([(uniq_sorted, gidx)] per batch, u_pad, k_max)."""
-        from paddlebox_tpu.ps.table import next_bucket
 
         def sort_rank(rows_u, inv):
             u = len(rows_u)
@@ -413,7 +416,8 @@ class ResidentPass:
                 futs.append(pool.submit(sort_rank, rows_u, inv))
             dedup = [f.result() for f in futs]
         u_max = max(len(u) + 1 for u, _ in dedup)
-        u_pad = next_bucket(table.unique_bucket_min, u_max)
+        from paddlebox_tpu.ps.table import next_bucket_fine
+        u_pad = next_bucket_fine(table.unique_bucket_min, u_max)
         k_max = max(kc for _, _, kc, _, _ in per_batch)
         return dedup, u_pad, k_max
 

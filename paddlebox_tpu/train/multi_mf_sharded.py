@@ -226,8 +226,8 @@ class MultiMfShardedTrainer:
         self.prefetch = prefetch
 
     def _group_iter(self, batches):
-        from paddlebox_tpu.train.sharded import ShardedTrainer
-        return ShardedTrainer._group_iter(self, batches)
+        from paddlebox_tpu.train.sharded import group_batches
+        return group_batches(batches, self.n)
 
     def _prep(self, group):
         # one split serves both the routing plans and the segments —

@@ -50,9 +50,7 @@ class MultiMfTrainStep:
         self.class_slots = [len(s) for s in table.class_slots]
         self.dims = table.dims
         # canonical reassembly order: (class, rank) per global slot
-        self.slot_route = [(int(table.class_of_slot[s]),
-                            int(table.slot_rank[s]))
-                           for s in range(table.num_slots)]
+        self.slot_route = table.slot_route()
         self._jit = jax.jit(self._step, donate_argnums=(0,))
 
     def init_params(self, dense_dim: int) -> Any:
@@ -217,6 +215,7 @@ class MultiMfTrainer:
         rp.upload()
         self.state = self.step_fn.run_resident(self.state, rp, self._rng)
         jax.block_until_ready(self.state.step)
+        rp.mark_trained_rows(self.table)
         self.global_step += rp.num_batches
         timer.pause()
         self.sync_table()
@@ -297,6 +296,18 @@ class MultiMfResidentPass:
                    jax.device_put(_jnp.asarray(ik)))
                   for iu, ik in self.class_ints),
             jax.device_put(_jnp.asarray(self.floats)))
+
+    def mark_trained_rows(self, table: MultiMfEmbeddingTable) -> None:
+        """Re-mark this pass's rows touched AFTER training: a delta save
+        landing between build (prepare marks at build time) and training
+        clears the flags and would otherwise drop the pass's updates from
+        the next delta (the ResidentPass.mark_trained_rows rationale)."""
+        for c, (iu, _ik) in enumerate(self.class_ints):
+            t = table.tables[c]
+            rows = np.unique(iu[:, :-2])  # last 2 cols = meta
+            rows = rows[(rows >= 0) & (rows < t.capacity)]
+            with t.host_lock:
+                t._touched[rows] = True
 
 
 def _mmf_resident_runner(step: MultiMfTrainStep, n_steps: int):

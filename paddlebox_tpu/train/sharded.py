@@ -483,6 +483,27 @@ class ShardedTrainStep:
         return state
 
 
+def group_batches(batches, n: int):
+    """Pack a batch stream into groups of ``n``; the tail group is padded
+    by repeating the last batch with show=0 AND clk=0 (so neither loss,
+    metrics, nor the pushed counters see the duplicated instances).
+    Shared by every mesh trainer (ShardedTrainer, MultiMfShardedTrainer)."""
+    import dataclasses as _dc
+    group: List[SlotBatch] = []
+    for bt in batches:
+        group.append(bt)
+        if len(group) == n:
+            yield group
+            group = []
+    if group:
+        filler = group[-1]
+        dead = _dc.replace(filler, show=np.zeros_like(filler.show),
+                           clk=np.zeros_like(filler.clk))
+        while len(group) < n:
+            group.append(dead)
+        yield group
+
+
 class ShardedTrainer:
     """Multi-chip trainer: groups the batch stream into N-device global
     batches, builds routing plans on host (prefetched), runs the sharded
@@ -513,24 +534,7 @@ class ShardedTrainer:
         self._threading = _threading
 
     def _group_iter(self, batches):
-        """Pack the batch stream into groups of N; the tail group is padded
-        by repeating the last batch with show=0 (contributes nothing)."""
-        group: List[SlotBatch] = []
-        for bt in batches:
-            group.append(bt)
-            if len(group) == self.n:
-                yield group
-                group = []
-        if group:
-            filler = group[-1]
-            import dataclasses as _dc
-            # dead batch: zero show AND clk so neither loss, metrics, nor the
-            # pushed show/clk counters see the duplicated instances
-            dead = _dc.replace(filler, show=np.zeros_like(filler.show),
-                               clk=np.zeros_like(filler.clk))
-            while len(group) < self.n:
-                group.append(dead)
-            yield group
+        return group_batches(batches, self.n)
 
     def _prefetch_iter(self, batches):
         from paddlebox_tpu.utils.prefetch import prefetch_iter

@@ -124,6 +124,177 @@ def test_launch_elastic_restart_resumes_from_checkpoint(tmp_path):
     assert (marker / "1").read_text().endswith("ckpt_pass3")
 
 
+ELASTIC_WORKER = """
+import json, os, pathlib, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import optax
+
+from paddlebox_tpu.config import FLAGS
+from paddlebox_tpu.data import DataFeedDesc, DatasetFactory
+from paddlebox_tpu.data.criteo import generate_criteo_files
+from paddlebox_tpu.distributed import ElasticManager, TcpKVStore
+from paddlebox_tpu.models import DeepFM
+from paddlebox_tpu.parallel import make_mesh
+from paddlebox_tpu.ps import SparseSGDConfig
+from paddlebox_tpu.ps.sharded import ShardedEmbeddingTable
+from paddlebox_tpu.train.checkpoint import CheckpointManager
+from paddlebox_tpu.train.sharded import ShardedTrainer
+
+rank = int(os.environ["PBOX_RANK"])
+world = int(os.environ["PBOX_WORLD_SIZE"])
+out_dir = pathlib.Path(sys.argv[1])
+n_passes = int(os.environ["N_PASSES"])
+kill_after = os.environ.get("KILL_RANK1_AFTER_PASS")
+resume = os.environ.get("PBOX_RESUME_CKPT")
+FLAGS.log_period_steps = 10 ** 9
+
+# membership over the NETWORK KV (the etcd lease/watch flow); the
+# worker MEMBERSHIP job is distinct from the launcher's own job, but
+# checkpoint pointers publish to the LAUNCHER's job id ("jobE") — that
+# is where launch_local reads the restart pointer from
+kv = TcpKVStore(os.environ["KV_ENDPOINT"])
+em = ElasticManager(kv, "jobE-workers", f"host{rank}", np=world,
+                    min_np=world, ttl=5.0)
+pub = ElasticManager(kv, "jobE", f"pub{rank}", np=1)  # not registered
+em.register()
+em.wait_for_np(timeout=60)
+
+# per-rank data shard (generated, deterministic)
+data_dir = out_dir / f"data_r{rank}"
+files = generate_criteo_files(str(data_dir), num_files=1,
+                              rows_per_file=600, vocab_per_slot=30,
+                              seed=100 + rank)
+desc = DataFeedDesc.criteo(batch_size=32)
+desc.key_bucket_min = 1024
+ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+ds.set_filelist(files)
+ds.load_into_memory()
+
+MESH_N = 4
+cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=0.0,
+                      learning_rate=0.1, mf_learning_rate=0.1)
+table = ShardedEmbeddingTable(MESH_N, mf_dim=4, capacity_per_shard=4096,
+                              cfg=cfg, req_bucket_min=128,
+                              serve_bucket_min=128)
+tr = ShardedTrainer(DeepFM(hidden=(16, 16)), table, desc, make_mesh(MESH_N),
+                    tx=optax.adam(2e-3), seed=7 + rank)
+nb_per_pass = sum(1 for _ in tr._group_iter(ds.batches()))
+
+cm = CheckpointManager(str(out_dir / f"ckpt_r{rank}"), keep=10)
+start_pass = 0
+if resume:
+    restored = cm.restore(tr)
+    if restored is not None:
+        start_pass = restored // nb_per_pass
+        print(f"rank {rank}: resumed step {restored} -> pass {start_pass}",
+              flush=True)
+
+res = None
+for p in range(start_pass, n_passes):
+    res = tr.train_pass(ds)
+    if kill_after is not None and resume is None and rank == 1 \\
+            and p == int(kill_after):
+        # die WITHOUT checkpointing this pass: the work since the last
+        # save is lost; the restarted gang must replay it from the
+        # published pointer
+        os._exit(1)
+    cm.save(tr)
+    if rank == 0:
+        pub.publish_checkpoint(str(out_dir), pass_id=p)
+
+if res is not None:
+    params = np.concatenate([np.asarray(l).ravel()
+                             for l in jax.tree.leaves(tr.state.params)])
+    out = dict(rank=rank, auc=float(res["auc"]),
+               last_loss=float(res["last_loss"]),
+               global_step=int(tr.global_step),
+               param_sum=float(np.abs(params).sum()),
+               features=int(table.feature_count()))
+    with open(out_dir / f"final_r{rank}.json", "w") as fh:
+        json.dump(out, fh)
+    np.save(out_dir / f"params_r{rank}.npy", params)
+else:
+    # this rank had already finished before a peer-triggered gang
+    # restart — its final artifacts are on disk from the first attempt
+    assert (out_dir / f"final_r{rank}.json").exists()
+em.deregister()
+"""
+
+
+@pytest.mark.slow
+def test_elastic_restart_of_real_sharded_trainer(tmp_path):
+    """THE elastic flagship (fleet/elastic/manager.py:131,248-250): a
+    2-process gang of REAL ShardedTrainers (4-dev virtual CPU mesh each),
+    membership over TcpKVStore. Rank 1 is killed mid-run WITHOUT saving
+    its in-flight pass; the launcher restarts the gang from the published
+    checkpoint pointer; both ranks resume at their last pass boundary.
+    The final AUC/loss/params must MATCH an uninterrupted run."""
+    import json
+    import subprocess
+    import numpy as np
+    from paddlebox_tpu.distributed import KVServer
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = tmp_path / "worker.py"
+    worker.write_text(ELASTIC_WORKER)
+    n_passes = 4
+
+    def run(out_dir, kill: bool, endpoint: str) -> int:
+        out_dir.mkdir()
+        env_extra = {
+            "PBOX_WORLD_SIZE": "2", "KV_ENDPOINT": endpoint,
+            "N_PASSES": str(n_passes), "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "PYTHONPATH": repo + os.pathsep
+            + os.environ.get("PYTHONPATH", ""),
+        }
+        if kill:
+            env_extra["KILL_RANK1_AFTER_PASS"] = "1"
+        old = {k: os.environ.get(k) for k in env_extra}
+        os.environ.update(env_extra)
+        try:
+            rc = launch_local(
+                [sys.executable, str(worker), str(out_dir)],
+                LaunchConfig(nproc=2, job_id="jobE",
+                             elastic_endpoint=endpoint, max_restarts=2,
+                             stop_grace_sec=15.0))
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        return rc
+
+    srv = KVServer()
+    try:
+        assert run(tmp_path / "killed", kill=True,
+                   endpoint=srv.endpoint) == 0
+    finally:
+        srv.close()
+    srv2 = KVServer()
+    try:
+        assert run(tmp_path / "clean", kill=False,
+                   endpoint=srv2.endpoint) == 0
+    finally:
+        srv2.close()
+
+    for r in range(2):
+        a = json.load(open(tmp_path / "killed" / f"final_r{r}.json"))
+        b = json.load(open(tmp_path / "clean" / f"final_r{r}.json"))
+        assert a["global_step"] == b["global_step"], (a, b)
+        assert a["features"] == b["features"], (a, b)
+        assert np.isclose(a["auc"], b["auc"], atol=1e-6), (a, b)
+        assert np.isclose(a["last_loss"], b["last_loss"],
+                          atol=1e-6), (a, b)
+        pa = np.load(tmp_path / "killed" / f"params_r{r}.npy")
+        pb = np.load(tmp_path / "clean" / f"params_r{r}.npy")
+        np.testing.assert_allclose(pa, pb, rtol=1e-6, atol=1e-7)
+
+
 def test_tcp_kv_store_matches_file_kv(tmp_path):
     """TcpKVStore speaks the full KVStore contract against a KVServer —
     drop-in for FileKVStore with no shared filesystem."""
