@@ -426,6 +426,38 @@ class InMemoryDataset(Dataset):
                 self._pass_keys = np.empty(0, dtype=np.uint64)
         return self._pass_keys
 
+    def pass_key_slots(self):
+        """(unique keys, slot id of each) — the pass working set WITH
+        slots, for tables whose routing needs the slot (multi-mf tiered:
+        a key's dim class is its slot's property).
+
+        CONTRACT: a key value must belong to exactly ONE slot (CTR
+        feasigns are slot-qualified — the native parser bakes
+        ``(slot+1) << 52`` into every key). A key seen under two slots
+        would stage into only one dim class and silently reset its other
+        class's values each pass, so that case raises here."""
+        if self.columnar is not None:
+            keys, first = np.unique(self.columnar.keys, return_index=True)
+            pairs = np.unique(np.stack(
+                [self.columnar.keys,
+                 self.columnar.key_slot.astype(np.uint64)]), axis=1)
+            if pairs.shape[1] != len(keys):
+                raise ValueError(
+                    "pass_key_slots: some key value appears under more "
+                    "than one slot — multi-mf routing requires "
+                    "slot-qualified keys (one slot per key value)")
+            return keys, self.columnar.key_slot[first].astype(np.int32)
+        if self.records:
+            all_keys = np.concatenate([r.keys for r in self.records])
+            all_slots = np.concatenate([
+                np.repeat(np.arange(len(r.slot_offsets) - 1,
+                                    dtype=np.int32),
+                          np.diff(r.slot_offsets))
+                for r in self.records])
+            keys, first = np.unique(all_keys, return_index=True)
+            return keys, all_slots[first]
+        return (np.empty(0, dtype=np.uint64), np.empty(0, dtype=np.int32))
+
     def __len__(self) -> int:
         if self.columnar is not None:
             return self.columnar.num_records

@@ -159,6 +159,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--job-id", default="default")
     ap.add_argument("--elastic-root", default=None,
                     help="shared KV dir; enables elastic restart")
+    ap.add_argument("--elastic-endpoint", default=None,
+                    help="KVServer host:port (network KV, no shared "
+                         "filesystem); enables elastic restart and "
+                         "overrides --elastic-root")
     ap.add_argument("--max-restarts", type=int, default=3)
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="worker command (e.g. python train.py ...)")
@@ -170,6 +174,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         ap.error("missing worker command")
     cfg = LaunchConfig(nproc=args.nproc, coordinator=args.coordinator,
                        job_id=args.job_id, elastic_root=args.elastic_root,
+                       elastic_endpoint=args.elastic_endpoint,
                        max_restarts=args.max_restarts)
     return launch_local(cmd, cfg)
 

@@ -68,7 +68,11 @@ class BoxPSHelper:
         """Promote the pass working set into HBM and point the trainer's
         jit state at it."""
         self.pass_id += 1
-        n = self.table.begin_pass(ds.pass_keys())
+        if getattr(self.table, "wants_slot_keys", False):
+            # multi-mf tiered: keys route by their slot's dim class
+            n = self.table.begin_pass(*ds.pass_key_slots())
+        else:
+            n = self.table.begin_pass(ds.pass_keys())
         if self.trainer is not None:
             self.trainer.adopt_table()
         return n

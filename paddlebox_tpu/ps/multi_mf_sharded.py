@@ -35,18 +35,21 @@ class MultiMfShardedTable(SlotClassMap):
                  capacity_per_class: Optional[Dict[int, int]] = None,
                  cfg: Optional[SparseSGDConfig] = None,
                  req_bucket_min: int = 512,
-                 serve_bucket_min: int = 1024) -> None:
+                 serve_bucket_min: int = 1024, **table_kw) -> None:
         super().__init__(slot_mf_dims)
         self.n = num_shards
         self.cfg = cfg or SparseSGDConfig()
         caps = capacity_per_class or {}
         self.tables: List[ShardedEmbeddingTable] = [
-            ShardedEmbeddingTable(
-                num_shards, mf_dim=d,
+            self._make_class_table(
+                num_shards, d,
                 capacity_per_shard=caps.get(d, capacity_per_shard),
                 cfg=cfg, req_bucket_min=req_bucket_min,
-                serve_bucket_min=serve_bucket_min)
+                serve_bucket_min=serve_bucket_min, **table_kw)
             for d in self.dims]
+
+    def _make_class_table(self, num_shards: int, mf_dim: int, **kw):
+        return ShardedEmbeddingTable(num_shards, mf_dim=mf_dim, **kw)
 
     # ------------------------------------------------------------------
     def prepare_global(self, batches: List[SlotBatch], assign: bool = True,
@@ -109,6 +112,24 @@ class MultiMfShardedTable(SlotClassMap):
         return sum(t.merge_model(f"{path}.mf{d}.npz")
                    for t, d in zip(self.tables, self.dims))
 
+    def merge_models(self, paths, update_type: str = "stats") -> int:
+        """MergeMultiModels across dim classes (box_wrapper.h:812-815) —
+        defined once here; the tiered subclass inherits it and its calls
+        dispatch to the tiered merge_model/load overrides."""
+        if update_type not in ("stats", "overwrite"):
+            raise ValueError(f"unknown update_type {update_type!r}")
+        return sum((self.merge_model(p) if update_type == "stats"
+                    else self.load(p, merge=True)) for p in paths)
+
+    def split_keys_by_class(self, keys: np.ndarray, slots: np.ndarray):
+        """Unique (key, slot-class) routing for pass working sets: each
+        key goes to its slot's class table. Returns per-class key
+        arrays."""
+        keys = np.ascontiguousarray(keys, np.uint64)
+        slots = np.asarray(slots, np.int32)
+        cls = self.class_of_slot[slots]
+        return [np.unique(keys[cls == c]) for c in range(self.num_classes)]
+
     def pull(self, keys: np.ndarray, slots: np.ndarray) -> np.ndarray:
         """Host-side per-key pull padded to the MAX class width — the
         dy_mf CopyForPull contract; routes each key to its slot's class
@@ -136,5 +157,93 @@ class MultiMfShardedTable(SlotClassMap):
                 tmp = np.zeros((int(sm.sum()), 3 + t.mf_dim), np.float32)
                 tmp[known] = block
                 vals[np.nonzero(sm)[0]] = tmp
+            out[np.nonzero(m)[0], :vals.shape[1]] = vals
+        return out
+
+
+class MultiMfTieredShardedTable(MultiMfShardedTable):
+    """Per-slot embedding dims × beyond-HBM tiering × mesh sharding — the
+    full cross-product: each dim class is a TieredShardedEmbeddingTable
+    (per-shard HostStores with pass windows), routed by the shared
+    SlotClassMap. The pass lifecycle fans out across classes; the
+    lifecycle/save surface is inherited (per-class delegation, and each
+    class table's methods already run on its host tier).
+
+    Pass keys must arrive WITH their slots (``stage(keys, slots)``) —
+    a key's dim class is a property of its slot, not its value
+    (feature_value.h: mf_dim rides the slot config)."""
+
+    wants_slot_keys = True  # BoxPSHelper passes (keys, slots)
+
+    def __init__(self, num_shards: int, slot_mf_dims: Sequence[int],
+                 capacity_per_shard: Optional[int] = None,
+                 capacity_per_class: Optional[Dict[int, int]] = None,
+                 cfg: Optional[SparseSGDConfig] = None,
+                 req_bucket_min: int = 512,
+                 serve_bucket_min: int = 1024,
+                 host_capacity: Optional[int] = None) -> None:
+        super().__init__(num_shards, slot_mf_dims,
+                         capacity_per_shard=capacity_per_shard,
+                         capacity_per_class=capacity_per_class, cfg=cfg,
+                         req_bucket_min=req_bucket_min,
+                         serve_bucket_min=serve_bucket_min,
+                         host_capacity=host_capacity)
+
+    def _make_class_table(self, num_shards: int, mf_dim: int, **kw):
+        from paddlebox_tpu.ps.tiered import TieredShardedEmbeddingTable
+        return TieredShardedEmbeddingTable(num_shards, mf_dim=mf_dim, **kw)
+
+    @property
+    def in_pass(self) -> bool:
+        return any(t.in_pass for t in self.tables)
+
+    # ---- pass lifecycle across classes ----
+    def stage(self, keys: np.ndarray, slots: np.ndarray,
+              background: bool = True) -> None:
+        for c, ks in enumerate(self.split_keys_by_class(keys, slots)):
+            self.tables[c].stage(ks, background=background)
+
+    def wait_stage_done(self) -> None:
+        for t in self.tables:
+            t.wait_stage_done()
+
+    def begin_pass(self, keys: Optional[np.ndarray] = None,
+                   slots: Optional[np.ndarray] = None) -> int:
+        if keys is not None:
+            per = self.split_keys_by_class(keys, slots)
+            return sum(t.begin_pass(ks)
+                       for t, ks in zip(self.tables, per))
+        return sum(t.begin_pass() for t in self.tables)
+
+    def end_pass(self) -> int:
+        return sum(t.end_pass() for t in self.tables)
+
+    def spill_cold(self, path_prefix: str, threshold: float) -> int:
+        return sum(t.spill_cold(f"{path_prefix}.mf{d}", threshold)
+                   for t, d in zip(self.tables, self.dims))
+
+    def pull(self, keys: np.ndarray, slots: np.ndarray) -> np.ndarray:
+        """Host-tier pull with per-slot widths (the parent reads the HBM
+        window's indexes — between passes those hold only the last
+        window; the FULL model lives in the per-shard host stores)."""
+        keys = np.ascontiguousarray(keys, np.uint64)
+        slots = np.asarray(slots, np.int32)
+        out = np.zeros((len(keys), 3 + max(self.dims)), np.float32)
+        for c, t in enumerate(self.tables):
+            m = self.class_of_slot[slots] == c
+            if not m.any():
+                continue
+            kc = keys[m]
+            vals = np.zeros((len(kc), 3 + t.mf_dim), np.float32)
+            owners = (kc % np.uint64(t.n)).astype(np.int64)
+            for s in range(t.n):
+                sm = owners == s
+                if not sm.any():
+                    continue
+                f = t.hosts[s].fetch(kc[sm])
+                gate = (f["mf_size"][:, None] > 0)
+                vals[np.nonzero(sm)[0]] = np.concatenate(
+                    [f["show"][:, None], f["clk"][:, None],
+                     f["embed_w"][:, None], f["embedx_w"] * gate], axis=1)
             out[np.nonzero(m)[0], :vals.shape[1]] = vals
         return out

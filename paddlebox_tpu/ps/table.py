@@ -253,6 +253,20 @@ def next_bucket_fine(minimum: int, need: int) -> int:
     return -(-need // step) * step
 
 
+def _flatten_sharded_blob(blob):
+    """Adapt a sharded-format save (``n`` + per-shard ``keys_s``/field_s
+    blocks, written by ShardedEmbeddingTable._dump and the tiered table)
+    to the single-table mapping ``load``/``merge_model`` consume."""
+    if "n" not in blob:
+        return blob
+    fn = int(blob["n"])
+    out = {"keys": np.concatenate([blob[f"keys_{s}"] for s in range(fn)])}
+    for f in list(FIELDS) + ["opt_ext"]:
+        if f"{f}_0" in blob:
+            out[f] = np.concatenate([blob[f"{f}_{s}"] for s in range(fn)])
+    return out
+
+
 def host_pull_block(vals: np.ndarray, mf_dim: int) -> np.ndarray:
     """[k, F] gathered logical rows → [k, 3+mf] pull values (show, clk,
     embed_w, mf_size-gated embedx) — THE host-side CopyForPull block
@@ -660,8 +674,11 @@ class EmbeddingTable:
 
     def load(self, path: str, merge: bool = False) -> int:
         """Load a save_base/save_delta file; merge=True keeps existing rows
-        (delta apply), else resets the table first."""
-        blob = np.load(path)
+        (delta apply), else resets the table first. Sharded-format saves
+        (ShardedEmbeddingTable/tiered, any shard count) load too — their
+        per-shard blocks concatenate into one table (the serving consumer
+        of a pod-trained model)."""
+        blob = _flatten_sharded_blob(np.load(path))
         keys = blob["keys"]
         with self.host_lock:
             if not merge:
@@ -694,7 +711,7 @@ class EmbeddingTable:
         - unseen keys: inserted wholesale (all fields from the file).
 
         Returns the number of rows merged."""
-        blob = np.load(path)
+        blob = _flatten_sharded_blob(np.load(path))
         keys = blob["keys"]
         if len(keys) == 0:
             return 0

@@ -292,3 +292,9 @@ class MultiMfShardedTrainer:
     def sync_table(self) -> None:
         for t, st in zip(self.table.tables, self.state.tables):
             t.state = st
+
+    def adopt_table(self) -> None:
+        """Point the jit state at the class tables' (re)built device
+        states — after a tiered begin_pass promotes new pass windows."""
+        self.state = self.state._replace(
+            tables=tuple(t.state for t in self.table.tables))

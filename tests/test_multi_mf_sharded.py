@@ -144,3 +144,117 @@ def test_mmf_sharded_save_load_roundtrip(mesh, criteo_files, tmp_path):
     slots = col.key_slot[:50]
     np.testing.assert_allclose(t2.pull(keys, slots),
                                table.pull(keys, slots), rtol=1e-6)
+
+
+def _write_offset_pass_mmf(tmp_path, pass_id, vocab=40, rows=600):
+    """Criteo files with per-pass disjoint value ranges (fresh features
+    each pass — the day-k workload for the tiered window tests)."""
+    import os
+    rng = np.random.default_rng(300 + pass_id)
+    d = tmp_path / f"mmfoff{pass_id}"
+    os.makedirs(str(d), exist_ok=True)
+    path = str(d / "part.txt")
+    base = pass_id * vocab
+    with open(path, "w") as fh:
+        for _ in range(rows):
+            dense = rng.integers(0, 100, size=13)
+            cats = base + rng.integers(0, vocab, size=26)
+            label = int(rng.random() < 0.5)
+            fh.write(f"{label}\t" + "\t".join(str(int(v)) for v in dense)
+                     + "\t" + "\t".join(format(int(c), "x") for c in cats)
+                     + "\n")
+    desc = DataFeedDesc.criteo(batch_size=32)
+    desc.key_bucket_min = 1024
+    ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+    ds.set_filelist([path])
+    ds.load_into_memory()
+    return ds, desc
+
+
+def test_mmf_tiered_full_cross_product(mesh, tmp_path):
+    """Per-slot dims x beyond-HBM tiering x mesh sharding: 3 dim classes,
+    3 disjoint day-passes, per-class capacity_per_shard far below the
+    union — the host tiers carry the full model across pass windows, and
+    save/load round-trips the whole thing."""
+    from paddlebox_tpu.ps import BoxPSHelper
+    from paddlebox_tpu.ps.multi_mf_sharded import MultiMfTieredShardedTable
+    built = [_write_offset_pass_mmf(tmp_path, p) for p in range(3)]
+    desc = built[0][1]
+    table = MultiMfTieredShardedTable(
+        N, _dims(), capacity_per_shard=128, cfg=_cfg(),
+        req_bucket_min=64, serve_bucket_min=64)
+    with flags_scope(log_period_steps=10000):
+        tr = MultiMfShardedTrainer(CtrDnn(hidden=(16, 8)), table, desc,
+                                   mesh, tx=optax.adam(1e-2))
+    helper = BoxPSHelper(table, trainer=tr)
+    for ds, _ in built:
+        helper.begin_pass(ds)
+        r = tr.train_pass(ds)
+        assert np.isfinite(r["last_loss"])
+        helper.end_pass(ds)
+    total = table.feature_count()
+    # union exceeds any single class's HBM window by construction:
+    # 3 passes x 26 slots x 40 vocab of mostly-disjoint keys
+    assert total > 2000, total
+    for t in table.tables:
+        for s in range(N):
+            assert len(t.indexes[s]) <= t.capacity
+    # host-tier pull serves per-slot widths for keys from EVERY pass
+    ds0 = built[0][0]
+    col = ds0.columnar
+    keys = col.keys[:60].astype(np.uint64)
+    slots = col.key_slot[:60]
+    vals = table.pull(keys, slots)
+    dims = np.asarray(_dims())
+    assert (vals[:, 0] > 0).all()  # show counters from pass 0 persisted
+    for i in range(60):
+        np.testing.assert_allclose(vals[i, 3 + dims[slots[i]]:], 0.0)
+    # full save/load round-trip through the tiers
+    path = str(tmp_path / "mmf_tiered")
+    n = table.save_base(path)
+    assert n == total
+    t2 = MultiMfTieredShardedTable(
+        N, _dims(), capacity_per_shard=128, cfg=_cfg())
+    assert t2.load(path) == n
+    np.testing.assert_allclose(t2.pull(keys, slots),
+                               table.pull(keys, slots), rtol=1e-6)
+
+
+def test_mmf_tiered_matches_untired(mesh, tmp_path):
+    """Tiering stays TRANSPARENT under multi-mf: when everything fits,
+    the tiered cross-product equals the plain multi-mf sharded table
+    trained straight through."""
+    from paddlebox_tpu.ps import BoxPSHelper
+    from paddlebox_tpu.ps.multi_mf_sharded import MultiMfTieredShardedTable
+    ds, desc = _ds(generate_criteo_files(
+        str(tmp_path / "flat"), num_files=1, rows_per_file=800,
+        vocab_per_slot=30, seed=23))
+    with flags_scope(log_period_steps=10000):
+        plain = MultiMfShardedTable(N, _dims(), capacity_per_shard=2048,
+                                    cfg=_cfg(), req_bucket_min=128,
+                                    serve_bucket_min=128)
+        tr_a = MultiMfShardedTrainer(CtrDnn(hidden=(16, 8)), plain, desc,
+                                     mesh, tx=optax.adam(1e-2))
+        tiered = MultiMfTieredShardedTable(
+            N, _dims(), capacity_per_shard=2048, cfg=_cfg(),
+            req_bucket_min=128, serve_bucket_min=128)
+        tr_b = MultiMfShardedTrainer(CtrDnn(hidden=(16, 8)), tiered, desc,
+                                     mesh, tx=optax.adam(1e-2))
+    helper = BoxPSHelper(tiered, trainer=tr_b)
+    ra = rb = None
+    for _ in range(2):
+        ra = tr_a.train_pass(ds)
+        helper.begin_pass(ds)
+        rb = tr_b.train_pass(ds)
+        helper.end_pass(ds)
+    assert np.isclose(rb["auc"], ra["auc"], atol=1e-6), (rb["auc"], ra["auc"])
+    for x, y in zip(jax.tree.leaves(tr_a.state.params),
+                    jax.tree.leaves(tr_b.state.params)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=1e-5, atol=1e-7)
+    col = ds.columnar
+    keys = col.keys[:80].astype(np.uint64)
+    slots = col.key_slot[:80]
+    np.testing.assert_allclose(tiered.pull(keys, slots),
+                               plain.pull(keys, slots),
+                               rtol=1e-5, atol=1e-7)

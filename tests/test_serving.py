@@ -81,3 +81,46 @@ def test_embed_lookup_known_and_unknown(trained):
     # duplicate keys map to identical values
     v2 = srv.embed_lookup(np.array([some[0], some[0]], np.uint64))
     np.testing.assert_array_equal(v2[0], v2[1])
+
+
+def test_serving_consumes_sharded_save(tmp_path):
+    """A pod-trained model (ShardedEmbeddingTable save: per-shard blocks)
+    loads into the single-table serving consumer — per-key values match
+    the sharded host pull."""
+    import numpy as np
+    import jax as _jax
+    from paddlebox_tpu.ps.sharded import ShardedEmbeddingTable
+    from paddlebox_tpu.ps.table import FIELD_COL
+    N = 8
+    cfg = SparseSGDConfig(mf_create_thresholds=1e9)
+    sh = ShardedEmbeddingTable(N, mf_dim=4, capacity_per_shard=64, cfg=cfg)
+    keys = np.arange(1, 101, dtype=np.uint64)
+    owners = (keys % np.uint64(N)).astype(np.int64)
+    data = np.asarray(_jax.device_get(sh.state.data)).copy()
+    for s in range(N):
+        ks = keys[owners == s]
+        rows = sh.indexes[s].assign(ks)
+        data[s][rows, FIELD_COL["embed_w"]] = ks.astype(np.float32) * 3
+        data[s][rows, FIELD_COL["show"]] = 2.0
+    sh.state = type(sh.state).from_logical(data, sh.capacity)
+    path = str(tmp_path / "pod.npz")
+    n = sh.save_base(path)
+    assert n == 100
+
+    t = EmbeddingTable(mf_dim=4, capacity=1 << 10, cfg=cfg)
+    assert t.load(path) == 100
+    vals = t.host_pull(keys)
+    np.testing.assert_allclose(vals[:, 2], keys.astype(np.float32) * 3)
+    np.testing.assert_allclose(vals[:, 0], 2.0)
+    # unknown key reads zeros after a sharded-format load too
+    assert not np.any(t.host_pull(np.array([999999], np.uint64)))
+    # merge_model accepts the sharded format as well (stat accumulate)
+    t2 = EmbeddingTable(mf_dim=4, capacity=1 << 10, cfg=cfg)
+    r2 = t2.index.assign(keys[:10])
+    d2 = np.asarray(_jax.device_get(t2.state.data)).copy()
+    d2[r2, 0] = 1.0  # show
+    from paddlebox_tpu.ps.table import TableState
+    t2.state = TableState.from_logical(d2, t2.capacity)
+    assert t2.merge_model(path) == 100
+    got = t2.host_pull(keys[:1])
+    assert got[0, 0] == 3.0  # 1 + 2 accumulated
