@@ -243,10 +243,14 @@ class ResidentPass:
             np.nonzero(valid)[0].astype(np.int32)
         loc_t = tuple(jax.device_put(a)
                       for a in cls._encode_locals(locs, bits))
-        segs_t = ((jax.device_put(np.zeros((1, 1), np.int32)),)
-                  if segs is None else
-                  tuple(jax.device_put(a)
-                        for a in cls._encode_gidx(segs)))
+        if segs is None:
+            segs_t = (jax.device_put(np.zeros((1, 1), np.int32)),)
+        else:
+            enc = cls._encode_segs_slotwire(segs, meta, floats.shape[1])
+            segs_t = (tuple(jax.device_put(a) for a in enc)
+                      if enc is not None else
+                      tuple(jax.device_put(a)
+                            for a in cls._encode_gidx(segs)))
         rp = cls(rows_g, locs, floats, meta, segs, nrec, qmeta=qmeta,
                  side=side)
         rp.wire = "compact"
@@ -465,9 +469,15 @@ class ResidentPass:
                          self._encode_uniq(self.uniq, self.meta))
             gidx = tuple(jnp.asarray(a) for a in
                          self._encode_gidx(self.gidx))
-            segs = ((jnp.zeros((1, 1), jnp.int32),) if self.segs is None
-                    else tuple(jnp.asarray(a)
-                               for a in self._encode_gidx(self.segs)))
+            if self.segs is None:
+                segs = (jnp.zeros((1, 1), jnp.int32),)
+            else:
+                enc = self._encode_segs_slotwire(
+                    self.segs, self.meta,
+                    self.floats.shape[1])
+                segs = tuple(jnp.asarray(a) for a in
+                             (enc if enc is not None
+                              else self._encode_gidx(self.segs)))
             qm = (jnp.zeros((2, 0), jnp.float32) if self.qmeta is None
                   else jnp.asarray(self.qmeta))
             self.dev = (uniq, gidx, jnp.asarray(self.floats),
@@ -503,6 +513,40 @@ class ResidentPass:
                 and gidx.shape[1] % 4 == 0):
             return pack_u18(gidx)
         return (gidx,)
+
+    @staticmethod
+    def _encode_segs_slotwire(segs: np.ndarray, meta: np.ndarray,
+                              batch_size: int):
+        """SLOT wire for non-trivial segments: ship per-key SLOT ids (u8)
+        plus per-record key COUNTS (u16) instead of u18 segments — the
+        device rebuilds ``segments = record * S + slot`` with one cumsum
+        + searchsorted (≈1 B/key instead of 2.25). Preconditions (else
+        None → the u18 wire): S ≤ 255, per-record counts ≤ 65535, keys
+        grouped by record in record order, and pad_segment == B·S (pads
+        then decode for free: record index saturates at B, slot pads 0)."""
+        nb, k = segs.shape
+        b = batch_size
+        s = int(meta[0, 1]) // b          # pad_segment == bs * S
+        if s <= 0 or s > 255 or int(meta[0, 1]) != b * s:
+            return None
+        slot = segs % s
+        rec = segs // s
+        counts = np.zeros((nb, b), np.int64)
+        for i in range(nb):
+            nk = int(meta[i, 0])
+            r = rec[i, :nk]
+            if nk and (np.diff(r) < 0).any():
+                return None               # keys not record-grouped
+            if nk and int(r.max()) >= b:
+                return None
+            counts[i] = np.bincount(r, minlength=b)
+            if segs[i, nk:].size and (segs[i, nk:] != b * s).any():
+                return None               # pads must be the discard bin
+        if int(counts.max()) > 65535:
+            return None
+        # numpy out, like every sibling encoder — transfer timing stays
+        # with the caller
+        return (slot.astype(np.uint8), counts.astype(np.uint16))
 
     def nbytes(self) -> int:
         """Wire bytes (after upload packing; host estimate before)."""
@@ -563,10 +607,27 @@ class ResidentPassRunner:
         self._jit: Dict[int, object] = {}  # n_steps → compiled runner
 
     @staticmethod
-    def _decode_segs(segs):
-        """segments arrive raw, as a u18-packed pair (ops/bitpack), or
-        as a bare array (hand-built passes / direct test calls)."""
+    def _decode_segs(segs, meta=None):
+        """segments arrive raw, as a u18-packed pair (ops/bitpack), as
+        the SLOT wire (u8 slots + u16 per-record counts — see
+        _encode_segs_slotwire), or as a bare array (hand-built passes /
+        direct test calls). The pair kinds are distinguished statically
+        by the first leaf's dtype (u18 lows are uint16). The SLOT wire
+        derives S from ``meta``: pad_segment == B·S and B is the counts
+        length — no runner configuration needed."""
         if isinstance(segs, tuple):
+            if len(segs) == 2 and segs[0].dtype == jnp.uint8:
+                slot = segs[0].astype(jnp.int32)          # [K]
+                counts = segs[1].astype(jnp.int32)        # [B]
+                k = slot.shape[0]
+                s = meta[1] // counts.shape[0]            # pad_seg // B
+                cum = jnp.cumsum(counts)
+                rec = jnp.searchsorted(
+                    cum, jnp.arange(k, dtype=jnp.int32),
+                    side="right").astype(jnp.int32)
+                # pads: rec saturates at B and slot pads are 0, so the
+                # reconstruction lands exactly on pad_segment == B*S
+                return rec * s + slot
             if len(segs) == 2:
                 return unpack_u16m(segs[0], segs[1], 2)
             return segs[0]
@@ -574,7 +635,7 @@ class ResidentPassRunner:
 
     def _make_view(self, uniq_t, gidx_t, floats, meta,
                    segs, qmeta) -> _BatchView:
-        segs = self._decode_segs(segs)
+        segs = self._decode_segs(segs, meta)
         if self.wire == "compact":
             return self._make_view_compact(uniq_t, gidx_t[0], floats,
                                            meta, segs, qmeta)

@@ -465,6 +465,42 @@ def test_compact_falls_back_after_slotless_assign(criteo_files):
     assert np.isfinite(res["auc"])
 
 
+def test_slot_wire_roundtrips_segments():
+    """The SLOT segment wire (u8 slots + u16 per-record counts) must
+    reconstruct the exact u18 segment stream, pads included."""
+    from paddlebox_tpu.train.device_pass import (ResidentPass,
+                                                 ResidentPassRunner)
+    rng = np.random.default_rng(5)
+    nb, B, S, K = 3, 16, 7, 128
+    pad_seg = B * S
+    segs = np.full((nb, K), pad_seg, np.int32)
+    meta = np.zeros((nb, 4), np.int32)
+    for i in range(nb):
+        counts = rng.integers(0, 4, size=B)
+        nk = int(counts.sum())
+        rec = np.repeat(np.arange(B), counts)
+        slot = rng.integers(0, S, size=nk)
+        segs[i, :nk] = rec * S + slot
+        meta[i, :2] = (nk, pad_seg)
+    enc = ResidentPass._encode_segs_slotwire(segs, meta, B)
+    assert enc is not None and enc[0].dtype == np.uint8
+    runner = ResidentPassRunner(None, 64, False)  # no num_slots needed:
+    # the decode derives S from meta (pad_segment // B)
+    enc_j = tuple(jnp.asarray(a) for a in enc)
+    for i in range(nb):
+        got = np.asarray(runner._decode_segs(
+            tuple(a[i] for a in enc_j), jnp.asarray(meta[i])))
+        np.testing.assert_array_equal(got, segs[i])
+    # violation: keys not grouped by record → falls back (None).
+    # Construct a guaranteed record-order inversion: put a key of the
+    # LAST record first.
+    bad = segs.copy()
+    nk0 = int(meta[0, 0])
+    assert nk0 >= 2
+    bad[0, 0] = (B - 1) * S  # record B-1, slot 0 ahead of everything
+    assert ResidentPass._encode_segs_slotwire(bad, meta, B) is None
+
+
 def test_compact_wire_sentinel_row_stays_zero(criteo_files):
     """The compact wire maps pad keys to the sentinel row (== capacity)
     and device dedup emits it as an in-bounds unique entry. With lazy mf

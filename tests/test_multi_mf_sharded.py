@@ -109,7 +109,9 @@ def test_mmf_sharded_e2e_learns_and_matches_single_chip(
     # both learn the planted signal; mesh quality tracks single-chip
     assert rs["auc"] > 0.60, rs["auc"]
     assert rm["auc"] > 0.60, rm["auc"]
-    assert abs(rm["auc"] - rs["auc"]) < 0.08, (rm["auc"], rs["auc"])
+    # one-sided: the mesh must not trail the single chip by much (it may
+    # LEAD it — 8 passes of N-batch global steps see more data-epochs)
+    assert rm["auc"] > rs["auc"] - 0.08, (rm["auc"], rs["auc"])
     # every class table holds features on the mesh
     assert all(t.feature_count() > 0 for t in sh_table.tables)
     # per-slot width contract on the mesh pull
