@@ -24,8 +24,14 @@ def generate_criteo_files(
     vocab_per_slot: int = 1000,
     seed: int = 0,
     planted_dim: int = 8,
+    value_base: int = 0,
 ) -> List[str]:
-    """Write criteo-format TSV files; returns file paths."""
+    """Write criteo-format TSV files; returns file paths.
+
+    ``value_base`` offsets every categorical value — day-k datasets with
+    ``value_base=k*vocab_per_slot`` have disjoint feature spaces (fresh
+    features per pass, the tiered-PS workload) while keeping the planted
+    learnable signal (weights hash from the offset value)."""
     rng = np.random.default_rng(seed)
     # planted model: each (slot, value) id gets a latent weight via hashing
     w_dense = rng.normal(0, 0.3, size=13).astype(np.float32)
@@ -36,7 +42,8 @@ def generate_criteo_files(
         with open(path, "w") as fh:
             for _ in range(rows_per_file):
                 dense_raw = rng.integers(0, 100, size=13)
-                cats = rng.integers(0, vocab_per_slot, size=26)
+                cats = value_base + rng.integers(0, vocab_per_slot,
+                                                 size=26)
                 # latent weight of a categorical value: deterministic hash → N(0, .25)
                 hvals = ((cats * 2654435761 + np.arange(26) * 97) % 1000003)
                 w_cat = ((hvals.astype(np.float64) / 1000003.0) - 0.5)
