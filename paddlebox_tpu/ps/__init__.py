@@ -11,6 +11,8 @@ from paddlebox_tpu.ps.extended import ExtendedEmbeddingTable
 from paddlebox_tpu.ps.replica_cache import InputTable, ReplicaCache
 from paddlebox_tpu.ps.sharded import ShardedEmbeddingTable
 from paddlebox_tpu.ps.tiered import TieredShardedEmbeddingTable
+from paddlebox_tpu.ps.multi_mf_sharded import (MultiMfShardedTable,
+                                               MultiMfTieredShardedTable)
 
 __all__ = ["SparseSGDConfig", "SparseAdamConfig", "EmbeddingTable",
            "MultiMfEmbeddingTable",
@@ -18,4 +20,5 @@ __all__ = ["SparseSGDConfig", "SparseAdamConfig", "EmbeddingTable",
            "apply_push", "merge_push", "push_stats", "init_table_state",
            "HostStore", "PassScopedTable", "BoxPSHelper",
            "ExtendedEmbeddingTable", "InputTable", "ReplicaCache",
-           "ShardedEmbeddingTable", "TieredShardedEmbeddingTable"]
+           "ShardedEmbeddingTable", "TieredShardedEmbeddingTable",
+           "MultiMfShardedTable", "MultiMfTieredShardedTable"]

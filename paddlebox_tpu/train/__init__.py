@@ -6,8 +6,11 @@ from paddlebox_tpu.train.device_pass import (PassPreloader, ResidentPass,
 from paddlebox_tpu.train.checkpoint import CheckpointManager
 from paddlebox_tpu.train.multi_mf_step import (MultiMfTrainStep,
                                                MultiMfTrainer)
+from paddlebox_tpu.train.sharded import ShardedTrainer
+from paddlebox_tpu.train.multi_mf_sharded import MultiMfShardedTrainer
 
 __all__ = ["TrainStep", "DeviceBatch", "make_device_batch", "Trainer",
            "AsyncDenseTable", "KStepParamSync",
            "PassPreloader", "ResidentPass", "ResidentPassRunner",
-           "CheckpointManager", "MultiMfTrainStep", "MultiMfTrainer"]
+           "CheckpointManager", "MultiMfTrainStep", "MultiMfTrainer",
+           "ShardedTrainer", "MultiMfShardedTrainer"]

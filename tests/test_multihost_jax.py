@@ -1,0 +1,78 @@
+"""True multi-process JAX runtime over the launcher: two processes
+rendezvous at the coordinator (``jax.distributed.initialize`` via
+``init_runtime_env``), form ONE global mesh spanning both, and run
+cross-process collectives — the DCN comm-backend story (SURVEY §2.6:
+NCCL/MPI/Gloo collapse into XLA collectives on one mesh; rendezvous via
+the JAX coordinator)."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddlebox_tpu.distributed.launch import init_runtime_env
+    info = init_runtime_env()          # jax.distributed.initialize inside
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    n = jax.device_count()             # GLOBAL devices across processes
+    nl = jax.local_device_count()
+    assert n == info["world_size"] * nl, (n, nl, info)
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+
+    def f(x):
+        return jax.lax.psum(x, "dp")
+
+    y = jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=P("dp"), out_specs=P()))(
+        jnp.arange(n, dtype=jnp.float32))
+    got = float(np.ravel(np.asarray(
+        y.addressable_shards[0].data))[0])
+    assert got == n * (n - 1) / 2, got   # psum crossed the process gap
+    print(f"rank={info['rank']} ok global={n} psum={got}", flush=True)
+""")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+@pytest.mark.slow
+def test_two_process_global_mesh_psum(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = tmp_path / "w.py"
+    worker.write_text(WORKER)
+    coord = f"127.0.0.1:{_free_port()}"
+    procs = []
+    for r in range(2):
+        env = dict(os.environ, PBOX_RANK=str(r), PBOX_WORLD_SIZE="2",
+                   PBOX_COORDINATOR=coord, PBOX_JAX_DISTRIBUTED="1",
+                   JAX_PLATFORMS="cpu",
+                   PYTHONPATH=repo + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        # two local devices per process -> 4 global
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        procs.append(subprocess.Popen(
+            [sys.executable, str(worker)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = [p.communicate(timeout=300)[0] for p in procs]
+    if any(p.returncode != 0 for p in procs):
+        raise AssertionError("\n\n".join(
+            f"--- rank {r} rc={p.returncode} ---\n{o[-1500:]}"
+            for r, (p, o) in enumerate(zip(procs, outs))))
+    for r, o in enumerate(outs):
+        assert f"rank={r} ok global=4" in o, o
