@@ -289,3 +289,26 @@ class TcpShuffler(TcpMesh, Shuffler):
         log.info("shuffle r%d: kept %d, received %d records", self.rank,
                  kept, len(out) - kept)
         return out
+
+    def allgather(self, records: List[SlotRecord]) -> List[SlotRecord]:
+        """Every rank returns EVERY rank's records, in rank order (rank
+        0's first) with each rank's original order preserved —
+        deterministic and identical on all ranks. This is the host data
+        plane of multi-controller SPMD training (train/multihost.py):
+        each host reads only its own file shard, then allgathers so
+        every process builds byte-identical global batches and routing
+        plans. O(world) duplication — intended for host-count ≪
+        chip-count jobs (one process per host)."""
+        blob = serialize_records(records)
+        inbox = self.exchange_bytes(
+            {dst: blob for dst in range(self.world)
+             if dst != self.rank})
+        out: List[SlotRecord] = []
+        for src in range(self.world):
+            if src == self.rank:
+                out.extend(records)
+            else:
+                out.extend(deserialize_records(inbox[src]))
+        log.info("allgather r%d: %d local -> %d global records",
+                 self.rank, len(records), len(out))
+        return out

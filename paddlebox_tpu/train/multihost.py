@@ -26,7 +26,6 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from paddlebox_tpu.metrics import AucState
 from paddlebox_tpu.parallel.mesh import DATA_AXIS
 from paddlebox_tpu.train.sharded import GlobalBatch, ShardedStepState
 
@@ -39,20 +38,18 @@ def global_mesh() -> Mesh:
 
 def stage_global(mesh: Mesh, arr: np.ndarray,
                  shard_dim0: bool = True) -> jax.Array:
-    """Stage one globally-identical host array onto the global mesh:
-    this process contributes its addressable slice of dim 0 (sharded)
-    or the whole array (replicated). ``arr`` must be byte-identical on
-    every process (the SPMD host contract)."""
+    """Stage one globally-identical host array onto the global mesh.
+    ``arr`` must be byte-identical on every process (the SPMD host
+    contract); with ``global_shape == local_data.shape``,
+    ``make_array_from_process_local_data`` maps every addressable device
+    to ITS OWN slice of the global value — correct for any device/mesh
+    order, no process-contiguity assumption."""
     a = np.asarray(arr)
-    if a.ndim == 0 or not shard_dim0:
-        sh = NamedSharding(mesh, P())
-        return jax.make_array_from_process_local_data(
-            sh, a, global_shape=a.shape)
-    pi = jax.process_index()
-    nl = jax.local_device_count()
-    sh = NamedSharding(mesh, P(*([DATA_AXIS] + [None] * (a.ndim - 1))))
+    spec = (P(*([DATA_AXIS] + [None] * (a.ndim - 1)))
+            if shard_dim0 and a.ndim > 0 else P())
+    sh = NamedSharding(mesh, spec)
     return jax.make_array_from_process_local_data(
-        sh, a[pi * nl:(pi + 1) * nl], global_shape=a.shape)
+        sh, a, global_shape=a.shape)
 
 
 def stage_global_batch(mesh: Mesh,
@@ -62,24 +59,23 @@ def stage_global_batch(mesh: Mesh,
                           for f in GlobalBatch._fields})
 
 
-def globalize_state(mesh: Mesh, state: ShardedStepState,
-                    zero1: bool = False) -> ShardedStepState:
-    """Re-stage a process-locally-initialized ShardedStepState onto the
-    global mesh, following the step's sharding spec: table + AUC sharded
-    on the device axis, params replicated, opt_state sharded iff zero1,
-    step replicated. Init is deterministic (fixed PRNG seeds), so every
-    process holds identical host values to slice from."""
-    table = state.table.with_packed(
-        stage_global(mesh, np.asarray(jax.device_get(state.table.packed))))
-    params = jax.tree.map(
-        lambda l: stage_global(mesh, np.asarray(jax.device_get(l)),
-                               shard_dim0=False), state.params)
-    opt_state = jax.tree.map(
-        lambda l: stage_global(mesh, np.asarray(jax.device_get(l)),
-                               shard_dim0=zero1), state.opt_state)
-    auc = AucState(*[stage_global(mesh, np.asarray(jax.device_get(l)))
-                     for l in state.auc])
-    step = stage_global(mesh, np.asarray(jax.device_get(state.step)),
-                        shard_dim0=False)
-    return ShardedStepState(table=table, params=params,
-                            opt_state=opt_state, auc=auc, step=step)
+def globalize_state(mesh: Mesh, state, state_spec) -> ShardedStepState:
+    """Re-stage a process-locally-initialized step state onto the global
+    mesh, following the STEP'S OWN sharding spec (pass
+    ``trainer.step_fn.state_spec`` — a pytree prefix of PartitionSpecs,
+    the same object the jitted shard_map consumes, so this can never
+    drift from the program). Init is deterministic (fixed PRNG seeds),
+    so every process holds identical host values."""
+    import jax.tree_util as jtu
+    is_spec = lambda x: isinstance(x, P)  # noqa: E731
+    spec_def = jtu.tree_structure(state_spec, is_leaf=is_spec)
+    subtrees = spec_def.flatten_up_to(state)
+    spec_leaves = jtu.tree_leaves(state_spec, is_leaf=is_spec)
+    staged = [
+        jtu.tree_map(
+            lambda l, sp=sp: stage_global(
+                mesh, np.asarray(jax.device_get(l)),
+                shard_dim0=(len(sp) > 0 and sp[0] == DATA_AXIS)), sub)
+        for sub, sp in zip(subtrees, spec_leaves)
+    ]
+    return jtu.tree_unflatten(spec_def, staged)

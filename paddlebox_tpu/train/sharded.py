@@ -234,6 +234,8 @@ class ShardedTrainStep:
             auc=AucState(*([shard0] * len(AucState._fields))),
             step=rep)
         self._state_spec = state_spec  # shared with _resident_runner
+        # public: multihost.globalize_state stages state by THIS spec
+        self.state_spec = state_spec
         batch_spec = GlobalBatch(*([shard0] * len(GlobalBatch._fields)))
         self._sharded = jax.jit(
             jax.shard_map(

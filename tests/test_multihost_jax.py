@@ -51,7 +51,7 @@ def _free_port():
     return p
 
 def _run_two_workers(tmp_path, script: str, name: str, extra_env=None,
-                     local_devices: int = 2):
+                     local_devices: int = 2, argv=None):
     """Launch the script as a 2-process PBOX gang (coordinator env,
     per-process virtual CPU devices); kill stragglers on timeout and
     report every rank's output on failure."""
@@ -70,21 +70,29 @@ def _run_two_workers(tmp_path, script: str, name: str, extra_env=None,
                    + os.environ.get("PYTHONPATH", ""))
         env.update(extra_env or {})
         procs.append(subprocess.Popen(
-            [sys.executable, str(worker)], env=env,
+            [sys.executable, str(worker)] + list(argv or []), env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
     outs = []
+    timed_out = False
     try:
         for p in procs:
             outs.append(p.communicate(timeout=300)[0])
+    except subprocess.TimeoutExpired:
+        timed_out = True
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
-                p.wait()
-    if any(p.returncode != 0 for p in procs):
-        raise AssertionError("\n\n".join(
-            f"--- rank {r} rc={p.returncode} ---\n{o[-2000:]}"
-            for r, (p, o) in enumerate(zip(procs, outs))))
+        if timed_out:
+            # drain the pipes AFTER the kill so the hanging rank's last
+            # output makes it into the failure report
+            while len(outs) < len(procs):
+                outs.append(procs[len(outs)].communicate()[0])
+    if timed_out or any(p.returncode != 0 for p in procs):
+        raise AssertionError(
+            ("TIMED OUT\n" if timed_out else "") + "\n\n".join(
+                f"--- rank {r} rc={p.returncode} ---\n{o[-2000:]}"
+                for r, (p, o) in enumerate(zip(procs, outs))))
     return outs
 
 
@@ -121,7 +129,7 @@ TRAIN_WORKER = textwrap.dedent("""
                         tx=optax.adam(1e-3))
     host = make_global_arrays(batches, table.prepare_global(batches))
     gb = stage_global_batch(mesh, host)
-    state = globalize_state(mesh, tr.state)
+    state = globalize_state(mesh, tr.state, tr.step_fn.state_spec)
     losses = []
     for i in range(2):
         state, stats = tr.step_fn(state, gb, jax.random.PRNGKey(i))
@@ -206,6 +214,102 @@ def test_two_process_sharded_train_matches_single_process(tmp_path):
         extra_env={"ORACLE_LOSSES": ",".join(f"{x:.9f}" for x in oracle)})
     for r, o in enumerate(outs):
         assert f"rank={r} train ok" in o, o
+
+
+SHARD_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddlebox_tpu.distributed.launch import init_runtime_env
+    info = init_runtime_env()
+    rank, world = info["rank"], info["world_size"]
+    import numpy as np
+    import optax
+    from paddlebox_tpu.config import FLAGS
+    from paddlebox_tpu.data import DataFeedDesc, DatasetFactory
+    from paddlebox_tpu.data.criteo import generate_criteo_files
+    from paddlebox_tpu.distributed.shuffle import TcpShuffler
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.ps import SparseSGDConfig
+    from paddlebox_tpu.ps.sharded import ShardedEmbeddingTable
+    from paddlebox_tpu.train.multihost import (global_mesh,
+                                               globalize_state,
+                                               stage_global_batch)
+    from paddlebox_tpu.train.sharded import (ShardedTrainer,
+                                             group_batches,
+                                             make_global_arrays)
+
+    # THIS host's own data shard (different per rank)
+    FLAGS.native_parse = False      # record objects for the exchange
+    desc = DataFeedDesc.criteo(batch_size=16)
+    desc.key_bucket_min = 512
+    ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+    files = generate_criteo_files(os.path.join(sys.argv[1], f"r{rank}"),
+                                  num_files=1, rows_per_file=200,
+                                  vocab_per_slot=40, seed=50 + rank)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    n_local = len(ds.records)
+
+    # host data plane: allgather the shards so every process holds the
+    # identical global record stream
+    sh = TcpShuffler(rank, world,
+                     os.environ["SHUFFLE_ENDPOINTS"].split(","))
+    ds.records = sh.allgather(ds.records)
+    sh.close()
+    ds.columnarize()
+    n_global = len(ds)
+
+    n = jax.device_count()
+    mesh = global_mesh()
+    cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=0.0,
+                          learning_rate=0.1, mf_learning_rate=0.1)
+    table = ShardedEmbeddingTable(n, mf_dim=4, capacity_per_shard=2048,
+                                  cfg=cfg, req_bucket_min=64,
+                                  serve_bucket_min=64)
+    tr = ShardedTrainer(DeepFM(hidden=(16, 16)), table, desc, mesh,
+                        tx=optax.adam(2e-3))
+    state = globalize_state(mesh, tr.state, tr.step_fn.state_spec)
+    nb = 0
+    for group in group_batches(ds.batches(), n):
+        host = make_global_arrays(group, table.prepare_global(group))
+        gb = stage_global_batch(mesh, host)
+        state, stats = tr.step_fn(state, gb, jax.random.PRNGKey(nb))
+        nb += 1
+    l = stats["loss"]
+    l = (np.asarray(jax.device_get(l.addressable_shards[0].data))
+         if hasattr(l, "addressable_shards") else np.asarray(l))
+    loss = float(np.ravel(l)[0])
+    print(f"rank={rank} shardtrain ok local={n_local} global={n_global} "
+          f"batches={nb} loss={loss:.7f}", flush=True)
+""")
+
+
+@pytest.mark.slow
+def test_two_process_per_host_shards_train(tmp_path):
+    """The full pod data story: each process reads ONLY its own file
+    shard, allgathers records over the TCP host plane (identical global
+    stream on every process — the SPMD host contract), then trains the
+    sharded step over the global mesh. Both ranks must report the same
+    loss over all records of both shards."""
+    import re
+    ports = [_free_port(), _free_port()]
+    endpoints = ",".join(f"127.0.0.1:{p}" for p in ports)
+    outs = _run_two_workers(
+        tmp_path, SHARD_WORKER, "w_shard.py",
+        extra_env={"SHUFFLE_ENDPOINTS": endpoints},
+        argv=[str(tmp_path)])
+    lines = []
+    for r, o in enumerate(outs):
+        m = re.search(rf"rank={r} shardtrain ok local=(\d+) global=(\d+) "
+                      rf"batches=(\d+) loss=([0-9.]+)", o)
+        assert m, o
+        lines.append(m.groups())
+    # every record landed on every process; losses identical across ranks
+    assert int(lines[0][1]) == int(lines[1][1]) == \
+        int(lines[0][0]) + int(lines[1][0]) == 400
+    assert lines[0][3] == lines[1][3], lines
 
 
 @pytest.mark.slow
