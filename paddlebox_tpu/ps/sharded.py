@@ -60,7 +60,9 @@ class ShardedPullIndex(NamedTuple):
     """Host-built routing plan for one global batch; leading dim = device.
 
     Shapes: N devices, A = per-(dst,src) request capacity, A2 = per-owner
-    serve capacity, K = padded keys per local batch."""
+    serve capacity, K = padded keys per local batch. ``req_need`` /
+    ``serve_need`` are the UNPADDED maxima behind A/A2 — the resident
+    builder re-buckets a whole pass with the fine ladder from them."""
 
     resp_idx: np.ndarray     # int32 [N_owner, N_dst, A] → slot in serve_rows
     serve_rows: np.ndarray   # int32 [N_owner, A2]; pads → sentinel row C
@@ -70,6 +72,8 @@ class ShardedPullIndex(NamedTuple):
     key_valid: np.ndarray    # f32   [N_dst, K]
     req_capacity: int        # A
     serve_capacity: int      # A2
+    req_need: int = 0        # max real requests per (dst, owner)
+    serve_need: int = 0      # max real serve rows per owner (+1 sentinel)
 
 
 def _bucket(n: int, bucket_min: int) -> int:
@@ -236,7 +240,8 @@ class ShardedEmbeddingTable:
         return ShardedPullIndex(
             resp_idx=resp_idx, serve_rows=serve_rows, serve_valid=serve_valid,
             serve_slot=serve_slot, gather_idx=gather_idx,
-            key_valid=key_valid, req_capacity=A, serve_capacity=A2)
+            key_valid=key_valid, req_capacity=A, serve_capacity=A2,
+            req_need=a_max, serve_need=a2_max)
 
     # ---- host save/load mirrors EmbeddingTable, per shard ----
     def feature_count(self) -> int:

@@ -27,10 +27,12 @@ from paddlebox_tpu.ops import fused_seqpool_cvm
 from paddlebox_tpu.parallel.mesh import DATA_AXIS
 from paddlebox_tpu.ps.sgd import SparseSGDConfig
 from paddlebox_tpu.ps.sharded import ShardedEmbeddingTable, ShardedPullIndex
-from paddlebox_tpu.ops.bitpack import (pack_u16m, pack_u24, unpack_u16m,
-                                       unpack_u24)
+from paddlebox_tpu.ops.bitpack import (pack_delta_auto, pack_u16m,
+                                       pack_u24, unpack_delta16,
+                                       unpack_u16m, unpack_u24)
 from paddlebox_tpu.ps.table import (TableState, apply_push,
-                                    gather_full_rows, pull_values)
+                                    fill_oob_pads, gather_full_rows,
+                                    pull_values)
 from paddlebox_tpu.train.step import quantize_floats
 
 
@@ -119,7 +121,19 @@ def _decode_wire_step(wire, fmt, i, capacity: int) -> GlobalBatch:
         return t[0][i]
 
     resp_idx = dec_int("resp_idx")
-    serve_rows = dec_int("serve_rows")
+    if fmt["serve_rows"] == "delta":
+        d = wire["serve_rows"]
+        srm = wire["srmeta"][0][i]                    # [N, 2] count, base
+        dec = jax.vmap(unpack_delta16)(d[0][i], d[1][i], d[2][i],
+                                       srm[:, 1])
+        a2 = dec.shape[-1]
+        pos = jnp.arange(a2, dtype=jnp.int32)[None, :]
+        # pads regenerate from the real count: distinct ascending OOB
+        # ids (the fill_oob_pads contract)
+        serve_rows = jnp.where(pos < srm[:, 0:1], dec,
+                               capacity + 1 + pos)
+    else:
+        serve_rows = dec_int("serve_rows")
     gather_idx = dec_int("gather_idx")
     if fmt["serve_valid"] == "derive":
         serve_valid = (serve_rows <= capacity).astype(jnp.float32)
@@ -703,19 +717,26 @@ class ShardedResidentPass:
     @classmethod
     def build(cls, dataset, trainer: "ShardedTrainer"
               ) -> "ShardedResidentPass":
+        from paddlebox_tpu.ps.table import next_bucket_fine
         table = trainer.table
         groups = list(trainer._group_iter(dataset.batches()))
         if not groups:
             raise ValueError("empty pass")
         plans = [table.prepare_global(g) for g in groups]
-        a = max(p.req_capacity for p in plans)
-        a2 = max(p.serve_capacity for p in plans)
-        # rebuild ONLY mismatched plans with forced buckets (typically
-        # just the tail group; row assignment is idempotent)
-        plans = [p if p.req_capacity == a and p.serve_capacity == a2
-                 else table.prepare_global(g, req_capacity=a,
-                                           serve_capacity=a2)
-                 for g, p in zip(groups, plans)]
+        # ONE uniform shape per pass either way → the FINE bucket ladder
+        # (≤~6% padding) replaces the streaming pow2 buckets (≤100%) for
+        # the staged wire. Plans re-PAD host-side (pure array surgery —
+        # no second routing/assignment pass on the staging thread).
+        a = next_bucket_fine(1, max(p.req_need for p in plans))
+        a2 = next_bucket_fine(1, max(p.serve_need for p in plans))
+        repadded = []
+        for g, p in zip(groups, plans):
+            rp = cls._repad_plan(p, a, a2, trainer.n, table.capacity)
+            if rp is None:  # ambiguous full bucket — re-route this group
+                rp = table.prepare_global(g, req_capacity=a,
+                                          serve_capacity=a2)
+            repadded.append(rp)
+        plans = repadded
         gbs = [make_global_arrays(g, p) for g, p in zip(groups, plans)]
         k = max(gb["gather_idx"].shape[1] for gb in gbs)
         # pad values that stay inert: gather_idx pads → the recv sentinel
@@ -747,6 +768,55 @@ class ShardedResidentPass:
                    capacity=trainer.table.capacity, trivial=trivial,
                    float_wire=getattr(trainer, "float_wire", "f32"))
 
+    @staticmethod
+    def _repad_plan(p: ShardedPullIndex, a: int, a2: int, n: int,
+                    capacity: int) -> ShardedPullIndex:
+        """Change a plan's A/A2 padding WITHOUT re-running the routing:
+        the serve lists and slot indices are identical under any padded
+        capacity — only pad regions, the resp_idx pad sentinel (A2-1)
+        and gather_idx's owner*A+j stride encode the capacity. Safe
+        because in the strict-repad case (new < old) every real index is
+        strictly below the old pad value, so pads are unambiguous."""
+        if p.req_capacity == a and p.serve_capacity == a2:
+            return p
+        a_old, a2_old = p.req_capacity, p.serve_capacity
+        if p.req_need >= a_old:
+            # an exactly-full request bucket makes the gather pad
+            # sentinel (n*a_old - 1) ambiguous with a real (owner n-1,
+            # j = a_old-1) position — signal the caller to re-prepare
+            return None
+        # serve side: real prefix length per owner from serve_valid
+        # (always < a2_old: the builder's a2_max includes the +1 slot)
+        u = p.serve_valid.astype(bool).sum(1)                  # [N]
+        serve_rows = np.empty((n, a2), np.int32)
+        serve_valid = np.zeros((n, a2), np.float32)
+        serve_slot = np.zeros((n, a2), np.float32)
+        resp_idx = np.full((n, n, a), a2 - 1, np.int32)
+        w = min(a, a_old)
+        for s in range(n):
+            us = int(u[s])
+            serve_rows[s, :us] = p.serve_rows[s, :us]
+            fill_oob_pads(serve_rows[s], us, capacity)
+            serve_valid[s, :us] = 1.0
+            serve_slot[s, :us] = p.serve_slot[s, :us]
+            # request prefix per (owner, dst): real serve indices are
+            # < u < a2_old-1, so counting non-pad entries is exact
+            cnt = (p.resp_idx[s] != a2_old - 1).sum(1)         # [N]
+            m = np.arange(w)[None, :] < cnt[:, None]
+            resp_idx[s][:, :w][m] = p.resp_idx[s][:, :w][m]
+        # gather positions re-stride from owner*A_old + j to owner*A + j;
+        # the pad sentinel (n*A_old - 1) maps to the new sentinel (no
+        # real position can equal it: j < req_need < a_old)
+        gi = p.gather_idx
+        pad_mask = gi == n * a_old - 1
+        owner, j = gi // a_old, gi % a_old
+        gather_idx = np.where(pad_mask, n * a - 1,
+                              owner * a + j).astype(np.int32)
+        return p._replace(resp_idx=resp_idx, serve_rows=serve_rows,
+                          serve_valid=serve_valid, serve_slot=serve_slot,
+                          gather_idx=gather_idx, req_capacity=a,
+                          serve_capacity=a2)
+
     def _encode_wire(self, capacity: int, trivial: bool,
                      float_wire: str) -> None:
         """Bit-pack the staged pass (ops/bitpack ladders): index arrays
@@ -771,7 +841,28 @@ class ShardedResidentPass:
 
         a = self.arrays
         enc_int("resp_idx", a["resp_idx"])
-        enc_int("serve_rows", a["serve_rows"])
+        # serve_rows: per-(step, shard) rows are ASCENDING (np.unique +
+        # ascending OOB pads) → the delta wire (~1 B/row) with the pads
+        # REGENERATED on device from the real count (srmeta)
+        sr = a["serve_rows"]
+        nbk, n, a2 = sr.shape
+        flat = sr.reshape(-1, a2)
+        counts = (flat <= capacity).sum(1).astype(np.int32)
+        from paddlebox_tpu.train.device_pass import ResidentPass
+        # THE delta-wire gap-exception budgets (shared with the
+        # single-chip uniq wire)
+        delta = pack_delta_auto(flat, counts, ResidentPass._EXC8,
+                                ResidentPass._EXC)
+        if delta is not None:
+            fmt["serve_rows"] = "delta"
+            wire["serve_rows"] = tuple(
+                d.reshape((nbk, n) + d.shape[1:]) for d in delta)
+            wire["srmeta"] = (np.stack(
+                [counts.reshape(nbk, n),
+                 flat[:, 0].reshape(nbk, n).astype(np.int32)],
+                axis=-1),)
+        else:
+            enc_int("serve_rows", sr)
         enc_int("gather_idx", a["gather_idx"])
         derived = (a["serve_rows"] <= capacity).astype(np.float32)
         if np.array_equal(derived, a["serve_valid"]):
@@ -780,7 +871,11 @@ class ShardedResidentPass:
             fmt["serve_valid"] = "raw"
             wire["serve_valid"] = (a["serve_valid"],)
         sl = a["serve_slot"]
-        if (sl >= 0).all() and (sl < 65536).all() \
+        if (sl >= 0).all() and (sl == np.rint(sl)).all() \
+                and (sl < 256).all():
+            fmt["serve_slot"] = "u8"
+            wire["serve_slot"] = (sl.astype(np.uint8),)
+        elif (sl >= 0).all() and (sl < 65536).all() \
                 and (sl == np.rint(sl)).all():
             fmt["serve_slot"] = "u16"
             wire["serve_slot"] = (sl.astype(np.uint16),)
