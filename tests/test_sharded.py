@@ -356,6 +356,44 @@ def test_zero1_matches_replicated_dense_update(mesh):
         np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-6)
 
 
+def test_repad_plan_equals_reroute():
+    """_repad_plan (host-side array surgery) must produce exactly the
+    plan prepare_global would build with the same forced capacities —
+    both shrink (fine < pow2) and growth (tail group) directions."""
+    from paddlebox_tpu.train.sharded import ShardedResidentPass
+    cfg = SparseSGDConfig(mf_create_thresholds=1e9)
+    for forced_a, forced_a2 in ((24, 40), (96, 104)):
+        table = ShardedEmbeddingTable(N, mf_dim=4, capacity_per_shard=256,
+                                      cfg=cfg, req_bucket_min=64,
+                                      serve_bucket_min=64)
+        batches = make_batches(N, seed=51)
+        p1 = table.prepare_global(batches)
+        if forced_a < p1.req_need or forced_a2 < p1.serve_need:
+            forced_a = max(forced_a, p1.req_need)
+            forced_a2 = max(forced_a2, p1.serve_need)
+        got = ShardedResidentPass._repad_plan(
+            p1, forced_a, forced_a2, N, table.capacity)
+        assert got is not None
+        want = table.prepare_global(batches, req_capacity=forced_a,
+                                    serve_capacity=forced_a2)
+        np.testing.assert_array_equal(got.resp_idx, want.resp_idx)
+        np.testing.assert_array_equal(got.serve_rows, want.serve_rows)
+        np.testing.assert_array_equal(got.serve_valid, want.serve_valid)
+        np.testing.assert_array_equal(got.serve_slot, want.serve_slot)
+        np.testing.assert_array_equal(got.gather_idx, want.gather_idx)
+        assert got.req_capacity == want.req_capacity == forced_a
+        assert got.serve_capacity == want.serve_capacity == forced_a2
+
+    # the ambiguous-full-bucket guard: when the OLD request bucket is
+    # exactly full (req_need == req_capacity), the gather pad sentinel
+    # aliases a real position — _repad_plan must refuse (build() then
+    # re-routes via prepare_global)
+    from paddlebox_tpu.train.sharded import ShardedResidentPass as SRP
+    p_full = p1._replace(req_need=p1.req_capacity)
+    assert SRP._repad_plan(p_full, p1.req_capacity + 512,
+                           p1.serve_capacity, N, table.capacity) is None
+
+
 def test_sharded_resident_matches_streaming(mesh, tmp_path):
     """Device-resident mesh pass == streaming mesh pass (same data, same
     init; mf_initial_range=0 so rng paths don't diverge)."""
