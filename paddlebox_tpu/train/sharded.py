@@ -251,11 +251,12 @@ class ShardedTrainStep:
         # public: multihost.globalize_state stages state by THIS spec
         self.state_spec = state_spec
         batch_spec = GlobalBatch(*([shard0] * len(GlobalBatch._fields)))
+        stats_spec = {"loss": rep, "pred": shard0}
         self._sharded = jax.jit(
             jax.shard_map(
                 self._device_step, mesh=mesh,
                 in_specs=(state_spec, batch_spec, rep),
-                out_specs=(state_spec, rep),
+                out_specs=(state_spec, stats_spec),
                 check_vma=False),
             donate_argnums=(0,))
 
@@ -380,7 +381,9 @@ class ShardedTrainStep:
             params=params, opt_state=opt_state,
             auc=AucState(*[l[None] for l in auc]),
             step=state.step + 1)
-        return new_state, {"loss": loss}
+        # pred stays device-sharded [N, B]; consumers (dump, registry)
+        # fetch it only when configured
+        return new_state, {"loss": loss, "pred": pred[None]}
 
     def __call__(self, state: ShardedStepState, batch: GlobalBatch,
                  rng: jax.Array):
@@ -414,11 +417,13 @@ class ShardedTrainStep:
             self.use_cvm, self.cvm_offset)
         logits = self.model.apply(params, pooled, dense)
         ins_w = (show > 0).astype(jnp.float32)
-        auc = auc_add_batch(auc, jax.nn.sigmoid(logits), label, ins_w)
-        return AucState(*[l[None] for l in auc])
+        pred = jax.nn.sigmoid(logits)
+        auc = auc_add_batch(auc, pred, label, ins_w)
+        return AucState(*[l[None] for l in auc]), pred[None]
 
     def eval(self, table_st: TableState, params, auc_st: AucState,
-             batch: GlobalBatch) -> AucState:
+             batch: GlobalBatch):
+        """→ (AucState, pred [N, B]) — pred feeds the metric registry."""
         if not hasattr(self, "_eval_jit"):
             shard0 = P(DATA_AXIS)
             rep = P()
@@ -428,7 +433,7 @@ class ShardedTrainStep:
             self._eval_jit = jax.jit(jax.shard_map(
                 self._device_eval, mesh=self.mesh,
                 in_specs=(shard0, rep, auc_spec, batch_spec),
-                out_specs=auc_spec, check_vma=False),
+                out_specs=(auc_spec, shard0), check_vma=False),
                 donate_argnums=(2,))
         return self._eval_jit(table_st, params, auc_st, batch)
 
@@ -548,6 +553,18 @@ class ShardedTrainer:
         self.global_step = 0
         self.prefetch = prefetch
         self._threading = _threading
+        self._dump_cfg = None
+        # metric-variant registry at pod scale (init_metric /
+        # get_metric_msg — the AddAucMonitor feed runs per device row)
+        from paddlebox_tpu.metrics import MetricRegistry
+        self.metrics = MetricRegistry()
+
+    def set_dump(self, cfg) -> None:
+        """Enable per-sample prediction dump for subsequent streaming
+        passes — the every-worker DumpField role (boxps_worker.cc:1595);
+        pass None to disable. Each device row of the global batch dumps
+        in device order (the mesh's worker order)."""
+        self._dump_cfg = cfg
 
     def _group_iter(self, batches):
         return group_batches(batches, self.n)
@@ -556,7 +573,8 @@ class ShardedTrainer:
         from paddlebox_tpu.utils.prefetch import prefetch_iter
 
         def prep(group):
-            return make_global_batch(group, self.table.prepare_global(group))
+            return group, make_global_batch(
+                group, self.table.prepare_global(group))
 
         return prefetch_iter(self._group_iter(batches), prep,
                              capacity=self.prefetch)
@@ -570,11 +588,39 @@ class ShardedTrainer:
         timer.start()
         nb = 0
         stats = None
-        for gb in self._prefetch_iter(dataset.batches()):
+        dump_writer = None
+        if self._dump_cfg is not None:
+            from paddlebox_tpu.utils.dump import DumpWriter
+            dump_writer = DumpWriter(self._dump_cfg)
+        for group, gb in self._prefetch_iter(dataset.batches()):
             self.global_step += 1
             rng = jax.random.fold_in(self._rng, self.global_step)
             self.state, stats = self.step_fn(self.state, gb, rng)
             nb += 1
+            want_dump = (dump_writer is not None
+                         and nb % self._dump_cfg.interval == 0)
+            if len(self.metrics) or want_dump:
+                # ONE pass over the device rows (worker order) feeds the
+                # metric registry (AddAucMonitor) and the dump — pred
+                # stays the device array, sliced once per row
+                preds = stats["pred"]
+                for d, b in enumerate(group):
+                    n_real = int((b.show > 0).sum())
+                    if n_real == 0:
+                        continue  # tail-group filler (dead batch)
+                    pred_d = preds[d]
+                    if len(self.metrics):
+                        self.metrics.add_batch(
+                            pred_d, b.label,
+                            (b.show > 0).astype(np.float32), uid=b.uid,
+                            rank=b.rank, cmatch=b.cmatch)
+                    if want_dump:
+                        dump_writer.add_batch(
+                            b.ins_ids,
+                            {"pred": pred_d, "label": b.label,
+                             "show": b.show, "clk": b.clk}, n_real)
+        if dump_writer is not None:
+            dump_writer.close()
         timer.pause()
         self.table.state = self.state.table
         auc_host = AucState(*[jnp.sum(l, axis=0) for l in self.state.auc])
@@ -619,10 +665,19 @@ class ShardedTrainer:
         timer.start()
         auc = init_sharded_auc(self.n)
         nb = 0
-        for gb in self._prefetch_iter_eval(dataset.batches()):
-            auc = self.step_fn.eval(self.state.table, self.state.params,
-                                    auc, gb)
+        for group, gb in self._prefetch_iter_eval(dataset.batches()):
+            auc, preds = self.step_fn.eval(
+                self.state.table, self.state.params, auc, gb)
             nb += 1
+            if len(self.metrics):
+                # test-phase AddAucMonitor feed, per device row
+                for d, b in enumerate(group):
+                    ins_w = (b.show > 0).astype(np.float32)
+                    if not ins_w.any():
+                        continue  # tail-group filler
+                    self.metrics.add_batch(
+                        preds[d], b.label, ins_w, uid=b.uid,
+                        rank=b.rank, cmatch=b.cmatch)
         timer.pause()
         auc_host = AucState(*[jnp.sum(l, axis=0) for l in auc])
         res = auc_compute(auc_host)
@@ -640,7 +695,7 @@ class ShardedTrainer:
         def prep(group):
             # read-only routing: lookup instead of assign (unknown keys
             # serve the zero sentinel row, prepare_eval semantics)
-            return make_global_batch(
+            return group, make_global_batch(
                 group, self.table.prepare_global_eval(group))
 
         return prefetch_iter(self._group_iter(batches), prep,
@@ -664,6 +719,11 @@ class ShardedTrainer:
         log = get_logger(__name__)
         timer = Timer()
         timer.start()
+        if len(self.metrics):
+            log.warning(
+                "registry metric variants do not accumulate in the MESH "
+                "resident pass (predictions stay on device inside the "
+                "fori_loop) — use train_pass for metric variants here")
         rp = (pass_or_dataset
               if isinstance(pass_or_dataset, ShardedResidentPass)
               else self.build_resident_pass(pass_or_dataset))

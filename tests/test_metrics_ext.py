@@ -249,3 +249,54 @@ def test_registry_skips_metric_missing_side_channel():
     reg.add_batch(pred, label, np.ones(2, np.float32))  # must not raise
     assert reg.get_metric_msg("a")["ins_num"] == 2
     assert reg.get_metric_msg("m")["ins_num"] == 0
+
+
+def test_registry_on_sharded_trainer():
+    """Metric variants accumulate on the MESH trainer: the per-device-row
+    AddAucMonitor feed matches the single-chip trainer's registry on the
+    same data (pod-scale init_metric/get_metric_msg)."""
+    import jax
+    import optax
+    from paddlebox_tpu.data import DataFeedDesc, DatasetFactory
+    from paddlebox_tpu.data.criteo import generate_criteo_files
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.parallel import make_mesh
+    from paddlebox_tpu.ps import EmbeddingTable, SparseSGDConfig
+    from paddlebox_tpu.ps.sharded import ShardedEmbeddingTable
+    from paddlebox_tpu.train import Trainer
+    from paddlebox_tpu.train.sharded import ShardedTrainer
+    import tempfile
+    assert len(jax.devices()) >= 8
+    tmp = tempfile.mkdtemp()
+    files = generate_criteo_files(tmp, num_files=1, rows_per_file=1024,
+                                  vocab_per_slot=40, seed=31)
+    desc = DataFeedDesc.criteo(batch_size=32)
+    desc.key_bucket_min = 1024
+    ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=0.0,
+                          learning_rate=0.05, mf_learning_rate=0.05)
+    sh = ShardedEmbeddingTable(8, mf_dim=4, capacity_per_shard=2048,
+                               cfg=cfg, req_bucket_min=128,
+                               serve_bucket_min=128)
+    tr_m = ShardedTrainer(DeepFM(hidden=(16, 8)), sh, desc, make_mesh(8),
+                          tx=optax.adam(1e-2), seed=3)
+    sc = EmbeddingTable(mf_dim=4, capacity=1 << 13, cfg=cfg,
+                        unique_bucket_min=1024)
+    tr_s = Trainer(DeepFM(hidden=(16, 8)), sc, desc, tx=optax.adam(1e-2),
+                   seed=3)
+    for tr in (tr_m, tr_s):
+        tr.metrics.init_metric("auc2", method="auc")
+        tr.metrics.init_metric("wu", method="wuauc")
+    tr_m.train_pass(ds)
+    tr_s.train_pass(ds)
+    mm = tr_m.metrics.get_metric_msg("auc2")
+    ms = tr_s.metrics.get_metric_msg("auc2")
+    # same data, same seeds — but mesh updates come per GLOBAL batch, so
+    # predictions differ slightly; the registry wiring must agree closely
+    assert abs(mm["auc"] - ms["auc"]) < 0.05, (mm, ms)
+    assert mm["ins_num"] == ms["ins_num"] == 1024
+    wm = tr_m.metrics.get_metric_msg("wu")
+    ws = tr_s.metrics.get_metric_msg("wu")
+    assert abs(wm["wuauc"] - ws["wuauc"]) < 0.08, (wm, ws)
