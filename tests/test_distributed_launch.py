@@ -199,7 +199,15 @@ for p in range(start_pass, n_passes):
             and p == int(kill_after):
         # die WITHOUT checkpointing this pass: the work since the last
         # save is lost; the restarted gang must replay it from the
-        # published pointer
+        # published pointer. Wait for rank 0 to have PUBLISHED a pointer
+        # first — otherwise the restart also sees no pointer and this
+        # rank kills itself again (raced in CI when rank 0 lagged)
+        import time as _time
+        deadline = _time.time() + 120
+        reader = ElasticManager(kv, "jobE", f"rd{rank}", np=1)
+        while _time.time() < deadline \\
+                and reader.latest_checkpoint() is None:
+            _time.sleep(0.2)
         os._exit(1)
     cm.save(tr)
     if rank == 0:
