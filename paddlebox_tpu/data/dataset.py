@@ -455,6 +455,13 @@ class InMemoryDataset(Dataset):
                           np.diff(r.slot_offsets))
                 for r in self.records])
             keys, first = np.unique(all_keys, return_index=True)
+            pairs = np.unique(np.stack(
+                [all_keys, all_slots.astype(np.uint64)]), axis=1)
+            if pairs.shape[1] != len(keys):
+                raise ValueError(
+                    "pass_key_slots: some key value appears under more "
+                    "than one slot — multi-mf routing requires "
+                    "slot-qualified keys (one slot per key value)")
             return keys, all_slots[first]
         return (np.empty(0, dtype=np.uint64), np.empty(0, dtype=np.int32))
 

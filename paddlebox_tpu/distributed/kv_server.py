@@ -83,19 +83,24 @@ class KVServer:
                 # quiet-but-alive TcpKVStore connection (poll cadence can
                 # exceed any fixed idle timeout) is never dropped
                 conn.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
-                if hasattr(socket, "TCP_KEEPIDLE"):
+                tuned_keepalive = hasattr(socket, "TCP_KEEPIDLE")
+                if tuned_keepalive:
                     conn.setsockopt(socket.IPPROTO_TCP,
                                     socket.TCP_KEEPIDLE, 60)
                     conn.setsockopt(socket.IPPROTO_TCP,
                                     socket.TCP_KEEPINTVL, 15)
                     conn.setsockopt(socket.IPPROTO_TCP,
                                     socket.TCP_KEEPCNT, 4)
+                # without TCP_KEEPIDLE tuning the OS default first probe is
+                # ~2h, so a dead peer could pin this handler thread for
+                # hours — cap idle generously instead of waiting forever
+                idle_timeout = None if tuned_keepalive else 900.0
                 while True:
-                    # idle between requests: no fixed timeout — keepalive
-                    # (above) owns dead-peer reaping; a timeout here would
-                    # drop the persistent connection and force
-                    # failed-sendall + reconnect churn on every later op
-                    conn.settimeout(None)
+                    # idle between requests: tuned keepalive (above) owns
+                    # dead-peer reaping with no idle cap — a quiet-but-alive
+                    # TcpKVStore connection (poll cadence can exceed any
+                    # fixed idle timeout) is never dropped
+                    conn.settimeout(idle_timeout)
                     hdr = conn.recv(1)
                     if not hdr:
                         return

@@ -311,6 +311,10 @@ class ShardedEmbeddingTable:
                     data[s][rows, mf_end:mf_end + self.opt_ext] = \
                         fields["opt_ext"]
                 elif len(keys):
+                    # keep the log honest: starting "fresh" must also hold
+                    # under merge=True, where the loaded rows may carry live
+                    # optimizer state from before the load
+                    data[s][rows, mf_end:mf_end + self.opt_ext] = 0.0
                     log.warning("load: file has no matching opt_ext block "
                                 "for shard %d; optimizer state starts "
                                 "fresh", s)

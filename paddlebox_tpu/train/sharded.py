@@ -563,7 +563,13 @@ class ShardedTrainer:
         """Enable per-sample prediction dump for subsequent streaming
         passes — the every-worker DumpField role (boxps_worker.cc:1595);
         pass None to disable. Each device row of the global batch dumps
-        in device order (the mesh's worker order)."""
+        in device order (the mesh's worker order).
+
+        Single-controller only: the feed slices ``stats['pred']`` per
+        device row on host, which requires every row to be addressable;
+        on a multi-process mesh the dump (and registry metric variants)
+        are skipped with a warning — run them from a single-controller
+        mesh, as with the mesh resident pass."""
         self._dump_cfg = cfg
 
     def _group_iter(self, batches):
@@ -589,7 +595,17 @@ class ShardedTrainer:
         nb = 0
         stats = None
         dump_writer = None
-        if self._dump_cfg is not None:
+        multi_controller = jax.process_count() > 1
+        if multi_controller and (self._dump_cfg is not None
+                                 or len(self.metrics)):
+            # preds[d] below slices every device row on host, which needs
+            # all rows addressable — true only on a single-controller mesh
+            log.warning(
+                "per-sample dump / registry metric variants are "
+                "single-controller features (host slices every device "
+                "row of stats['pred']); skipping on this %d-process mesh",
+                jax.process_count())
+        if self._dump_cfg is not None and not multi_controller:
             from paddlebox_tpu.utils.dump import DumpWriter
             dump_writer = DumpWriter(self._dump_cfg)
         for group, gb in self._prefetch_iter(dataset.batches()):
@@ -599,7 +615,7 @@ class ShardedTrainer:
             nb += 1
             want_dump = (dump_writer is not None
                          and nb % self._dump_cfg.interval == 0)
-            if len(self.metrics) or want_dump:
+            if (len(self.metrics) and not multi_controller) or want_dump:
                 # ONE pass over the device rows (worker order) feeds the
                 # metric registry (AddAucMonitor) and the dump — pred
                 # stays the device array, sliced once per row
@@ -669,8 +685,9 @@ class ShardedTrainer:
             auc, preds = self.step_fn.eval(
                 self.state.table, self.state.params, auc, gb)
             nb += 1
-            if len(self.metrics):
+            if len(self.metrics) and jax.process_count() == 1:
                 # test-phase AddAucMonitor feed, per device row
+                # (single-controller only — see set_dump)
                 for d, b in enumerate(group):
                     ins_w = (b.show > 0).astype(np.float32)
                     if not ins_w.any():
