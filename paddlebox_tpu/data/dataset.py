@@ -436,24 +436,7 @@ class InMemoryDataset(Dataset):
         ``(slot+1) << 52`` into every key). A key seen under two slots
         would stage into only one dim class and silently reset its other
         class's values each pass, so that case raises here."""
-        if self.columnar is not None:
-            keys, first = np.unique(self.columnar.keys, return_index=True)
-            pairs = np.unique(np.stack(
-                [self.columnar.keys,
-                 self.columnar.key_slot.astype(np.uint64)]), axis=1)
-            if pairs.shape[1] != len(keys):
-                raise ValueError(
-                    "pass_key_slots: some key value appears under more "
-                    "than one slot — multi-mf routing requires "
-                    "slot-qualified keys (one slot per key value)")
-            return keys, self.columnar.key_slot[first].astype(np.int32)
-        if self.records:
-            all_keys = np.concatenate([r.keys for r in self.records])
-            all_slots = np.concatenate([
-                np.repeat(np.arange(len(r.slot_offsets) - 1,
-                                    dtype=np.int32),
-                          np.diff(r.slot_offsets))
-                for r in self.records])
+        def check_and_split(all_keys, all_slots):
             keys, first = np.unique(all_keys, return_index=True)
             pairs = np.unique(np.stack(
                 [all_keys, all_slots.astype(np.uint64)]), axis=1)
@@ -462,7 +445,19 @@ class InMemoryDataset(Dataset):
                     "pass_key_slots: some key value appears under more "
                     "than one slot — multi-mf routing requires "
                     "slot-qualified keys (one slot per key value)")
-            return keys, all_slots[first]
+            return keys, all_slots[first].astype(np.int32)
+
+        if self.columnar is not None:
+            return check_and_split(self.columnar.keys,
+                                   self.columnar.key_slot)
+        if self.records:
+            all_keys = np.concatenate([r.keys for r in self.records])
+            all_slots = np.concatenate([
+                np.repeat(np.arange(len(r.slot_offsets) - 1,
+                                    dtype=np.int32),
+                          np.diff(r.slot_offsets))
+                for r in self.records])
+            return check_and_split(all_keys, all_slots)
         return (np.empty(0, dtype=np.uint64), np.empty(0, dtype=np.int32))
 
     def __len__(self) -> int:

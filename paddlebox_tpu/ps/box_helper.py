@@ -64,6 +64,31 @@ class BoxPSHelper:
     def wait_feed_pass_done(self, ds: PaddleBoxDataset) -> None:
         ds.wait_preload_done()
 
+    def stage_pass(self, ds: PaddleBoxDataset) -> None:
+        """Overlap the NEXT pass's host-tier fetch with the OPEN pass's
+        training (pre_build_thread, ps_gpu_wrapper.cc:913) — tiered
+        tables only fetch keys missing from the resident HBM window,
+        which are by construction outside the open pass's write-back
+        set. Call after wait_feed_pass_done(ds_next), while the current
+        pass still trains; the later begin_pass(ds_next) consumes the
+        stage after reconciling it against the window.
+
+        Overlap (staging while a pass is open) requires a table with the
+        persistent-window reconcile (``supports_overlap_stage``);
+        PassScopedTable rebuilds its window every pass, so for it this
+        is only legal between end_pass and the next begin_pass."""
+        if (getattr(self.table, "in_pass", False)
+                and not getattr(self.table, "supports_overlap_stage",
+                                False)):
+            raise RuntimeError(
+                f"{type(self.table).__name__} cannot stage while a pass "
+                "is open — call stage_pass between end_pass and "
+                "begin_pass, or use a tiered sharded table")
+        if getattr(self.table, "wants_slot_keys", False):
+            self.table.stage(*ds.pass_key_slots())
+        else:
+            self.table.stage(ds.pass_keys())
+
     def begin_pass(self, ds: PaddleBoxDataset) -> int:
         """Promote the pass working set into HBM and point the trainer's
         jit state at it."""

@@ -174,6 +174,7 @@ class MultiMfTieredShardedTable(MultiMfShardedTable):
     (feature_value.h: mf_dim rides the slot config)."""
 
     wants_slot_keys = True  # BoxPSHelper passes (keys, slots)
+    supports_overlap_stage = True  # per-class tiered tables reconcile
 
     def __init__(self, num_shards: int, slot_mf_dims: Sequence[int],
                  capacity_per_shard: Optional[int] = None,

@@ -335,6 +335,28 @@ def gather_full_rows(state: TableState, unique_rows: jax.Array) -> jax.Array:
     return vals[:, :state._feat] if fp != state._feat else vals
 
 
+def scatter_logical_rows(state: TableState, shard_idx: np.ndarray,
+                         rows: np.ndarray,
+                         values: np.ndarray) -> TableState:
+    """ONE device scatter of logical rows into a STACKED packed state
+    [N, L, 128]: row ``rows[k]`` of shard ``shard_idx[k]`` becomes
+    ``values[k]`` (logical width feat). The delta-staging primitive
+    (tiered begin_pass): wire cost is just ``values`` — the table itself
+    never crosses the host↔device boundary. (shard, row) pairs must be
+    unique; pad columns [feat:f_pad] of the line stay untouched (zero
+    by the init/push invariants)."""
+    rpl, fp, _ = state.geometry
+    feat = state._feat
+    rows = np.ascontiguousarray(rows, np.int32)
+    lines = rows // rpl
+    col0 = (rows % rpl) * fp
+    cols = col0[:, None] + np.arange(feat, dtype=np.int32)[None, :]
+    packed = state.packed.at[
+        np.ascontiguousarray(shard_idx, np.int32)[:, None],
+        lines[:, None], cols].set(jnp.asarray(values, state.packed.dtype))
+    return state.with_packed(packed)
+
+
 def pull_values(rows_full: jax.Array,
                 mf_dim: Optional[int] = None) -> jax.Array:
     """Pull-value view of gathered rows → [U, 3+mf_dim] laid out as

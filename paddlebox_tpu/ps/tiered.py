@@ -1,4 +1,4 @@
-"""Tiered sharded PS: HostStore-backed pass windows per HBM shard.
+"""Tiered sharded PS: HostStore-backed PERSISTENT pass windows per HBM shard.
 
 The reference's core capability — a table BIGGER than device memory on a
 multi-device PS: per pass, ``BuildPull`` fetches the pass's values from
@@ -19,10 +19,31 @@ lifecycle mirrors ``PassScopedTable``:
     ...train (streaming or resident)...
     trainer.sync_table(); table.end_pass()   # EndPass: HBM → host
 
+INCREMENTAL windows (the reference's pass machinery is incremental by
+construction — BeginFeedPass schedules only SSD→mem *misses* and the HBM
+table persists across BeginPass/EndPass windows, box_wrapper.cc:129-186):
+rows stay RESIDENT in the HBM shards across passes. ``stage`` fetches
+host values only for keys NOT already in the window; ``begin_pass``
+reconciles (drops fetched values for keys that became resident
+meanwhile), evicts only what capacity demands (write-back of touched
+evictees), and device-scatters just the delta; ``end_pass`` gathers and
+writes back only rows touched since the last write-back. Host↔HBM wire
+per pass is therefore proportional to the working-set DELTA, not its
+size.
+
+OVERLAPPED staging (pre_build_thread, ps_gpu_wrapper.cc:913): ``stage``
+is legal while a pass is OPEN. Keys missing from the window are by
+definition outside the open pass's write-back set, so fetching them
+during training cannot race ``end_pass``; a key that does enter the
+window mid-pass (streaming assigns outside the staged set) is caught by
+the begin_pass reconcile, which drops its fetched value in favor of the
+fresher resident row.
+
 Contract (same as the reference's pass windows): the staged key set must
 cover every key the pass's batches touch — keys outside it allocate fresh
-zero rows in the window and would overwrite their host values at
-end_pass. ``ds.pass_keys()`` provides exactly that set.
+zero rows in the window. ``ds.pass_keys()`` provides exactly that set.
+Host-tier mutations outside the pass protocol (load/merge_model/shrink)
+invalidate residency — the next begin_pass re-fetches everything.
 """
 
 from __future__ import annotations
@@ -31,28 +52,37 @@ import threading
 from typing import Dict, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from paddlebox_tpu.ps.host_store import HostStore
 from paddlebox_tpu.ps.sharded import ShardedEmbeddingTable
 from paddlebox_tpu.ps.sgd import SparseSGDConfig
-from paddlebox_tpu.ps.table import (FIELDS, NUM_FIXED, HostKV, TableState,
-                                    field_assign, field_slice)
+from paddlebox_tpu.ps.table import (FIELDS, NUM_FIXED, HostKV,
+                                    field_assign, field_slice,
+                                    scatter_logical_rows)
 from paddlebox_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
 
 
 class _ShardStage:
-    def __init__(self, keys: List[np.ndarray],
+    def __init__(self, keys: List[np.ndarray], new_keys: List[np.ndarray],
                  values: List[Dict[str, np.ndarray]]) -> None:
-        self.keys = keys        # per shard
-        self.values = values    # per shard
+        self.keys = keys          # per shard: FULL working set (sorted)
+        self.new_keys = new_keys  # per shard: keys missing at stage time
+        self.values = values      # per shard: host values for new_keys
 
 
 class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
-    """ShardedEmbeddingTable whose HBM shards hold one pass's working set;
-    the full model lives in N per-shard HostStores (+ disk spill)."""
+    """ShardedEmbeddingTable whose HBM shards hold a persistent window of
+    the working set; the full model lives in N per-shard HostStores
+    (+ disk spill)."""
+
+    # stage() is legal while a pass is open (missing keys are outside
+    # the open window's write-back set) — BoxPSHelper.stage_pass gates
+    # on this; PassScopedTable has no such guarantee
+    supports_overlap_stage = True
 
     def __init__(self, num_shards: int, mf_dim: int = 8,
                  capacity_per_shard: Optional[int] = None,
@@ -73,6 +103,11 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         self._stage: Optional[_ShardStage] = None
         self._stage_thread: Optional[threading.Thread] = None
         self._stage_exc: Optional[BaseException] = None
+        # per-pass delta accounting (asserted by tests, reported by bench):
+        # resident = working-set keys already in the window,
+        # staged = keys fetched+scattered, evicted / evicted_writeback,
+        # written_back = rows end_pass shipped to the host tier
+        self.last_pass_stats: Dict[str, int] = {}
 
     # ------------------------------------------------------------------
     def _split_by_owner(self, keys: np.ndarray) -> List[np.ndarray]:
@@ -80,15 +115,38 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         owners = (keys % np.uint64(self.n)).astype(np.int64)
         return [keys[owners == s] for s in range(self.n)]
 
+    def _store_fields(self, sub: np.ndarray) -> Dict[str, np.ndarray]:
+        """Logical rows [k, feat] → HostStore field dict. embedx sliced to
+        mf_dim explicitly: field_slice's tail is unbounded and would leak
+        the opt_ext columns into the host store's (k, mf_dim) array."""
+        mf_end = NUM_FIXED + self.mf_dim
+        vals = {f: (sub[:, NUM_FIXED:mf_end] if f == "embedx_w"
+                    else field_slice(sub, f)) for f in FIELDS}
+        if self.opt_ext:
+            vals["opt_ext"] = sub[:, mf_end:]
+        return vals
+
+    def _logical_rows(self, vals: Dict[str, np.ndarray]) -> np.ndarray:
+        """HostStore field dict → logical rows [k, feat] (scatter input)."""
+        k = len(vals["show"])
+        mf_end = NUM_FIXED + self.mf_dim
+        out = np.zeros((k, mf_end + self.opt_ext), np.float32)
+        idx = np.arange(k)
+        for f in FIELDS:
+            field_assign(out, idx, f, vals[f])
+        if self.opt_ext:
+            out[:, mf_end:] = vals["opt_ext"]
+        return out
+
     # ---- feed-pass staging (BuildPull, ps_gpu_wrapper.cc:337) ----
     def stage(self, pass_keys: np.ndarray, background: bool = True) -> None:
-        """Fetch the pass working set from every shard's host store. Only
-        legal between end_pass and the next begin_pass (staged values must
-        reflect the previous pass's write-back)."""
-        if self.in_pass:
-            raise RuntimeError(
-                "stage() while a pass is open — end_pass first")
-        if self._stage_thread is not None:
+        """Fetch host values for the pass keys NOT already resident in
+        the HBM window. Legal while a pass is open (the overlapped
+        pre_build_thread, ps_gpu_wrapper.cc:913): missing keys are
+        outside the open window, so the open pass's end_pass write-back
+        cannot touch them; any key that becomes resident between stage
+        and begin_pass has its fetched value dropped by the reconcile."""
+        if self._stage_thread is not None or self._stage is not None:
             raise RuntimeError("a feed pass is already staging")
         per_shard = self._split_by_owner(pass_keys)
         for s, ks in enumerate(per_shard):
@@ -96,13 +154,15 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
                 raise ValueError(
                     f"shard {s} working set ({len(ks)}) exceeds "
                     f"capacity_per_shard ({self.capacity})")
+        with self.host_lock:
+            new = [per_shard[s][self.indexes[s].lookup(per_shard[s]) < 0]
+                   for s in range(self.n)]
         self._stage_exc = None
 
         def run() -> None:
             try:
-                vals = [self.hosts[s].fetch(per_shard[s])
-                        for s in range(self.n)]
-                self._stage = _ShardStage(per_shard, vals)
+                vals = [self.hosts[s].fetch(new[s]) for s in range(self.n)]
+                self._stage = _ShardStage(per_shard, new, vals)
             except BaseException as e:
                 self._stage_exc = e
 
@@ -124,8 +184,10 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
 
     # ---- pass window (BuildGPUTask/EndPass, ps_gpu_wrapper.cc:684,983) --
     def begin_pass(self, pass_keys: Optional[np.ndarray] = None) -> int:
-        """Promote the staged (or given) working set into the HBM shards.
-        Returns the number of working-set rows across shards."""
+        """Promote the staged (or given) working set into the HBM shards:
+        reconcile the stage against the live window, evict only what
+        capacity demands, scatter only the genuinely new rows. Returns
+        the number of working-set rows across shards."""
         if self.in_pass:
             raise RuntimeError("begin_pass while a pass is open")
         if pass_keys is not None:
@@ -145,52 +207,109 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
             raise RuntimeError("begin_pass with nothing staged")
         self._stage = None
 
-        mf_end = NUM_FIXED + self.mf_dim
-        data = np.zeros((self.n, self.capacity + 1, mf_end + self.opt_ext),
-                        np.float32)
+        stats = dict(resident=0, staged=0, evicted=0, evicted_writeback=0,
+                     written_back=0)
+        sh_l: List[np.ndarray] = []
+        row_l: List[np.ndarray] = []
+        val_l: List[np.ndarray] = []
         total = 0
         with self.host_lock:
             for s in range(self.n):
-                self.indexes[s] = HostKV(self.capacity)
-                rows = self.indexes[s].assign(st.keys[s])
-                for f in FIELDS:
-                    field_assign(data[s], rows, f, st.values[s][f])
-                if self.opt_ext:
-                    data[s][rows, mf_end:] = st.values[s]["opt_ext"]
-                total += len(rows)
-            self._touched[:] = False
-        self.state = TableState.from_logical(data, self.capacity,
-                                             ext=self.opt_ext)
+                want = st.keys[s]
+                # reconcile: a staged key may have become resident since
+                # stage() (mid-pass streaming assign) — the live row is
+                # fresher than the fetched host value, keep it
+                still = self.indexes[s].lookup(st.new_keys[s]) < 0
+                ins_keys = st.new_keys[s][still]
+                ins_vals = {f: v[still] for f, v in st.values[s].items()}
+                # evict only what capacity demands, never the new working
+                # set; untouched rows first (no write-back needed)
+                overflow = (len(self.indexes[s]) + len(ins_keys)
+                            - self.capacity)
+                if overflow > 0:
+                    live_keys, live_rows = self.indexes[s].items()
+                    cand = ~np.isin(live_keys, want)
+                    ck, cr = live_keys[cand], live_rows[cand]
+                    t = self._touched[s][cr]
+                    order = np.argsort(t, kind="stable")[:overflow]
+                    ck, cr, t = ck[order], cr[order], t[order]
+                    if t.any():
+                        sub = np.asarray(
+                            jax.device_get(self.state.data[s][cr[t]]))
+                        self.hosts[s].update(ck[t], self._store_fields(sub))
+                        stats["evicted_writeback"] += int(t.sum())
+                    freed = self.indexes[s].release(ck)
+                    self._touched[s][freed] = False
+                    stats["evicted"] += len(ck)
+                rows_new = self.indexes[s].assign(ins_keys)
+                self._touched[s][rows_new] = False  # freshly loaded = clean
+                sh_l.append(np.full(len(rows_new), s, np.int32))
+                row_l.append(rows_new)
+                val_l.append(self._logical_rows(ins_vals))
+                stats["staged"] += len(ins_keys)
+                stats["resident"] += len(want) - len(ins_keys)
+                total += len(want)
+            rows = np.concatenate(row_l) if row_l else np.empty(0, np.int32)
+            if len(rows):
+                self.state = scatter_logical_rows(
+                    self.state, np.concatenate(sh_l), rows,
+                    np.concatenate(val_l))
         self.in_pass = True
-        log.info("begin_pass: %d working-set rows across %d HBM shards",
-                 total, self.n)
+        self.last_pass_stats = stats
+        log.info("begin_pass: %d working-set rows (%d resident, %d staged, "
+                 "%d evicted) across %d HBM shards", total,
+                 stats["resident"], stats["staged"], stats["evicted"],
+                 self.n)
         return total
 
     def end_pass(self) -> int:
-        """Write the (jit-updated) working set back to the host stores."""
+        """Write back only the rows touched since the last write-back
+        (HBM→host gather is touched-rows-sized, not window-sized); the
+        window stays resident for the next pass's reuse."""
         if not self.in_pass:
             raise RuntimeError("end_pass without begin_pass")
-        data = np.asarray(jax.device_get(self.state.data))
-        mf_end = NUM_FIXED + self.mf_dim
         total = 0
         with self.host_lock:
             for s in range(self.n):
                 keys, rows = self.indexes[s].items()
-                sub = data[s][rows]
-                # embedx sliced to mf_dim explicitly: field_slice's tail is
-                # unbounded and would leak the opt_ext columns into the
-                # host store's (k, mf_dim) array (EmbeddingTable.
-                # _gather_host does the same)
-                vals = {f: (sub[:, NUM_FIXED:mf_end] if f == "embedx_w"
-                            else field_slice(sub, f)) for f in FIELDS}
-                if self.opt_ext:
-                    vals["opt_ext"] = sub[:, mf_end:]
-                self.hosts[s].update(keys, vals)
-                total += len(keys)
+                m = self._touched[s][rows]
+                keys, rows = keys[m], rows[m]
+                if len(rows):
+                    sub = np.asarray(
+                        jax.device_get(self.state.data[s][rows]))
+                    self.hosts[s].update(keys, self._store_fields(sub))
+                    self._touched[s][rows] = False
+                total += len(rows)
         self.in_pass = False
-        log.info("end_pass: %d rows written back to %d host stores",
+        self.last_pass_stats["written_back"] = total
+        log.info("end_pass: %d touched rows written back to %d host stores",
                  total, self.n)
         return total
+
+    def drop_window(self) -> None:
+        """Invalidate HBM residency (between passes): the next begin_pass
+        re-fetches everything from the host tier. Called automatically
+        after host-tier mutations outside the pass protocol
+        (load/merge_model/shrink), whose updates would otherwise be
+        shadowed by stale resident rows; also the recovery entry point
+        after a host-tier restore (LoadSSD2Mem, box_wrapper.cc:1415).
+
+        Discards any pending stage (its fetched values predate the
+        host-tier mutation, and its resident/missing split predates the
+        residency drop) and zeroes the device rows (released rows must
+        read as fresh zero rows if a later mid-pass assign reuses them
+        before a scatter initializes them)."""
+        self._no_pass("drop_window")
+        if self._stage_thread is not None or self._stage is not None:
+            try:
+                self.wait_stage_done()
+            finally:
+                self._stage = None
+        with self.host_lock:
+            self.indexes = [HostKV(self.capacity) for _ in range(self.n)]
+            self._touched[:] = False
+            self.state = self.state.with_packed(
+                jnp.zeros_like(self.state.packed))
 
     def _no_pass(self, what: str) -> None:
         if self.in_pass:
@@ -241,6 +360,7 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         # shard-splitting shared with the parent (same file formats)
         for s, (keys, fields) in enumerate(self._file_per_shard(blob)):
             total += self.hosts[s].import_rows(keys, fields, merge=merge)
+        self.drop_window()  # resident rows may shadow the loaded values
         return total
 
     def merge_model(self, path: str) -> int:
@@ -253,20 +373,25 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         total = 0
         for s, (keys, fields) in enumerate(self._file_per_shard(blob)):
             total += self.hosts[s].merge_model_rows(keys, fields)
+        self.drop_window()
         return total
 
     def shrink(self, delete_threshold: Optional[float] = None,
                decay: Optional[float] = None) -> int:
         """ShrinkTable over every shard's host store (box_wrapper.h:638)."""
         self._no_pass("shrink")
-        return sum(h.shrink(delete_threshold=delete_threshold, decay=decay,
-                            nonclk_coeff=self.cfg.nonclk_coeff,
-                            clk_coeff=self.cfg.clk_coeff)
-                   for h in self.hosts)
+        freed = sum(h.shrink(delete_threshold=delete_threshold, decay=decay,
+                             nonclk_coeff=self.cfg.nonclk_coeff,
+                             clk_coeff=self.cfg.clk_coeff)
+                    for h in self.hosts)
+        self.drop_window()  # resident rows hold pre-decay stats
+        return freed
 
     def spill_cold(self, path_prefix: str, threshold: float) -> int:
         """Move cold rows of every shard to disk-tier files
-        ``{path_prefix}.s{K}.npz`` (the host-RAM ↔ SSD boundary)."""
+        ``{path_prefix}.s{K}.npz`` (the host-RAM ↔ SSD boundary). Values
+        are unchanged, so HBM residency stays valid — spilled keys that
+        are still resident simply keep serving from the window."""
         self._no_pass("spill_cold")
         return sum(h.spill_cold(f"{path_prefix}.s{s}.npz", threshold,
                                 nonclk_coeff=self.cfg.nonclk_coeff,

@@ -247,6 +247,10 @@ def test_tiered_spilled_rows_promote_on_stage(mesh, tmp_path):
     table.save_base(str(tmp_path / "b.npz"))  # spill requires saved rows
     assert table.spill_cold(str(tmp_path / "sp"), threshold=1e9) > 0
     assert len(table.hosts[s0]) == 0  # gone from RAM
+    # drop HBM residency so the next stage MUST go through the disk
+    # tier (with the persistent window the keys would otherwise still
+    # serve from HBM and never exercise promotion)
+    table.drop_window()
     helper.begin_pass(ds)  # stage promotes from the disk tier
     rows = table.indexes[s0].lookup(probe)
     assert (rows >= 0).all()
@@ -321,13 +325,16 @@ def test_tiered_adam_opt_ext_roundtrips(mesh):
     assert table.opt_ext > 0
     keys = np.arange(1, 25, dtype=np.uint64)
     table.begin_pass(keys)
-    # simulate a jit update: plant distinct embedx and opt_ext values
+    # simulate a jit update: plant distinct embedx and opt_ext values,
+    # and mark the rows touched as the trainer's prepare/mark_trained
+    # paths do (end_pass writes back only touched rows)
     mf_end = NUM_FIXED + table.mf_dim
     data = np.asarray(jax.device_get(table.state.data)).copy()
     for s in range(N):
         _, rows = table.indexes[s].items()
         data[s][rows, NUM_FIXED:mf_end] = 2.0
         data[s][rows, mf_end:] = 0.5
+        table._touched[s][rows] = True
     table.state = type(table.state).from_logical(data, table.capacity,
                                                  ext=table.opt_ext)
     table.end_pass()
@@ -350,6 +357,222 @@ def test_tiered_adam_opt_ext_roundtrips(mesh):
     table.end_pass()
 
 
+def _write_overlap_pass(tmp_path, pass_id, vocab=100, step=10, rows=600):
+    """Criteo-format files whose categorical values live in a SLIDING
+    range [pass_id*step, pass_id*step + vocab) — consecutive passes
+    share ~(vocab-step)/vocab of their key range (the CTR workload:
+    day k+1 mostly re-touches day k's features)."""
+    import os
+    rng = np.random.default_rng(500 + pass_id)
+    d = tmp_path / f"ovl{pass_id}"
+    os.makedirs(str(d), exist_ok=True)
+    path = str(d / "part.txt")
+    base = pass_id * step
+    with open(path, "w") as fh:
+        for _ in range(rows):
+            dense = rng.integers(0, 100, size=13)
+            cats = base + rng.integers(0, vocab, size=26)
+            label = int(rng.random() < 0.5)
+            dense_s = "\t".join(str(int(v)) for v in dense)
+            cat_s = "\t".join(format(int(c), "x") for c in cats)
+            fh.write(f"{label}\t{dense_s}\t{cat_s}\n")
+    desc = DataFeedDesc.criteo(batch_size=32)
+    desc.key_bucket_min = 1024
+    ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+    ds.set_filelist([path])
+    ds.load_into_memory()
+    return ds, desc
+
+
+def test_delta_staging_equals_full_staging(mesh, tmp_path):
+    """THE delta-staging contract (box_wrapper.cc:129-186): with ~90%
+    overlapping pass working sets, a table reusing its resident window
+    (delta staging, the default) must match a table that re-stages the
+    full working set every pass (drop_window between passes) — same AUC,
+    same dense params, bit-identical host-tier values. And the staged
+    row count per pass must equal the working-set DELTA, not its size."""
+    built = [_write_overlap_pass(tmp_path, p) for p in range(4)]
+    datasets = [b[0] for b in built]
+    desc = built[0][1]
+
+    def mk():
+        t = TieredShardedEmbeddingTable(
+            N, mf_dim=4, capacity_per_shard=2048, cfg=_cfg(),
+            req_bucket_min=256, serve_bucket_min=256)
+        with flags_scope(log_period_steps=10000):
+            tr = ShardedTrainer(DeepFM(hidden=(16, 16)), t, desc, mesh,
+                                tx=optax.adam(2e-3))
+        return t, tr, BoxPSHelper(t, trainer=tr)
+
+    ta, tr_a, ha = mk()   # delta (default)
+    tb, tr_b, hb = mk()   # forced full re-staging
+    resident: set = set()
+    for p, ds in enumerate(datasets):
+        want = set(ds.pass_keys().tolist())
+        ha.begin_pass(ds)
+        st = ta.last_pass_stats
+        # staged == |want \ resident|: wire ∝ working-set delta
+        assert st["staged"] == len(want - resident), (p, st)
+        assert st["resident"] == len(want & resident), (p, st)
+        assert st["evicted"] == 0
+        resident |= want
+        ra = tr_a.train_pass(ds)
+        ha.end_pass(ds)
+
+        tb.drop_window()  # forces full staging: everything re-fetched
+        hb.begin_pass(ds)
+        assert tb.last_pass_stats["staged"] == len(want)
+        rb = tr_b.train_pass(ds)
+        hb.end_pass(ds)
+        assert np.isclose(ra["auc"], rb["auc"], atol=1e-9)
+    # pass 2+ staged a small fraction of the working set
+    assert st["staged"] < 0.25 * (st["staged"] + st["resident"])
+    for x, y in zip(jax.tree.leaves(tr_a.state.params),
+                    jax.tree.leaves(tr_b.state.params)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    for s in range(N):
+        keys, _ = ta.hosts[s].index.items()
+        keys = np.sort(keys)
+        kb, _ = tb.hosts[s].index.items()
+        np.testing.assert_array_equal(keys, np.sort(kb))
+        a = ta.hosts[s].fetch(keys)
+        b = tb.hosts[s].fetch(keys)
+        for f in ta.hosts[s].fields:
+            np.testing.assert_array_equal(a[f], b[f], err_msg=f"s{s} {f}")
+
+
+def test_overlap_stage_reconciles_mid_pass_assign(mesh):
+    """The overlap race, resolved by the begin_pass reconcile: key K is
+    staged for pass 2 while pass 1 is open (host value fetched), then
+    pass 1's streaming training assigns K mid-pass (outside its staged
+    set) and trains it. The stale fetched value must be DROPPED — the
+    resident row (written back at end_pass 1) wins."""
+    from paddlebox_tpu.ps.table import FIELD_COL, FIELDS
+    table = TieredShardedEmbeddingTable(N, mf_dim=2, capacity_per_shard=64,
+                                        cfg=_cfg())
+    K = np.uint64(200)
+    s = int(K) % N
+    # host tier knows K with embed_w = -5
+    f0 = {f: np.zeros((1, 2), np.float32) if f == "embedx_w"
+          else np.zeros(1, np.float32) for f in FIELDS}
+    f0["embed_w"] = np.array([-5.0], np.float32)
+    table.hosts[s].update(np.array([K]), f0)
+
+    k1 = np.arange(1, 17, dtype=np.uint64)
+    table.begin_pass(k1)
+    # overlap: stage pass 2 (includes K, missing from the window → its
+    # host value -5 is fetched) while pass 1 is open
+    k2 = np.concatenate([np.arange(9, 17, dtype=np.uint64), [K]])
+    table.stage(k2, background=False)
+    assert np.any(np.concatenate(table._stage.new_keys) == K)
+    # pass 1's streaming step assigns K mid-pass and trains it to 7
+    with table.host_lock:
+        row = int(table.indexes[s].assign(np.array([K]))[0])
+        table._touched[s][row] = True
+    data = np.asarray(jax.device_get(table.state.data)).copy()
+    data[s][row, FIELD_COL["embed_w"]] = 7.0
+    table.state = type(table.state).from_logical(data, table.capacity,
+                                                 ext=table.opt_ext)
+    table.end_pass()
+    assert table.hosts[s].fetch(np.array([K]))["embed_w"][0] == 7.0
+    table.begin_pass(k2)
+    st = table.last_pass_stats
+    # K was reconciled away: resident, not staged
+    row2 = int(table.indexes[s].lookup(np.array([K]))[0])
+    w = float(np.asarray(jax.device_get(
+        table.state.data[s][row2, FIELD_COL["embed_w"]])))
+    assert w == 7.0, f"stale staged value overwrote the trained row: {w}"
+    table.end_pass()
+
+
+def test_eviction_writes_back_touched_rows(mesh):
+    """Capacity-pressure eviction: clean rows evict silently (host tier
+    already has their values), rows touched since the last write-back
+    are written back before release."""
+    from paddlebox_tpu.ps.table import FIELD_COL
+    cap = 16
+    table = TieredShardedEmbeddingTable(N, mf_dim=2,
+                                        capacity_per_shard=cap, cfg=_cfg())
+    k1 = np.arange(0, N * cap, dtype=np.uint64)       # fills every shard
+    table.begin_pass(k1)
+    # train every row, write back, window stays full and clean
+    for s in range(N):
+        _, rows = table.indexes[s].items()
+        table._touched[s][rows] = True
+    data = np.asarray(jax.device_get(table.state.data)).copy()
+    data[:, :, FIELD_COL["embed_w"]] = 3.0
+    data[:, table.capacity, :] = 0.0  # keep the sentinel row zero
+    table.state = type(table.state).from_logical(data, table.capacity,
+                                                 ext=table.opt_ext)
+    table.end_pass()
+    # between passes, one row is dirtied again (streaming use outside
+    # the pass protocol): its eviction must write back
+    s0 = 0
+    keys0, rows0 = table.indexes[s0].items()
+    probe_key, probe_row = keys0[0], rows0[0]
+    data = np.asarray(jax.device_get(table.state.data)).copy()
+    data[s0][probe_row, FIELD_COL["embed_w"]] = 9.0
+    table.state = type(table.state).from_logical(data, table.capacity,
+                                                 ext=table.opt_ext)
+    table._touched[s0][probe_row] = True
+    # pass 2: disjoint working set, full capacity → evicts everything
+    k2 = np.arange(N * cap, 2 * N * cap, dtype=np.uint64)
+    table.begin_pass(k2)
+    st = table.last_pass_stats
+    assert st["evicted"] > 0
+    assert st["evicted_writeback"] == 1  # only the dirtied row
+    got = table.hosts[s0].fetch(np.array([probe_key]))["embed_w"][0]
+    assert got == 9.0, "touched evicted row lost its update"
+    # clean evicted rows kept their pass-1 write-back values
+    other = keys0[1]
+    assert table.hosts[s0].fetch(
+        np.array([other]))["embed_w"][0] == 3.0
+    table.end_pass()
+
+
+def test_drop_window_discards_pending_stage(mesh):
+    """drop_window (auto-run by load/merge_model/shrink) must discard a
+    pending stage — its fetched values and resident/missing split
+    predate the host-tier mutation — and zero the device rows so
+    released rows read as fresh zero rows."""
+    from paddlebox_tpu.ps.table import FIELDS
+    table = TieredShardedEmbeddingTable(N, mf_dim=2, capacity_per_shard=32,
+                                        cfg=_cfg())
+    k1 = np.arange(1, 17, dtype=np.uint64)
+    # seed host values and make keys resident once
+    table.begin_pass(k1)
+    for s in range(N):
+        _, rows = table.indexes[s].items()
+        table._touched[s][rows] = True
+    from paddlebox_tpu.ps.table import FIELD_COL
+    data = np.asarray(jax.device_get(table.state.data)).copy()
+    data[:, :, FIELD_COL["show"]] = 5.0
+    data[:, table.capacity, :] = 0.0
+    table.state = type(table.state).from_logical(data, table.capacity,
+                                                 ext=table.opt_ext)
+    table.end_pass()
+    # stage k2 (all resident → nothing fetched), then mutate the host
+    # tier: the stale stage must not survive
+    table.stage(k1, background=False)
+    assert table._stage is not None
+    table.shrink(delete_threshold=0.0, decay=0.5)  # decays show 5→2.5
+    assert table._stage is None, "drop_window kept a stale stage"
+    assert not np.any(np.asarray(jax.device_get(table.state.packed))), (
+        "drop_window left stale values in released device rows")
+    # next pass re-fetches everything, with post-shrink values
+    table.begin_pass(k1)
+    assert table.last_pass_stats["staged"] == len(k1)
+    assert table.last_pass_stats["resident"] == 0
+    for s in range(N):
+        keys, rows = table.indexes[s].items()
+        if not len(keys):
+            continue
+        show = np.asarray(jax.device_get(table.state.data))[s][
+            rows, FIELD_COL["show"]]
+        np.testing.assert_allclose(show, 2.5)
+    table.end_pass()
+
+
 def test_tiered_guards(mesh):
     table = TieredShardedEmbeddingTable(N, mf_dim=2, capacity_per_shard=16)
     with pytest.raises(RuntimeError):
@@ -360,7 +583,14 @@ def test_tiered_guards(mesh):
     with pytest.raises(RuntimeError):
         table.save_base("/tmp/never.npz")
     with pytest.raises(RuntimeError):
+        table.drop_window()
+    # staging DURING an open pass is the overlap contract — legal; but a
+    # second concurrent stage is not
+    table.stage(np.arange(8, 16, dtype=np.uint64), background=False)
+    with pytest.raises(RuntimeError):
         table.stage(np.arange(8, dtype=np.uint64))
+    table.end_pass()
+    table.begin_pass(np.arange(8, 16, dtype=np.uint64))  # consumes stage
     table.end_pass()
     # per-shard capacity guard
     with pytest.raises(ValueError):
