@@ -300,16 +300,20 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         read as fresh zero rows if a later mid-pass assign reuses them
         before a scatter initializes them)."""
         self._no_pass("drop_window")
-        if self._stage_thread is not None or self._stage is not None:
-            try:
+        try:
+            if self._stage_thread is not None or self._stage is not None:
                 self.wait_stage_done()
-            finally:
-                self._stage = None
-        with self.host_lock:
-            self.indexes = [HostKV(self.capacity) for _ in range(self.n)]
-            self._touched[:] = False
-            self.state = self.state.with_packed(
-                jnp.zeros_like(self.state.packed))
+        finally:
+            # the reset must run even when the pending stage raised —
+            # callers that swallow the stage error would otherwise keep
+            # pre-mutation rows resident, shadowing the host tier
+            self._stage = None
+            with self.host_lock:
+                self.indexes = [HostKV(self.capacity)
+                                for _ in range(self.n)]
+                self._touched[:] = False
+                self.state = self.state.with_packed(
+                    jnp.zeros_like(self.state.packed))
 
     def _no_pass(self, what: str) -> None:
         if self.in_pass:

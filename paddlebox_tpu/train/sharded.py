@@ -438,8 +438,9 @@ class ShardedTrainStep:
         return self._eval_jit(table_st, params, auc_st, batch)
 
     # ---- resident pass: the whole loop inside one shard_map program ----
-    def _resident_runner(self, n_steps: int, fmt=None, capacity=0):
-        key = ("resident", n_steps, fmt, capacity)
+    def _resident_runner(self, n_steps: int, fmt=None, capacity=0,
+                         collect: bool = False):
+        key = ("resident", n_steps, fmt, capacity, collect)
         cached = getattr(self, "_resident_cache", None)
         if cached is None:
             cached = self._resident_cache = {}
@@ -451,19 +452,28 @@ class ShardedTrainStep:
 
             def run(state, wire, start, rng):
                 def body(i, carry):
-                    st, r = carry
+                    st, r, preds = carry
                     gb = (GlobalBatch(*[leaf[i] for leaf in wire])
                           if fmt_d is None else
                           _decode_wire_step(wire, fmt_d, i, capacity))
                     # per-step rng matching the streaming trainer exactly:
                     # it folds the PRE-incremented global_step (1-based)
-                    st, _ = self._device_step(
+                    st, stats = self._device_step(
                         st, gb, jax.random.fold_in(r, st.step + 1))
-                    return st, r
+                    if collect:
+                        # per-batch predictions collected inside the loop
+                        # (the single-chip collect_preds pattern,
+                        # device_pass.py run_pass) — stays device-sharded
+                        preds = jax.lax.dynamic_update_index_in_dim(
+                            preds, stats["pred"], i - start, 0)
+                    return st, r, preds
 
-                state, _ = jax.lax.fori_loop(
-                    start, start + n_steps, body, (state, rng))
-                return state
+                preds0 = (jnp.zeros((n_steps, 1, self.batch_size),
+                                    jnp.float32) if collect
+                          else jnp.zeros((), jnp.float32))
+                state, _, preds = jax.lax.fori_loop(
+                    start, start + n_steps, body, (state, rng, preds0))
+                return (state, preds) if collect else state
 
             def make_specs(we):
                 if isinstance(we, dict):
@@ -473,13 +483,16 @@ class ShardedTrainStep:
                 return jax.tree.map(
                     lambda a: _wire_spec("", a.ndim), we)
 
+            out_specs = ((state_spec, P(None, DATA_AXIS, None))
+                         if collect else state_spec)
+
             def jit_for(wire_example):
                 return jax.jit(
                     jax.shard_map(run, mesh=self.mesh,
                                   in_specs=(state_spec,
                                             make_specs(wire_example),
                                             rep, rep),
-                                  out_specs=state_spec, check_vma=False),
+                                  out_specs=out_specs, check_vma=False),
                     donate_argnums=(0,))
 
             # resolved lazily at first call (needs the wire pytree)
@@ -487,21 +500,33 @@ class ShardedTrainStep:
         return cached[key]
 
     def run_resident(self, state: ShardedStepState, rp, rng: jax.Array,
-                     chunk: int = 0):
-        """Run every staged global batch of a ShardedResidentPass."""
+                     chunk: int = 0, collect_preds: bool = False):
+        """Run every staged global batch of a ShardedResidentPass.
+        ``collect_preds`` also returns [nb, N, B] per-batch predictions
+        (device-sharded on axis 1) for the post-pass registry replay."""
         rp.upload()
         nb = rp.num_batches
         fmt = getattr(rp, "fmt", None)
         fmt_key = tuple(sorted(fmt.items())) if fmt else None
         c = chunk or nb
         i = 0
+        chunks = []
         while i < nb:
             n = min(c, nb - i)
-            state = self._resident_runner(
-                n, fmt_key, getattr(rp, "capacity", 0) or 0)(
+            out = self._resident_runner(
+                n, fmt_key, getattr(rp, "capacity", 0) or 0,
+                collect=collect_preds)(
                 state, rp.dev, jnp.asarray(i, jnp.int32), rng)
+            if collect_preds:
+                state, preds = out
+                chunks.append(preds)
+            else:
+                state = out
             i += n
-        return state
+        if not collect_preds:
+            return state, None
+        return state, (chunks[0] if len(chunks) == 1
+                       else jnp.concatenate(chunks, axis=0))
 
 
 def group_batches(batches, n: int):
@@ -722,6 +747,26 @@ class ShardedTrainer:
     def build_resident_pass(self, dataset) -> "ShardedResidentPass":
         return ShardedResidentPass.build(dataset, self)
 
+    def _feed_registry_resident(self, rp, preds) -> None:
+        """Post-pass metric registry replay (the per-batch AddAucMonitor
+        hook, boxps_worker.cc:1267,1337) from predictions collected
+        inside the mesh fori_loop — the mesh analogue of the single-chip
+        Trainer._feed_registry_resident. ONE D2H fetch of [nb, N, B]."""
+        preds_h = np.asarray(preds)
+        sd = rp.side
+        for i in range(rp.num_batches):
+            for dcol in range(preds_h.shape[1]):
+                ins_w = (sd["show"][i, dcol] > 0).astype(np.float32)
+                if not ins_w.any():
+                    continue  # tail-group filler (dead batch)
+                self.metrics.add_batch(
+                    preds_h[i, dcol], sd["label"][i, dcol], ins_w,
+                    uid=None if sd["uid"] is None else sd["uid"][i, dcol],
+                    rank=(None if sd["rank"] is None
+                          else sd["rank"][i, dcol]),
+                    cmatch=(None if sd["cmatch"] is None
+                            else sd["cmatch"][i, dcol]))
+
     def train_pass_resident(self, pass_or_dataset,
                             log_prefix: str = "") -> Dict[str, float]:
         """Mesh analogue of Trainer.train_pass_resident: the whole pass's
@@ -736,18 +781,30 @@ class ShardedTrainer:
         log = get_logger(__name__)
         timer = Timer()
         timer.start()
-        if len(self.metrics):
-            log.warning(
-                "registry metric variants do not accumulate in the MESH "
-                "resident pass (predictions stay on device inside the "
-                "fori_loop) — use train_pass for metric variants here")
         rp = (pass_or_dataset
               if isinstance(pass_or_dataset, ShardedResidentPass)
               else self.build_resident_pass(pass_or_dataset))
+        want_metrics = len(self.metrics) > 0
+        if want_metrics and jax.process_count() > 1:
+            log.warning(
+                "registry metric variants are single-controller features "
+                "(the replay slices every device row of the collected "
+                "predictions); skipping on this %d-process mesh",
+                jax.process_count())
+            want_metrics = False
+        if want_metrics and rp.side is None:
+            log.warning(
+                "registry metrics need the pass's side channels — this "
+                "prebuilt ShardedResidentPass predates them; rebuild it "
+                "with build_resident_pass, or use train_pass")
+            want_metrics = False
         rp.upload()
-        self.state = self.step_fn.run_resident(self.state, rp, self._rng)
+        self.state, preds = self.step_fn.run_resident(
+            self.state, rp, self._rng, collect_preds=want_metrics)
         jax.block_until_ready(self.state.step)
         rp.mark_trained_rows(self.table)
+        if want_metrics:
+            self._feed_registry_resident(rp, preds)
         self.global_step += rp.num_batches
         timer.pause()
         self.table.state = self.state.table
@@ -778,6 +835,11 @@ class ShardedResidentPass:
         self.num_records = num_records
         self.mesh = mesh
         self.dev = None
+        # host side channels for the post-pass registry replay
+        # ({label, show, uid, rank, cmatch} as [nb, N, B], None where a
+        # batch lacked the channel) — set by build(); kept OUT of the
+        # wire (never uploaded)
+        self.side: Optional[Dict[str, Optional[np.ndarray]]] = None
         # packed wire (same bit-diet as the single-chip ResidentPass —
         # the tunnel/DCN H2D is the scarce resource): fmt maps each
         # GlobalBatch field to its encoding, wire holds the host arrays
@@ -841,9 +903,27 @@ class ShardedResidentPass:
             arrays["meta"] = np.stack([
                 np.array([[b.num_keys, b.pad_segment] for b in g],
                          np.int32) for g in groups])
-        return cls(arrays, n_rec, trainer.mesh,
-                   capacity=trainer.table.capacity, trivial=trivial,
-                   float_wire=getattr(trainer, "float_wire", "f32"))
+        rp = cls(arrays, n_rec, trainer.mesh,
+                 capacity=trainer.table.capacity, trivial=trivial,
+                 float_wire=getattr(trainer, "float_wire", "f32"))
+
+        def stack_opt(field):
+            if any(getattr(b, field) is None for g in groups for b in g):
+                return None
+            return np.stack([np.stack([getattr(b, field) for b in g])
+                             for g in groups])
+
+        # side channels only when the registry will replay them —
+        # unconditionally pinning show + uid/rank/cmatch stacks would
+        # reintroduce the host-memory cost _encode_wire exists to avoid
+        # (double-buffered preloader keeps two passes alive)
+        if len(getattr(trainer, "metrics", ())) > 0:
+            # label/show reference the pre-encode host arrays (no copy);
+            # optional channels stack only if every batch carries them
+            rp.side = {"label": arrays["label"], "show": arrays["show"],
+                       "uid": stack_opt("uid"), "rank": stack_opt("rank"),
+                       "cmatch": stack_opt("cmatch")}
+        return rp
 
     @staticmethod
     def _repad_plan(p: ShardedPullIndex, a: int, a2: int, n: int,

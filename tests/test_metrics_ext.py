@@ -300,3 +300,54 @@ def test_registry_on_sharded_trainer():
     wm = tr_m.metrics.get_metric_msg("wu")
     ws = tr_s.metrics.get_metric_msg("wu")
     assert abs(wm["wuauc"] - ws["wuauc"]) < 0.08, (wm, ws)
+
+
+def test_registry_on_mesh_resident_pass():
+    """Metric variants accumulate in the MESH RESIDENT pass: predictions
+    are collected inside the fori_loop (device-sharded [nb, N, B]) and
+    replayed through the registry post-pass — the outputs must match the
+    mesh STREAMING pass on identical data/seeds (boxps_worker.cc:1267,
+    1337 accumulates monitors in every worker mode unconditionally)."""
+    import jax
+    import optax
+    from paddlebox_tpu.data import DataFeedDesc, DatasetFactory
+    from paddlebox_tpu.data.criteo import generate_criteo_files
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.parallel import make_mesh
+    from paddlebox_tpu.ps import SparseSGDConfig
+    from paddlebox_tpu.ps.sharded import ShardedEmbeddingTable
+    from paddlebox_tpu.train.sharded import ShardedTrainer
+    import tempfile
+    assert len(jax.devices()) >= 8
+    tmp = tempfile.mkdtemp()
+    files = generate_criteo_files(tmp, num_files=1, rows_per_file=1024,
+                                  vocab_per_slot=40, seed=37)
+    desc = DataFeedDesc.criteo(batch_size=32)
+    desc.key_bucket_min = 1024
+    ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=0.0,
+                          learning_rate=0.05, mf_learning_rate=0.05)
+
+    def mk():
+        sh = ShardedEmbeddingTable(8, mf_dim=4, capacity_per_shard=2048,
+                                   cfg=cfg, req_bucket_min=128,
+                                   serve_bucket_min=128)
+        tr = ShardedTrainer(DeepFM(hidden=(16, 8)), sh, desc,
+                            make_mesh(8), tx=optax.adam(1e-2), seed=3)
+        tr.metrics.init_metric("auc2", method="auc")
+        tr.metrics.init_metric("wu", method="wuauc")
+        return tr
+
+    tr_s = mk()   # streaming
+    tr_r = mk()   # resident
+    rs = tr_s.train_pass(ds)
+    rr = tr_r.train_pass_resident(ds)
+    assert rr["ins_num"] == rs["ins_num"]
+    ms, mr = (t.metrics.get_metric_msg("auc2") for t in (tr_s, tr_r))
+    assert mr["ins_num"] == ms["ins_num"] == 1024
+    assert abs(mr["auc"] - ms["auc"]) < 1e-5, (mr, ms)
+    ws, wr = (t.metrics.get_metric_msg("wu") for t in (tr_s, tr_r))
+    assert abs(wr["wuauc"] - ws["wuauc"]) < 1e-5, (wr, ws)
+    assert wr["user_count"] == ws["user_count"]
