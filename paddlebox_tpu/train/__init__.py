@@ -1,6 +1,8 @@
 from paddlebox_tpu.train.step import TrainStep, DeviceBatch, make_device_batch
 from paddlebox_tpu.train.trainer import Trainer
-from paddlebox_tpu.train.dense_modes import AsyncDenseTable, KStepParamSync
+from paddlebox_tpu.train.dense_modes import (AsyncDenseTable, KStepParamSync,
+                                             build_lr_scales,
+                                             lr_map_transform)
 from paddlebox_tpu.train.device_pass import (PassPreloader, ResidentPass,
                                              ResidentPassRunner)
 from paddlebox_tpu.train.checkpoint import CheckpointManager
@@ -10,7 +12,8 @@ from paddlebox_tpu.train.sharded import ShardedTrainer
 from paddlebox_tpu.train.multi_mf_sharded import MultiMfShardedTrainer
 
 __all__ = ["TrainStep", "DeviceBatch", "make_device_batch", "Trainer",
-           "AsyncDenseTable", "KStepParamSync",
+           "AsyncDenseTable", "KStepParamSync", "build_lr_scales",
+           "lr_map_transform",
            "PassPreloader", "ResidentPass", "ResidentPassRunner",
            "CheckpointManager", "MultiMfTrainStep", "MultiMfTrainer",
            "ShardedTrainer", "MultiMfShardedTrainer"]

@@ -37,6 +37,48 @@ log = get_logger(__name__)
 
 
 # ---------------------------------------------------------------------------
+# Per-param dense learning rates (lr_map)
+# ---------------------------------------------------------------------------
+# Reference: InitializeGPUAndLoadModel carries a param-name→lr map applied
+# to .w_0/.b_0 names (box_wrapper.cc:1303-1335), consumed per parameter by
+# the async dense table (boxps_worker.cc:199-204). TPU-native form: a
+# per-leaf UPDATE multiplier (lr_name / base_lr) — applied after the
+# optimizer's update (scaling the grad instead would be normalized away
+# by Adam), so it composes with any optax tx, the in-jit psum mode,
+# ZeRO-1 flat chunks, and the host async table.
+
+def build_lr_scales(params: Any, lr_map: dict, base_lr: float) -> Any:
+    """Pytree of per-leaf multipliers matching ``params``: a leaf whose
+    path (jax keystr, e.g. ``"['params']['Dense_0']['kernel']"``)
+    contains a key of ``lr_map`` gets ``lr_map[key] / base_lr``; first
+    match wins; unmatched leaves get 1.0 (the global lr)."""
+    def scale_of(path, _leaf):
+        ks = jax.tree_util.keystr(path)
+        for pat, lr in lr_map.items():
+            if pat in ks:
+                return float(lr) / float(base_lr)
+        return 1.0
+    return jax.tree_util.tree_map_with_path(scale_of, params)
+
+
+def lr_map_transform(scales: Any):
+    """optax transform scaling each leaf's update by its multiplier —
+    chain AFTER the optimizer: ``optax.chain(optax.adam(base_lr),
+    lr_map_transform(build_lr_scales(params, lr_map, base_lr)))``."""
+    import optax
+
+    def init(params):
+        del params
+        return optax.EmptyState()
+
+    def update(updates, state, params=None):
+        del params
+        return jax.tree.map(lambda u, s: u * s, updates, scales), state
+
+    return optax.GradientTransformation(init, update)
+
+
+# ---------------------------------------------------------------------------
 # K-step periodic parameter averaging (SyncParam analogue)
 # ---------------------------------------------------------------------------
 
@@ -94,8 +136,10 @@ class KStepParamSync:
 # ---------------------------------------------------------------------------
 
 class _HostAdam:
-    def __init__(self, n: int, lr: float, beta1: float, beta2: float,
+    def __init__(self, n: int, lr, beta1: float, beta2: float,
                  eps: float) -> None:
+        """``lr`` is a scalar or a per-element [n] vector (lr_map,
+        boxps_worker.cc:199-204)."""
         self.m = np.zeros(n, np.float32)
         self.v = np.zeros(n, np.float32)
         self.t = 0
@@ -108,6 +152,9 @@ class _HostAdam:
         mhat = self.m / (1 - self.b1 ** self.t)
         vhat = self.v / (1 - self.b2 ** self.t)
         p -= self.lr * mhat / (np.sqrt(vhat) + self.eps)
+
+    def _lr_sel(self, sel: np.ndarray):
+        return self.lr[sel] if isinstance(self.lr, np.ndarray) else self.lr
 
 
 class AsyncDenseTable:
@@ -123,26 +170,40 @@ class AsyncDenseTable:
     def __init__(self, params: Any, lr: float = 1e-3, beta1: float = 0.9,
                  beta2: float = 0.999, eps: float = 1e-8,
                  queue_capacity: int = 64,
-                 is_summary: Optional[Callable[[str], bool]] = None) -> None:
+                 is_summary: Optional[Callable[[str], bool]] = None,
+                 lr_map: Optional[dict] = None) -> None:
+        """``lr_map`` — param-name→lr overrides (path-substring match as
+        in build_lr_scales); unmatched params use the global ``lr``
+        (InitializeGPUAndLoadModel's per-param dense lr map,
+        box_wrapper.cc:1303-1335, consumed boxps_worker.cc:199-204)."""
         from jax.flatten_util import ravel_pytree
 
         host = jax.tree.map(lambda x: np.asarray(x, np.float32), params)
         flat, self._unravel = ravel_pytree(host)
         self._ps = np.array(flat, np.float32)
 
-        # summary mask over the flat vector
+        # summary mask + per-element lr over the flat vector
         leaves_with_path = jax.tree_util.tree_leaves_with_path(host)
         mask = np.zeros(self._ps.size, bool)
+        lr_vec = np.full(self._ps.size, lr, np.float32) if lr_map else None
         off = 0
         pred = is_summary or (lambda name: "summary" in name.lower())
         for path, leaf in leaves_with_path:
             n = int(np.size(leaf))
-            if pred(jax.tree_util.keystr(path)):
+            ks = jax.tree_util.keystr(path)
+            if pred(ks):
                 mask[off:off + n] = True
+            if lr_map:
+                for pat, plr in lr_map.items():
+                    if pat in ks:
+                        lr_vec[off:off + n] = plr
+                        break
             off += n
         self._summary_mask = mask
 
-        self._adam = _HostAdam(self._ps.size, lr, beta1, beta2, eps)
+        self._adam = _HostAdam(self._ps.size,
+                               lr if lr_vec is None else lr_vec,
+                               beta1, beta2, eps)
         self._q: Channel = Channel(capacity=queue_capacity)
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
@@ -183,7 +244,7 @@ class AsyncDenseTable:
         a.v[sel] = a.b2 * a.v[sel] + (1 - a.b2) * g[sel] ** 2
         mhat = a.m[sel] / (1 - a.b1 ** a.t)
         vhat = a.v[sel] / (1 - a.b2 ** a.t)
-        self._ps[sel] -= a.lr * mhat / (np.sqrt(vhat) + a.eps)
+        self._ps[sel] -= a._lr_sel(sel) * mhat / (np.sqrt(vhat) + a.eps)
 
     # -- worker API ---------------------------------------------------------
 

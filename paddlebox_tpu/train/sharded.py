@@ -213,9 +213,16 @@ class ShardedTrainStep:
         use_cvm: bool = True,
         cvm_offset: int = 2,
         zero1: bool = False,
+        lr_scales: Any = None,
     ) -> None:
+        """``lr_scales`` — per-leaf update multipliers (pytree matching
+        params, from dense_modes.build_lr_scales): the per-param dense
+        lr_map (box_wrapper.cc:1303-1335) applied after tx.update so it
+        composes with Adam and the ZeRO-1 flat chunks."""
         self.model = model
         self.tx = tx
+        self.lr_scales = lr_scales
+        self._zero1_scaled = False  # set at init_state
         self.sgd_cfg = sgd_cfg
         self.mesh = mesh
         self.n = mesh.shape[DATA_AXIS]
@@ -276,6 +283,21 @@ class ShardedTrainStep:
             pad = self.n * self._chunk - self._psize
             chunks = jnp.pad(flat, (0, pad)).reshape(self.n, self._chunk)
             opt_state = jax.vmap(self.tx.init)(chunks)
+            self._zero1_scaled = self.lr_scales is not None
+            if self._zero1_scaled:
+                # lr_map through the flat-chunk layout: ravel per-leaf
+                # multipliers exactly as params ravel, pad with 1s. The
+                # chunks ride INSIDE opt_state (sharded over the mesh
+                # axis) so each device holds only its own [chunk] slice —
+                # a closure constant would replicate the full param-size
+                # array per device, against ZeRO-1's point
+                sflat, _ = ravel_pytree(jax.tree.map(
+                    lambda x, s: jnp.full(x.shape, s, jnp.float32),
+                    params, self.lr_scales))
+                scale_chunks = jnp.pad(
+                    sflat, (0, pad), constant_values=1.0).reshape(
+                    self.n, self._chunk)
+                opt_state = (opt_state, scale_chunks)
         else:
             opt_state = self.tx.init(params)
         return ShardedStepState(
@@ -359,17 +381,31 @@ class ShardedTrainStep:
             p_flat, _ = ravel_pytree(state.params)
             p_mine = jnp.pad(p_flat, (0, pad)).reshape(
                 self.n, self._chunk)[me]
-            opt_mine = jax.tree.map(lambda l: l[0], state.opt_state)
+            opt_st = state.opt_state
+            scale_mine = None
+            if getattr(self, "_zero1_scaled", False):
+                opt_st, scale_block = opt_st  # [1, chunk] device block
+                scale_mine = scale_block[0]
+            opt_mine = jax.tree.map(lambda l: l[0], opt_st)
             updates, opt_mine = self.tx.update(g_mine, opt_mine, p_mine)
+            if scale_mine is not None:
+                # per-param lr_map on this device's flat chunk
+                updates = updates * scale_mine
             p_mine = optax.apply_updates(p_mine, updates)
             p_all = jax.lax.all_gather(p_mine, DATA_AXIS, tiled=True)
             params = self._unravel(p_all[:self._psize])
             opt_state = jax.tree.map(lambda l: l[None], opt_mine)
+            if scale_mine is not None:
+                opt_state = (opt_state, scale_block)
         else:
             # psum == SyncParam's allreduce
             g_params = jax.lax.psum(g_params, DATA_AXIS)
             updates, opt_state = self.tx.update(g_params, state.opt_state,
                                                 state.params)
+            if self.lr_scales is not None:
+                # per-param lr_map (boxps_worker.cc:199-204)
+                updates = jax.tree.map(lambda u, s: u * s, updates,
+                                       self.lr_scales)
             params = optax.apply_updates(state.params, updates)
 
         pred = jax.nn.sigmoid(logits)
@@ -558,9 +594,18 @@ class ShardedTrainer:
     def __init__(self, model, table: ShardedEmbeddingTable, desc, mesh: Mesh,
                  tx: Optional[optax.GradientTransformation] = None,
                  use_cvm: bool = True, prefetch: int = 4, seed: int = 0,
-                 zero1: bool = False, float_wire: str = "f32") -> None:
+                 zero1: bool = False, float_wire: str = "f32",
+                 lr_map: Optional[dict] = None,
+                 lr_map_base: float = 1.0) -> None:
         """``float_wire="q8"`` ships resident-pass dense/label/show/clk
-        as the int8 affine wire (opt-in: ~1e-2 dense rounding)."""
+        as the int8 affine wire (opt-in: ~1e-2 dense rounding).
+
+        ``lr_map`` — per-param dense learning-rate overrides, name
+        (path-substring) → lr, against ``lr_map_base`` (the tx's base
+        lr): each matched leaf's UPDATE scales by lr/lr_map_base, so 0.0
+        freezes a param (InitializeGPUAndLoadModel's lr_map,
+        box_wrapper.cc:1303-1335; consumed boxps_worker.cc:199-204).
+        Respected by both the psum mode and the zero1 flat chunks."""
         import threading as _threading
         self.float_wire = float_wire
         self.model = model
@@ -573,6 +618,12 @@ class ShardedTrainer:
             model, self.tx, table.cfg, mesh, desc.batch_size,
             len(desc.sparse_slots), use_cvm=use_cvm, zero1=zero1)
         params = self.step_fn.init_params(table.mf_dim, desc.dense_dim)
+        if lr_map:
+            # set before init_state (zero1 ravels the scales into its
+            # flat chunks there) and before the first traced step
+            from paddlebox_tpu.train.dense_modes import build_lr_scales
+            self.step_fn.lr_scales = build_lr_scales(params, lr_map,
+                                                     lr_map_base)
         self.state = self.step_fn.init_state(table, params)
         self._rng = jax.random.PRNGKey(seed + 1)
         self.global_step = 0

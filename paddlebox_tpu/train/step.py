@@ -248,11 +248,21 @@ class TrainStep:
         self._jit = jax.jit(self._step, donate_argnums=(0,))
         self._jit_eval = jax.jit(self._eval_step, donate_argnums=(2,))
 
+    @staticmethod
+    def init_params_for(model, batch_size: int, num_slots: int,
+                        mf_dim: int, dense_dim: int, use_cvm: bool = True,
+                        cvm_offset: int = 2) -> Any:
+        """Deterministic dense-param init without a TrainStep (lr_map
+        scale building needs the param pytree before the tx is final)."""
+        d = cvm_offset + 1 + mf_dim if use_cvm else 1 + mf_dim
+        pooled = jnp.zeros((batch_size, num_slots, d))
+        dense = jnp.zeros((batch_size, dense_dim))
+        return model.init(jax.random.PRNGKey(0), pooled, dense)
+
     def init_params(self, mf_dim: int, dense_dim: int) -> Any:
-        d = self.cvm_offset + 1 + mf_dim if self.use_cvm else 1 + mf_dim
-        pooled = jnp.zeros((self.batch_size, self.num_slots, d))
-        dense = jnp.zeros((self.batch_size, dense_dim))
-        return self.model.init(jax.random.PRNGKey(0), pooled, dense)
+        return self.init_params_for(self.model, self.batch_size,
+                                    self.num_slots, mf_dim, dense_dim,
+                                    self.use_cvm, self.cvm_offset)
 
     def init_state(self, table_state: TableState, params: Any,
                    auc: AucState) -> StepState:

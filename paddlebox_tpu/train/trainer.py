@@ -54,11 +54,26 @@ class Trainer:
         use_cvm: bool = True,
         prefetch: int = 4,
         seed: int = 0,
+        lr_map: Optional[dict] = None,
+        lr_map_base: float = 1.0,
     ) -> None:
+        """``lr_map`` — per-param dense lr overrides, name
+        (path-substring) → lr against ``lr_map_base``; implemented by
+        chaining a per-leaf update scaler after ``tx``
+        (box_wrapper.cc:1303-1335, boxps_worker.cc:199-204)."""
         self.model = model
         self.table = table
         self.desc = desc
         self.tx = tx or optax.adam(1e-3)
+        if lr_map:
+            from paddlebox_tpu.train.dense_modes import (build_lr_scales,
+                                                         lr_map_transform)
+            scales = build_lr_scales(
+                TrainStep.init_params_for(
+                    model, desc.batch_size, len(desc.sparse_slots),
+                    table.mf_dim, desc.dense_dim, use_cvm=use_cvm),
+                lr_map, lr_map_base)
+            self.tx = optax.chain(self.tx, lr_map_transform(scales))
         self.step_fn = TrainStep(
             model, self.tx, table.cfg, desc.batch_size,
             len(desc.sparse_slots), use_cvm=use_cvm, rng_seed=seed)
