@@ -194,12 +194,16 @@ def main() -> None:
         # Device-resident passes with double-buffered preload — the
         # reference's steady state (preload_into_memory overlaps training,
         # BeginPass stages the pass in HBM; SURVEY.md §3.3). Pass 0 pays
-        # compile+upload; measurement covers passes 1..num_passes wall
-        # clock, preloads overlapped. Datasets are materialized up front:
+        # compile+upload; measurement is ADAPTIVE: at least BENCH_PASSES
+        # passes, extended until the trimmed estimate stabilizes within
+        # 10% (a bimodal tunnel cannot fake a steady rate) or a
+        # pass/wall budget is hit. Datasets come from a cycled pool:
         # synthetic data GENERATION is the data source, not the system
         # under test (the measured pipeline still includes batch build,
         # row assign and upload via the preloader).
-        datasets = iter([make_ds(s) for s in range(num_passes + 1)])
+        import itertools
+        pool = [make_ds(s) for s in range(4)]
+        datasets = itertools.cycle(pool)
         # q8 float wire (per-column affine int8 dense + exact-u8
         # label/show/clk) — the H2D wire is the measured bottleneck on
         # tunneled runtimes and CTR dense features fit 8-bit affine
@@ -216,13 +220,33 @@ def main() -> None:
         rp = pre.wait()
         pre.start_next()
         tr.train_pass_resident(rp)          # warmup/compile pass
-        # per-pass wall includes that pass's preload wait; the
-        # steady-state estimate below drops the single worst pass and
-        # uses total records / total remaining wall
+        # per-pass wall includes that pass's preload wait
         walls_l, waits_l, trains_l, rates_l, wire_l = [], [], [], [], []
         debug = os.environ.get("BENCH_DEBUG", "0") == "1"
         no_overlap = os.environ.get("BENCH_NO_OVERLAP", "0") == "1"
-        for _ in range(num_passes):
+        max_passes = int(os.environ.get("BENCH_MAX_PASSES",
+                                        str(max(12, num_passes))))
+        budget_s = float(os.environ.get("BENCH_WALL_BUDGET_SEC", "180"))
+
+        def trimmed_kept(walls):
+            """Indices of the kept passes after dropping the worst ~20%
+            (≥1, but never the only pass): one-off tunnel stalls are
+            environment noise; the TOTAL-based rate over the kept passes
+            resists the alternating-wall pattern a plain median
+            overstates."""
+            d = max(1, len(walls) // 5) if len(walls) > 1 else 0
+            order = np.argsort(walls)
+            return order[:len(walls) - d], d
+
+        def trimmed_estimate(walls):
+            kept, d = trimmed_kept(walls)
+            return (num_records * len(kept)
+                    / sum(walls[i] for i in kept) / chips), d
+
+        est_hist = []
+        stable = False
+        bench_t0 = time.perf_counter()
+        while True:
             t0 = time.perf_counter()
             rp = pre.wait()
             t_wait = time.perf_counter() - t0
@@ -243,6 +267,27 @@ def main() -> None:
             rates_l.append(rp.num_records / wall)
             if hasattr(rp, "nbytes"):
                 wire_l.append(rp.nbytes())
+            if len(walls_l) >= 2:
+                est_hist.append(trimmed_estimate(walls_l)[0])
+            if len(walls_l) < num_passes:
+                continue
+            # stable = two consecutive estimate moves both within 10%
+            stable = (len(est_hist) >= 3
+                      and abs(est_hist[-1] - est_hist[-2])
+                      <= 0.10 * est_hist[-2]
+                      and abs(est_hist[-2] - est_hist[-3])
+                      <= 0.10 * est_hist[-3])
+            if stable or len(walls_l) >= max_passes \
+                    or time.perf_counter() - bench_t0 > budget_s:
+                break
+        # drain the in-flight preload before the wire-free rerun: the
+        # cycled dataset source ALWAYS has a next pass building, and its
+        # background batch-build + H2D upload would contaminate dev_only
+        # (deflating device_only_ex_per_sec / device_busy_frac)
+        import jax
+        rp_next = pre.wait()
+        if rp_next is not None and getattr(rp_next, "dev", None) is not None:
+            jax.block_until_ready(jax.tree.leaves(rp_next.dev))
         # device-only rate: re-run the LAST staged pass (its wire is
         # already resident, so nothing rides the tunnel) — the clean
         # numerator for MFU / duty-cycle attribution. NOTE: this is a
@@ -253,29 +298,35 @@ def main() -> None:
         t0 = time.perf_counter()
         tr.train_pass_resident(rp)
         dev_only = rp.num_records / (time.perf_counter() - t0)
-        # steady-state estimate: drop the single worst pass (one-off
-        # tunnel stalls are environment noise), then TOTAL-based rate —
-        # a plain median can overstate when pass walls alternate
-        walls = sorted(walls_l)
-        if len(walls) > 1:
-            walls = walls[:-1]
-        value = num_records * len(walls) / sum(walls) / chips
+        value, n_dropped = trimmed_estimate(walls_l)
         # evidence block: per-pass arrays + duty cycle + wire + MFU
         # (PrintSyncTimer per-stage reporting, box_wrapper.cc:1182)
         params = (tr.state.params if hasattr(tr.state, "params")
                   else None)
         fpe = dense_flops_per_example(params) if params is not None else 0
         peak = float(os.environ.get("BENCH_PEAK_TFLOPS", "459")) * 1e12
+        # honest duty cycle: the device's ACTUAL compute time per pass is
+        # records/dev_only (wire-free rerun); jnp.asarray is lazy, so
+        # sum(train)/sum(wall) counts in-step H2D waits as "busy" and
+        # saturates exactly when the device is idlest (the round-3
+        # reviewer finding) — report both, clearly named
+        n_meas = len(walls_l)
+        dev_time_total = num_records * n_meas / max(dev_only, 1e-9)
         extras.update(
-            passes=num_passes,
+            passes=n_meas,
+            passes_dropped=n_dropped,
+            estimate_stable=stable,
             per_pass_wall_sec=[round(w, 3) for w in walls_l],
             per_pass_wait_sec=[round(w, 3) for w in waits_l],
             per_pass_train_sec=[round(w, 3) for w in trains_l],
             per_pass_ex_per_sec=[round(r, 1) for r in rates_l],
-            # fraction of the measured wall the device spent inside the
-            # resident pass program (vs waiting on preload/upload)
-            device_busy_frac=round(sum(trains_l) / max(sum(walls_l),
-                                                       1e-9), 4),
+            # fraction of wall the device spent on real compute
+            device_busy_frac=round(
+                min(dev_time_total / max(sum(walls_l), 1e-9), 1.0), 4),
+            # fraction of wall spent inside the step CALL (includes
+            # waiting on in-flight wire — NOT device busyness)
+            wall_in_step_frac=round(sum(trains_l) / max(sum(walls_l),
+                                                        1e-9), 4),
             flops_per_example_dense=round(fpe),
             # per-chip rate over one chip's peak (value is already /chips)
             mfu_dense=round(value * fpe / peak, 6),
@@ -285,10 +336,24 @@ def main() -> None:
             peak_tflops_assumed=peak / 1e12,
         )
         if wire_l:
+            wire_rate = sum(wire_l) / 1e6 / max(sum(walls_l), 1e-9)
+            # the normalized rate uses the SAME kept-pass set as the
+            # trimmed headline — mixing a trimmed numerator with an
+            # untrimmed wire rate would inflate with stall count
+            kept, _ = trimmed_kept(walls_l)
+            kept_wire_rate = (sum(wire_l[i] for i in kept) / 1e6
+                              / max(sum(walls_l[i] for i in kept), 1e-9))
             extras.update(
                 wire_mb_per_pass=round(np.mean(wire_l) / 1e6, 2),
-                wire_mb_per_sec=round(
-                    sum(wire_l) / 1e6 / max(sum(walls_l), 1e-9), 2))
+                wire_bytes_per_record=round(
+                    np.mean(wire_l) / num_records, 1),
+                wire_mb_per_sec=round(wire_rate, 2),
+                # FIRST-CLASS wire-normalized rate: ex/s per wire-MB/s is
+                # invariant to tunnel weather (code speed per unit of
+                # wire the box actually moved) — the reproducible
+                # companion when the raw headline rides a shared tunnel
+                ex_per_sec_per_wire_mb_per_sec=round(
+                    value / max(kept_wire_rate, 1e-9), 1))
     baseline_per_chip = 1_000_000 / 16  # v5p-32 north-star / chips
     print(json.dumps({
         "metric": metric,

@@ -97,3 +97,90 @@ class InputTable:
             if r is not None:
                 out[i] = self._rows[r]
         return jnp.asarray(out)
+
+    def load_index_filelist(self, filelist: Sequence[str],
+                            parse_index_line=None,
+                            thread_num: int = 4) -> int:
+        """The ``InputIndexDataFeed`` role (data_feed.h:2289,
+        data_feed.cc:4637; driven by InputTableDataset::
+        LoadIndexIntoMemory, data_set.cc:3195): load index files of
+        ``key → float vector`` rows into this table with a reader-thread
+        pool and a pluggable line parser.
+
+        ``parse_index_line(line) -> (key, values) | None`` is the
+        ``ISlotParser::ParseIndexData`` hook; the default parses
+        ``key<TAB>v0 v1 ...`` (space- or comma-separated floats). Bad
+        LINES/ROWS are skipped with a warning (the reference's reader
+        callback contract); a missing/unreadable FILE raises. Files
+        parse in parallel but apply in FILELIST ORDER — a key appearing
+        in several files deterministically keeps the last file's row.
+        Returns the number of rows applied (overwrites included)."""
+        import threading
+        from paddlebox_tpu.utils.logging import get_logger
+        log = get_logger(__name__)
+
+        def default_parse(line: str):
+            parts = line.rstrip("\n").split("\t", 1)
+            if len(parts) != 2:
+                return None
+            vals = parts[1].replace(",", " ").split()
+            return parts[0], [float(v) for v in vals]
+
+        parse = parse_index_line or default_parse
+        lock = threading.Lock()
+        files = list(filelist)
+        fidx = [0]
+        parsed: List[Optional[list]] = [None] * len(files)
+        errors: List[BaseException] = []
+
+        def worker() -> None:
+            while True:
+                with lock:
+                    if errors or fidx[0] >= len(files):
+                        return
+                    i = fidx[0]
+                    fidx[0] += 1
+                path = files[i]
+                try:
+                    rows = []
+                    with open(path, "r") as fh:
+                        for line in fh:
+                            try:
+                                item = parse(line)
+                            except (ValueError, IndexError):
+                                item = None
+                            if item is None:
+                                log.warning("index feed: bad line in %s "
+                                            "skipped", path)
+                                continue
+                            rows.append(item)
+                    parsed[i] = rows
+                except BaseException as e:
+                    # a missing/unreadable FILE is an error, not a skip —
+                    # surface it instead of returning a partial count
+                    with lock:
+                        errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(max(1, thread_num))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        # apply in FILELIST order: duplicate keys deterministically keep
+        # the last file's row regardless of thread completion order
+        added = 0
+        for i, rows in enumerate(parsed):
+            for key, vals in rows or ():
+                try:
+                    self.add_input(key, vals)
+                    added += 1
+                except ValueError:
+                    # wrong-width vector: skip the row, as the
+                    # reference's reader callback does
+                    log.warning("index feed: bad row %r in %s skipped",
+                                key, files[i])
+        return added

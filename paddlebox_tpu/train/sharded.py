@@ -614,16 +614,23 @@ class ShardedTrainer:
         self.mesh = mesh
         self.n = mesh.shape[DATA_AXIS]
         self.tx = tx or optax.adam(1e-3)
+        lr_scales = None
+        params = None
+        if lr_map:
+            from paddlebox_tpu.train.dense_modes import build_lr_scales
+            from paddlebox_tpu.train.step import TrainStep
+            # deterministic param init (same formula as init_params) so
+            # the scales can ride the constructor, not a post-hoc poke
+            params = TrainStep.init_params_for(
+                model, desc.batch_size, len(desc.sparse_slots),
+                table.mf_dim, desc.dense_dim, use_cvm=use_cvm)
+            lr_scales = build_lr_scales(params, lr_map, lr_map_base)
         self.step_fn = ShardedTrainStep(
             model, self.tx, table.cfg, mesh, desc.batch_size,
-            len(desc.sparse_slots), use_cvm=use_cvm, zero1=zero1)
-        params = self.step_fn.init_params(table.mf_dim, desc.dense_dim)
-        if lr_map:
-            # set before init_state (zero1 ravels the scales into its
-            # flat chunks there) and before the first traced step
-            from paddlebox_tpu.train.dense_modes import build_lr_scales
-            self.step_fn.lr_scales = build_lr_scales(params, lr_map,
-                                                     lr_map_base)
+            len(desc.sparse_slots), use_cvm=use_cvm, zero1=zero1,
+            lr_scales=lr_scales)
+        if params is None:
+            params = self.step_fn.init_params(table.mf_dim, desc.dense_dim)
         self.state = self.step_fn.init_state(table, params)
         self._rng = jax.random.PRNGKey(seed + 1)
         self.global_step = 0

@@ -65,19 +65,20 @@ class Trainer:
         self.table = table
         self.desc = desc
         self.tx = tx or optax.adam(1e-3)
+        params = None
         if lr_map:
             from paddlebox_tpu.train.dense_modes import (build_lr_scales,
                                                          lr_map_transform)
-            scales = build_lr_scales(
-                TrainStep.init_params_for(
-                    model, desc.batch_size, len(desc.sparse_slots),
-                    table.mf_dim, desc.dense_dim, use_cvm=use_cvm),
-                lr_map, lr_map_base)
+            params = TrainStep.init_params_for(
+                model, desc.batch_size, len(desc.sparse_slots),
+                table.mf_dim, desc.dense_dim, use_cvm=use_cvm)
+            scales = build_lr_scales(params, lr_map, lr_map_base)
             self.tx = optax.chain(self.tx, lr_map_transform(scales))
         self.step_fn = TrainStep(
             model, self.tx, table.cfg, desc.batch_size,
             len(desc.sparse_slots), use_cvm=use_cvm, rng_seed=seed)
-        params = self.step_fn.init_params(table.mf_dim, desc.dense_dim)
+        if params is None:
+            params = self.step_fn.init_params(table.mf_dim, desc.dense_dim)
         self.state = self.step_fn.init_state(table.state, params,
                                              init_auc_state())
         # table.state now lives inside self.state; keep table's handle in

@@ -138,12 +138,14 @@ from paddlebox_tpu.data.criteo import generate_criteo_files
 from paddlebox_tpu.distributed import ElasticManager, TcpKVStore
 from paddlebox_tpu.models import DeepFM
 from paddlebox_tpu.parallel import make_mesh
-from paddlebox_tpu.ps import SparseSGDConfig
+from paddlebox_tpu.ps import BoxPSHelper, SparseSGDConfig
 from paddlebox_tpu.ps.sharded import ShardedEmbeddingTable
+from paddlebox_tpu.ps.tiered import TieredShardedEmbeddingTable
 from paddlebox_tpu.train.checkpoint import CheckpointManager
 from paddlebox_tpu.train.sharded import ShardedTrainer
 
 rank = int(os.environ["PBOX_RANK"])
+table_kind = os.environ.get("TABLE_KIND", "sharded")
 world = int(os.environ["PBOX_WORLD_SIZE"])
 out_dir = pathlib.Path(sys.argv[1])
 n_passes = int(os.environ["N_PASSES"])
@@ -176,11 +178,20 @@ ds.load_into_memory()
 MESH_N = 4
 cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=0.0,
                       learning_rate=0.1, mf_learning_rate=0.1)
-table = ShardedEmbeddingTable(MESH_N, mf_dim=4, capacity_per_shard=4096,
-                              cfg=cfg, req_bucket_min=128,
-                              serve_bucket_min=128)
+if table_kind == "tiered":
+    # the production topology: per-process host-tier stores fronting the
+    # HBM pass windows — a replacement rank has EMPTY stores and must
+    # rebuild them from the save_base/delta chain (box_wrapper.cc:1415)
+    table = TieredShardedEmbeddingTable(
+        MESH_N, mf_dim=4, capacity_per_shard=4096, cfg=cfg,
+        req_bucket_min=128, serve_bucket_min=128)
+else:
+    table = ShardedEmbeddingTable(MESH_N, mf_dim=4, capacity_per_shard=4096,
+                                  cfg=cfg, req_bucket_min=128,
+                                  serve_bucket_min=128)
 tr = ShardedTrainer(DeepFM(hidden=(16, 16)), table, desc, make_mesh(MESH_N),
                     tx=optax.adam(2e-3), seed=7 + rank)
+helper = BoxPSHelper(table, trainer=tr) if table_kind == "tiered" else None
 nb_per_pass = sum(1 for _ in tr._group_iter(ds.batches()))
 
 cm = CheckpointManager(str(out_dir / f"ckpt_r{rank}"), keep=10)
@@ -194,7 +205,11 @@ if resume:
 
 res = None
 for p in range(start_pass, n_passes):
+    if helper is not None:
+        helper.begin_pass(ds)
     res = tr.train_pass(ds)
+    if helper is not None:
+        helper.end_pass(ds)
     if kill_after is not None and resume is None and rank == 1 \\
             and p == int(kill_after):
         # die WITHOUT checkpointing this pass: the work since the last
@@ -209,7 +224,9 @@ for p in range(start_pass, n_passes):
                 and reader.latest_checkpoint() is None:
             _time.sleep(0.2)
         os._exit(1)
-    cm.save(tr)
+    # tiered: exercise the base + DELTA chain (the xbox save pattern) —
+    # restore must replay it into the rebuilt host stores
+    cm.save(tr, delta=(table_kind == "tiered" and p > 0))
     if rank == 0:
         pub.publish_checkpoint(str(out_dir), pass_id=p)
 
@@ -221,6 +238,16 @@ if res is not None:
                global_step=int(tr.global_step),
                param_sum=float(np.abs(params).sum()),
                features=int(table.feature_count()))
+    if table_kind == "tiered":
+        # host-tier content fingerprint: the rebuilt-from-checkpoint
+        # stores must match the uninterrupted run's
+        hsum = 0.0
+        for hs in table.hosts:
+            ks, _ = hs.index.items()
+            if len(ks):
+                hsum += float(np.abs(
+                    hs.fetch(np.sort(ks))["embed_w"]).sum())
+        out["host_sum"] = hsum
     with open(out_dir / f"final_r{rank}.json", "w") as fh:
         json.dump(out, fh)
     np.save(out_dir / f"params_r{rank}.npy", params)
@@ -233,13 +260,22 @@ em.deregister()
 
 
 @pytest.mark.slow
-def test_elastic_restart_of_real_sharded_trainer(tmp_path):
+@pytest.mark.parametrize("table_kind", ["sharded", "tiered"])
+def test_elastic_restart_of_real_sharded_trainer(tmp_path, table_kind):
     """THE elastic flagship (fleet/elastic/manager.py:131,248-250): a
     2-process gang of REAL ShardedTrainers (4-dev virtual CPU mesh each),
     membership over TcpKVStore. Rank 1 is killed mid-run WITHOUT saving
     its in-flight pass; the launcher restarts the gang from the published
     checkpoint pointer; both ranks resume at their last pass boundary.
-    The final AUC/loss/params must MATCH an uninterrupted run."""
+    The final AUC/loss/params must MATCH an uninterrupted run.
+
+    ``tiered`` composes the gang restart with
+    TieredShardedEmbeddingTable — the production topology where each
+    process's host-tier stores are in-memory state: the replacement rank
+    rebuilds them by replaying the base + DELTA checkpoint chain
+    (LoadSSD2Mem on recovery, box_wrapper.cc:1415), runs the pass
+    protocol (begin/end pass windows), and its final host-tier content
+    must fingerprint-match the uninterrupted run's."""
     import json
     import subprocess
     import numpy as np
@@ -256,6 +292,7 @@ def test_elastic_restart_of_real_sharded_trainer(tmp_path):
             "PBOX_WORLD_SIZE": "2", "KV_ENDPOINT": endpoint,
             "N_PASSES": str(n_passes), "JAX_PLATFORMS": "cpu",
             "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "TABLE_KIND": table_kind,
             "PYTHONPATH": repo + os.pathsep
             + os.environ.get("PYTHONPATH", ""),
         }
@@ -301,6 +338,11 @@ def test_elastic_restart_of_real_sharded_trainer(tmp_path):
         pa = np.load(tmp_path / "killed" / f"params_r{r}.npy")
         pb = np.load(tmp_path / "clean" / f"params_r{r}.npy")
         np.testing.assert_allclose(pa, pb, rtol=1e-6, atol=1e-7)
+        if table_kind == "tiered":
+            # host stores rebuilt from the base+delta chain match the
+            # uninterrupted run's host-tier content
+            assert np.isclose(a["host_sum"], b["host_sum"],
+                              rtol=1e-6), (a, b)
 
 
 def test_tcp_kv_store_matches_file_kv(tmp_path):

@@ -198,6 +198,56 @@ def test_replica_cache_and_input_table():
     np.testing.assert_allclose(got[2], [1, 2, 3])
 
 
+def test_input_index_feed_loads_filelist(tmp_path):
+    """InputIndexDataFeed (data_feed.h:2289, data_feed.cc:4637): index
+    files of key→vector rows load into the InputTable through a
+    reader-thread pool with a pluggable parser; bad lines skip."""
+    from paddlebox_tpu.ps import InputTable
+    f1 = tmp_path / "idx1.txt"
+    f1.write_text("adv_1\t1 2 3\nadv_2\t4,5,6\nBADLINE\nadv_3\t7 8 9\n")
+    f2 = tmp_path / "idx2.txt"
+    f2.write_text("adv_4\t-1 -2 -3\n")
+    it = InputTable(dim=3)
+    n = it.load_index_filelist([str(f1), str(f2)], thread_num=2)
+    assert n == 4 and len(it) == 4
+    got = np.asarray(it.lookup(["adv_2", "adv_4"]))
+    np.testing.assert_allclose(got[0], [4, 5, 6])
+    np.testing.assert_allclose(got[1], [-1, -2, -3])
+
+    # pluggable parser (the ParseIndexData hook)
+    f3 = tmp_path / "idx3.txt"
+    f3.write_text("k9|9;9;9\n")
+    it2 = InputTable(dim=3)
+    it2.load_index_filelist(
+        [str(f3)],
+        parse_index_line=lambda ln: (
+            (p := ln.strip().split("|"))[0],
+            [float(v) for v in p[1].split(";")]))
+    np.testing.assert_allclose(np.asarray(it2.lookup(["k9"]))[0], 9.0)
+
+    # a wrong-width vector skips the ROW; a missing FILE raises (no
+    # silent partial loads)
+    f4 = tmp_path / "idx4.txt"
+    f4.write_text("short\t1 2\nok\t1 2 3\n")
+    it3 = InputTable(dim=3)
+    assert it3.load_index_filelist([str(f4)]) == 1
+    import pytest as _pytest
+    with _pytest.raises(FileNotFoundError):
+        it3.load_index_filelist([str(tmp_path / "nope.txt"), str(f4)],
+                                thread_num=1)
+
+    # duplicate keys across files: LAST file in filelist order wins,
+    # deterministically, regardless of reader-thread completion order
+    fa = tmp_path / "dup_a.txt"
+    fa.write_text("k\t1 1 1\n")
+    fb = tmp_path / "dup_b.txt"
+    fb.write_text("k\t2 2 2\n")
+    it4 = InputTable(dim=3)
+    assert it4.load_index_filelist([str(fa), str(fb)], thread_num=2) == 2
+    assert len(it4) == 1
+    np.testing.assert_allclose(np.asarray(it4.lookup(["k"]))[0], 2.0)
+
+
 def test_extended_embedding_table():
     from paddlebox_tpu.data.batch import SlotBatch
     from paddlebox_tpu.ps import ExtendedEmbeddingTable, SparseSGDConfig
