@@ -165,6 +165,8 @@ def main() -> None:
         if "BENCH_ARENA" in os.environ:
             print("warning: BENCH_ARENA is ignored in sharded mode",
                   file=sys.stderr)
+    elif mode == "tiered":
+        pass  # table/trainer built inside the tiered measurement branch
     else:
         # slot-arena allocation → the resident path ships the COMPACT
         # wire (per-key ~17-bit slot-local rows, no dedup streams); set
@@ -180,7 +182,90 @@ def main() -> None:
     extras = {"mode": mode, "shape": shape, "batch_size": bs,
               "records_per_pass": num_records, "num_slots": shape_slots,
               "avg_keys_per_slot": shape_avg}
-    if mode == "streaming":
+    if mode == "tiered":
+        # pass-window benchmark: the tiered sharded PS with PERSISTENT
+        # HBM windows (ps/tiered.py). Consecutive passes draw from the
+        # same key space (the CTR workload), so delta staging should
+        # shrink the begin_pass boundary stall to ~the working-set
+        # delta; a drop_window control pass measures what full
+        # re-staging would cost on the same box state.
+        import jax
+        from paddlebox_tpu.parallel import make_mesh
+        from paddlebox_tpu.ps import BoxPSHelper
+        from paddlebox_tpu.ps.tiered import TieredShardedEmbeddingTable
+        from paddlebox_tpu.train.sharded import ShardedTrainer
+        chips = len(jax.devices())
+        metric += "_tiered"
+        # smaller working set than the resident headline: the cold
+        # stage ships the full working set over the tunnel once
+        num_records = int(os.environ.get("BENCH_RECORDS", 32768))
+        shape_vocab = int(os.environ.get("BENCH_VOCAB", 10_000))
+        extras.update(records_per_pass=num_records)
+        mesh = make_mesh(chips)
+        table = TieredShardedEmbeddingTable(
+            chips, mf_dim=mf_dim, capacity_per_shard=(1 << 22) // chips,
+            cfg=cfg, req_bucket_min=1 << 12, serve_bucket_min=1 << 12)
+        tr = ShardedTrainer(DeepFM(hidden=(512, 256, 128)), table,
+                            desc, mesh, tx=optax.adam(1e-3))
+        helper = BoxPSHelper(table, trainer=tr)
+        pool = [make_ds(s) for s in range(2)]
+        n_meas = int(os.environ.get("BENCH_PASSES", 4))
+
+        def one_pass(ds, stage_overlap=None):
+            t0 = time.perf_counter()
+            helper.begin_pass(ds)
+            t_begin = time.perf_counter() - t0
+            if stage_overlap is not None:
+                helper.stage_pass(stage_overlap)  # overlapped pre-build
+            t1 = time.perf_counter()
+            tr.train_pass_resident(ds)
+            t_train = time.perf_counter() - t1
+            t2 = time.perf_counter()
+            helper.end_pass(ds)
+            t_end = time.perf_counter() - t2
+            return t_begin, t_train, t_end, dict(table.last_pass_stats)
+
+        # cold pass: full stage + compile (not measured in the headline)
+        b0, _, e0, st0 = one_pass(pool[0])
+        begin_l, train_l, end_l, staged_l = [], [], [], []
+        for i in range(n_meas):
+            ds = pool[(i + 1) % 2]
+            nxt = pool[i % 2]
+            b, t, e, st = one_pass(ds, stage_overlap=nxt)
+            begin_l.append(b)
+            train_l.append(t)
+            end_l.append(e)
+            staged_l.append(st["staged"])
+        # control: drop residency, re-stage the SAME working set as the
+        # last measured pass, fully (drop_window also discards the
+        # stage the last pass overlapped)
+        table.drop_window()
+        t0 = time.perf_counter()
+        helper.begin_pass(pool[n_meas % 2])
+        begin_full = time.perf_counter() - t0
+        staged_full = table.last_pass_stats["staged"]
+        helper.end_pass(None)
+        walls = [b + t + e for b, t, e in zip(begin_l, train_l, end_l)]
+        value = num_records * len(walls) / sum(walls) / chips
+        # steady state = the median begin (the first delta pass pays the
+        # scatter compile; later passes show the true boundary)
+        begin_steady = float(np.median(begin_l))
+        extras.update(
+            passes=n_meas,
+            stage_cold_sec=round(b0, 3),
+            staged_rows_cold=st0["staged"],
+            begin_delta_sec=[round(b, 3) for b in begin_l],
+            staged_rows_delta=staged_l,
+            train_sec=[round(t, 3) for t in train_l],
+            end_pass_sec=[round(e, 3) for e in end_l],
+            begin_delta_steady_sec=round(begin_steady, 4),
+            begin_full_control_sec=round(begin_full, 3),
+            staged_rows_full_control=staged_full,
+            # the headline ratio: steady-state boundary stall with delta
+            # staging vs full re-staging of the same working set
+            begin_stall_shrink=round(
+                begin_full / max(begin_steady, 1e-9), 1))
+    elif mode == "streaming":
         ds = make_ds(0)
         warm = InMemoryDataset(desc)
         warm.records = build_records(bs * 3, num_slots=shape_slots,

@@ -74,9 +74,9 @@ class BoxPSHelper:
         stage after reconciling it against the window.
 
         Overlap (staging while a pass is open) requires a table with the
-        persistent-window reconcile (``supports_overlap_stage``);
-        PassScopedTable rebuilds its window every pass, so for it this
-        is only legal between end_pass and the next begin_pass."""
+        persistent-window reconcile (``supports_overlap_stage`` — both
+        PassScopedTable and the tiered sharded tables have it; the guard
+        below protects third-party tables without it)."""
         if (getattr(self.table, "in_pass", False)
                 and not getattr(self.table, "supports_overlap_stage",
                                 False)):
@@ -126,10 +126,35 @@ class BoxPSHelper:
     def save_delta(self, path: str) -> int:
         return self._store().save_delta(path)
 
+    def _check_no_pass(self, what: str) -> None:
+        """Refuse host-tier mutation BEFORE applying it when a pass is
+        open — the guard must precede the mutation or a caller that
+        catches the error is left with a half-applied lifecycle op whose
+        load/decay the still-resident window would overwrite at
+        end_pass (tiered tables guard internally; this covers the
+        PassScopedTable path where the store is mutated directly)."""
+        if getattr(self.table, "in_pass", False):
+            raise RuntimeError(
+                f"{what} while a pass is open — the window's updates "
+                "are not in the host store yet; end_pass first")
+
+    def _invalidate_window(self) -> None:
+        """After a host-tier mutation through a store that is NOT the
+        table itself (PassScopedTable's HostStore), resident window rows
+        would shadow the updated host values — drop them. Tiered tables
+        drop their own window inside load/shrink/merge."""
+        if (self._store() is not self.table
+                and hasattr(self.table, "drop_window")):
+            self.table.drop_window()
+
     def load_model(self, path: str, merge: bool = False) -> int:
-        return self._store().load(path, merge=merge)
+        self._check_no_pass("load_model")
+        n = self._store().load(path, merge=merge)
+        self._invalidate_window()
+        return n
 
     def shrink_table(self, **kw) -> int:
+        self._check_no_pass("shrink_table")
         store = self._store()
         if store is self.table:  # tiered: scores with its own cfg coeffs
             return store.shrink(**kw)
@@ -137,4 +162,6 @@ class BoxPSHelper:
         # device-side shrink agree on what to drop
         kw.setdefault("nonclk_coeff", self.table.cfg.nonclk_coeff)
         kw.setdefault("clk_coeff", self.table.cfg.clk_coeff)
-        return store.shrink(**kw)
+        n = store.shrink(**kw)
+        self._invalidate_window()
+        return n

@@ -1,4 +1,4 @@
-"""Pass-scoped HBM table: per-pass working set promoted from the HostStore.
+"""Pass-scoped HBM table: PERSISTENT pass windows promoted from the HostStore.
 
 Reference lifecycle (SURVEY.md §3.3): ``BeginFeedPass`` schedules SSD→mem
 for the pass's key set, ``BeginPass`` buffers the pass embeddings into HBM,
@@ -6,14 +6,24 @@ training pulls/pushes hit only that working set, ``EndPass`` writes back
 HBM→mem (box_wrapper.cc:129-186; open analogue BuildGPUTask/EndPass,
 ps_gpu_wrapper.cc:684,983).
 
-TPU-native: the device TableState stays statically shaped (pass_capacity
-rows); begin_pass assigns every pass key a fresh row, scatters host-fetched
-values in with one vectorized np write per field, and device_puts the SoA.
-The host fetch can run on a background thread (``stage()``) between
-end_pass and begin_pass (overlapping dataset columnarization); what
-overlaps the previous pass's *training* is the dataset IO/parse/dedup
-(PreLoadIntoMemory/WaitFeedPassDone), since staged values must reflect
-that pass's write-back.
+TPU-native, incremental (the single-chip mirror of
+``TieredShardedEmbeddingTable`` — see ps/tiered.py for the full design
+notes): rows stay RESIDENT in HBM across passes, matching the reference's
+incremental FeedPass (only SSD→mem *misses* are scheduled) and persistent
+HBM windows. ``stage`` fetches host values only for keys NOT already in
+the window and is legal while a pass is OPEN (the overlapped
+pre_build_thread, ps_gpu_wrapper.cc:913) — missing keys are outside the
+open pass's write-back set, so the fetch cannot race ``end_pass``;
+``begin_pass`` reconciles (a key that entered the window mid-pass keeps
+its fresher resident row), evicts only under capacity pressure (clean
+rows first; dirty evictees write back), and device-scatters only the
+delta; ``end_pass`` gathers and writes back only rows touched since the
+last write-back. Host↔HBM wire per pass ∝ the working-set DELTA.
+
+Host-tier mutations outside the pass protocol (``host.load`` / ``shrink``
+/ ``merge``) must be followed by ``drop_window()`` — resident rows would
+otherwise shadow the updated host values (BoxPSHelper does this for its
+lifecycle methods).
 """
 
 from __future__ import annotations
@@ -22,29 +32,41 @@ import threading
 from typing import Dict, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from paddlebox_tpu.config import FLAGS
-from paddlebox_tpu.ps.host_store import FIELDS, HostStore
+from paddlebox_tpu.ps.host_store import HostStore
 from paddlebox_tpu.ps.kv import make_kv
 from paddlebox_tpu.ps.sgd import SparseSGDConfig
-from paddlebox_tpu.ps.table import (NUM_FIXED, EmbeddingTable, TableState,
-                                    field_assign)
+from paddlebox_tpu.ps.table import (EmbeddingTable, promote_window_delta,
+                                    rows_from_store_fields,
+                                    scatter_logical_rows,
+                                    store_fields_from_rows)
 from paddlebox_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
 
 
 class PassStage:
-    """Host-side staging of one pass (keys + fetched values)."""
+    """Host-side staging of one pass: the full key set, the keys that
+    were missing from the window at stage time, and their host values."""
 
-    def __init__(self, keys: np.ndarray, values: Dict[str, np.ndarray]):
+    def __init__(self, keys: np.ndarray, new_keys: np.ndarray,
+                 values: Dict[str, np.ndarray]):
         self.keys = keys
+        self.new_keys = new_keys
         self.values = values
 
 
 class PassScopedTable(EmbeddingTable):
-    """EmbeddingTable whose contents are one pass's working set."""
+    """EmbeddingTable whose contents are a persistent window of the
+    working set; the full model lives in the backing HostStore."""
+
+    # stage() is legal while a pass is open (missing keys are outside
+    # the open window's write-back set) — BoxPSHelper.stage_pass gates
+    # on this
+    supports_overlap_stage = True
 
     def __init__(self, host: HostStore, pass_capacity: Optional[int] = None,
                  cfg: Optional[SparseSGDConfig] = None, seed: int = 0,
@@ -75,31 +97,46 @@ class PassScopedTable(EmbeddingTable):
         self._stage_thread: Optional[threading.Thread] = None
         self._stage_exc: Optional[BaseException] = None
         self.in_pass = False
+        # per-pass delta accounting (same keys as the tiered table)
+        self.last_pass_stats: Dict[str, int] = {}
+
+    # ---- host field <-> logical row conversion --------------------------
+    def _logical_rows(self, vals: Dict[str, np.ndarray]) -> np.ndarray:
+        return rows_from_store_fields(vals, self.mf_dim, self.opt_ext)
+
+    def _store_fields(self, sub: np.ndarray,
+                      rows: np.ndarray) -> Dict[str, np.ndarray]:
+        """Slot comes from host metadata (the device column is not
+        maintained — EmbeddingTable._gather_host does the same)."""
+        return store_fields_from_rows(
+            sub, self.mf_dim, self.opt_ext,
+            slot_override=self.slot_host[rows].astype(np.float32))
+
+    def _gather_rows_device(self, rows: np.ndarray) -> np.ndarray:
+        """Device-side row gather → host [k, feat]: D2H wire is the
+        gathered rows, not the whole table."""
+        return np.asarray(jax.device_get(self.state.data[rows]))
 
     # ---- feed-pass staging (BeginFeedPass/EndFeedPass) ----
     def stage(self, pass_keys: np.ndarray, background: bool = True) -> None:
-        """Fetch the pass working set from the host store. Only legal
-        between the previous end_pass and the next begin_pass: staging
-        while a pass is open would read host rows the open pass has not
-        written back yet (the reference's closed PS enforces the same
-        EndPass→BeginPass order). What overlaps training is the dataset
-        IO/parse/key-dedup (BoxPSHelper.preload_into_memory), not this."""
-        if self.in_pass:
-            raise RuntimeError(
-                "stage() while a pass is open — the open pass's updates "
-                "are not in the host store yet; end_pass first")
-        if self._stage_thread is not None:
+        """Fetch host values for the pass keys NOT already resident.
+        Legal while a pass is open — see the module docstring for the
+        overlap contract."""
+        if self._stage_thread is not None or self._stage is not None:
             raise RuntimeError("a feed pass is already staging")
+        pass_keys = np.unique(np.ascontiguousarray(pass_keys, np.uint64))
         if len(pass_keys) > self.capacity:
             raise ValueError(
                 f"pass working set ({len(pass_keys)}) exceeds table "
                 f"capacity ({self.capacity})")
+        with self.host_lock:
+            new = pass_keys[self.index.lookup(pass_keys) < 0]
         self._stage_exc = None
 
         def run() -> None:
             try:
-                self._stage = PassStage(pass_keys,
-                                        self.host.fetch(pass_keys))
+                self._stage = PassStage(pass_keys, new,
+                                        self.host.fetch(new))
             except BaseException as e:
                 self._stage_exc = e
 
@@ -121,11 +158,15 @@ class PassScopedTable(EmbeddingTable):
 
     # ---- pass window (BeginPass/EndPass) ----
     def begin_pass(self, pass_keys: Optional[np.ndarray] = None) -> int:
-        """Promote the staged (or given) working set into the device table.
-        Returns the number of working-set rows."""
+        """Promote the staged (or given) working set into the device
+        window: reconcile against live residency, evict only under
+        capacity pressure, scatter only the genuinely new rows. Returns
+        the number of working-set rows."""
         if self.in_pass:
             raise RuntimeError("begin_pass while a pass is open")
         if pass_keys is not None:
+            pass_keys = np.unique(
+                np.ascontiguousarray(pass_keys, np.uint64))
             if self._stage_thread is not None or self._stage is not None:
                 # a stage exists: it must be for the same key set, else
                 # promoting it would corrupt rows for keys only in one set
@@ -142,37 +183,67 @@ class PassScopedTable(EmbeddingTable):
             raise RuntimeError("begin_pass with nothing staged")
         self._stage = None
 
-        self.index = make_kv(self.capacity)
-        rows = self.index.assign(st.keys)
-        c1 = self.capacity + 1
-        mf_end = NUM_FIXED + self.mf_dim
-        data = np.zeros((c1, mf_end + self.opt_ext), np.float32)
-        for f in FIELDS:
-            field_assign(data, rows, f, st.values[f])
-        if self.opt_ext:
-            data[rows, mf_end:] = st.values["opt_ext"]
-        # slot is HOST metadata (_gather_host reads slot_host, never the
-        # device column) and the index was just rebuilt (make_kv
-        # reassigns row ids) — reset it wholesale, then seed the staged
-        # slots so a working-set row survives begin_pass → end_pass even
-        # when no prepare()/record_slots touches it during the window
-        # (eval-only passes, staged key supersets)
-        self.slot_host[:] = 0
-        self.slot_host[rows] = st.values["slot"].astype(np.int16)
-        self.state = TableState.from_logical(data, self.capacity,
-                                             ext=self.opt_ext)
-        self._touched[:] = False
+        with self.host_lock:
+            rows_new, still, stats = promote_window_delta(
+                self.index, self._touched, self.capacity,
+                st.keys, st.new_keys,
+                gather_rows=self._gather_rows_device,
+                writeback=lambda ks, rs, sub:
+                    self.host.update(ks, self._store_fields(sub, rs)),
+                on_freed=lambda freed:
+                    self.slot_host.__setitem__(freed, 0))
+            ins_vals = {f: v[still] for f, v in st.values.items()}
+            self.slot_host[rows_new] = ins_vals["slot"].astype(np.int16)
+            if len(rows_new):
+                self.state = scatter_logical_rows(
+                    self.state, None, rows_new,
+                    self._logical_rows(ins_vals))
+        stats["written_back"] = 0
         self.in_pass = True
-        log.info("begin_pass: %d working-set rows in HBM", len(st.keys))
+        self.last_pass_stats = stats
+        log.info("begin_pass: %d working-set rows (%d resident, %d "
+                 "staged, %d evicted) in HBM", len(st.keys),
+                 stats["resident"], stats["staged"], stats["evicted"])
         return len(st.keys)
 
     def end_pass(self) -> int:
-        """Write the (jit-updated) working set back to the host store."""
+        """Write back only the rows touched since the last write-back;
+        the window stays resident for the next pass's reuse."""
         if not self.in_pass:
             raise RuntimeError("end_pass without begin_pass")
-        keys, rows = self.index.items()
-        data = self._gather_host(rows)
-        self.host.update(keys, {f: data[f] for f in self.host.fields})
+        with self.host_lock:
+            keys, rows = self.index.items()
+            m = self._touched[rows]
+            keys, rows = keys[m], rows[m]
+            if len(rows):
+                sub = self._gather_rows_device(rows)
+                self.host.update(keys, self._store_fields(sub, rows))
+                self._touched[rows] = False
         self.in_pass = False
-        log.info("end_pass: %d rows written back to host store", len(keys))
+        self.last_pass_stats["written_back"] = len(keys)
+        log.info("end_pass: %d touched rows written back to host store",
+                 len(keys))
         return len(keys)
+
+    def drop_window(self) -> None:
+        """Invalidate HBM residency (between passes): the next
+        begin_pass re-fetches everything from the host store. Required
+        after host-store mutations outside the pass protocol
+        (load/shrink/merge on ``self.host``) — resident rows would
+        shadow them. Discards any pending stage and zeroes the device
+        rows (released rows must read as fresh zero rows)."""
+        if self.in_pass:
+            raise RuntimeError(
+                "drop_window while a pass is open — the window's updates "
+                "are not in the host store yet; end_pass first")
+        try:
+            if self._stage_thread is not None or self._stage is not None:
+                self.wait_stage_done()
+        finally:
+            self._stage = None
+            with self.host_lock:
+                self.index = make_kv(self.capacity)
+                self._touched[:] = False
+                self.slot_host[:] = 0
+                self.state = self.state.with_packed(
+                    jnp.zeros_like(self.state.packed))

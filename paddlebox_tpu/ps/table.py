@@ -267,6 +267,79 @@ def _flatten_sharded_blob(blob):
     return out
 
 
+def store_fields_from_rows(sub: np.ndarray, mf_dim: int, opt_ext: int,
+                           slot_override: Optional[np.ndarray] = None
+                           ) -> Dict[str, np.ndarray]:
+    """Logical rows [k, feat] → HostStore field dict — THE shared
+    write-back assembly (tiered/pass-scoped end_pass + eviction).
+    embedx is sliced to mf_dim explicitly: field_slice's tail is
+    unbounded and would leak the opt_ext columns into the host store's
+    (k, mf_dim) array. ``slot_override`` substitutes host slot metadata
+    for tables that do not maintain the device slot column."""
+    mf_end = NUM_FIXED + mf_dim
+    vals = {f: (sub[:, NUM_FIXED:mf_end] if f == "embedx_w"
+                else field_slice(sub, f)) for f in FIELDS}
+    if slot_override is not None:
+        vals["slot"] = slot_override
+    if opt_ext:
+        vals["opt_ext"] = sub[:, mf_end:]
+    return vals
+
+
+def rows_from_store_fields(vals: Dict[str, np.ndarray], mf_dim: int,
+                           opt_ext: int) -> np.ndarray:
+    """HostStore field dict → logical rows [k, feat] (the scatter input
+    of delta staging) — inverse of store_fields_from_rows."""
+    k = len(vals["show"])
+    mf_end = NUM_FIXED + mf_dim
+    out = np.zeros((k, mf_end + opt_ext), np.float32)
+    idx = np.arange(k)
+    for f in FIELDS:
+        field_assign(out, idx, f, vals[f])
+    if opt_ext:
+        out[:, mf_end:] = vals["opt_ext"]
+    return out
+
+
+def promote_window_delta(index, touched: np.ndarray, capacity: int,
+                         want_keys: np.ndarray, new_keys: np.ndarray,
+                         gather_rows, writeback, on_freed=None):
+    """THE shared per-window delta-promotion core (tiered shards and the
+    single-chip PassScopedTable — box_wrapper.cc:129-186's incremental
+    window, one place): reconcile the staged delta against the live
+    window (keys that became resident since stage() keep their fresher
+    rows), evict only under capacity pressure (clean rows first; dirty
+    evictees go through ``writeback(keys, rows, gather_rows(rows))``),
+    assign the remaining new keys as clean rows.
+
+    Caller holds the host lock and scatters the staged values for the
+    returned ``rows_new``. Returns (rows_new, still_missing_mask,
+    stats). ``on_freed(rows)`` hooks per-row host metadata cleanup."""
+    still = index.lookup(new_keys) < 0
+    ins_keys = new_keys[still]
+    stats = dict(resident=len(want_keys) - len(ins_keys),
+                 staged=len(ins_keys), evicted=0, evicted_writeback=0)
+    overflow = len(index) + len(ins_keys) - capacity
+    if overflow > 0:
+        live_keys, live_rows = index.items()
+        cand = ~np.isin(live_keys, want_keys)
+        ck, cr = live_keys[cand], live_rows[cand]
+        t = touched[cr]
+        order = np.argsort(t, kind="stable")[:overflow]
+        ck, cr, t = ck[order], cr[order], t[order]
+        if t.any():
+            writeback(ck[t], cr[t], gather_rows(cr[t]))
+            stats["evicted_writeback"] = int(t.sum())
+        freed = index.release(ck)
+        touched[freed] = False
+        if on_freed is not None:
+            on_freed(freed)
+        stats["evicted"] = len(ck)
+    rows_new = index.assign(ins_keys)
+    touched[rows_new] = False  # freshly loaded = clean
+    return rows_new, still, stats
+
+
 def host_pull_block(vals: np.ndarray, mf_dim: int) -> np.ndarray:
     """[k, F] gathered logical rows → [k, 3+mf] pull values (show, clk,
     embed_w, mf_size-gated embedx) — THE host-side CopyForPull block
@@ -335,25 +408,31 @@ def gather_full_rows(state: TableState, unique_rows: jax.Array) -> jax.Array:
     return vals[:, :state._feat] if fp != state._feat else vals
 
 
-def scatter_logical_rows(state: TableState, shard_idx: np.ndarray,
+def scatter_logical_rows(state: TableState, shard_idx,
                          rows: np.ndarray,
                          values: np.ndarray) -> TableState:
-    """ONE device scatter of logical rows into a STACKED packed state
-    [N, L, 128]: row ``rows[k]`` of shard ``shard_idx[k]`` becomes
-    ``values[k]`` (logical width feat). The delta-staging primitive
-    (tiered begin_pass): wire cost is just ``values`` — the table itself
-    never crosses the host↔device boundary. (shard, row) pairs must be
-    unique; pad columns [feat:f_pad] of the line stay untouched (zero
-    by the init/push invariants)."""
+    """ONE device scatter of logical rows into a packed state — stacked
+    [N, L, 128] with ``shard_idx`` per row, or a single table [L, 128]
+    with ``shard_idx=None``: row ``rows[k]`` (of shard ``shard_idx[k]``)
+    becomes ``values[k]`` (logical width feat). The delta-staging
+    primitive (tiered/pass-scoped begin_pass): wire cost is just
+    ``values`` — the table itself never crosses the host↔device
+    boundary. (shard, row) pairs must be unique; pad columns
+    [feat:f_pad] of the line stay untouched (zero by the init/push
+    invariants)."""
     rpl, fp, _ = state.geometry
     feat = state._feat
     rows = np.ascontiguousarray(rows, np.int32)
     lines = rows // rpl
     col0 = (rows % rpl) * fp
     cols = col0[:, None] + np.arange(feat, dtype=np.int32)[None, :]
-    packed = state.packed.at[
-        np.ascontiguousarray(shard_idx, np.int32)[:, None],
-        lines[:, None], cols].set(jnp.asarray(values, state.packed.dtype))
+    vals = jnp.asarray(values, state.packed.dtype)
+    if shard_idx is None:
+        packed = state.packed.at[lines[:, None], cols].set(vals)
+    else:
+        packed = state.packed.at[
+            np.ascontiguousarray(shard_idx, np.int32)[:, None],
+            lines[:, None], cols].set(vals)
     return state.with_packed(packed)
 
 

@@ -44,6 +44,13 @@ cover every key the pass's batches touch — keys outside it allocate fresh
 zero rows in the window. ``ds.pass_keys()`` provides exactly that set.
 Host-tier mutations outside the pass protocol (load/merge_model/shrink)
 invalidate residency — the next begin_pass re-fetches everything.
+
+NOT supported: PassPreloader(build_fn=build_resident_pass) over a tiered
+table — building pass k+1's ROUTING PLANS during pass k assigns k+1's
+keys fresh zero rows before their host values stage (the reconcile then
+keeps those zero rows). Overlap the HOST FETCH with ``stage``/
+``BoxPSHelper.stage_pass`` instead, and build the resident pass after
+``begin_pass`` (what ShardedTrainer.train_pass_resident(dataset) does).
 """
 
 from __future__ import annotations
@@ -58,9 +65,10 @@ import numpy as np
 from paddlebox_tpu.ps.host_store import HostStore
 from paddlebox_tpu.ps.sharded import ShardedEmbeddingTable
 from paddlebox_tpu.ps.sgd import SparseSGDConfig
-from paddlebox_tpu.ps.table import (FIELDS, NUM_FIXED, HostKV,
-                                    field_assign, field_slice,
-                                    scatter_logical_rows)
+from paddlebox_tpu.ps.table import (HostKV, promote_window_delta,
+                                    rows_from_store_fields,
+                                    scatter_logical_rows,
+                                    store_fields_from_rows)
 from paddlebox_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -81,7 +89,7 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
 
     # stage() is legal while a pass is open (missing keys are outside
     # the open window's write-back set) — BoxPSHelper.stage_pass gates
-    # on this; PassScopedTable has no such guarantee
+    # on this; PassScopedTable carries the same contract single-chip
     supports_overlap_stage = True
 
     def __init__(self, num_shards: int, mf_dim: int = 8,
@@ -116,27 +124,10 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         return [keys[owners == s] for s in range(self.n)]
 
     def _store_fields(self, sub: np.ndarray) -> Dict[str, np.ndarray]:
-        """Logical rows [k, feat] → HostStore field dict. embedx sliced to
-        mf_dim explicitly: field_slice's tail is unbounded and would leak
-        the opt_ext columns into the host store's (k, mf_dim) array."""
-        mf_end = NUM_FIXED + self.mf_dim
-        vals = {f: (sub[:, NUM_FIXED:mf_end] if f == "embedx_w"
-                    else field_slice(sub, f)) for f in FIELDS}
-        if self.opt_ext:
-            vals["opt_ext"] = sub[:, mf_end:]
-        return vals
+        return store_fields_from_rows(sub, self.mf_dim, self.opt_ext)
 
     def _logical_rows(self, vals: Dict[str, np.ndarray]) -> np.ndarray:
-        """HostStore field dict → logical rows [k, feat] (scatter input)."""
-        k = len(vals["show"])
-        mf_end = NUM_FIXED + self.mf_dim
-        out = np.zeros((k, mf_end + self.opt_ext), np.float32)
-        idx = np.arange(k)
-        for f in FIELDS:
-            field_assign(out, idx, f, vals[f])
-        if self.opt_ext:
-            out[:, mf_end:] = vals["opt_ext"]
-        return out
+        return rows_from_store_fields(vals, self.mf_dim, self.opt_ext)
 
     # ---- feed-pass staging (BuildPull, ps_gpu_wrapper.cc:337) ----
     def stage(self, pass_keys: np.ndarray, background: bool = True) -> None:
@@ -215,40 +206,20 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         total = 0
         with self.host_lock:
             for s in range(self.n):
-                want = st.keys[s]
-                # reconcile: a staged key may have become resident since
-                # stage() (mid-pass streaming assign) — the live row is
-                # fresher than the fetched host value, keep it
-                still = self.indexes[s].lookup(st.new_keys[s]) < 0
-                ins_keys = st.new_keys[s][still]
+                rows_new, still, st_s = promote_window_delta(
+                    self.indexes[s], self._touched[s], self.capacity,
+                    st.keys[s], st.new_keys[s],
+                    gather_rows=lambda rs, s=s: np.asarray(
+                        jax.device_get(self.state.data[s][rs])),
+                    writeback=lambda ks, rs, sub, s=s:
+                        self.hosts[s].update(ks, self._store_fields(sub)))
                 ins_vals = {f: v[still] for f, v in st.values[s].items()}
-                # evict only what capacity demands, never the new working
-                # set; untouched rows first (no write-back needed)
-                overflow = (len(self.indexes[s]) + len(ins_keys)
-                            - self.capacity)
-                if overflow > 0:
-                    live_keys, live_rows = self.indexes[s].items()
-                    cand = ~np.isin(live_keys, want)
-                    ck, cr = live_keys[cand], live_rows[cand]
-                    t = self._touched[s][cr]
-                    order = np.argsort(t, kind="stable")[:overflow]
-                    ck, cr, t = ck[order], cr[order], t[order]
-                    if t.any():
-                        sub = np.asarray(
-                            jax.device_get(self.state.data[s][cr[t]]))
-                        self.hosts[s].update(ck[t], self._store_fields(sub))
-                        stats["evicted_writeback"] += int(t.sum())
-                    freed = self.indexes[s].release(ck)
-                    self._touched[s][freed] = False
-                    stats["evicted"] += len(ck)
-                rows_new = self.indexes[s].assign(ins_keys)
-                self._touched[s][rows_new] = False  # freshly loaded = clean
                 sh_l.append(np.full(len(rows_new), s, np.int32))
                 row_l.append(rows_new)
                 val_l.append(self._logical_rows(ins_vals))
-                stats["staged"] += len(ins_keys)
-                stats["resident"] += len(want) - len(ins_keys)
-                total += len(want)
+                for k in st_s:
+                    stats[k] += st_s[k]
+                total += len(st.keys[s])
             rows = np.concatenate(row_l) if row_l else np.empty(0, np.int32)
             if len(rows):
                 self.state = scatter_logical_rows(

@@ -63,12 +63,15 @@ def test_pass_scoped_table_promote_and_writeback():
     keys = np.array([7, 8, 9], np.uint64)
     t.begin_pass(keys)
     assert t.in_pass and t.feature_count == 3
-    # simulate a jit update: bump show on the working set rows
+    # simulate a jit update: bump show on the working set rows, marking
+    # them touched as prepare()/apply_push do (end_pass writes back only
+    # touched rows)
     rows = t.index.lookup(keys)
     st = t.state
     d = np.asarray(st.data).copy()
     d[rows, 0] = 5.0  # col 0 = show
     t.state = type(st).from_logical(d, st.capacity)
+    t._touched[rows] = True
     t.end_pass()
     assert not t.in_pass
     np.testing.assert_allclose(hs.fetch(keys)["show"], 5.0)
@@ -77,6 +80,36 @@ def test_pass_scoped_table_promote_and_writeback():
     r = t.index.lookup(np.array([8], np.uint64))
     assert float(np.asarray(t.state.show)[r[0]]) == 5.0
     t.end_pass()
+
+
+def test_pass_scoped_delta_staging():
+    """Persistent window (single-chip mirror of the tiered delta
+    staging, box_wrapper.cc:129-186): overlapping pass 2 stages only the
+    NEW keys; resident rows keep their trained values without a host
+    round-trip; stats report the delta."""
+    from paddlebox_tpu.ps.table import FIELD_COL
+    hs = HostStore(mf_dim=2, capacity=1 << 12)
+    t = PassScopedTable(hs, pass_capacity=256, cfg=SparseSGDConfig())
+    k1 = np.arange(0, 100, dtype=np.uint64)
+    t.begin_pass(k1)
+    assert t.last_pass_stats["staged"] == 100
+    assert t.last_pass_stats["resident"] == 0
+    rows = t.index.lookup(k1)
+    d = np.asarray(t.state.data).copy()
+    d[rows, FIELD_COL["embed_w"]] = 4.25
+    t.state = type(t.state).from_logical(d, t.state.capacity)
+    t._touched[rows] = True
+    assert t.end_pass() == 100
+    k2 = np.arange(50, 150, dtype=np.uint64)
+    t.begin_pass(k2)
+    st = t.last_pass_stats
+    assert st["staged"] == 50 and st["resident"] == 50, st
+    r60 = int(t.index.lookup(np.array([60], np.uint64))[0])
+    assert float(np.asarray(t.state.data)[r60, FIELD_COL["embed_w"]]) \
+        == 4.25  # resident row, no re-fetch
+    t.end_pass()
+    # untouched pass: nothing written back
+    assert t.last_pass_stats["written_back"] == 0
 
 
 def test_pass_capacity_guard():
@@ -90,14 +123,22 @@ def test_stage_guards():
     hs = HostStore(mf_dim=2, capacity=1 << 12)
     t = PassScopedTable(hs, pass_capacity=64)
     t.begin_pass(np.array([1, 2], np.uint64))
-    # staging while a pass is open would read stale host rows
-    with pytest.raises(RuntimeError, match="pass is open"):
-        t.stage(np.array([3], np.uint64))
+    # staging DURING an open pass is the overlap contract (missing keys
+    # are outside the open window's write-back set) — legal; a second
+    # concurrent stage is not
+    t.stage(np.array([3], np.uint64), background=False)
+    with pytest.raises(RuntimeError, match="already staging"):
+        t.stage(np.array([4], np.uint64))
     t.end_pass()
     # begin_pass with keys differing from the staged set must refuse
-    t.stage(np.array([1, 2], np.uint64), background=False)
     with pytest.raises(RuntimeError, match="differ"):
         t.begin_pass(np.array([1, 3], np.uint64))
+    t._stage = None
+    # drop_window while a pass is open is refused
+    t.begin_pass(np.array([1, 2], np.uint64))
+    with pytest.raises(RuntimeError, match="pass is open"):
+        t.drop_window()
+    t.end_pass()
 
 
 @pytest.fixture(scope="module")
@@ -303,8 +344,11 @@ def test_pass_scoped_table_sparse_adam_state_survives():
     mf_end = 8 + 4
     d[rows, mf_end + 1] = 0.81   # embed beta1 power after 2 steps
     t.state = type(st).from_logical(d, st.capacity, ext=ext)
+    t._touched[rows] = True      # as apply_push's serve rows would be
     t.end_pass()
-    # next pass sees the persisted optimizer state
+    # next pass sees the persisted optimizer state FROM THE HOST STORE
+    # (drop_window forces a real re-stage, not window residency)
+    t.drop_window()
     t.begin_pass(keys)
     d2 = np.asarray(jax.device_get(t.state.data))
     rows2 = t.index.lookup(keys)
