@@ -41,13 +41,15 @@ VOCAB = 400
 
 
 def write_day(work: str, day: int, rows: int = 3000) -> str:
-    """Day-k criteo files in a per-day value range — each day brings
-    fresh features with the generator's PLANTED learnable signal (the
-    production CTR pattern that makes the union exceed any pass window)."""
+    """Day-k criteo files in a SLIDING value range — consecutive days
+    share half their feature space (the production CTR pattern: day k+1
+    mostly re-touches day k's features while the multi-day union still
+    exceeds any pass window), so the persistent window's delta staging
+    has real reuse to exploit."""
     return generate_criteo_files(
         os.path.join(work, f"day{day}"), num_files=1, rows_per_file=rows,
         vocab_per_slot=VOCAB, seed=1000 + day,
-        value_base=day * VOCAB)[0]
+        value_base=day * VOCAB // 2)[0]
 
 
 def main() -> None:
@@ -68,19 +70,42 @@ def main() -> None:
                         tx=optax.adam(2e-3))
     helper = BoxPSHelper(table, trainer=tr)
 
+    def make_day(day: int):
+        """PaddleBoxDataset so day k+1's IO/parse can ALSO overlap day
+        k's training (preload_into_memory / wait_feed_pass_done — the
+        box_wrapper.h:1142 double-buffering)."""
+        d = DatasetFactory().create_dataset("PaddleBoxDataset", desc)
+        d.set_filelist([write_day(work, day)])
+        return d
+
+    ds = make_day(0)
+    helper.read_data_to_memory(ds)
     for day in range(4):
-        ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
-        ds.set_filelist([write_day(work, day)])
-        ds.load_into_memory()
         tr.reset_metrics()                          # per-day AUC
         helper.begin_pass(ds)                       # host → HBM window
-        for _ in range(3):                          # epochs in the window
+        st = dict(table.last_pass_stats)            # delta accounting
+        ds_next = make_day(day + 1) if day < 3 else None
+        if ds_next is not None:
+            helper.preload_into_memory(ds_next)     # IO overlaps epoch 1
+        for e in range(3):                          # epochs in the window
             res = tr.train_pass(ds)                 # or train_pass_resident
+            if e == 0 and ds_next is not None:
+                # the FULL overlap pipeline: day k+1's IO/parse rode
+                # epoch 1 in reader threads; its host-tier fetch of
+                # MISSING keys (pre_build_thread, ps_gpu_wrapper.cc:913)
+                # now rides epochs 2-3 — with the sliding feature
+                # space, ~half of day k+1 is already resident and never
+                # re-ships
+                helper.wait_feed_pass_done(ds_next)
+                helper.stage_pass(ds_next)
         helper.end_pass(ds, need_save_delta=True,
                         delta_path=os.path.join(work, f"delta_{day}.npz"))
         print(f"day {day}: auc={res['auc']:.4f} "
+              f"staged={st['staged']} resident={st['resident']} "
+              f"evicted={st['evicted']} "
               f"window_rows={sum(len(ix) for ix in table.indexes)} "
               f"host_tier_rows={table.feature_count()}")
+        ds = ds_next
 
     hbm_window = n * table.capacity
     total = table.feature_count()
