@@ -83,25 +83,45 @@ class KVServer:
                 # quiet-but-alive TcpKVStore connection (poll cadence can
                 # exceed any fixed idle timeout) is never dropped
                 conn.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
-                tuned_keepalive = hasattr(socket, "TCP_KEEPIDLE")
-                if tuned_keepalive:
+                tuned_keepalive = False
+                # linux spelling, then the macOS one (TCP_KEEPALIVE is
+                # its idle-seconds knob) — tuned keepalive means a dead
+                # peer is probed within ~2 min instead of the OS default
+                # first probe at ~2h
+                if hasattr(socket, "TCP_KEEPIDLE"):
                     conn.setsockopt(socket.IPPROTO_TCP,
                                     socket.TCP_KEEPIDLE, 60)
+                    tuned_keepalive = True
+                elif hasattr(socket, "TCP_KEEPALIVE"):
                     conn.setsockopt(socket.IPPROTO_TCP,
-                                    socket.TCP_KEEPINTVL, 15)
-                    conn.setsockopt(socket.IPPROTO_TCP,
-                                    socket.TCP_KEEPCNT, 4)
-                # without TCP_KEEPIDLE tuning the OS default first probe is
-                # ~2h, so a dead peer could pin this handler thread for
-                # hours — cap idle generously instead of waiting forever
+                                    socket.TCP_KEEPALIVE, 60)
+                    tuned_keepalive = True
+                if tuned_keepalive:
+                    if hasattr(socket, "TCP_KEEPINTVL"):
+                        conn.setsockopt(socket.IPPROTO_TCP,
+                                        socket.TCP_KEEPINTVL, 15)
+                    if hasattr(socket, "TCP_KEEPCNT"):
+                        conn.setsockopt(socket.IPPROTO_TCP,
+                                        socket.TCP_KEEPCNT, 4)
+                # without ANY idle tuning, cap idle generously so a dead
+                # peer can't pin this handler thread for hours; the cost
+                # is that a quiet-but-alive client slower than the cap
+                # reconnects (logged as idle, not as garbage)
                 idle_timeout = None if tuned_keepalive else 900.0
                 while True:
                     # idle between requests: tuned keepalive (above) owns
                     # dead-peer reaping with no idle cap — a quiet-but-alive
                     # TcpKVStore connection (poll cadence can exceed any
-                    # fixed idle timeout) is never dropped
+                    # fixed idle timeout) is never dropped on tuned
+                    # platforms
                     conn.settimeout(idle_timeout)
-                    hdr = conn.recv(1)
+                    try:
+                        hdr = conn.recv(1)
+                    except socket.timeout:
+                        log.info("kv server: closing idle connection "
+                                 "(>%.0fs, untuned-keepalive platform)",
+                                 idle_timeout)
+                        return
                     if not hdr:
                         return
                     # mid-request: a short timeout so a half-written

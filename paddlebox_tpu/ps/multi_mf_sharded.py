@@ -201,12 +201,30 @@ class MultiMfTieredShardedTable(MultiMfShardedTable):
     # ---- pass lifecycle across classes ----
     def stage(self, keys: np.ndarray, slots: np.ndarray,
               background: bool = True) -> None:
-        for c, ks in enumerate(self.split_keys_by_class(keys, slots)):
+        per = self.split_keys_by_class(keys, slots)
+        # validate EVERY class's per-shard capacity BEFORE any class
+        # spawns its stage — a mid-fan-out failure would leave a
+        # half-staged wrapper whose pending stages block the next
+        # stage/begin_pass with no recovery path
+        for c, (t, ks) in enumerate(zip(self.tables, per)):
+            for s, sk in enumerate(t._split_by_owner(ks)):
+                if len(sk) > t.capacity:
+                    raise ValueError(
+                        f"class {c} shard {s} working set ({len(sk)}) "
+                        f"exceeds capacity_per_shard ({t.capacity})")
+        for c, ks in enumerate(per):
             self.tables[c].stage(ks, background=background)
 
     def wait_stage_done(self) -> None:
         for t in self.tables:
             t.wait_stage_done()
+
+    def drop_window(self) -> None:
+        """Invalidate every class table's HBM residency (between
+        passes) — discards pending stages; see
+        TieredShardedEmbeddingTable.drop_window."""
+        for t in self.tables:
+            t.drop_window()
 
     def begin_pass(self, keys: Optional[np.ndarray] = None,
                    slots: Optional[np.ndarray] = None) -> int:

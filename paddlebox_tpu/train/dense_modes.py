@@ -47,15 +47,32 @@ log = get_logger(__name__)
 # by Adam), so it composes with any optax tx, the in-jit psum mode,
 # ZeRO-1 flat chunks, and the host async table.
 
+def lr_pattern_matches(pat: str, keystr: str) -> bool:
+    """Segment-boundary substring match — THE lr_map matching rule
+    (build_lr_scales AND AsyncDenseTable use it): ``pat`` must occur in
+    ``keystr`` with non-identifier characters (or string ends) on both
+    sides, so ``"Dense_1"`` matches ``['Dense_1']['kernel']`` but NOT
+    ``['Dense_10']`` (the reference's lr_map keys are exact param
+    names; a bare substring test silently over-matched)."""
+    import re
+    for m in re.finditer(re.escape(pat), keystr):
+        a = keystr[m.start() - 1] if m.start() else ""
+        b = keystr[m.end()] if m.end() < len(keystr) else ""
+        if not (a.isalnum() or a == "_") and not (b.isalnum() or b == "_"):
+            return True
+    return False
+
+
 def build_lr_scales(params: Any, lr_map: dict, base_lr: float) -> Any:
     """Pytree of per-leaf multipliers matching ``params``: a leaf whose
     path (jax keystr, e.g. ``"['params']['Dense_0']['kernel']"``)
-    contains a key of ``lr_map`` gets ``lr_map[key] / base_lr``; first
-    match wins; unmatched leaves get 1.0 (the global lr)."""
+    matches a key of ``lr_map`` (segment-boundary rule,
+    lr_pattern_matches) gets ``lr_map[key] / base_lr``; first match
+    wins; unmatched leaves get 1.0 (the global lr)."""
     def scale_of(path, _leaf):
         ks = jax.tree_util.keystr(path)
         for pat, lr in lr_map.items():
-            if pat in ks:
+            if lr_pattern_matches(pat, ks):
                 return float(lr) / float(base_lr)
         return 1.0
     return jax.tree_util.tree_map_with_path(scale_of, params)
@@ -182,25 +199,27 @@ class AsyncDenseTable:
         flat, self._unravel = ravel_pytree(host)
         self._ps = np.array(flat, np.float32)
 
-        # summary mask + per-element lr over the flat vector
+        # summary mask over the flat vector
         leaves_with_path = jax.tree_util.tree_leaves_with_path(host)
         mask = np.zeros(self._ps.size, bool)
-        lr_vec = np.full(self._ps.size, lr, np.float32) if lr_map else None
         off = 0
         pred = is_summary or (lambda name: "summary" in name.lower())
         for path, leaf in leaves_with_path:
             n = int(np.size(leaf))
-            ks = jax.tree_util.keystr(path)
-            if pred(ks):
+            if pred(jax.tree_util.keystr(path)):
                 mask[off:off + n] = True
-            if lr_map:
-                for pat, plr in lr_map.items():
-                    if pat in ks:
-                        lr_vec[off:off + n] = plr
-                        break
             off += n
         self._summary_mask = mask
 
+        # per-element lr through THE shared matcher (build_lr_scales):
+        # ratios vs the global lr ravel exactly as params do
+        lr_vec = None
+        if lr_map:
+            scales = build_lr_scales(host, lr_map, base_lr=lr)
+            sflat, _ = ravel_pytree(jax.tree.map(
+                lambda x, s: np.full(np.shape(x), s, np.float32),
+                host, scales))
+            lr_vec = (lr * np.asarray(sflat)).astype(np.float32)
         self._adam = _HostAdam(self._ps.size,
                                lr if lr_vec is None else lr_vec,
                                beta1, beta2, eps)

@@ -39,6 +39,33 @@ def ctr_dataset(tmp_path_factory):
     return ds, desc
 
 
+def test_lr_pattern_segment_boundaries():
+    """'Dense_1' must not match 'Dense_10' (bare substring over-match):
+    the rule requires non-identifier boundaries, shared by
+    build_lr_scales and AsyncDenseTable."""
+    from paddlebox_tpu.train.dense_modes import lr_pattern_matches
+    assert lr_pattern_matches("Dense_1", "['params']['Dense_1']['kernel']")
+    assert not lr_pattern_matches("Dense_1",
+                                  "['params']['Dense_10']['kernel']")
+    assert lr_pattern_matches("['Dense_1']['kernel']",
+                              "['params']['Dense_1']['kernel']")
+    params = {"Dense_1": jnp.ones(2), "Dense_10": jnp.ones(2)}
+    scales = build_lr_scales(params, {"Dense_1": 0.0}, 1.0)
+    assert scales["Dense_1"] == 0.0 and scales["Dense_10"] == 1.0
+    # AsyncDenseTable goes through the same matcher
+    t = AsyncDenseTable({"Dense_1": np.ones(2, np.float32),
+                         "Dense_10": np.ones(2, np.float32)},
+                        lr=1e-3, lr_map={"Dense_1": 0.0})
+    t.start()
+    t.push({"Dense_1": np.ones(2, np.float32),
+            "Dense_10": np.ones(2, np.float32)})
+    t.drain()
+    t.stop()
+    out = t.pull()
+    np.testing.assert_array_equal(out["Dense_1"], 1.0)   # frozen
+    assert (out["Dense_10"] != 1.0).all()                # trains
+
+
 def test_lr_map_transform_scales_updates_exactly():
     params = {"w_0": jnp.ones(4), "b_0": jnp.ones(2), "other": jnp.ones(3)}
     base = 0.1

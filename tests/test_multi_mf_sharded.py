@@ -222,6 +222,39 @@ def test_mmf_tiered_full_cross_product(mesh, tmp_path):
                                table.pull(keys, slots), rtol=1e-6)
 
 
+def test_mmf_tiered_overlap_stage_and_delta(mesh, tmp_path):
+    """Overlapped staging × multi-mf: stage_pass during an OPEN pass
+    fans out per dim class (keys route by their slot's class), and the
+    next begin_pass consumes a pure per-class delta when working sets
+    repeat — the round-4 persistent-window contract composed with the
+    dim-class routing."""
+    from paddlebox_tpu.ps import BoxPSHelper
+    from paddlebox_tpu.ps.multi_mf_sharded import MultiMfTieredShardedTable
+    ds, desc = _ds(generate_criteo_files(
+        str(tmp_path / "ovl"), num_files=1, rows_per_file=800,
+        vocab_per_slot=40, seed=77))
+    table = MultiMfTieredShardedTable(
+        N, _dims(), capacity_per_shard=2048, cfg=_cfg(),
+        req_bucket_min=64, serve_bucket_min=64)
+    with flags_scope(log_period_steps=10000):
+        tr = MultiMfShardedTrainer(CtrDnn(hidden=(16, 8)), table, desc,
+                                   mesh, tx=optax.adam(1e-2))
+    helper = BoxPSHelper(table, trainer=tr)
+    helper.begin_pass(ds)
+    assert sum(t.last_pass_stats["staged"] for t in table.tables) > 0
+    helper.stage_pass(ds)  # overlap: stage the SAME keys mid-pass
+    r1 = tr.train_pass(ds)
+    helper.end_pass(ds)
+    helper.begin_pass(ds)  # consumes the overlapped per-class stages
+    for t in table.tables:
+        st = t.last_pass_stats
+        assert st["staged"] == 0, st       # pure delta: all resident
+        assert st["resident"] > 0, st
+    r2 = tr.train_pass(ds)
+    helper.end_pass(ds)
+    assert np.isfinite(r1["last_loss"]) and np.isfinite(r2["last_loss"])
+
+
 def test_mmf_tiered_matches_untired(mesh, tmp_path):
     """Tiering stays TRANSPARENT under multi-mf: when everything fits,
     the tiered cross-product equals the plain multi-mf sharded table
