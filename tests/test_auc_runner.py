@@ -56,11 +56,10 @@ def test_record_replace_and_back():
         runner.record_replace_back()
 
 
-def test_slot_importance_detects_informative_slot():
-    """Slot 0 determines the label; slot 3 is pure noise. Destroying
-    slot 0 must collapse AUC; destroying slot 3 must not."""
-    from paddlebox_tpu.data import DataFeedDesc, SlotDef
-
+def _informative_setup(batch_size):
+    """Slot 0 determines the label; slot 3 is pure noise — shared by the
+    single-chip and mesh slot-importance tests."""
+    from paddlebox_tpu.data import SlotDef
     rng = np.random.default_rng(5)
     n, num_slots = 4000, 4
     recs = []
@@ -75,17 +74,19 @@ def test_slot_importance_detects_informative_slot():
             keys=keys, slot_offsets=np.arange(num_slots + 1, dtype=np.int32),
             dense=np.zeros(1, np.float32), label=float(k0 < 10),
             clk=float(k0 < 10)))
-
     desc = DataFeedDesc(
         slots=[SlotDef(name=f"s{i}") for i in range(num_slots)]
         + [SlotDef(name="d0", type="float", dim=1)],
-        batch_size=256)
+        batch_size=batch_size)
     desc.key_bucket_min = 2048
     cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=1e-3,
                           learning_rate=0.1, mf_learning_rate=0.1)
-    table = EmbeddingTable(mf_dim=8, capacity=1 << 12, cfg=cfg,
-                           unique_bucket_min=2048)
-    tr = Trainer(CtrDnn(hidden=(32, 32)), table, desc, tx=optax.adam(5e-3))
+    return recs, desc, cfg
+
+
+def _assert_slot_importance(tr, recs, desc):
+    """Train 3 passes, then slot-replacement importance: destroying the
+    label-defining slot collapses AUC; the noise slot does not."""
     ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
     ds.records = recs
     for _ in range(3):
@@ -99,5 +100,35 @@ def test_slot_importance_detects_informative_slot():
     runner = AucRunner(slots_to_replace=[0, 3], pool_size=2000, seed=3)
     runner.init_pass(recs)
     imp = runner.slot_importance(eval_fn, recs)
-    assert imp[0] > 0.2, imp       # label-defining slot: big AUC drop
+    assert imp[0] > 0.2, imp        # label-defining slot: big AUC drop
     assert abs(imp[3]) < 0.05, imp  # noise slot: no real drop
+
+
+def test_slot_importance_detects_informative_slot():
+    """Slot 0 determines the label; slot 3 is pure noise. Destroying
+    slot 0 must collapse AUC; destroying slot 3 must not."""
+    recs, desc, cfg = _informative_setup(batch_size=256)
+    table = EmbeddingTable(mf_dim=8, capacity=1 << 12, cfg=cfg,
+                           unique_bucket_min=2048)
+    tr = Trainer(CtrDnn(hidden=(32, 32)), table, desc, tx=optax.adam(5e-3))
+    _assert_slot_importance(tr, recs, desc)
+
+
+def test_slot_importance_on_mesh_trainer():
+    """AucRunner composes with the MESH trainer unchanged (it is
+    dataset-level — the reference embeds the same machinery in
+    BoxWrapper, box_wrapper.h:908-1009, available to every worker
+    mode): slot importance via ShardedTrainer.eval_pass on the
+    8-device mesh finds the same informative slot."""
+    import jax
+    from paddlebox_tpu.parallel import make_mesh
+    from paddlebox_tpu.ps.sharded import ShardedEmbeddingTable
+    from paddlebox_tpu.train.sharded import ShardedTrainer
+    assert len(jax.devices()) >= 8
+    recs, desc, cfg = _informative_setup(batch_size=64)
+    table = ShardedEmbeddingTable(8, mf_dim=8, capacity_per_shard=1 << 10,
+                                  cfg=cfg, req_bucket_min=128,
+                                  serve_bucket_min=128)
+    tr = ShardedTrainer(CtrDnn(hidden=(32, 32)), table, desc, make_mesh(8),
+                        tx=optax.adam(5e-3))
+    _assert_slot_importance(tr, recs, desc)
