@@ -11,6 +11,7 @@ from paddlebox_tpu.ps.extended import ExtendedEmbeddingTable
 from paddlebox_tpu.ps.replica_cache import InputTable, ReplicaCache
 from paddlebox_tpu.ps.sharded import ShardedEmbeddingTable
 from paddlebox_tpu.ps.tiered import TieredShardedEmbeddingTable
+from paddlebox_tpu.ps.tiered_multihost import MultihostTieredShardedTable
 from paddlebox_tpu.ps.multi_mf_sharded import (MultiMfShardedTable,
                                                MultiMfTieredShardedTable)
 
@@ -21,4 +22,5 @@ __all__ = ["SparseSGDConfig", "SparseAdamConfig", "EmbeddingTable",
            "HostStore", "PassScopedTable", "BoxPSHelper",
            "ExtendedEmbeddingTable", "InputTable", "ReplicaCache",
            "ShardedEmbeddingTable", "TieredShardedEmbeddingTable",
+           "MultihostTieredShardedTable",
            "MultiMfShardedTable", "MultiMfTieredShardedTable"]

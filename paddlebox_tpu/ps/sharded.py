@@ -103,14 +103,20 @@ class ShardedEmbeddingTable:
         self.serve_bucket_min = serve_bucket_min
         # stacked state [N, L, 128] — sharded over the mesh axis
         single = init_table_state(self.capacity, mf_dim, ext=self.opt_ext)
-        self.state = single.with_packed(
-            jnp.broadcast_to(single.packed[None],
-                             (num_shards,) + single.packed.shape).copy())
+        self.state = self._make_stacked_state(single, num_shards)
         self._touched = np.zeros((num_shards, self.capacity + 1), dtype=bool)
         # serializes host index/touched mutation across threads (resident
         # pass preloading vs save/shrink — same discipline as
         # EmbeddingTable.host_lock)
         self.host_lock = threading.Lock()
+
+    def _make_stacked_state(self, single: TableState, n: int) -> TableState:
+        """Subclass hook: build the stacked [N, L, 128] device state —
+        the multihost table stages it SHARDED over the global mesh
+        instead of materializing N windows on one device."""
+        return single.with_packed(
+            jnp.broadcast_to(single.packed[None],
+                             (n,) + single.packed.shape).copy())
 
     # ------------------------------------------------------------------
     def prepare_global_eval(self, batches: List[SlotBatch],

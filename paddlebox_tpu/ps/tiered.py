@@ -130,6 +130,11 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         return rows_from_store_fields(vals, self.mf_dim, self.opt_ext)
 
     # ---- feed-pass staging (BuildPull, ps_gpu_wrapper.cc:337) ----
+    def _fetch_stage_values(self, s: int, new_keys: np.ndarray):
+        """Subclass hook: host values for shard s's missing keys — the
+        multihost table returns None for shards it does not own."""
+        return self.hosts[s].fetch(new_keys)
+
     def stage(self, pass_keys: np.ndarray, background: bool = True) -> None:
         """Fetch host values for the pass keys NOT already resident in
         the HBM window. Legal while a pass is open (the overlapped
@@ -152,7 +157,8 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
 
         def run() -> None:
             try:
-                vals = [self.hosts[s].fetch(new[s]) for s in range(self.n)]
+                vals = [self._fetch_stage_values(s, new[s])
+                        for s in range(self.n)]
                 self._stage = _ShardStage(per_shard, new, vals)
             except BaseException as e:
                 self._stage_exc = e
@@ -174,11 +180,10 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
             raise exc
 
     # ---- pass window (BuildGPUTask/EndPass, ps_gpu_wrapper.cc:684,983) --
-    def begin_pass(self, pass_keys: Optional[np.ndarray] = None) -> int:
-        """Promote the staged (or given) working set into the HBM shards:
-        reconcile the stage against the live window, evict only what
-        capacity demands, scatter only the genuinely new rows. Returns
-        the number of working-set rows across shards."""
+    def _resolve_stage(self, pass_keys: Optional[np.ndarray]) -> _ShardStage:
+        """Shared begin_pass prologue: consume the pending stage (after
+        validating its keys against ``pass_keys``), or stage
+        synchronously."""
         if self.in_pass:
             raise RuntimeError("begin_pass while a pass is open")
         if pass_keys is not None:
@@ -197,6 +202,14 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         if st is None:
             raise RuntimeError("begin_pass with nothing staged")
         self._stage = None
+        return st
+
+    def begin_pass(self, pass_keys: Optional[np.ndarray] = None) -> int:
+        """Promote the staged (or given) working set into the HBM shards:
+        reconcile the stage against the live window, evict only what
+        capacity demands, scatter only the genuinely new rows. Returns
+        the number of working-set rows across shards."""
+        st = self._resolve_stage(pass_keys)
 
         stats = dict(resident=0, staged=0, evicted=0, evicted_writeback=0,
                      written_back=0)

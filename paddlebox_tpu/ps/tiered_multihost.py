@@ -1,0 +1,299 @@
+"""Tiered sharded PS on a MULTI-CONTROLLER mesh (the pod topology).
+
+Reference: each AIBox node owns its slice of the PS — SSD + host memory
+are per-node, coordinated over MPI (box_wrapper.h:446-450; SURVEY §2.6
+multi-node rows). TPU-native mapping: ONE global mesh spans every
+process (train/multihost.py); the stacked table state [N, L, 128] is
+sharded over it, so shard s's HBM slice physically lives on the process
+that owns device s. This table puts shard s's HOST TIER (HostStore) on
+that same process:
+
+- key→row INDEXES and ``_touched`` stay replicated on every process —
+  the SPMD host contract (every process builds identical batches and
+  routing plans) makes every assign/evict deterministic and identical,
+  so the bookkeeping never needs communication.
+- host VALUE stores exist only for owned shards. ``stage`` fetches only
+  owned shards' missing keys (the ``_fetch_stage_values`` hook);
+  ``begin_pass`` runs the shared reconcile/evict core for ALL shards
+  (bookkeeping) but moves values only for owned shards — each process
+  scatters ON DEVICE into its addressable slices and the new global
+  state is reassembled with ``make_array_from_single_device_arrays``
+  (no cross-process value motion, ever); ``end_pass`` writes back owned
+  shards' touched rows via small on-device row gathers.
+- save/load operate per process on the owned shards (the per-node
+  SaveBase files of the reference); ``feature_count`` is per-process.
+
+Every process must call stage/begin_pass/end_pass/drop_window
+collectively (same keys, same order) — the same discipline as running
+the jitted step itself.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from paddlebox_tpu.ps.sgd import SparseSGDConfig
+from paddlebox_tpu.ps.table import (HostKV, TableState, pack_geometry,
+                                    promote_window_delta)
+from paddlebox_tpu.ps.tiered import TieredShardedEmbeddingTable
+from paddlebox_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class MultihostTieredShardedTable(TieredShardedEmbeddingTable):
+    """TieredShardedEmbeddingTable whose host tiers are per-process."""
+
+    def __init__(self, mesh: Mesh, mf_dim: int = 8,
+                 capacity_per_shard: Optional[int] = None,
+                 cfg: Optional[SparseSGDConfig] = None,
+                 host_capacity: Optional[int] = None,
+                 host_init_rows: int = 1 << 14,
+                 req_bucket_min: int = 512,
+                 serve_bucket_min: int = 1024) -> None:
+        devs = list(mesh.devices.ravel())
+        # set before super().__init__: _make_stacked_state needs the mesh
+        self.mesh = mesh
+        super().__init__(len(devs), mf_dim=mf_dim,
+                         capacity_per_shard=capacity_per_shard, cfg=cfg,
+                         host_capacity=host_capacity,
+                         host_init_rows=host_init_rows,
+                         req_bucket_min=req_bucket_min,
+                         serve_bucket_min=serve_bucket_min)
+        me = jax.process_index()
+        self.owned = {s for s, d in enumerate(devs)
+                      if d.process_index == me}
+        # shard s's value store lives on the process owning device s
+        self.hosts = [h if s in self.owned else None
+                      for s, h in enumerate(self.hosts)]
+
+    def _make_stacked_state(self, single: TableState, n: int) -> TableState:
+        """Zero-init directly SHARDED over the global mesh — never
+        materialize N windows on one device (at pod scale one window is
+        sized near a device's HBM)."""
+        from paddlebox_tpu.train.multihost import stage_global
+        host = np.zeros((n,) + single.packed.shape,
+                        np.asarray(single.packed).dtype)
+        return single.with_packed(stage_global(self.mesh, host))
+
+    # ---- local-shard plumbing ------------------------------------------
+    @staticmethod
+    def _shard_id(sh) -> int:
+        idx = sh.index[0]
+        return idx.start if isinstance(idx, slice) else int(idx)
+
+    def _addressable(self) -> Dict[int, object]:
+        return {self._shard_id(sh): sh
+                for sh in self.state.packed.addressable_shards}
+
+    def _gather_local_rows(self, s: int, rows: np.ndarray) -> np.ndarray:
+        """On-device row gather on the owned shard's single-device
+        array; only the requested rows cross to host."""
+        data = self._addressable()[s].data        # [1, L, 128] on-device
+        rpl, fp, nl = pack_geometry(self.capacity, self.state._feat)
+        flat = data.reshape(nl * rpl, fp)
+        out = flat[jnp.asarray(np.ascontiguousarray(rows, np.int32))]
+        return np.asarray(jax.device_get(out))[:, :self.state._feat]
+
+    def _reassemble(self, new_shards: Dict[int, jax.Array]) -> None:
+        """Swap owned shards' device arrays into a new global array (no
+        cross-process transfer; unchanged shards are reused as-is)."""
+        packed = self.state.packed
+        locals_ = []
+        for sh in packed.addressable_shards:
+            s = self._shard_id(sh)
+            if s in new_shards:
+                a = new_shards[s]
+                if not isinstance(a, jax.Array) or a.ndim == 2:
+                    a = jax.device_put(np.asarray(a)[None]
+                                       if np.ndim(a) == 2 else np.asarray(a),
+                                       sh.device)
+                locals_.append(a)
+            else:
+                locals_.append(sh.data)
+        out = jax.make_array_from_single_device_arrays(
+            packed.shape, packed.sharding, locals_)
+        self.state = self.state.with_packed(out)
+
+    # ---- pass lifecycle (collective) -----------------------------------
+    def _fetch_stage_values(self, s: int, new_keys: np.ndarray):
+        return (self.hosts[s].fetch(new_keys)
+                if s in self.owned else None)
+
+    def begin_pass(self, pass_keys: Optional[np.ndarray] = None) -> int:
+        st = self._resolve_stage(pass_keys)
+        stats = dict(resident=0, staged=0, evicted=0, evicted_writeback=0,
+                     written_back=0)
+        total = 0
+        new_shards: Dict[int, jax.Array] = {}
+        rpl, fp, nl = pack_geometry(self.capacity, self.state._feat)
+        feat = self.state._feat
+        with self.host_lock:
+            addr = self._addressable()
+            for s in range(self.n):
+                owned = s in self.owned
+
+                def gather(rows, s=s, owned=owned):
+                    return (self._gather_local_rows(s, rows)
+                            if owned else None)
+
+                def writeback(ks, rs, sub, s=s, owned=owned):
+                    if owned:
+                        self.hosts[s].update(ks, self._store_fields(sub))
+
+                rows_new, still, st_s = promote_window_delta(
+                    self.indexes[s], self._touched[s], self.capacity,
+                    st.keys[s], st.new_keys[s],
+                    gather_rows=gather, writeback=writeback)
+                for k in st_s:
+                    stats[k] += st_s[k]
+                total += len(st.keys[s])
+                if owned and len(rows_new):
+                    vals = self._logical_rows(
+                        {f: v[still] for f, v in st.values[s].items()})
+                    data = addr[s].data           # [1, L, 128] on-device
+                    flat = data.reshape(nl * rpl, fp)
+                    flat = flat.at[
+                        jnp.asarray(np.ascontiguousarray(rows_new,
+                                                         np.int32)),
+                        :feat].set(jnp.asarray(vals))
+                    new_shards[s] = flat.reshape(data.shape)
+            if new_shards:
+                self._reassemble(new_shards)
+        self.in_pass = True
+        self.last_pass_stats = stats
+        log.info("begin_pass (mh, %d owned shards): %d rows (%d resident "
+                 "%d staged %d evicted)", len(self.owned), total,
+                 stats["resident"], stats["staged"], stats["evicted"])
+        return total
+
+    def end_pass(self) -> int:
+        if not self.in_pass:
+            raise RuntimeError("end_pass without begin_pass")
+        total = 0
+        with self.host_lock:
+            for s in range(self.n):
+                keys, rows = self.indexes[s].items()
+                m = self._touched[s][rows]
+                keys, rows = keys[m], rows[m]
+                if s in self.owned and len(rows):
+                    sub = self._gather_local_rows(s, rows)
+                    self.hosts[s].update(keys, self._store_fields(sub))
+                self._touched[s][rows] = False
+                total += len(rows)
+        self.in_pass = False
+        self.last_pass_stats["written_back"] = total
+        return total
+
+    def drop_window(self) -> None:
+        self._no_pass("drop_window")
+        try:
+            if self._stage_thread is not None or self._stage is not None:
+                self.wait_stage_done()
+        finally:
+            self._stage = None
+            with self.host_lock:
+                self.indexes = [HostKV(self.capacity)
+                                for _ in range(self.n)]
+                self._touched[:] = False
+                zeros = {
+                    self._shard_id(sh): jax.device_put(
+                        np.zeros(sh.data.shape, sh.data.dtype), sh.device)
+                    for sh in self.state.packed.addressable_shards}
+                self._reassemble(zeros)
+
+    # ---- per-process model lifecycle (owned shards only) ---------------
+    def feature_count(self) -> int:
+        """Rows in THIS process's host tiers (per-node count, as each
+        AIBox node reports its own shard)."""
+        return sum(len(h) for h in self.hosts if h is not None)
+
+    def save_base(self, path: str) -> int:
+        """Owned shards only → a per-process file (the per-node SaveBase
+        convention); restore each process from its own file."""
+        self._no_pass("save_base")
+        blobs: Dict[str, np.ndarray] = {}
+        total = 0
+        for s in sorted(self.owned):
+            keys, fields = self.hosts[s].export_rows()
+            blobs[f"keys_{s}"] = keys
+            for f, v in fields.items():
+                blobs[f"{f}_{s}"] = v
+            total += len(keys)
+        np.savez_compressed(path, n=self.n,
+                            owned=np.array(sorted(self.owned)), **blobs)
+        return total
+
+    def save_delta(self, path: str) -> int:
+        self._no_pass("save_delta")
+        blobs: Dict[str, np.ndarray] = {}
+        total = 0
+        for s in sorted(self.owned):
+            keys, fields = self.hosts[s].export_rows(delta=True)
+            blobs[f"keys_{s}"] = keys
+            for f, v in fields.items():
+                blobs[f"{f}_{s}"] = v
+            total += len(keys)
+        np.savez_compressed(path, n=self.n,
+                            owned=np.array(sorted(self.owned)), **blobs)
+        return total
+
+    def load(self, path: str, merge: bool = False) -> int:
+        self._no_pass("load")
+        blob = np.load(path)
+        if "n" not in blob or int(blob["n"]) != self.n:
+            # a shard-count mismatch would need key%N re-splitting across
+            # PROCESSES (keys_0..3 imported here may route to shards this
+            # process does not own) — refuse rather than silently skip
+            raise ValueError(
+                f"per-process load needs a save written by an {self.n}-"
+                f"shard multihost table (got n="
+                f"{blob.get('n', 'missing')}); use the single-controller "
+                "table to re-shard a foreign save")
+        total = 0
+        for s in sorted(self.owned):
+            if f"keys_{s}" not in blob:
+                continue
+            want = list(self.hosts[s].fields)
+            fields = {f: blob[f"{f}_{s}"] for f in want
+                      if f"{f}_{s}" in blob}
+            total += self.hosts[s].import_rows(blob[f"keys_{s}"], fields,
+                                               merge=merge)
+        self.drop_window()
+        return total
+
+    def shrink(self, delete_threshold: Optional[float] = None,
+               decay: Optional[float] = None) -> int:
+        self._no_pass("shrink")
+        freed = sum(
+            self.hosts[s].shrink(delete_threshold=delete_threshold,
+                                 decay=decay,
+                                 nonclk_coeff=self.cfg.nonclk_coeff,
+                                 clk_coeff=self.cfg.clk_coeff)
+            for s in sorted(self.owned))
+        self.drop_window()
+        return freed
+
+    def spill_cold(self, path_prefix: str, threshold: float) -> int:
+        self._no_pass("spill_cold")
+        return sum(
+            self.hosts[s].spill_cold(
+                f"{path_prefix}.s{s}.npz", threshold,
+                nonclk_coeff=self.cfg.nonclk_coeff,
+                clk_coeff=self.cfg.clk_coeff)
+            for s in sorted(self.owned))
+
+    def merge_model(self, path: str) -> int:
+        self._no_pass("merge_model")
+        blob = np.load(path)
+        total = 0
+        for s, (keys, fields) in enumerate(self._file_per_shard(blob)):
+            if s in self.owned:
+                total += self.hosts[s].merge_model_rows(keys, fields)
+        self.drop_window()
+        return total
