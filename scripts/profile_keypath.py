@@ -1,0 +1,351 @@
+#!/usr/bin/env python
+"""Decompose the ragged-shape device step cost, component by component.
+
+Round-5 measurement harness for the device key-path attack (VERDICT item
+1). Loop-shaped probes per DESIGN_NOTES §4h: every probe threads state
+through a fori_loop with VARYING indices per iteration — single-shot
+probes with repeated identical indices read 100x too fast.
+
+Prints one JSON line per probe: {"probe": ..., "ms_per_iter": ...}.
+Run on the real chip (no conftest): python scripts/profile_keypath.py
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.ps.table import (TableState, apply_push,
+                                    gather_full_rows, init_table_state)
+from paddlebox_tpu.ps.sgd import SparseSGDConfig, opt_ext_width
+from paddlebox_tpu.ops.device_unique import dedup_rows
+from paddlebox_tpu.ops.pallas_kernels import segment_sum
+
+N_ITER = int(os.environ.get("PROF_ITERS", 16))
+SHAPE = os.environ.get("PROF_SHAPE", "ragged")
+
+# ragged bench shape: bs 4096, 26 slots, ~5 keys/slot, vocab 100k/slot
+if SHAPE == "ragged":
+    B, S, AVG, VOCAB = 4096, 26, 5.0, 100_000
+elif SHAPE == "thousand":
+    B, S, AVG, VOCAB = 512, 1000, 1.0, 4_000
+else:  # uniform
+    B, S, AVG, VOCAB = 8192, 26, 1.0, 100_000
+MF = 8
+CAP = 1 << 23
+cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=1e-3)
+EXT = opt_ext_width(cfg, MF)
+FEAT = 8 + MF + EXT
+
+rng = np.random.default_rng(0)
+if AVG > 1.0:
+    counts = 1 + rng.poisson(AVG - 1.0, size=(B, S))
+else:
+    counts = np.ones((B, S), np.int64)
+K = int(counts.sum())
+from paddlebox_tpu.ps.table import next_bucket_fine
+K_pad = next_bucket_fine(4096, K)
+
+# per-iteration index stacks (varying indices per §4h)
+def draw_rows(n):
+    """Per-key table rows for n iterations: keys are slot-partitioned
+    draws (like the bench), mapped to rows within slot arenas."""
+    out = np.empty((n, K_pad), np.int32)
+    slot_of_key = np.repeat(np.tile(np.arange(S), B), counts.reshape(-1))
+    for i in range(n):
+        k_ids = rng.integers(0, VOCAB, size=K)
+        out[i, :K] = (slot_of_key * VOCAB + k_ids).astype(np.int32) % CAP
+        out[i, K:] = CAP  # pads → sentinel
+    return out
+
+rows_stack = jnp.asarray(draw_rows(N_ITER))
+# segments per key: record*S + slot
+rec_of_key = np.repeat(np.arange(B, dtype=np.int32), counts.sum(axis=1))
+slot_flat = np.repeat(np.tile(np.arange(S, dtype=np.int32), B),
+                      counts.reshape(-1))
+segs_np = np.full(K_pad, B * S, np.int32)
+segs_np[:K] = rec_of_key * S + slot_flat
+segs = jnp.asarray(segs_np)
+key_valid = jnp.asarray((np.arange(K_pad) < K).astype(np.float32))
+
+# unique-rows stacks: dedup each iteration's rows on host
+uniqs, u_max = [], 0
+for i in range(N_ITER):
+    u = np.unique(np.asarray(rows_stack[i][:K]))
+    uniqs.append(u)
+    u_max = max(u_max, len(u))
+U_pad = next_bucket_fine(4096, u_max + 1)
+uniq_np = np.empty((N_ITER, U_pad), np.int32)
+for i, u in enumerate(uniqs):
+    uniq_np[i, :len(u)] = u
+    uniq_np[i, len(u):] = CAP + 1 + np.arange(U_pad - len(u))
+uniq_stack = jnp.asarray(uniq_np)
+U_real = u_max
+
+state = init_table_state(CAP, MF, ext=EXT)
+grads = jnp.asarray(rng.normal(size=(U_pad, 3 + MF)).astype(np.float32))
+vals_k = jnp.asarray(rng.normal(size=(K_pad, 3 + MF)).astype(np.float32))
+prng = jax.random.PRNGKey(0)
+
+print(json.dumps({"probe": "shape", "B": B, "S": S, "K": K,
+                  "K_pad": K_pad, "U": U_real, "U_pad": U_pad}),
+      flush=True)
+
+
+def timeit(name, fn, *args, **extra):
+    """fn: jitted callable taking iteration index array slot; runs a
+    warmup call then wall-times N_ITER iterations via fori_loop
+    INSIDE one jit (no per-iter dispatch)."""
+    r = fn(*args)
+    jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    r = fn(*args)
+    jax.block_until_ready(r)
+    dt = (time.perf_counter() - t0) / N_ITER * 1000
+    print(json.dumps({"probe": name, "ms_per_iter": round(dt, 3),
+                      **extra}), flush=True)
+    return dt
+
+
+# ---- probe: gather U rows from the big table ----
+@jax.jit
+def p_gather(state, uniq_stack):
+    def body(i, acc):
+        rows = gather_full_rows(state, uniq_stack[i])
+        return acc + rows[0, 0] + rows[-1, -1]
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+
+timeit("gather_U_big", p_gather, state, uniq_stack,
+       U_pad=U_pad)
+
+# ---- probe: apply_push U rows ----
+@jax.jit
+def p_push(state, uniq_stack, grads, prng):
+    def body(i, st):
+        return apply_push(st, uniq_stack[i], grads, cfg, prng)
+    return jax.lax.fori_loop(0, N_ITER, body, state).packed[0, 0]
+
+timeit("push_U", p_push, state, uniq_stack, grads, prng, U_pad=U_pad)
+
+# ---- probe: dedup_rows at K ----
+@jax.jit
+def p_dedup(rows_stack):
+    def body(i, acc):
+        u, g = dedup_rows(rows_stack[i], CAP)
+        return acc + u[0] + g[-1]
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros((), jnp.int32))
+
+timeit("dedup_rows_K", p_dedup, rows_stack, K_pad=K_pad)
+
+# ---- probe: expand gather K from [U, 11] ----
+gidx_np = rng.integers(0, U_real, size=(N_ITER, K_pad)).astype(np.int32)
+gidx_stack = jnp.asarray(gidx_np)
+vals_u = jnp.asarray(rng.normal(size=(U_pad, 3 + MF)).astype(np.float32))
+
+@jax.jit
+def p_expand(vals_u, gidx_stack):
+    def body(i, acc):
+        v = vals_u[gidx_stack[i]]
+        return acc + v[0, 0] + v[-1, -1]
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+
+timeit("expand_K_from_U", p_expand, vals_u, gidx_stack)
+
+# ---- probe: seqpool segment_sum fwd (K→B*S) ----
+@jax.jit
+def p_segsum(vals_k, segs):
+    def body(i, acc):
+        pooled = segment_sum(vals_k * (1.0 + acc), segs,
+                             num_segments=B * S + 1)
+        return acc + pooled[0, 0] + pooled[-1, -1]
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+
+timeit("segsum_K", p_segsum, vals_k, segs)
+
+# ---- probe: seqpool bwd (gather K from B*S) ----
+pooled_g = jnp.asarray(
+    rng.normal(size=(B * S + 1, 3 + MF)).astype(np.float32))
+
+@jax.jit
+def p_seg_bwd(pooled_g, segs):
+    def body(i, acc):
+        v = pooled_g[segs] * (1.0 + acc)
+        return acc + v[0, 0] + v[-1, -1]
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+
+timeit("seg_bwd_gather_K", p_seg_bwd, pooled_g, segs)
+
+# ---- probe: slot-wire decode (cumsum + searchsorted at K) ----
+counts_u16 = jnp.asarray(counts.sum(axis=1).astype(np.int32))
+
+@jax.jit
+def p_slotwire(counts_u16):
+    def body(i, acc):
+        cum = jnp.cumsum(counts_u16 + acc.astype(jnp.int32))
+        rec = jnp.searchsorted(cum, jnp.arange(K_pad, dtype=jnp.int32),
+                               side="right").astype(jnp.int32)
+        return acc + rec[-1]
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros((), jnp.int32))
+
+timeit("slotwire_decode_K", p_slotwire, counts_u16)
+
+# ---- probe: slot-wire decode via scatter+cumsum (candidate fix) ----
+@jax.jit
+def p_slotwire2(counts_u16):
+    def body(i, acc):
+        cum = jnp.cumsum(counts_u16 + acc.astype(jnp.int32))
+        marks = jnp.zeros(K_pad, jnp.int32).at[cum].add(
+            1, mode="drop")
+        rec = jnp.cumsum(marks)
+        return acc + rec[-1]
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros((), jnp.int32))
+
+timeit("slotwire_scatter_cumsum_K", p_slotwire2, counts_u16)
+
+# ---- probe: expand backward (segment_sum K→U, the grad merge) ----
+@jax.jit
+def p_expand_bwd(vals_k, gidx_stack):
+    def body(i, acc):
+        g = jax.ops.segment_sum(vals_k * (1.0 + acc), gidx_stack[i],
+                                num_segments=U_pad)
+        return acc + g[0, 0] + g[-1, -1]
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+
+timeit("expand_bwd_segsum_K_to_U", p_expand_bwd, vals_k, gidx_stack)
+
+# ---- probe: gather linearity (half U) ----
+half_stack = uniq_stack[:, :U_pad // 2]
+
+@jax.jit
+def p_gather_half(state, half_stack):
+    def body(i, acc):
+        rows = gather_full_rows(state, half_stack[i])
+        return acc + rows[0, 0] + rows[-1, -1]
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+
+timeit("gather_halfU_big", p_gather_half, state, half_stack,
+       U=U_pad // 2)
+
+# ---- probe: per-key direct gather from big table (K-sized) ----
+@jax.jit
+def p_gather_K_direct(state, rows_stack):
+    def body(i, acc):
+        rows = gather_full_rows(state, rows_stack[i])
+        return acc + rows[0, 0] + rows[-1, -1]
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+
+timeit("gather_K_direct_big", p_gather_K_direct, state, rows_stack,
+       K_pad=K_pad)
+
+# ---- probe: dense DeepFM fwd+bwd at this B ----
+from paddlebox_tpu.models import DeepFM
+import optax
+model = DeepFM(hidden=(512, 256, 128))
+pooled0 = jnp.zeros((B, S, 3 + MF))
+dense0 = jnp.zeros((B, 13))
+params = model.init(jax.random.PRNGKey(0), pooled0, dense0)
+pooled_in = jnp.asarray(rng.normal(size=(B, S, 3 + MF)).astype(np.float32))
+dense_in = jnp.asarray(rng.normal(size=(B, 13)).astype(np.float32))
+label = jnp.asarray((rng.random(B) < 0.25).astype(np.float32))
+
+@jax.jit
+def p_dense(params, pooled_in, dense_in, label):
+    def body(i, carry):
+        acc, params = carry
+        def loss_fn(p):
+            lg = model.apply(p, pooled_in * (1 + acc), dense_in)
+            return optax.sigmoid_binary_cross_entropy(lg, label).mean()
+        l, g = jax.value_and_grad(loss_fn)(params)
+        params = jax.tree.map(lambda a, b: a - 1e-9 * b, params, g)
+        return acc + l * 1e-9, params
+    acc, params = jax.lax.fori_loop(
+        0, N_ITER, body, (jnp.zeros(()), params))
+    return acc
+
+timeit("dense_fwd_bwd", p_dense, params, pooled_in, dense_in, label)
+
+# ---- hot-tier probes ----
+H = int(os.environ.get("PROF_HOT_ROWS", 8192))
+hot_packed = jnp.asarray(
+    rng.normal(size=(H // 8, 128)).astype(np.float32))
+hot_idx = jnp.asarray(
+    rng.integers(0, H, size=(N_ITER, K_pad)).astype(np.int32))
+
+@jax.jit
+def p_hot_gather(hot_packed, hot_idx):
+    """Same packed-line gather, small table: is per-index cost lower
+    when the source fits VMEM?"""
+    def body(i, acc):
+        rows = hot_idx[i]
+        lines = hot_packed[rows // 8]
+        sub = (rows % 8).astype(jnp.int32)
+        grouped = lines.reshape(K_pad, 8, 16)
+        v = jnp.take_along_axis(grouped, sub[:, None, None], axis=1)[:, 0]
+        return acc + v[0, 0] + v[-1, -1]
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+
+timeit("hot_gather_smalltable_K", p_hot_gather, hot_packed, hot_idx, H=H)
+
+# one-hot MXU matmul gather: [K, H] @ [H, 16] for a few H
+for Hm in (512, 2048, 8192):
+    hot_tab = jnp.asarray(rng.normal(size=(Hm, 16)).astype(np.float32))
+    hidx = jnp.asarray(
+        rng.integers(0, Hm, size=(N_ITER, K_pad)).astype(np.int32))
+
+    @jax.jit
+    def p_onehot(hot_tab, hidx):
+        def body(i, acc):
+            oh = jax.nn.one_hot(hidx[i], Hm, dtype=jnp.bfloat16)
+            v = oh @ hot_tab.astype(jnp.bfloat16)
+            return acc + v[0, 0].astype(jnp.float32) \
+                + v[-1, -1].astype(jnp.float32)
+        return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+
+    timeit(f"onehot_matmul_gather_H{Hm}", p_onehot, hot_tab, hidx, H=Hm)
+
+    @jax.jit
+    def p_onehot_push(hot_tab, hidx, grads16):
+        """Push via transposed one-hot: [H, K] @ [K, 16] scatter-add."""
+        def body(i, tab):
+            oh = jax.nn.one_hot(hidx[i], Hm, dtype=jnp.bfloat16,
+                                axis=0)  # [H, K]
+            return tab + (oh @ grads16).astype(jnp.float32)
+        return jax.lax.fori_loop(0, N_ITER, body, hot_tab)[0, 0]
+
+    grads16 = jnp.asarray(
+        rng.normal(size=(K_pad, 16)).astype(np.float32)).astype(
+            jnp.bfloat16)
+    timeit(f"onehot_matmul_push_H{Hm}", p_onehot_push, hot_tab, hidx,
+           grads16, H=Hm)
+
+# sorted vs unsorted gather from the big table
+sorted_stack = jnp.asarray(np.sort(uniq_np, axis=1))
+
+@jax.jit
+def p_gather_sorted(state, sorted_stack):
+    def body(i, acc):
+        rows = gather_full_rows(state, sorted_stack[i])
+        return acc + rows[0, 0] + rows[-1, -1]
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+
+timeit("gather_U_big_sorted", p_gather_sorted, state, sorted_stack)
+
+# bf16 pull lines: gather from a bf16 copy of the packed table
+state_bf = TableState(state.packed.astype(jnp.bfloat16), CAP, FEAT, EXT)
+
+@jax.jit
+def p_gather_bf16(state_bf, uniq_stack):
+    def body(i, acc):
+        rows = gather_full_rows(state_bf, uniq_stack[i])
+        return acc + rows[0, 0].astype(jnp.float32)
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+
+timeit("gather_U_big_bf16", p_gather_bf16, state_bf, uniq_stack)
+
+print(json.dumps({"probe": "done"}), flush=True)

@@ -235,3 +235,78 @@ def test_uint64_overflow_and_hexfloat_parity(tmp_path):
     ref = _columnar_from_python(p, str(f), desc.dense_dim)
     assert len(got["label"]) == ref.num_records == 1
     np.testing.assert_array_equal(got["keys"], ref.keys)
+
+
+def _real_criteo_fixture(path, rows=384, seed=7):
+    """A fixture file with REAL Criteo day-file quirks (the reference's
+    tolerant MultiSlot parse semantics, data_feed.cc): 8-hex-digit
+    lowercase feature hashes, EMPTY dense fields, NEGATIVE ints in I2
+    (present in the real dataset), EMPTY categorical fields (missing →
+    sentinel), rows ending in an empty field (trailing tab), plus
+    malformed lines (wrong field count / garbage label) that must drop."""
+    rng = np.random.default_rng(seed)
+    lines = []
+    for r in range(rows):
+        label = str(int(rng.random() < 0.3))
+        dense = [str(int(v)) for v in rng.integers(0, 1500, size=13)]
+        dense[1] = str(int(rng.integers(-3, 10)))   # I2 goes negative
+        for i in rng.choice(13, size=4, replace=False):
+            dense[i] = ""                            # missing dense
+        cats = [format(int(v), "08x")
+                for v in rng.integers(0, 1 << 32, size=26)]
+        for i in rng.choice(25, size=2, replace=False):
+            cats[i] = ""                             # missing categorical
+        cats[25] = ""                                # trailing tab
+        lines.append("\t".join([label] + dense + cats))
+    # interleave malformed rows: all must be dropped, no bleed
+    lines.insert(0, "")                              # blank line
+    lines.insert(5, "\t".join(["1"] + ["1"] * 12))   # too few fields
+    lines.insert(9, "abc\t" + "\t".join(["1"] * 39)) # garbage label
+    path.write_text("\n".join(lines) + "\n")
+    return rows
+
+
+def test_real_criteo_fixture_end_to_end(tmp_path):
+    """Real-format quirks parse through DataFeedDesc.criteo → columnar →
+    one resident train step (VERDICT r4 item 9)."""
+    import optax
+
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.ps import EmbeddingTable, SparseSGDConfig
+    from paddlebox_tpu.train import Trainer
+
+    f = tmp_path / "day_quirks.txt"
+    rows = _real_criteo_fixture(f)
+    desc = DataFeedDesc.criteo(batch_size=128)
+    desc.key_bucket_min = 4096
+
+    # both parse paths agree line-for-line on the quirk fixture
+    p = CriteoParser(desc)
+    ref = _columnar_from_python(p, str(f), desc.dense_dim)
+    assert ref.num_records == rows          # malformed lines dropped
+    if load_native() is not None:
+        got = p.parse_file_columnar(str(f))
+        assert got["dropped"] == 3
+        np.testing.assert_array_equal(got["keys"], ref.keys)
+        np.testing.assert_allclose(got["dense"], ref.dense, rtol=1e-6)
+        np.testing.assert_array_equal(got["label"], ref.label)
+
+    # missing categoricals land on the slot-salted sentinel, missing /
+    # negative dense on 0 (log1p clamps at 0)
+    sent_low = np.uint64(0xFFFFFFFF)
+    mask = (np.uint64(1) << np.uint64(52)) - np.uint64(1)
+    assert ((ref.keys & mask) == sent_low).sum() == rows * 3
+    assert (ref.dense >= 0).all() and np.isfinite(ref.dense).all()
+
+    # → dataset → columnar → one resident pass on the quirk data
+    ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+    ds.set_filelist([str(f)])
+    ds.load_into_memory()
+    cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=0.0)
+    table = EmbeddingTable(mf_dim=4, capacity=1 << 15, cfg=cfg,
+                           unique_bucket_min=4096)
+    tr = Trainer(DeepFM(hidden=(16, 8)), table, desc, tx=optax.adam(1e-2))
+    res = tr.train_pass_resident(ds)
+    assert res["batches"] == rows // 128
+    assert np.isfinite(res["auc"])
+    assert tr.table.feature_count > 0
