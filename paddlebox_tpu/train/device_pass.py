@@ -621,10 +621,17 @@ class ResidentPassRunner:
                 counts = segs[1].astype(jnp.int32)        # [B]
                 k = slot.shape[0]
                 s = meta[1] // counts.shape[0]            # pad_seg // B
+                # rec[p] = #{records whose cumulative count <= p}:
+                # scatter record-boundary marks and prefix-sum them —
+                # identical to searchsorted(cum, arange(k), "right")
+                # (empty records stack duplicate marks, hence .add) but
+                # ~14x faster: the vectorized binary search measured
+                # 56 ms/step at K=557k vs 3.9 ms for scatter+cumsum
+                # (scripts/profile_keypath.py, round 5)
                 cum = jnp.cumsum(counts)
-                rec = jnp.searchsorted(
-                    cum, jnp.arange(k, dtype=jnp.int32),
-                    side="right").astype(jnp.int32)
+                marks = jnp.zeros(k, jnp.int32).at[cum].add(
+                    1, mode="drop")
+                rec = jnp.cumsum(marks)
                 # pads: rec saturates at B and slot pads are 0, so the
                 # reconstruction lands exactly on pad_segment == B*S
                 return rec * s + slot
