@@ -77,6 +77,24 @@ class Flags:
     # tunneled/remote devices where the bucket pull is dead weight.
     auc_device_reduce: bool = False
 
+    # --- pass-boundary scatter (ps/table.scatter_logical_rows) ---
+    # fixed chunk size for the begin_pass delta scatter: one compiled
+    # executable per table geometry instead of one per delta size (the
+    # per-size compile measured ~20 s on TPU — BENCH_SHAPES tiered row)
+    scatter_chunk_rows: int = 1 << 14
+    # warm the chunk-scatter executable in a background thread at tiered
+    # table construction, so the first pass boundary doesn't pay the
+    # compile (utils/compile_cache + ps/tiered)
+    warmup_pass_scatter: bool = True
+
+    # --- XLA persistent compilation cache (utils/compile_cache) ---
+    # "" = auto (<tmp>/paddlebox_tpu_xla_cache, honoring
+    # JAX_COMPILATION_CACHE_DIR); "off" disables. Enabled by
+    # Trainer/ShardedTrainer/launcher init so cold processes (elastic
+    # replacement ranks included) deserialize compiles instead of
+    # re-running XLA at the first pass boundary.
+    compilation_cache_dir: str = ""
+
     # --- runtime ---
     profile: bool = False
     log_period_steps: int = 100

@@ -29,6 +29,58 @@ from paddlebox_tpu.metrics import (AucResult, auc_add_batch, auc_compute,
                                    init_auc_state)
 
 
+def _allgather_bits(a: np.ndarray) -> np.ndarray:
+    """process_allgather with BIT-EXACT transport: with x64 disabled,
+    jax canonicalizes int64→int32 / float64→float32 on device_put
+    (inside process_allgather), silently truncating 64-bit uids and
+    large f64 sums. 8-byte dtypes therefore ride the wire as uint32
+    PAIRS and reassemble by view — no value ever passes through a jax
+    64-bit array. Returns the [P, ...] stacked gather."""
+    from jax.experimental import multihost_utils
+    a = np.ascontiguousarray(a)
+    if a.dtype.itemsize == 8:
+        bits = a.view(np.uint32).reshape(a.shape + (2,))
+        g = np.asarray(multihost_utils.process_allgather(bits))
+        return np.ascontiguousarray(g).view(a.dtype).reshape(
+            g.shape[:-1])
+    return np.asarray(multihost_utils.process_allgather(a))
+
+
+def _pod_sum_tree(tree):
+    """Sum per-process partial accumulators across a multi-controller
+    pod — the MPI metric allreduce of the reference
+    (fleet/metrics.cc:288-304: every trainer allreduces its bucket
+    tables before computing ONE global AUC). Rides the jax distributed
+    runtime (process_allgather, 64-bit-safe via _allgather_bits; the
+    sum itself happens on host in the source dtype), so it needs no
+    extra rendezvous; on a single-controller mesh it is the identity.
+    COLLECTIVE: on a pod, every process must call
+    compute()/get_metric_msg in lockstep (the SPMD host contract that
+    already governs batch prep)."""
+    if jax.process_count() == 1:
+        return tree
+    return jax.tree.map(
+        lambda a: _allgather_bits(np.asarray(a)).sum(axis=0), tree)
+
+
+def _pod_gather_varlen(arrays: Sequence[np.ndarray]) -> List[np.ndarray]:
+    """Concatenate per-process variable-length record arrays across the
+    pod (the record-collecting WuAuc calculator's gather). Pads to the
+    pod-max length for the fixed-shape allgather, then drops pads.
+    64-bit dtypes (uids are 64-bit hashes) transport bit-exactly."""
+    if jax.process_count() == 1:
+        return list(arrays)
+    ns = _allgather_bits(np.asarray(len(arrays[0]), np.int64))
+    m = max(int(ns.max()), 1)
+    out = []
+    for a in arrays:
+        pad = np.zeros(m - len(a), a.dtype)
+        g = _allgather_bits(np.concatenate([a, pad]))
+        out.append(np.concatenate([g[p, :int(ns[p])]
+                                   for p in range(g.shape[0])]))
+    return out
+
+
 def parse_cmatch_rank_group(group: str) -> List[Tuple[int, int]]:
     """"401:0,402:0" → [(401,0),(402,0)]; entries without ':' get rank 0
     (MetricMsg parse_cmatch_rank, metrics.h helpers)."""
@@ -69,7 +121,9 @@ class AucMetric:
                                    self.selection_weight(w, **inputs))
 
     def compute(self) -> Dict[str, float]:
-        return auc_compute(self.state).as_dict()
+        # pod: transient global sum of the bucket tables (non-mutating,
+        # so compute() stays repeatable while accumulation continues)
+        return auc_compute(_pod_sum_tree(self.state)).as_dict()
 
     def reset(self) -> None:
         self.state = init_auc_state(self._nbins)
@@ -172,9 +226,11 @@ class ContinueValueMetric:
         self._n += float(jnp.sum(w))
 
     def compute(self) -> Dict[str, float]:
-        n = max(self._n, 1e-12)
-        return {"mae": self._abs / n, "mse": self._sqr / n,
-                "rmse": float(np.sqrt(self._sqr / n)), "ins_num": self._n}
+        s_abs, s_sqr, s_n = (float(x) for x in _pod_sum_tree(
+            np.array([self._abs, self._sqr, self._n])))
+        n = max(s_n, 1e-12)
+        return {"mae": s_abs / n, "mse": s_sqr / n,
+                "rmse": float(np.sqrt(s_sqr / n)), "ins_num": s_n}
 
     def reset(self):
         self._abs = 0.0
@@ -199,8 +255,10 @@ class NanInfMetric:
         self.total += int(pred.shape[0])
 
     def compute(self) -> Dict[str, float]:
-        return {"nan": float(self.nan_cnt), "inf": float(self.inf_cnt),
-                "ins_num": float(self.total)}
+        s = _pod_sum_tree(np.array([self.nan_cnt, self.inf_cnt,
+                                    self.total], np.float64))
+        return {"nan": float(s[0]), "inf": float(s[1]),
+                "ins_num": float(s[2])}
 
     def reset(self):
         self.nan_cnt = 0
@@ -275,10 +333,18 @@ class WuAucMetric:
         self._label.append(np.asarray(label)[mask])
 
     def compute(self) -> Dict[str, float]:
-        uid = np.concatenate(self._uid) if self._uid else np.empty(0, np.int64)
+        uid = (np.concatenate(self._uid) if self._uid
+               else np.empty(0, np.int64))
         pred = np.concatenate(self._pred) if self._pred else np.empty(0)
         label = (np.concatenate(self._label) if self._label
                  else np.empty(0))
+        # pod: gather every process's records (dtype-stable for the
+        # fixed-shape allgather; a user's records may span processes —
+        # the per-user math runs on the concatenated whole)
+        uid, pred, label = _pod_gather_varlen(
+            [uid.astype(np.int64, copy=False),
+             pred.astype(np.float64, copy=False),
+             label.astype(np.float64, copy=False)])
         wuauc, uauc, users = _tie_averaged_user_auc(uid, pred, label)
         return {"wuauc": wuauc, "uauc": uauc, "user_count": float(users),
                 "ins_num": float(len(uid))}

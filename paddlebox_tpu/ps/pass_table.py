@@ -42,6 +42,7 @@ from paddlebox_tpu.ps.sgd import SparseSGDConfig
 from paddlebox_tpu.ps.table import (EmbeddingTable, promote_window_delta,
                                     rows_from_store_fields,
                                     scatter_logical_rows,
+                                    start_scatter_warmup,
                                     store_fields_from_rows)
 from paddlebox_tpu.utils.logging import get_logger
 
@@ -99,6 +100,7 @@ class PassScopedTable(EmbeddingTable):
         self.in_pass = False
         # per-pass delta accounting (same keys as the tiered table)
         self.last_pass_stats: Dict[str, int] = {}
+        start_scatter_warmup(self.state, sharded=False)
 
     # ---- host field <-> logical row conversion --------------------------
     def _logical_rows(self, vals: Dict[str, np.ndarray]) -> np.ndarray:

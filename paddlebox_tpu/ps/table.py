@@ -390,11 +390,15 @@ def gather_full_rows(state: TableState, unique_rows: jax.Array) -> jax.Array:
     """ONE line-gather of complete feature rows → [U, 8+mf_dim].
 
     Each logical row lives lane-contiguous inside one 128-wide storage
-    line (see TableState); the gather fetches whole lines and the
-    in-register take_along_axis extracts the row's slice. Pad/OOB ids
-    are clamped to the SENTINEL row before the line split so they read
-    its zeros — clamping raw line indices instead would let a far-OOB id
-    alias a real row when capacity % rows_per_line == rpl-1."""
+    line (see TableState); the gather fetches whole lines and a ONE-HOT
+    mask + sum over the rows-per-line axis extracts the row's slice
+    in-register. The earlier take_along_axis extract lowered to a SECOND
+    per-index gather and cost as much as the line fetch itself — the
+    mask extract is pure VPU work (measured: 23.3 → 12.9 ms at U=491k,
+    scripts/profile_keypath2.py, round 5). Pad/OOB ids are clamped to
+    the SENTINEL row before the line split so they read its zeros —
+    clamping raw line indices instead would let a far-OOB id alias a
+    real row when capacity % rows_per_line == rpl-1."""
     rpl, fp, _ = state.geometry
     u = unique_rows.shape[0]
     rows = jnp.minimum(unique_rows, state.capacity)
@@ -404,14 +408,49 @@ def gather_full_rows(state: TableState, unique_rows: jax.Array) -> jax.Array:
         lines = state.packed[rows // rpl]                 # [U, 128]
     sub = (rows % rpl).astype(jnp.int32)
     grouped = lines.reshape(u, rpl, fp)
-    vals = jnp.take_along_axis(grouped, sub[:, None, None], axis=1)[:, 0]
+    onehot = (jnp.arange(rpl, dtype=jnp.int32)[None, :]
+              == sub[:, None]).astype(lines.dtype)        # [U, rpl]
+    vals = jnp.einsum("urf,ur->uf", grouped, onehot)
     return vals[:, :state._feat] if fp != state._feat else vals
+
+
+_SCATTER_CHUNK_FNS: Dict[tuple, object] = {}
+
+
+def _scatter_chunk_fn(sharded: bool, rpl: int, fp: int, feat: int):
+    """Jitted FIXED-SHAPE chunk scatter (one executable per geometry ×
+    chunk size, reused across every pass boundary): rows arrive padded
+    to the chunk with out-of-bounds line ids, ``mode="drop"`` discards
+    them. The packed buffer is DONATED — the caller must treat the input
+    state as consumed."""
+    key = (sharded, rpl, fp, feat)
+    fn = _SCATTER_CHUNK_FNS.get(key)
+    if fn is not None:
+        return fn
+    cols_off = jnp.arange(feat, dtype=jnp.int32)
+
+    if sharded:
+        def run(packed, shard_c, rows_c, vals_c):
+            lines = rows_c // rpl
+            cols = (rows_c % rpl * fp)[:, None] + cols_off[None, :]
+            return packed.at[shard_c[:, None], lines[:, None],
+                             cols].set(vals_c, mode="drop")
+    else:
+        def run(packed, rows_c, vals_c):
+            lines = rows_c // rpl
+            cols = (rows_c % rpl * fp)[:, None] + cols_off[None, :]
+            return packed.at[lines[:, None], cols].set(vals_c,
+                                                       mode="drop")
+    fn = jax.jit(run, donate_argnums=(0,))
+    _SCATTER_CHUNK_FNS[key] = fn
+    return fn
 
 
 def scatter_logical_rows(state: TableState, shard_idx,
                          rows: np.ndarray,
-                         values: np.ndarray) -> TableState:
-    """ONE device scatter of logical rows into a packed state — stacked
+                         values: np.ndarray,
+                         chunk: Optional[int] = None) -> TableState:
+    """Device scatter of logical rows into a packed state — stacked
     [N, L, 128] with ``shard_idx`` per row, or a single table [L, 128]
     with ``shard_idx=None``: row ``rows[k]`` (of shard ``shard_idx[k]``)
     becomes ``values[k]`` (logical width feat). The delta-staging
@@ -419,21 +458,110 @@ def scatter_logical_rows(state: TableState, shard_idx,
     ``values`` — the table itself never crosses the host↔device
     boundary. (shard, row) pairs must be unique; pad columns
     [feat:f_pad] of the line stay untouched (zero by the init/push
-    invariants)."""
-    rpl, fp, _ = state.geometry
+    invariants).
+
+    The scatter runs in FIXED-SIZE chunks (``FLAGS.scatter_chunk_rows``)
+    so XLA compiles ONE executable per table geometry instead of one per
+    delta size — the per-pass-boundary scatter compile measured ~20 s on
+    TPU (docs/BENCH_SHAPES.md tiered row, round 4) and delta sizes vary
+    every pass. Chunk pads are out-of-bounds line ids (dropped on
+    device); values ship exact-size and are zero-padded on device, so no
+    pad bytes ride the wire. The input state stays VALID (unchanged
+    semantics for callers that keep references, e.g. trainers that
+    adopted it): one explicit device copy feeds the first chunk and the
+    chunks donate intermediates to each other — total table traffic is
+    one copy regardless of chunk count."""
+    rpl, fp, n_lines = state.geometry
     feat = state._feat
+    n = len(rows)
+    if n == 0:
+        return state
+    from paddlebox_tpu.config import FLAGS
+    c = int(chunk or FLAGS.scatter_chunk_rows)
+    sharded = shard_idx is not None
     rows = np.ascontiguousarray(rows, np.int32)
-    lines = rows // rpl
-    col0 = (rows % rpl) * fp
-    cols = col0[:, None] + np.arange(feat, dtype=np.int32)[None, :]
-    vals = jnp.asarray(values, state.packed.dtype)
-    if shard_idx is None:
-        packed = state.packed.at[lines[:, None], cols].set(vals)
-    else:
-        packed = state.packed.at[
-            np.ascontiguousarray(shard_idx, np.int32)[:, None],
-            lines[:, None], cols].set(vals)
+    if sharded:
+        shard_idx = np.ascontiguousarray(shard_idx, np.int32)
+        n_shards = state.packed.shape[0]
+    vals_np = np.asarray(values)
+    fn = _scatter_chunk_fn(sharded, rpl, fp, feat)
+    # the chunk executable donates its input; feed it a copy so callers
+    # (trainers that adopted this state) keep a live buffer
+    packed = jnp.copy(state.packed)
+    oob_row = n_lines * rpl  # line index == n_lines → dropped
+    for off in range(0, n, c):
+        m = min(c, n - off)
+        r_c = np.full(c, oob_row, np.int32)
+        r_c[:m] = rows[off:off + m]
+        v = jnp.asarray(vals_np[off:off + m], packed.dtype)
+        v_c = jax.lax.dynamic_update_slice(
+            jnp.zeros((c, feat), packed.dtype), v, (0, 0))
+        if sharded:
+            s_c = np.full(c, n_shards, np.int32)
+            s_c[:m] = shard_idx[off:off + m]
+            packed = fn(packed, jnp.asarray(s_c), jnp.asarray(r_c), v_c)
+        else:
+            packed = fn(packed, jnp.asarray(r_c), v_c)
     return state.with_packed(packed)
+
+
+def warmup_begin_scatter(state: TableState, sharded: bool,
+                         chunk: Optional[int] = None) -> TableState:
+    """Compile the begin_pass chunk scatter AHEAD of the first pass
+    boundary (a no-op scatter of one dropped row): with the persistent
+    compilation cache enabled this also seeds the on-disk cache, so a
+    cold process's first delta begin_pass deserializes instead of
+    paying the ~20 s scatter compile. Returns the (unchanged-content)
+    state."""
+    rpl, _, n_lines = state.geometry
+    oob = np.array([n_lines * rpl], np.int32)
+    z = np.zeros((1, state._feat), np.float32)
+    sh = np.array([state.packed.shape[0]], np.int32) if sharded else None
+    return scatter_logical_rows(state, sh, oob, z, chunk=chunk)
+
+
+def start_scatter_warmup(state: TableState, sharded: bool) -> None:
+    """Background-compile the pass-boundary chunk scatter at table
+    construction (FLAGS.warmup_pass_scatter): runs warmup_begin_scatter
+    on a THROWAWAY zero state of the live state's shape — same shapes →
+    same jitted executable, so the real begin_pass hits the compile
+    cache, while the live buffer is never donated behind the backs of
+    trainers that already adopted it. The transient costs one extra
+    table-sized device allocation during construction/staging, before
+    training starts."""
+    from paddlebox_tpu.config import FLAGS
+    if not FLAGS.warmup_pass_scatter:
+        return
+
+    rpl, fp, n_lines = state.geometry
+    feat = state._feat
+    shape = state.packed.shape
+    dtype = state.packed.dtype
+
+    def run() -> None:
+        try:
+            # call the chunk executable DIRECTLY on a throwaway zeros
+            # buffer (donated) — going through scatter_logical_rows
+            # would add its jnp.copy and peak at 2x table size while
+            # the main thread stages the cold pass
+            from paddlebox_tpu.config import FLAGS as _F
+            c = int(_F.scatter_chunk_rows)
+            fn = _scatter_chunk_fn(sharded, rpl, fp, feat)
+            dummy = jnp.zeros(shape, dtype)
+            r_c = jnp.full((c,), n_lines * rpl, jnp.int32)
+            v_c = jnp.zeros((c, feat), dtype)
+            if sharded:
+                s_c = jnp.full((c,), shape[0], jnp.int32)
+                out = fn(dummy, s_c, r_c, v_c)
+            else:
+                out = fn(dummy, r_c, v_c)
+            jax.block_until_ready(out)
+        except Exception as e:  # OOM mid-construction etc. — warmup only
+            from paddlebox_tpu.utils.logging import get_logger
+            get_logger(__name__).warning("pass-scatter warmup failed: %s",
+                                         e)
+
+    threading.Thread(target=run, daemon=True).start()
 
 
 def pull_values(rows_full: jax.Array,

@@ -68,6 +68,7 @@ from paddlebox_tpu.ps.sgd import SparseSGDConfig
 from paddlebox_tpu.ps.table import (HostKV, promote_window_delta,
                                     rows_from_store_fields,
                                     scatter_logical_rows,
+                                    start_scatter_warmup,
                                     store_fields_from_rows)
 from paddlebox_tpu.utils.logging import get_logger
 
@@ -111,6 +112,7 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         self._stage: Optional[_ShardStage] = None
         self._stage_thread: Optional[threading.Thread] = None
         self._stage_exc: Optional[BaseException] = None
+        start_scatter_warmup(self.state, sharded=True)
         # per-pass delta accounting (asserted by tests, reported by bench):
         # resident = working-set keys already in the window,
         # staged = keys fetched+scattered, evicted / evicted_writeback,

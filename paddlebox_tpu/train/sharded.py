@@ -607,6 +607,10 @@ class ShardedTrainer:
         box_wrapper.cc:1303-1335; consumed boxps_worker.cc:199-204).
         Respected by both the psum mode and the zero1 flat chunks."""
         import threading as _threading
+
+        from paddlebox_tpu.utils.compile_cache import \
+            enable_compilation_cache
+        enable_compilation_cache()
         self.float_wire = float_wire
         self.model = model
         self.table = table
@@ -648,21 +652,58 @@ class ShardedTrainer:
         pass None to disable. Each device row of the global batch dumps
         in device order (the mesh's worker order).
 
-        Single-controller only: the feed slices ``stats['pred']`` per
-        device row on host, which requires every row to be addressable;
-        on a multi-process mesh the dump (and registry metric variants)
-        are skipped with a warning — run them from a single-controller
-        mesh, as with the mesh resident pass."""
+        On a multi-process pod each process dumps only its ADDRESSABLE
+        device rows into its own ``.part-<rank>`` shard — the
+        reference's per-worker dump channel (every worker writes its own
+        file; no global addressing). Concatenating the rank shards in
+        device order reproduces the single-controller dump
+        line-for-line (tested 2-process in test_multihost_train.py)."""
         self._dump_cfg = cfg
+
+    @staticmethod
+    def _addressable_rows(arr, axis: int = 0):
+        """Yield (device_row, np_slice) for the rows of a global array
+        this process can address, in device order — the per-worker feed
+        contract (each worker sees its own rows; single-controller sees
+        all of them). ``np_slice`` drops the sliced axis."""
+        seen = set()
+        shards = sorted(getattr(arr, "addressable_shards", []),
+                        key=lambda s: s.index[axis].start or 0)
+        if not shards:  # plain np/jnp array (tests call with host data)
+            a = np.asarray(arr)
+            for d in range(a.shape[axis]):
+                yield d, np.take(a, d, axis=axis)
+            return
+        for sh in shards:
+            i0 = sh.index[axis].start or 0
+            data = np.asarray(sh.data)
+            for j in range(data.shape[axis]):
+                d = i0 + j
+                if d in seen:
+                    continue  # replicated shard
+                seen.add(d)
+                yield d, np.take(data, j, axis=axis)
 
     def _group_iter(self, batches):
         return group_batches(batches, self.n)
+
+    def _stage_batch(self, group, idx) -> "GlobalBatch":
+        """Stage one global batch for the step: single-controller puts
+        host arrays straight on the mesh; a multi-controller pod routes
+        through make_array_from_process_local_data (every process built
+        the identical host arrays — the SPMD prep contract,
+        train/multihost.py)."""
+        if jax.process_count() > 1:
+            from paddlebox_tpu.train.multihost import stage_global_batch
+            return stage_global_batch(
+                self.mesh, make_global_arrays(group, idx))
+        return make_global_batch(group, idx)
 
     def _prefetch_iter(self, batches):
         from paddlebox_tpu.utils.prefetch import prefetch_iter
 
         def prep(group):
-            return group, make_global_batch(
+            return group, self._stage_batch(
                 group, self.table.prepare_global(group))
 
         return prefetch_iter(self._group_iter(batches), prep,
@@ -677,61 +718,103 @@ class ShardedTrainer:
         timer.start()
         nb = 0
         stats = None
-        dump_writer = None
-        multi_controller = jax.process_count() > 1
-        if multi_controller and (self._dump_cfg is not None
-                                 or len(self.metrics)):
-            # preds[d] below slices every device row on host, which needs
-            # all rows addressable — true only on a single-controller mesh
-            log.warning(
-                "per-sample dump / registry metric variants are "
-                "single-controller features (host slices every device "
-                "row of stats['pred']); skipping on this %d-process mesh",
-                jax.process_count())
-        if self._dump_cfg is not None and not multi_controller:
-            from paddlebox_tpu.utils.dump import DumpWriter
-            dump_writer = DumpWriter(self._dump_cfg)
+        # one DumpWriter per ADDRESSABLE device row — the reference's
+        # one-dump-channel-per-worker model (boxps_worker.cc:1595: each
+        # of the N per-GPU workers writes its own file). Part files are
+        # keyed by DEVICE row, so a pod run's per-rank files are
+        # byte-identical to the single-controller run's.
+        dump_writers: Dict[int, object] = {}
+
+        def writer_for(d: int):
+            w = dump_writers.get(d)
+            if w is None:
+                import copy
+
+                from paddlebox_tpu.utils.dump import DumpWriter
+                cfg = copy.copy(self._dump_cfg)
+                cfg.rank = cfg.rank + d
+                w = dump_writers[d] = DumpWriter(cfg)
+            return w
+
+        if self._dump_cfg is not None:
+            # eager part-file creation for every addressable device row:
+            # a row whose batches are all tail filler must still leave
+            # an (empty) shard, so device-order concatenation consumers
+            # never hit a file gap
+            for d, dev in enumerate(self.mesh.devices.ravel()):
+                if dev.process_index == jax.process_index():
+                    writer_for(d)
+
         for group, gb in self._prefetch_iter(dataset.batches()):
             self.global_step += 1
             rng = jax.random.fold_in(self._rng, self.global_step)
             self.state, stats = self.step_fn(self.state, gb, rng)
             nb += 1
-            want_dump = (dump_writer is not None
+            want_dump = (self._dump_cfg is not None
                          and nb % self._dump_cfg.interval == 0)
-            if (len(self.metrics) and not multi_controller) or want_dump:
-                # ONE pass over the device rows (worker order) feeds the
-                # metric registry (AddAucMonitor) and the dump — pred
-                # stays the device array, sliced once per row
-                preds = stats["pred"]
-                for d, b in enumerate(group):
+            if len(self.metrics) or want_dump:
+                # ONE pass over this process's ADDRESSABLE device rows
+                # (worker order) feeds the metric registry
+                # (AddAucMonitor) and the dump — the per-worker model:
+                # each process handles its own rows; registry partials
+                # merge across the pod inside compute()
+                # (metrics_ext._pod_sum_tree)
+                for d, pred_d in self._addressable_rows(stats["pred"]):
+                    b = group[d]
                     n_real = int((b.show > 0).sum())
                     if n_real == 0:
                         continue  # tail-group filler (dead batch)
-                    pred_d = preds[d]
                     if len(self.metrics):
                         self.metrics.add_batch(
                             pred_d, b.label,
                             (b.show > 0).astype(np.float32), uid=b.uid,
                             rank=b.rank, cmatch=b.cmatch)
                     if want_dump:
-                        dump_writer.add_batch(
+                        writer_for(d).add_batch(
                             b.ins_ids,
                             {"pred": pred_d, "label": b.label,
                              "show": b.show, "clk": b.clk}, n_real)
-        if dump_writer is not None:
-            dump_writer.close()
+        for w in dump_writers.values():
+            w.close()
         timer.pause()
         self.table.state = self.state.table
-        auc_host = AucState(*[jnp.sum(l, axis=0) for l in self.state.auc])
-        res = auc_compute(auc_host)
+        res = auc_compute(self._finalize_auc(self.state.auc))
         out = res.as_dict()
         out.update(
             batches=nb, elapsed_sec=timer.elapsed_sec(),
             examples_per_sec=res.ins_num / max(timer.elapsed_sec(), 1e-9),
-            last_loss=float(stats["loss"]) if stats is not None else float("nan"))
+            last_loss=(self._host_scalar(stats["loss"])
+                       if stats is not None else float("nan")))
         log.info("%ssharded pass done: %d global batches, %.0f ex/s, auc=%.4f",
                  log_prefix, nb, out["examples_per_sec"], res.auc)
         return out
+
+    def _finalize_auc(self, auc) -> "AucState":
+        """Per-shard AUC leaves → one host AucState. On a pod the leaves
+        are global arrays whose shards live on other processes — eager
+        reduction is illegal there, so the sum runs jitted with a
+        replicated out-sharding every process can read."""
+        if jax.process_count() > 1:
+            if getattr(self, "_auc_reduce_jit", None) is None:
+                from jax.sharding import NamedSharding, PartitionSpec
+                self._auc_reduce_jit = jax.jit(
+                    lambda ls: tuple(jnp.sum(l, axis=0) for l in ls),
+                    out_shardings=NamedSharding(self.mesh,
+                                                PartitionSpec()))
+            reduced = self._auc_reduce_jit(tuple(auc))
+            return AucState(*[np.asarray(jax.device_get(x))
+                              for x in reduced])
+        return AucState(*[jnp.sum(l, axis=0) for l in auc])
+
+    @staticmethod
+    def _host_scalar(x) -> float:
+        """float() of a step stat that may be a non-fully-addressable
+        global array on a pod (every process holds the same replicated
+        value in its addressable shard)."""
+        shards = getattr(x, "addressable_shards", None)
+        if shards:
+            return float(np.ravel(np.asarray(shards[0].data))[0])
+        return float(x)
 
     def reset_metrics(self) -> None:
         self.state = self.state._replace(auc=init_sharded_auc(self.n))
@@ -768,19 +851,19 @@ class ShardedTrainer:
             auc, preds = self.step_fn.eval(
                 self.state.table, self.state.params, auc, gb)
             nb += 1
-            if len(self.metrics) and jax.process_count() == 1:
-                # test-phase AddAucMonitor feed, per device row
-                # (single-controller only — see set_dump)
-                for d, b in enumerate(group):
+            if len(self.metrics):
+                # test-phase AddAucMonitor feed over this process's
+                # addressable rows (per-worker model — see set_dump)
+                for d, pred_d in self._addressable_rows(preds):
+                    b = group[d]
                     ins_w = (b.show > 0).astype(np.float32)
                     if not ins_w.any():
                         continue  # tail-group filler
                     self.metrics.add_batch(
-                        preds[d], b.label, ins_w, uid=b.uid,
+                        pred_d, b.label, ins_w, uid=b.uid,
                         rank=b.rank, cmatch=b.cmatch)
         timer.pause()
-        auc_host = AucState(*[jnp.sum(l, axis=0) for l in auc])
-        res = auc_compute(auc_host)
+        res = auc_compute(self._finalize_auc(auc))
         out = res.as_dict()
         out.update(batches=nb, elapsed_sec=timer.elapsed_sec(),
                    examples_per_sec=res.ins_num /
@@ -795,7 +878,7 @@ class ShardedTrainer:
         def prep(group):
             # read-only routing: lookup instead of assign (unknown keys
             # serve the zero sentinel row, prepare_eval semantics)
-            return group, make_global_batch(
+            return group, self._stage_batch(
                 group, self.table.prepare_global_eval(group))
 
         return prefetch_iter(self._group_iter(batches), prep,
@@ -809,16 +892,20 @@ class ShardedTrainer:
         """Post-pass metric registry replay (the per-batch AddAucMonitor
         hook, boxps_worker.cc:1267,1337) from predictions collected
         inside the mesh fori_loop — the mesh analogue of the single-chip
-        Trainer._feed_registry_resident. ONE D2H fetch of [nb, N, B]."""
-        preds_h = np.asarray(preds)
+        Trainer._feed_registry_resident. One D2H fetch per addressable
+        device column ([nb, 1, B] each): on a pod every process replays
+        only its own workers' rows (side channels are host-global per
+        the SPMD prep contract) and the registry partials merge inside
+        compute()."""
         sd = rp.side
-        for i in range(rp.num_batches):
-            for dcol in range(preds_h.shape[1]):
+        for dcol, pred_col in self._addressable_rows(preds, axis=1):
+            # pred_col: [nb, B] — this device column across the pass
+            for i in range(rp.num_batches):
                 ins_w = (sd["show"][i, dcol] > 0).astype(np.float32)
                 if not ins_w.any():
                     continue  # tail-group filler (dead batch)
                 self.metrics.add_batch(
-                    preds_h[i, dcol], sd["label"][i, dcol], ins_w,
+                    pred_col[i], sd["label"][i, dcol], ins_w,
                     uid=None if sd["uid"] is None else sd["uid"][i, dcol],
                     rank=(None if sd["rank"] is None
                           else sd["rank"][i, dcol]),
@@ -843,13 +930,6 @@ class ShardedTrainer:
               if isinstance(pass_or_dataset, ShardedResidentPass)
               else self.build_resident_pass(pass_or_dataset))
         want_metrics = len(self.metrics) > 0
-        if want_metrics and jax.process_count() > 1:
-            log.warning(
-                "registry metric variants are single-controller features "
-                "(the replay slices every device row of the collected "
-                "predictions); skipping on this %d-process mesh",
-                jax.process_count())
-            want_metrics = False
         if want_metrics and rp.side is None:
             log.warning(
                 "registry metrics need the pass's side channels — this "
@@ -866,8 +946,7 @@ class ShardedTrainer:
         self.global_step += rp.num_batches
         timer.pause()
         self.table.state = self.state.table
-        auc_host = AucState(*[jnp.sum(l, axis=0) for l in self.state.auc])
-        res = auc_compute(auc_host)
+        res = auc_compute(self._finalize_auc(self.state.auc))
         out = res.as_dict()
         out.update(batches=rp.num_batches, elapsed_sec=timer.elapsed_sec(),
                    examples_per_sec=rp.num_records /

@@ -1,0 +1,243 @@
+#!/usr/bin/env python
+"""Round-5 probe set 2: grad-merge ordering, gather extract form, push
+variants — the levers left after the slot-wire decode fix.
+
+Prints one JSON line per probe. Run on the real chip.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.ps.table import gather_full_rows, init_table_state
+from paddlebox_tpu.ps.sgd import SparseSGDConfig, opt_ext_width
+from paddlebox_tpu.ps.table import next_bucket_fine
+
+N_ITER = int(os.environ.get("PROF_ITERS", 16))
+B, S, AVG, VOCAB = 4096, 26, 5.0, 100_000
+MF = 8
+CAP = 1 << 23
+cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=1e-3)
+EXT = opt_ext_width(cfg, MF)
+
+rng = np.random.default_rng(0)
+counts = 1 + rng.poisson(AVG - 1.0, size=(B, S))
+K = int(counts.sum())
+K_pad = next_bucket_fine(4096, K)
+
+slot_of_key = np.repeat(np.tile(np.arange(S), B), counts.reshape(-1))
+rows_np = np.empty((N_ITER, K_pad), np.int32)
+for i in range(N_ITER):
+    k_ids = rng.integers(0, VOCAB, size=K)
+    rows_np[i, :K] = (slot_of_key * VOCAB + k_ids).astype(np.int32) % CAP
+    rows_np[i, K:] = CAP
+
+# host-computed dedup per iteration (uniq sorted / gidx / perm / uid_sorted)
+uniqs = [np.unique(rows_np[i][:K], return_inverse=True)
+         for i in range(N_ITER)]
+u_max = max(len(u) for u, _ in uniqs)
+U_pad = next_bucket_fine(4096, u_max + 1)
+gidx_np = np.zeros((N_ITER, K_pad), np.int32)
+for i, (u, inv) in enumerate(uniqs):
+    gidx_np[i, :K] = inv
+    gidx_np[i, K:] = len(u)  # pad position
+gidx_stack = jnp.asarray(gidx_np)
+# sorted-by-row order: perm sorts keys by row id; uid_sorted nondecreasing
+perm_np = np.empty((N_ITER, K_pad), np.int32)
+uid_sorted_np = np.empty((N_ITER, K_pad), np.int32)
+for i in range(N_ITER):
+    p = np.argsort(rows_np[i], kind="stable")
+    perm_np[i] = p
+    uid_sorted_np[i] = gidx_np[i][p]
+perm_stack = jnp.asarray(perm_np)
+uid_sorted_stack = jnp.asarray(uid_sorted_np)
+
+g_k = jnp.asarray(rng.normal(size=(K_pad, 3 + MF)).astype(np.float32))
+state = init_table_state(CAP, MF, ext=EXT)
+uniq_pad_np = np.empty((N_ITER, U_pad), np.int32)
+for i, (u, _) in enumerate(uniqs):
+    uniq_pad_np[i, :len(u)] = u
+    uniq_pad_np[i, len(u):] = CAP + 1 + np.arange(U_pad - len(u))
+uniq_stack = jnp.asarray(uniq_pad_np)
+
+print(json.dumps({"probe": "shape", "K": K, "K_pad": K_pad,
+                  "U_pad": U_pad}), flush=True)
+
+
+def timeit(name, fn, *args, **extra):
+    r = fn(*args)
+    v = np.asarray(jax.device_get(r)).ravel()
+    t0 = time.perf_counter()
+    r = fn(*args)
+    v = np.asarray(jax.device_get(r)).ravel()
+    dt = (time.perf_counter() - t0) / N_ITER * 1000
+    print(json.dumps({"probe": name, "ms_per_iter": round(dt, 3),
+                      "val": float(v[0]), **extra}), flush=True)
+    return dt
+
+
+# ---- merge variants: segment_sum K→U ----
+@jax.jit
+def p_merge_unsorted(g_k, gidx_stack):
+    def body(i, acc):
+        g = jax.ops.segment_sum(g_k + acc * 1e-9, gidx_stack[i],
+                                num_segments=U_pad)
+        return acc + g.sum()
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+
+timeit("merge_unsorted", p_merge_unsorted, g_k, gidx_stack)
+
+
+@jax.jit
+def p_merge_sorted_hint(g_k, perm_stack, uid_sorted_stack):
+    """Permute grads into row-sorted order (one K-gather), then
+    segment_sum with nondecreasing ids + sorted hint."""
+    def body(i, acc):
+        gs = g_k[perm_stack[i]] + acc * 1e-9
+        g = jax.ops.segment_sum(gs, uid_sorted_stack[i],
+                                num_segments=U_pad,
+                                indices_are_sorted=True)
+        return acc + g.sum()
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+
+timeit("merge_perm_plus_sorted_hint", p_merge_sorted_hint, g_k,
+       perm_stack, uid_sorted_stack)
+
+
+@jax.jit
+def p_merge_sorted_nohint(g_k, perm_stack, uid_sorted_stack):
+    def body(i, acc):
+        gs = g_k[perm_stack[i]] + acc * 1e-9
+        g = jax.ops.segment_sum(gs, uid_sorted_stack[i],
+                                num_segments=U_pad)
+        return acc + g.sum()
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+
+timeit("merge_perm_plus_sorted_nohint", p_merge_sorted_nohint, g_k,
+       perm_stack, uid_sorted_stack)
+
+
+# isolate: sorted ids WITHOUT the perm gather (upper bound of the win)
+@jax.jit
+def p_merge_sorted_only(g_k, uid_sorted_stack):
+    def body(i, acc):
+        g = jax.ops.segment_sum(g_k + acc * 1e-9, uid_sorted_stack[i],
+                                num_segments=U_pad,
+                                indices_are_sorted=True)
+        return acc + g.sum()
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+
+timeit("merge_sorted_ids_only_hint", p_merge_sorted_only, g_k,
+       uid_sorted_stack)
+
+# sortedness vs num_segments: random ids into B*S segments
+rand_small_np = rng.integers(0, B * S, size=(N_ITER, K_pad)) \
+    .astype(np.int32)
+rand_small = jnp.asarray(rand_small_np)
+
+@jax.jit
+def p_segsum_small_random(g_k, rand_small):
+    def body(i, acc):
+        g = jax.ops.segment_sum(g_k + acc * 1e-9, rand_small[i],
+                                num_segments=B * S + 1)
+        return acc + g.sum()
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+
+timeit("segsum_small_random_ids", p_segsum_small_random, g_k, rand_small)
+
+
+# ---- gather extract forms ----
+@jax.jit
+def p_gather_take(state, uniq_stack):
+    def body(i, acc):
+        rows = gather_full_rows(state, uniq_stack[i])
+        return acc + rows.sum()
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+
+timeit("gather_take_along_axis", p_gather_take, state, uniq_stack)
+
+
+@jax.jit
+def p_gather_maskex(state, uniq_stack):
+    """Line fetch + ONE-HOT mask extract (VPU mask+sum over rpl) instead
+    of take_along_axis (a second per-index gather)."""
+    rpl, fp, _ = state.geometry
+    def body(i, acc):
+        rows = jnp.minimum(uniq_stack[i], CAP)
+        lines = state.packed[rows // rpl]              # [U, 128]
+        sub = (rows % rpl).astype(jnp.int32)
+        grouped = lines.reshape(-1, rpl, fp)
+        oh = (jnp.arange(rpl, dtype=jnp.int32)[None, :]
+              == sub[:, None]).astype(lines.dtype)     # [U, rpl]
+        vals = jnp.einsum("urf,ur->uf", grouped, oh)
+        return acc + vals.sum()
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+
+timeit("gather_maskextract", p_gather_maskex, state, uniq_stack)
+
+
+# line fetch only (floor for any extract scheme)
+@jax.jit
+def p_gather_lines_only(state, uniq_stack):
+    rpl, fp, _ = state.geometry
+    def body(i, acc):
+        rows = jnp.minimum(uniq_stack[i], CAP)
+        lines = state.packed[rows // rpl]
+        return acc + lines.sum()
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+
+timeit("gather_lines_only", p_gather_lines_only, state, uniq_stack)
+
+
+# ---- push variants ----
+d_lines = jnp.asarray(rng.normal(size=(U_pad, 128)).astype(np.float32))
+
+@jax.jit
+def p_scatter_lines(state, uniq_stack, d_lines):
+    rpl, fp, _ = state.geometry
+    def body(i, packed):
+        return packed.at[uniq_stack[i] // rpl].add(d_lines, mode="drop")
+    return jax.lax.fori_loop(0, N_ITER, body, state.packed)[0, 0]
+
+timeit("scatter_add_lines_U", p_scatter_lines, state, uniq_stack,
+       d_lines)
+
+
+# line-dedup'd scatter: merge co-resident rows' deltas first (uniq is
+# sorted, so line ids are nondecreasing → sorted segment_sum), then
+# scatter unique lines. Uses a host-precomputed line-uid (in real step
+# it derives from uniq with one cumsum).
+line_uid_np = np.empty((N_ITER, U_pad), np.int32)
+n_ulines = 0
+for i in range(N_ITER):
+    lines_i = uniq_pad_np[i] // 8
+    uid = np.zeros(U_pad, np.int32)
+    uid[1:] = np.cumsum(lines_i[1:] != lines_i[:-1])
+    line_uid_np[i] = uid
+    n_ulines = max(n_ulines, uid[-1] + 1)
+UL_pad = next_bucket_fine(4096, int(n_ulines) + 1)
+line_uid_stack = jnp.asarray(line_uid_np)
+
+@jax.jit
+def p_scatter_linededup(state, uniq_stack, line_uid_stack, d_lines):
+    rpl, fp, _ = state.geometry
+    def body(i, packed):
+        uid = line_uid_stack[i]
+        merged = jax.ops.segment_sum(d_lines, uid, num_segments=UL_pad,
+                                     indices_are_sorted=True)
+        first_pos = jnp.full(UL_pad, U_pad - 1, jnp.int32).at[uid].min(
+            jnp.arange(U_pad, dtype=jnp.int32), mode="drop")
+        tgt_lines = (uniq_stack[i] // rpl)[first_pos]
+        return packed.at[tgt_lines].add(merged, mode="drop")
+    return jax.lax.fori_loop(0, N_ITER, body, state.packed)[0, 0]
+
+timeit("scatter_add_linededup", p_scatter_linededup, state, uniq_stack,
+       line_uid_stack, d_lines, UL_pad=UL_pad)
+
+print(json.dumps({"probe": "done"}), flush=True)

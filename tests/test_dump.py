@@ -99,14 +99,19 @@ def test_sharded_trainer_dump_pass(tmp_path):
     tr.set_dump(DumpConfig(str(tmp_path / "mesh/preds"),
                            fields=["pred", "label"]))
     tr.train_pass(ds)
-    [f] = glob.glob(str(tmp_path / "mesh/preds.part-*"))
-    lines = open(f).read().strip().split("\n")
+    # one part file per DEVICE row (the reference's per-worker dump
+    # channel, boxps_worker.cc:1595); concatenated in device order the
+    # parts cover every record exactly once
+    files = sorted(glob.glob(str(tmp_path / "mesh/preds.part-*")))
+    lines = [ln for f in files
+             for ln in open(f).read().strip().split("\n") if ln]
     assert len(lines) == len(ds.records)  # every record exactly once
     ids = [ln.split("\t")[0] for ln in lines]
     assert ids[0] == "ins_00000" and len(set(ids)) == len(ids)
     for ln in lines[:5]:
         kv = dict(p.split(":") for p in ln.split("\t")[1:])
         assert 0.0 <= float(kv["pred"]) <= 1.0
+    n_files = len(files)
     tr.set_dump(None)
     tr.train_pass(ds)
-    assert len(glob.glob(str(tmp_path / "mesh/preds.part-*"))) == 1
+    assert len(glob.glob(str(tmp_path / "mesh/preds.part-*"))) == n_files
