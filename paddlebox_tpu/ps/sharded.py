@@ -109,6 +109,12 @@ class ShardedEmbeddingTable:
         # pass preloading vs save/shrink — same discipline as
         # EmbeddingTable.host_lock)
         self.host_lock = threading.Lock()
+        # >0 while a ROUTING PLAN for a *future* pass is being built
+        # (tiered plan_scope): new-key assigns are then recorded via
+        # _note_plan_assigned instead of being marked touched — they
+        # have no values yet and train only after their pass's
+        # begin_pass promotes the staged values into them
+        self._plan_depth = 0
 
     def _make_stacked_state(self, single: TableState, n: int) -> TableState:
         """Subclass hook: build the stacked [N, L, 128] device state —
@@ -171,7 +177,15 @@ class ShardedEmbeddingTable:
                 sel = np.nonzero(owners == s)[0]
                 keys_s = uniq[sel]
                 with self.host_lock:
-                    if assign:
+                    if assign and self._plan_depth:
+                        pre = self.indexes[s].lookup(keys_s)
+                        rows_s = self.indexes[s].assign(keys_s)
+                        if (pre < 0).any():
+                            self._note_plan_assigned(s, keys_s[pre < 0])
+                        # touched stays clear: plan rows train only
+                        # after their pass opens; mark_trained_rows
+                        # flags them post-training
+                    elif assign:
                         rows_s = self.indexes[s].assign(keys_s)
                         self._touched[s][rows_s] = True
                     else:
@@ -248,6 +262,12 @@ class ShardedEmbeddingTable:
             serve_slot=serve_slot, gather_idx=gather_idx,
             key_valid=key_valid, req_capacity=A, serve_capacity=A2,
             req_need=a_max, serve_need=a2_max)
+
+    def _note_plan_assigned(self, s: int, new_keys: np.ndarray) -> None:
+        """Hook (called under host_lock) for keys newly assigned during
+        a plan build — the tiered table records them as value-less
+        PENDING rows; the plain HBM-resident table needs nothing (fresh
+        zero rows ARE its contract for unseen keys)."""
 
     # ---- host save/load mirrors EmbeddingTable, per shard ----
     def feature_count(self) -> int:

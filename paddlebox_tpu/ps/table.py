@@ -303,7 +303,8 @@ def rows_from_store_fields(vals: Dict[str, np.ndarray], mf_dim: int,
 
 def promote_window_delta(index, touched: np.ndarray, capacity: int,
                          want_keys: np.ndarray, new_keys: np.ndarray,
-                         gather_rows, writeback, on_freed=None):
+                         gather_rows, writeback, on_freed=None,
+                         pending: Optional[np.ndarray] = None):
     """THE shared per-window delta-promotion core (tiered shards and the
     single-chip PassScopedTable — box_wrapper.cc:129-186's incremental
     window, one place): reconcile the staged delta against the live
@@ -312,17 +313,34 @@ def promote_window_delta(index, touched: np.ndarray, capacity: int,
     evictees go through ``writeback(keys, rows, gather_rows(rows))``),
     assign the remaining new keys as clean rows.
 
+    ``pending`` (sorted uint64) lists keys whose rows were assigned by
+    a ROUTING-PLAN build before their values staged (the overlapped
+    preloader, ps/tiered.plan_scope): they look resident to the index
+    but hold fresh ZERO rows, so the usual resident-is-fresher rule
+    must NOT apply — their staged values win, and their (plan-baked)
+    rows are pinned against eviction.
+
     Caller holds the host lock and scatters the staged values for the
     returned ``rows_new``. Returns (rows_new, still_missing_mask,
     stats). ``on_freed(rows)`` hooks per-row host metadata cleanup."""
-    still = index.lookup(new_keys) < 0
+    miss = index.lookup(new_keys) < 0
+    still = miss
+    if pending is not None and len(pending):
+        still = miss | np.isin(new_keys, pending, assume_unique=False)
     ins_keys = new_keys[still]
     stats = dict(resident=len(want_keys) - len(ins_keys),
                  staged=len(ins_keys), evicted=0, evicted_writeback=0)
-    overflow = len(index) + len(ins_keys) - capacity
+    # capacity pressure counts only truly-missing keys: pending keys
+    # already own rows, re-assigning them allocates nothing
+    overflow = len(index) + int(miss.sum()) - capacity
     if overflow > 0:
         live_keys, live_rows = index.items()
         cand = ~np.isin(live_keys, want_keys)
+        if pending is not None and len(pending):
+            # plan-baked rows for a FUTURE pass: their row ids are
+            # already encoded in that pass's staged wire — evicting
+            # them would hand the rows to other keys
+            cand &= ~np.isin(live_keys, pending)
         ck, cr = live_keys[cand], live_rows[cand]
         t = touched[cr]
         order = np.argsort(t, kind="stable")[:overflow]
@@ -489,13 +507,22 @@ def scatter_logical_rows(state: TableState, shard_idx,
     # (trainers that adopted this state) keep a live buffer
     packed = jnp.copy(state.packed)
     oob_row = n_lines * rpl  # line index == n_lines → dropped
+    np_dtype = np.dtype(packed.dtype)
     for off in range(0, n, c):
         m = min(c, n - off)
         r_c = np.full(c, oob_row, np.int32)
         r_c[:m] = rows[off:off + m]
-        v = jnp.asarray(vals_np[off:off + m], packed.dtype)
-        v_c = jax.lax.dynamic_update_slice(
-            jnp.zeros((c, feat), packed.dtype), v, (0, 0))
+        if m == c:
+            v_c = jnp.asarray(
+                np.ascontiguousarray(vals_np[off:off + m], np_dtype))
+        else:
+            # tail chunk: pad on HOST — a device-side pad
+            # (dynamic_update_slice) would compile per remainder size,
+            # re-introducing a per-delta compile at the pass boundary;
+            # the ≤1-chunk of zero pad bytes compresses on the wire
+            v_full = np.zeros((c, feat), np_dtype)
+            v_full[:m] = vals_np[off:off + m]
+            v_c = jnp.asarray(v_full)
         if sharded:
             s_c = np.full(c, n_shards, np.int32)
             s_c[:m] = shard_idx[off:off + m]

@@ -45,16 +45,25 @@ zero rows in the window. ``ds.pass_keys()`` provides exactly that set.
 Host-tier mutations outside the pass protocol (load/merge_model/shrink)
 invalidate residency — the next begin_pass re-fetches everything.
 
-NOT supported: PassPreloader(build_fn=build_resident_pass) over a tiered
-table — building pass k+1's ROUTING PLANS during pass k assigns k+1's
-keys fresh zero rows before their host values stage (the reconcile then
-keeps those zero rows). Overlap the HOST FETCH with ``stage``/
-``BoxPSHelper.stage_pass`` instead, and build the resident pass after
-``begin_pass`` (what ShardedTrainer.train_pass_resident(dataset) does).
+OVERLAPPED PLAN BUILD (preload_into_memory, box_wrapper.h:1142-1156 —
+the reference overlaps the ENTIRE next-pass feed with training):
+``PassPreloader(build_fn=trainer.build_resident_pass)`` is legal over a
+tiered table. The trainer brackets plan builds in ``plan_scope()``:
+keys newly assigned by a future pass's routing plan are recorded
+PENDING (value-less zero rows, pinned against eviction, not marked
+touched); ``stage`` treats them as missing so their host values still
+fetch, and ``begin_pass``'s reconcile scatters the staged values into
+the plan-baked rows instead of keeping the zeros. The begin_pass
+boundary is then reconcile-only — plan construction, host fetch AND
+upload all ride the previous pass's training. Capacity contract: the
+window must hold the UNION of the open pass's and the planned pass's
+working sets (pending rows are pinned; promotion raises when eviction
+cannot free enough).
 """
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from typing import Dict, List, Optional
 
@@ -112,12 +121,35 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         self._stage: Optional[_ShardStage] = None
         self._stage_thread: Optional[threading.Thread] = None
         self._stage_exc: Optional[BaseException] = None
-        start_scatter_warmup(self.state, sharded=True)
-        # per-pass delta accounting (asserted by tests, reported by bench):
-        # resident = working-set keys already in the window,
+        # keys assigned by a future pass's plan build (plan_scope)
+        # whose values haven't been promoted yet — sorted per shard
+        self._pending: List[np.ndarray] = [np.empty(0, np.uint64)
+                                           for _ in range(self.n)]
+        # per-pass delta accounting (asserted by tests, reported by
+        # bench): resident = working-set keys already in the window,
         # staged = keys fetched+scattered, evicted / evicted_writeback,
         # written_back = rows end_pass shipped to the host tier
         self.last_pass_stats: Dict[str, int] = {}
+        start_scatter_warmup(self.state, sharded=True)
+
+    # ---- overlapped plan builds (preload_into_memory) ----------------
+    @contextlib.contextmanager
+    def plan_scope(self):
+        """Bracket a FUTURE pass's routing-plan build (the preloader's
+        background thread): new-key assigns inside the scope become
+        PENDING zero rows that the next begin_pass reconciles with
+        their staged values (see module docstring)."""
+        with self.host_lock:
+            self._plan_depth += 1
+        try:
+            yield
+        finally:
+            with self.host_lock:
+                self._plan_depth -= 1
+
+    def _note_plan_assigned(self, s: int, new_keys: np.ndarray) -> None:
+        # under host_lock (prepare_global holds it around the assign)
+        self._pending[s] = np.union1d(self._pending[s], new_keys)
 
     # ------------------------------------------------------------------
     def _split_by_owner(self, keys: np.ndarray) -> List[np.ndarray]:
@@ -153,8 +185,16 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
                     f"shard {s} working set ({len(ks)}) exceeds "
                     f"capacity_per_shard ({self.capacity})")
         with self.host_lock:
-            new = [per_shard[s][self.indexes[s].lookup(per_shard[s]) < 0]
-                   for s in range(self.n)]
+            # "missing" includes PENDING plan rows: they sit in the
+            # index but hold zero values, so their host values must
+            # still fetch (begin_pass scatters them at the reconcile)
+            new = []
+            for s in range(self.n):
+                ks = per_shard[s]
+                miss = self.indexes[s].lookup(ks) < 0
+                if len(self._pending[s]):
+                    miss |= np.isin(ks, self._pending[s])
+                new.append(ks[miss])
         self._stage_exc = None
 
         def run() -> None:
@@ -227,7 +267,14 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
                     gather_rows=lambda rs, s=s: np.asarray(
                         jax.device_get(self.state.data[s][rs])),
                     writeback=lambda ks, rs, sub, s=s:
-                        self.hosts[s].update(ks, self._store_fields(sub)))
+                        self.hosts[s].update(ks, self._store_fields(sub)),
+                    pending=self._pending[s])
+                # pending keys promoted by THIS pass leave the pending
+                # set; keys a concurrent plan build (the pass after
+                # next) recorded stay pinned until their own begin
+                if len(self._pending[s]):
+                    self._pending[s] = self._pending[s][
+                        ~np.isin(self._pending[s], st.keys[s])]
                 ins_vals = {f: v[still] for f, v in st.values[s].items()}
                 sh_l.append(np.full(len(rows_new), s, np.int32))
                 row_l.append(rows_new)
@@ -265,6 +312,13 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
                         jax.device_get(self.state.data[s][rows]))
                     self.hosts[s].update(keys, self._store_fields(sub))
                     self._touched[s][rows] = False
+                    # a PENDING key that trained anyway (a key outside
+                    # its pass's staged set) was just written back — the
+                    # host value is authoritative again, so the usual
+                    # resident-is-fresher reconcile may resume for it
+                    if len(self._pending[s]):
+                        self._pending[s] = self._pending[s][
+                            ~np.isin(self._pending[s], keys)]
                 total += len(rows)
         self.in_pass = False
         self.last_pass_stats["written_back"] = total
@@ -298,6 +352,8 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
                 self.indexes = [HostKV(self.capacity)
                                 for _ in range(self.n)]
                 self._touched[:] = False
+                self._pending = [np.empty(0, np.uint64)
+                                 for _ in range(self.n)]
                 self.state = self.state.with_packed(
                     jnp.zeros_like(self.state.packed))
 

@@ -149,7 +149,14 @@ class MultihostTieredShardedTable(TieredShardedEmbeddingTable):
                 rows_new, still, st_s = promote_window_delta(
                     self.indexes[s], self._touched[s], self.capacity,
                     st.keys[s], st.new_keys[s],
-                    gather_rows=gather, writeback=writeback)
+                    gather_rows=gather, writeback=writeback,
+                    pending=self._pending[s])
+                # pending keys promoted by THIS pass leave the pending
+                # set (same bookkeeping as the single-controller table;
+                # identical on every process per the SPMD host contract)
+                if len(self._pending[s]):
+                    self._pending[s] = self._pending[s][
+                        ~np.isin(self._pending[s], st.keys[s])]
                 for k in st_s:
                     stats[k] += st_s[k]
                 total += len(st.keys[s])
@@ -185,6 +192,11 @@ class MultihostTieredShardedTable(TieredShardedEmbeddingTable):
                     sub = self._gather_local_rows(s, rows)
                     self.hosts[s].update(keys, self._store_fields(sub))
                 self._touched[s][rows] = False
+                # written-back pending keys: host value authoritative
+                # again (see TieredShardedEmbeddingTable.end_pass)
+                if len(self._pending[s]) and len(keys):
+                    self._pending[s] = self._pending[s][
+                        ~np.isin(self._pending[s], keys)]
                 total += len(rows)
         self.in_pass = False
         self.last_pass_stats["written_back"] = total
@@ -201,6 +213,8 @@ class MultihostTieredShardedTable(TieredShardedEmbeddingTable):
                 self.indexes = [HostKV(self.capacity)
                                 for _ in range(self.n)]
                 self._touched[:] = False
+                self._pending = [np.empty(0, np.uint64)
+                                 for _ in range(self.n)]
                 zeros = {
                     self._shard_id(sh): jax.device_put(
                         np.zeros(sh.data.shape, sh.data.dtype), sh.device)

@@ -662,18 +662,20 @@ class ShardedTrainer:
 
     @staticmethod
     def _addressable_rows(arr, axis: int = 0):
-        """Yield (device_row, np_slice) for the rows of a global array
+        """Yield (device_row, row_slice) for the rows of a global array
         this process can address, in device order — the per-worker feed
         contract (each worker sees its own rows; single-controller sees
-        all of them). ``np_slice`` drops the sliced axis."""
-        seen = set()
-        shards = sorted(getattr(arr, "addressable_shards", []),
-                        key=lambda s: s.index[axis].start or 0)
-        if not shards:  # plain np/jnp array (tests call with host data)
-            a = np.asarray(arr)
-            for d in range(a.shape[axis]):
-                yield d, np.take(a, d, axis=axis)
+        all of them). Single-controller yields LAZY device slices (the
+        metric feed then stays on device — no per-batch D2H in the hot
+        loop); a pod yields np views of the local shards."""
+        if jax.process_count() == 1:
+            for d in range(arr.shape[axis]):
+                yield d, (arr[d] if axis == 0
+                          else jnp.take(arr, d, axis=axis))
             return
+        seen = set()
+        shards = sorted(arr.addressable_shards,
+                        key=lambda s: s.index[axis].start or 0)
         for sh in shards:
             i0 = sh.index[axis].start or 0
             data = np.asarray(sh.data)
@@ -886,7 +888,18 @@ class ShardedTrainer:
 
     # ---- device-resident passes over the mesh ----
     def build_resident_pass(self, dataset) -> "ShardedResidentPass":
-        return ShardedResidentPass.build(dataset, self)
+        """Build (and on preloader threads, overlap) one pass's staged
+        plan. Tiered tables get the build bracketed in ``plan_scope``:
+        new keys become value-less PENDING rows the next begin_pass
+        reconciles with their staged host values — which makes
+        ``PassPreloader(build_fn=trainer.build_resident_pass)`` legal
+        over a pass-window table (preload_into_memory,
+        box_wrapper.h:1142-1156)."""
+        scope = getattr(self.table, "plan_scope", None)
+        if scope is None:
+            return ShardedResidentPass.build(dataset, self)
+        with scope():
+            return ShardedResidentPass.build(dataset, self)
 
     def _feed_registry_resident(self, rp, preds) -> None:
         """Post-pass metric registry replay (the per-batch AddAucMonitor

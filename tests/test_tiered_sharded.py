@@ -595,3 +595,80 @@ def test_tiered_guards(mesh):
     # per-shard capacity guard
     with pytest.raises(ValueError):
         table.stage(np.arange(N * 64, dtype=np.uint64), background=False)
+
+
+def test_tiered_preloader_overlapped_plan_build(mesh, tmp_path):
+    """PassPreloader(build_fn=trainer.build_resident_pass) over a tiered
+    table (VERDICT r4 item 3, preload_into_memory box_wrapper.h:1142):
+    pass k+1's ROUTING PLAN builds during pass k (plan_scope pending
+    rows), its host values stage overlapped, and begin_pass scatters the
+    staged values into the plan-baked rows instead of keeping zeros —
+    the model matches the build-after-begin oracle."""
+    from paddlebox_tpu.train.device_pass import PassPreloader
+
+    ds_a, desc = _make_ds(tmp_path, 31)
+    # ds_b draws from an OFFSET value range → a real key delta vs ds_a
+    files_b = generate_criteo_files(str(tmp_path / "q32"), num_files=2,
+                                    rows_per_file=1200, vocab_per_slot=40,
+                                    seed=32, value_base=1000)
+    ds_b = DatasetFactory().create_dataset("InMemoryDataset", desc)
+    ds_b.set_filelist(files_b)
+    ds_b.load_into_memory()
+    datasets = [ds_a, ds_b, ds_a, ds_b]
+
+    def mk():
+        t = TieredShardedEmbeddingTable(
+            N, mf_dim=4, capacity_per_shard=4096, cfg=_cfg(),
+            req_bucket_min=256, serve_bucket_min=256)
+        with flags_scope(log_period_steps=10000):
+            tr = ShardedTrainer(DeepFM(hidden=(16, 16)), t, desc, mesh,
+                                tx=optax.adam(2e-3))
+        return t, tr, BoxPSHelper(t, trainer=tr)
+
+    # oracle: the sequential order (begin_pass, THEN build+train)
+    ta, tr_a, ha = mk()
+    staged_a = []
+    for ds in datasets:
+        ha.begin_pass(ds)
+        staged_a.append(ta.last_pass_stats["staged"])
+        tr_a.train_pass_resident(ds)
+        ha.end_pass(ds)
+
+    # overlapped: the preloader builds pass k+1's plan while k trains
+    tb, tr_b, hb = mk()
+    pre = PassPreloader(iter(datasets), build_fn=tr_b.build_resident_pass)
+    pre.start_next()
+    staged_b = []
+    pending_seen = 0
+    for i, ds in enumerate(datasets):
+        rp = pre.wait()
+        assert rp is not None
+        hb.begin_pass(ds)     # staged values win over plan zero rows
+        staged_b.append(tb.last_pass_stats["staged"])
+        if pre.start_next() and i + 1 < len(datasets):
+            hb.stage_pass(datasets[i + 1])   # host fetch overlaps too
+        tr_b.train_pass_resident(rp)         # the PREBUILT pass
+        pending_seen = max(pending_seen,
+                           sum(len(p) for p in tb._pending))
+        hb.end_pass(ds)
+    # the mechanism actually engaged: some future-pass keys were
+    # plan-assigned as pending before their begin_pass
+    assert pending_seen > 0
+    # begin_pass staged the same deltas as the sequential oracle
+    assert staged_b == staged_a, (staged_b, staged_a)
+    assert staged_b[1] > 0          # ds_b's keys were a real delta
+    # model parity: dense params and per-key host-tier values (row ids
+    # differ — plan-order vs promote-order assignment — so reductions
+    # reorder; values agree to float-drift tolerance)
+    for x, y in zip(jax.tree.leaves(tr_a.state.params),
+                    jax.tree.leaves(tr_b.state.params)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=2e-2, atol=2e-3)
+    for s in range(N):
+        ka, fa = ta.hosts[s].export_rows()
+        kb, fb = tb.hosts[s].export_rows()
+        oa, ob = np.argsort(ka), np.argsort(kb)
+        np.testing.assert_array_equal(ka[oa], kb[ob])
+        assert np.abs(fa["embed_w"][oa]).sum() > 0  # actually trained
+        np.testing.assert_allclose(fa["embed_w"][oa], fb["embed_w"][ob],
+                                   rtol=2e-2, atol=2e-3)
