@@ -109,12 +109,19 @@ class ShardedEmbeddingTable:
         # pass preloading vs save/shrink — same discipline as
         # EmbeddingTable.host_lock)
         self.host_lock = threading.Lock()
-        # >0 while a ROUTING PLAN for a *future* pass is being built
-        # (tiered plan_scope): new-key assigns are then recorded via
-        # _note_plan_assigned instead of being marked touched — they
-        # have no values yet and train only after their pass's
-        # begin_pass promotes the staged values into them
-        self._plan_depth = 0
+        # THREAD-LOCAL plan marker (tiered plan_scope): while the
+        # CALLING thread builds a routing plan for a *future* pass, its
+        # new-key assigns are recorded via _note_plan_assigned instead
+        # of being marked touched — they have no values yet and train
+        # only after their pass's begin_pass promotes the staged values.
+        # Thread-local, not table-global: a concurrent streaming
+        # prepare_global on another thread (training the OPEN pass)
+        # must keep the normal assign semantics
+        self._plan_tls = threading.local()
+
+    @property
+    def _plan_depth(self) -> int:
+        return getattr(self._plan_tls, "depth", 0)
 
     def _make_stacked_state(self, single: TableState, n: int) -> TableState:
         """Subclass hook: build the stacked [N, L, 128] device state —

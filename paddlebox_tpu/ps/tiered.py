@@ -136,20 +136,48 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
     @contextlib.contextmanager
     def plan_scope(self):
         """Bracket a FUTURE pass's routing-plan build (the preloader's
-        background thread): new-key assigns inside the scope become
-        PENDING zero rows that the next begin_pass reconciles with
-        their staged values (see module docstring)."""
-        with self.host_lock:
-            self._plan_depth += 1
+        background thread): new-key assigns by THIS thread inside the
+        scope become PENDING zero rows that the next begin_pass
+        reconciles with their staged values (see module docstring).
+        A build that RAISES rolls its pending records back — its pass
+        will never open, and leaked pendings would pin window capacity
+        forever (eviction excludes pending rows)."""
+        tls = self._plan_tls
+        tls.depth = getattr(tls, "depth", 0) + 1
+        outer_added = getattr(tls, "added", None)
+        tls.added = [np.empty(0, np.uint64) for _ in range(self.n)]
         try:
             yield
-        finally:
+            if outer_added is not None:  # propagate to the outer scope
+                for s in range(self.n):
+                    outer_added[s] = np.union1d(outer_added[s],
+                                                tls.added[s])
+        except BaseException:
             with self.host_lock:
-                self._plan_depth -= 1
+                for s in range(self.n):
+                    if len(tls.added[s]):
+                        self._pending[s] = self._pending[s][
+                            ~np.isin(self._pending[s], tls.added[s])]
+            raise
+        finally:
+            tls.depth -= 1
+            tls.added = outer_added
 
     def _note_plan_assigned(self, s: int, new_keys: np.ndarray) -> None:
         # under host_lock (prepare_global holds it around the assign)
         self._pending[s] = np.union1d(self._pending[s], new_keys)
+        added = getattr(self._plan_tls, "added", None)
+        if added is not None:
+            added[s] = np.union1d(added[s], new_keys)
+
+    def _unpin_pending(self, s: int, keys: np.ndarray) -> None:
+        """Remove ``keys`` from shard s's pending set (under host_lock):
+        their values were promoted (begin_pass) or written back
+        (end_pass), so the usual resident-is-fresher reconcile and
+        eviction rules apply to them again."""
+        if len(self._pending[s]) and len(keys):
+            self._pending[s] = self._pending[s][
+                ~np.isin(self._pending[s], keys)]
 
     # ------------------------------------------------------------------
     def _split_by_owner(self, keys: np.ndarray) -> List[np.ndarray]:
@@ -272,9 +300,7 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
                 # pending keys promoted by THIS pass leave the pending
                 # set; keys a concurrent plan build (the pass after
                 # next) recorded stay pinned until their own begin
-                if len(self._pending[s]):
-                    self._pending[s] = self._pending[s][
-                        ~np.isin(self._pending[s], st.keys[s])]
+                self._unpin_pending(s, st.keys[s])
                 ins_vals = {f: v[still] for f, v in st.values[s].items()}
                 sh_l.append(np.full(len(rows_new), s, np.int32))
                 row_l.append(rows_new)
@@ -316,9 +342,7 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
                     # its pass's staged set) was just written back — the
                     # host value is authoritative again, so the usual
                     # resident-is-fresher reconcile may resume for it
-                    if len(self._pending[s]):
-                        self._pending[s] = self._pending[s][
-                            ~np.isin(self._pending[s], keys)]
+                    self._unpin_pending(s, keys)
                 total += len(rows)
         self.in_pass = False
         self.last_pass_stats["written_back"] = total

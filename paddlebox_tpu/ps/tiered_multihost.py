@@ -154,9 +154,7 @@ class MultihostTieredShardedTable(TieredShardedEmbeddingTable):
                 # pending keys promoted by THIS pass leave the pending
                 # set (same bookkeeping as the single-controller table;
                 # identical on every process per the SPMD host contract)
-                if len(self._pending[s]):
-                    self._pending[s] = self._pending[s][
-                        ~np.isin(self._pending[s], st.keys[s])]
+                self._unpin_pending(s, st.keys[s])
                 for k in st_s:
                     stats[k] += st_s[k]
                 total += len(st.keys[s])
@@ -194,9 +192,7 @@ class MultihostTieredShardedTable(TieredShardedEmbeddingTable):
                 self._touched[s][rows] = False
                 # written-back pending keys: host value authoritative
                 # again (see TieredShardedEmbeddingTable.end_pass)
-                if len(self._pending[s]) and len(keys):
-                    self._pending[s] = self._pending[s][
-                        ~np.isin(self._pending[s], keys)]
+                self._unpin_pending(s, keys)
                 total += len(rows)
         self.in_pass = False
         self.last_pass_stats["written_back"] = total

@@ -123,9 +123,15 @@ class CheckpointManager:
         else:
             n = trainer.table.save_base(os.path.join(tmp, "sparse.npz"))
         with open(os.path.join(tmp, "dense.pkl"), "wb") as fh:
-            pickle.dump(jax.device_get(
-                (trainer.state.params, trainer.state.opt_state,
-                 trainer.state.auc)), fh)
+            if hasattr(trainer, "dense_snapshot"):
+                # pod-safe hook: per-shard AUC leaves are not host-
+                # addressable on a multi-controller mesh
+                blob = trainer.dense_snapshot()
+            else:
+                blob = jax.device_get(
+                    (trainer.state.params, trainer.state.opt_state,
+                     trainer.state.auc))
+            pickle.dump(blob, fh)
         with open(os.path.join(tmp, "meta.json"), "w") as fh:
             json.dump({"step": step, "kind": "delta" if delta else "base",
                        "base_step": base_step,

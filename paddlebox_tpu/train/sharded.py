@@ -831,7 +831,62 @@ class ShardedTrainer:
         window into the HBM shards."""
         self.state = self.state._replace(table=self.table.state)
 
+    def globalize_dense_state(self) -> None:
+        """Re-stage the DENSE leaves of a locally-initialized step state
+        onto the global mesh (params/opt replicated, AUC sharded,
+        step replicated), keeping the table state exactly as the table
+        manages it — the right init for tables that already hold a
+        global array (MultihostTieredShardedTable); plain sharded tables
+        use train.multihost.globalize_state, which re-stages the table
+        leaf too."""
+        from paddlebox_tpu.train.multihost import stage_global
+        st = self.state
+        rep = lambda l: stage_global(  # noqa: E731
+            self.mesh, np.asarray(jax.device_get(l)), shard_dim0=False)
+        self.state = ShardedStepState(
+            table=self.table.state,
+            params=jax.tree.map(rep, st.params),
+            opt_state=jax.tree.map(rep, st.opt_state),
+            auc=AucState(*[stage_global(
+                self.mesh, np.asarray(jax.device_get(l)),
+                shard_dim0=True) for l in st.auc]),
+            step=rep(st.step))
+
+    def dense_snapshot(self):
+        """Host snapshot of the dense checkpoint state (CheckpointManager
+        hook). Pod-safe: params/opt_state are replicated (addressable
+        everywhere); the per-shard AUC leaves are NOT, so they ship as
+        the shard-REDUCED host AucState — additive state, restored as
+        shard 0's content + zeros (identical totals)."""
+        return jax.device_get((self.state.params, self.state.opt_state,
+                               self._finalize_auc(self.state.auc)))
+
     def restore_state(self, params, opt_state, auc, step: int) -> None:
+        auc = AucState(*[np.asarray(l) for l in auc])
+        n_dims = jax.tree.leaves(init_auc_state())[0].ndim
+        if auc[0].ndim == n_dims:
+            # REDUCED host AucState (dense_snapshot): rebuild the
+            # per-shard layout — all mass on shard 0, zeros elsewhere
+            # (the finalize sum is invariant)
+            auc = AucState(*[
+                np.concatenate([l[None],
+                                np.zeros((self.n - 1,) + l.shape,
+                                         l.dtype)])
+                for l in auc])
+        if jax.process_count() > 1:
+            from paddlebox_tpu.train.multihost import stage_global
+            params = jax.tree.map(
+                lambda l: stage_global(self.mesh, np.asarray(l),
+                                       shard_dim0=False), params)
+            opt_state = jax.tree.map(
+                lambda l: stage_global(self.mesh, np.asarray(l),
+                                       shard_dim0=False), opt_state)
+            auc = AucState(*[stage_global(self.mesh, l, shard_dim0=True)
+                             for l in auc])
+        else:
+            params = jax.device_put(params)
+            opt_state = jax.device_put(opt_state)
+            auc = AucState(*[jnp.asarray(l) for l in auc])
         self.state = ShardedStepState(
             table=self.table.state, params=params, opt_state=opt_state,
             auc=auc, step=jnp.asarray(step, jnp.int32))
