@@ -141,6 +141,32 @@ def unpack_u16m(lo: jax.Array, hi: jax.Array, mbits: int) -> jax.Array:
     return lo.astype(jnp.int32) | (h << 16)
 
 
+def pack_u12(values: np.ndarray) -> Tuple[np.ndarray]:
+    """int array [..., K] (values in [0, 2^12), K % 2 == 0) → one uint8
+    stream [..., K*3/2]: value pairs ride as 3 bytes (lo8_a,
+    hi4_a | lo4_b<<4, hi8_b). The thousand-slot wire lever: per-slot
+    CTR vocabularies are a few thousand entries, so slot-local rows fit
+    12 bits and the u16 wire ships 25% padding (docs/BENCH_SHAPES.md
+    thousand row — 2,017 B/record, ~all per-key locals)."""
+    v = values.astype(np.uint32, copy=False)
+    assert v.max(initial=0) < (1 << 12), "pack_u12 range"
+    assert v.shape[-1] % 2 == 0, "pack_u12 alignment"
+    p = v.reshape(*v.shape[:-1], -1, 2)
+    out = np.empty((*p.shape[:-1], 3), np.uint8)
+    out[..., 0] = p[..., 0] & 0xFF
+    out[..., 1] = ((p[..., 0] >> 8) & 0xF) | ((p[..., 1] & 0xF) << 4)
+    out[..., 2] = (p[..., 1] >> 4) & 0xFF
+    return (out.reshape(*v.shape[:-1], -1),)
+
+
+def unpack_u12(b: jax.Array) -> jax.Array:
+    """uint8 [K*3/2] → int32 [K] (traced)."""
+    t = b.reshape(*b.shape[:-1], -1, 3).astype(jnp.int32)
+    a = t[..., 0] | ((t[..., 1] & 0xF) << 8)
+    c = (t[..., 1] >> 4) | (t[..., 2] << 4)
+    return jnp.stack([a, c], axis=-1).reshape(*b.shape[:-1], -1)
+
+
 def pack_u18(values: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     """18-bit :func:`pack_u16m` (kept for call-site clarity)."""
     return pack_u16m(values, 2)

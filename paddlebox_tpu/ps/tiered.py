@@ -155,9 +155,25 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         except BaseException:
             with self.host_lock:
                 for s in range(self.n):
-                    if len(tls.added[s]):
-                        self._pending[s] = self._pending[s][
-                            ~np.isin(self._pending[s], tls.added[s])]
+                    ks = tls.added[s]
+                    if not len(ks):
+                        continue
+                    self._pending[s] = self._pending[s][
+                        ~np.isin(self._pending[s], ks)]
+                    # ALSO release the rows this build assigned:
+                    # unpinned-but-still-assigned keys would read as
+                    # resident at a later pass's reconcile and silently
+                    # keep their zero rows over the staged values.
+                    # Keys a concurrent streaming assign trained
+                    # meanwhile (touched) stay — releasing a row whose
+                    # updates await write-back would corrupt it; they
+                    # follow the normal resident-is-fresher rule.
+                    rows = self.indexes[s].lookup(ks)
+                    ok = rows >= 0
+                    ks, rows = ks[ok], rows[ok]
+                    untouched = ~self._touched[s][rows]
+                    if untouched.any():
+                        self.indexes[s].release(ks[untouched])
             raise
         finally:
             tls.depth -= 1

@@ -205,9 +205,14 @@ class CheckpointManager:
             first = False
         with open(os.path.join(self._dir(target), "dense.pkl"), "rb") as fh:
             params, opt_state, auc = pickle.load(fh)
-        trainer.restore_state(jax.device_put(params),
-                              jax.device_put(opt_state),
-                              jax.device_put(auc), target)
+        if hasattr(trainer, "dense_snapshot"):
+            # the trainer handles placement itself (pod staging) — a
+            # device_put here would just round-trip device→host→device
+            trainer.restore_state(params, opt_state, auc, target)
+        else:
+            trainer.restore_state(jax.device_put(params),
+                                  jax.device_put(opt_state),
+                                  jax.device_put(auc), target)
         log.info("restored step %d (chain: %s)", target, chain)
         return target
 

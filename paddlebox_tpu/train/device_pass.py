@@ -31,9 +31,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddlebox_tpu.data.dataset import Dataset
-from paddlebox_tpu.ops.bitpack import (pack_delta_auto, pack_u16m,
-                                       pack_u18, pack_u24, unpack_delta16,
-                                       unpack_u16m, unpack_u18, unpack_u24)
+from paddlebox_tpu.ops.bitpack import (pack_delta_auto, pack_u12,
+                                       pack_u16m, pack_u18, pack_u24,
+                                       unpack_delta16, unpack_u12,
+                                       unpack_u16m, unpack_u18,
+                                       unpack_u24)
 from paddlebox_tpu.ops.device_unique import dedup_rows
 from paddlebox_tpu.train.step import (dequantize_floats, pack_floats,
                                       quantize_floats, unpack_floats)
@@ -263,12 +265,16 @@ class ResidentPass:
 
     @staticmethod
     def _encode_locals(locs: np.ndarray, bits: int):
-        """Wire for slot-local rows: plain u16 when they fit, else
-        16-bit lows + m-bit packed highs (ops/bitpack.pack_u16m),
-        else raw int32."""
+        """Wire for slot-local rows, narrowest first: u12 byte-pairs
+        (1.5 B/key — thousand-slot vocabularies are a few thousand
+        entries, the shape whose wire is ~all locals), plain u16,
+        16-bit lows + m-bit packed highs (ops/bitpack.pack_u16m), raw
+        int32."""
+        k = locs.shape[-1]
+        if bits <= 12 and k % 2 == 0:
+            return pack_u12(locs)
         if bits <= 16:
             return (locs.astype(np.uint16),)
-        k = locs.shape[-1]
         for m in (1, 2, 4, 8):
             if bits <= 16 + m and k % (8 // m) == 0:
                 return pack_u16m(locs, m)
@@ -685,6 +691,8 @@ class ResidentPassRunner:
             k = loc_t[0].shape[-1]
             m = 8 * loc_t[1].shape[-1] // k
             local = unpack_u16m(loc_t[0], loc_t[1], m)
+        elif loc_t[0].dtype == jnp.uint8:   # u12 byte-pair wire
+            local = unpack_u12(loc_t[0])
         else:
             local = loc_t[0].astype(jnp.int32)
         k = local.shape[-1]

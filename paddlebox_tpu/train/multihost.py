@@ -65,17 +65,25 @@ def globalize_state(mesh: Mesh, state, state_spec) -> ShardedStepState:
     ``trainer.step_fn.state_spec`` — a pytree prefix of PartitionSpecs,
     the same object the jitted shard_map consumes, so this can never
     drift from the program). Init is deterministic (fixed PRNG seeds),
-    so every process holds identical host values."""
+    so every process holds identical host values.
+
+    IDEMPOTENT on already-global leaves: a leaf that is not fully
+    addressable (a multihost table's state, a previously staged array)
+    is kept as-is — it cannot be device_get and is already placed."""
     import jax.tree_util as jtu
     is_spec = lambda x: isinstance(x, P)  # noqa: E731
+
+    def stage_leaf(l, sp):
+        if isinstance(l, jax.Array) and not l.is_fully_addressable:
+            return l
+        return stage_global(mesh, np.asarray(jax.device_get(l)),
+                            shard_dim0=(len(sp) > 0 and sp[0] == DATA_AXIS))
+
     spec_def = jtu.tree_structure(state_spec, is_leaf=is_spec)
     subtrees = spec_def.flatten_up_to(state)
     spec_leaves = jtu.tree_leaves(state_spec, is_leaf=is_spec)
     staged = [
-        jtu.tree_map(
-            lambda l, sp=sp: stage_global(
-                mesh, np.asarray(jax.device_get(l)),
-                shard_dim0=(len(sp) > 0 and sp[0] == DATA_AXIS)), sub)
+        jtu.tree_map(lambda l, sp=sp: stage_leaf(l, sp), sub)
         for sub, sp in zip(subtrees, spec_leaves)
     ]
     return jtu.tree_unflatten(spec_def, staged)

@@ -832,25 +832,14 @@ class ShardedTrainer:
         self.state = self.state._replace(table=self.table.state)
 
     def globalize_dense_state(self) -> None:
-        """Re-stage the DENSE leaves of a locally-initialized step state
-        onto the global mesh (params/opt replicated, AUC sharded,
-        step replicated), keeping the table state exactly as the table
-        manages it — the right init for tables that already hold a
-        global array (MultihostTieredShardedTable); plain sharded tables
-        use train.multihost.globalize_state, which re-stages the table
-        leaf too."""
-        from paddlebox_tpu.train.multihost import stage_global
-        st = self.state
-        rep = lambda l: stage_global(  # noqa: E731
-            self.mesh, np.asarray(jax.device_get(l)), shard_dim0=False)
-        self.state = ShardedStepState(
-            table=self.table.state,
-            params=jax.tree.map(rep, st.params),
-            opt_state=jax.tree.map(rep, st.opt_state),
-            auc=AucState(*[stage_global(
-                self.mesh, np.asarray(jax.device_get(l)),
-                shard_dim0=True) for l in st.auc]),
-            step=rep(st.step))
+        """Stage a locally-initialized step state onto the global mesh
+        following the step's own sharding spec (globalize_state, now
+        idempotent on already-global leaves — a multihost table's state
+        passes through untouched)."""
+        from paddlebox_tpu.train.multihost import globalize_state
+        self.state = globalize_state(
+            self.mesh, self.state._replace(table=self.table.state),
+            self.step_fn.state_spec)
 
     def dense_snapshot(self):
         """Host snapshot of the dense checkpoint state (CheckpointManager
@@ -873,23 +862,21 @@ class ShardedTrainer:
                                 np.zeros((self.n - 1,) + l.shape,
                                          l.dtype)])
                 for l in auc])
-        if jax.process_count() > 1:
-            from paddlebox_tpu.train.multihost import stage_global
-            params = jax.tree.map(
-                lambda l: stage_global(self.mesh, np.asarray(l),
-                                       shard_dim0=False), params)
-            opt_state = jax.tree.map(
-                lambda l: stage_global(self.mesh, np.asarray(l),
-                                       shard_dim0=False), opt_state)
-            auc = AucState(*[stage_global(self.mesh, l, shard_dim0=True)
-                             for l in auc])
-        else:
-            params = jax.device_put(params)
-            opt_state = jax.device_put(opt_state)
-            auc = AucState(*[jnp.asarray(l) for l in auc])
         self.state = ShardedStepState(
             table=self.table.state, params=params, opt_state=opt_state,
-            auc=auc, step=jnp.asarray(step, jnp.int32))
+            auc=AucState(*[jnp.asarray(l) for l in auc])
+            if jax.process_count() == 1 else auc,
+            step=np.asarray(step, np.int32))
+        if jax.process_count() > 1:
+            # spec-driven staging (no hand-coded layout): the table leaf
+            # — local after table.load, or already-global for multihost
+            # tables — stages or passes through per globalize_state
+            self.globalize_dense_state()
+        else:
+            self.state = self.state._replace(
+                params=jax.device_put(params),
+                opt_state=jax.device_put(opt_state),
+                step=jnp.asarray(step, jnp.int32))
         self.global_step = step
 
     def eval_pass(self, dataset, log_prefix: str = "") -> Dict[str, float]:
