@@ -53,6 +53,15 @@ def _f_pad(feat: int) -> int:
     raise ValueError(f"feature width {feat} > 128 unsupported")
 
 
+def _lane_onehot(sub: jax.Array, rpl: int, dtype) -> jax.Array:
+    """[..., 1]-hot row-in-line selector mask (THE lane-packing
+    selector, shared by gather_full_rows / expand_pull / merge_rows /
+    apply_push): 1.0 at each element's row slot within its 128-lane
+    line, 0 elsewhere."""
+    return (jnp.arange(rpl, dtype=jnp.int32)[None, :]
+            == sub.astype(jnp.int32)[:, None]).astype(dtype)
+
+
 def pack_geometry(capacity: int, feat: int):
     """(rows_per_line, f_pad, n_lines) for a [capacity+1, feat] logical
     table stored as [n_lines, 128] lane-aligned lines."""
@@ -424,11 +433,11 @@ def gather_full_rows(state: TableState, unique_rows: jax.Array) -> jax.Array:
         lines = gather_rows(state.packed, rows // rpl)
     else:
         lines = state.packed[rows // rpl]                 # [U, 128]
-    sub = (rows % rpl).astype(jnp.int32)
     grouped = lines.reshape(u, rpl, fp)
-    onehot = (jnp.arange(rpl, dtype=jnp.int32)[None, :]
-              == sub[:, None]).astype(lines.dtype)        # [U, rpl]
-    vals = jnp.einsum("urf,ur->uf", grouped, onehot)
+    onehot = _lane_onehot(rows % rpl, rpl, lines.dtype)   # [U, rpl]
+    # elementwise mask+reduce, NOT einsum (default-precision dot_general
+    # would round through bf16 on TPU)
+    vals = (grouped * onehot[:, :, None]).sum(axis=1)
     return vals[:, :state._feat] if fp != state._feat else vals
 
 
@@ -612,8 +621,63 @@ def pull_rows(state: TableState, unique_rows: jax.Array) -> jax.Array:
 
 
 def expand_pull(values_u: jax.Array, gather_idx: jax.Array) -> jax.Array:
-    """[U, D] unique values → [K, D] per-key-occurrence values."""
-    return values_u[gather_idx]
+    """[U, D] unique values → [K, D] per-key-occurrence values.
+
+    LANE-PACKED formulation (round 5): the naive ``values_u[gather_idx]``
+    row gather — and, worse, its autodiff transpose (the per-unique grad
+    merge) — pay XLA's per-index cost on narrow strided rows. Packing
+    the unique values into 128-lane lines (8 rows/line at D ≤ 16) makes
+    the forward a line fetch + one-hot VPU extract and the TRANSPOSE a
+    line-granular scatter-add of masked deltas (the apply_push trick,
+    derived by autodiff for free). Measured at the ragged bench shape
+    (K=557k, U=491k): fwd 18.1 → 11.0 ms, transpose 39.4 → 13.3 ms
+    (scripts/profile_keypath3.py, exact f32 both ways). Falls back to
+    the plain gather when the shapes don't line-align."""
+    u, d = values_u.shape
+    fp = _f_pad(d) if d <= 128 else 0
+    rpl = 128 // fp if fp else 0
+    if not fp or u % rpl:
+        return values_u[gather_idx]
+    padded = (values_u if fp == d else
+              jnp.pad(values_u, ((0, 0), (0, fp - d))))
+    packed = padded.reshape(u // rpl, 128)
+    # clamp BEFORE the line split so out-of-range indices read row u-1,
+    # exactly like the plain gather's clamp semantics (line-clamping
+    # alone would read row u-rpl)
+    gi = jnp.clip(gather_idx, 0, u - 1)
+    lines = packed[gi // rpl]                          # [K, 128]
+    grouped = lines.reshape(-1, rpl, fp)
+    onehot = _lane_onehot(gi % rpl, rpl, lines.dtype)  # [K, rpl]
+    # elementwise mask+reduce, NOT einsum: a dot_general would run at
+    # default (bf16-pass) matmul precision on TPU and break the exact-
+    # f32 contract of this op and its autodiff transpose
+    vals = (grouped * onehot[:, :, None]).sum(axis=1)
+    return vals[:, :d] if fp != d else vals
+
+
+def merge_rows(values: jax.Array, idx: jax.Array,
+               num_segments: int) -> jax.Array:
+    """segment_sum of narrow rows in LANE-PACKED form: [M, D] values
+    summed by ``idx`` into [num_segments, D]. A scatter-add into a
+    [num, D<16] accumulator is random-access RMW on strided narrow rows
+    (~3x slower than line-granular — DESIGN_NOTES §4i); this packs each
+    contribution into its row's lane span of a 128-lane line delta and
+    scatter-adds whole lines (disjoint-lane adds commute exactly, the
+    apply_push trick). Exact f32; falls back to jax.ops.segment_sum when
+    shapes don't line-align."""
+    m, d = values.shape
+    fp = _f_pad(d) if d <= 128 else 0
+    rpl = 128 // fp if fp else 0
+    if not fp or num_segments % rpl:
+        return jax.ops.segment_sum(values, idx, num_segments=num_segments)
+    v = (values if fp == d else
+         jnp.pad(values, ((0, 0), (0, fp - d))))
+    onehot = _lane_onehot(idx % rpl, rpl, v.dtype)      # [M, rpl]
+    d_lines = (onehot[:, :, None] * v[:, None, :]).reshape(m, 128)
+    out = jnp.zeros((num_segments // rpl, 128), v.dtype).at[
+        idx // rpl].add(d_lines, mode="drop")
+    out = out.reshape(num_segments, fp)
+    return out[:, :d] if fp != d else out
 
 
 def merge_push(key_grads: jax.Array, gather_idx: jax.Array,
@@ -710,9 +774,7 @@ def apply_push(
     if fp != state._feat:
         delta = jnp.concatenate(
             [delta, jnp.zeros((u, fp - state._feat), delta.dtype)], axis=1)
-    sub = (unique_rows % rpl).astype(jnp.int32)
-    onehot = (jnp.arange(rpl, dtype=jnp.int32)[None, :]
-              == sub[:, None]).astype(delta.dtype)
+    onehot = _lane_onehot(unique_rows % rpl, rpl, delta.dtype)
     d_lines = (onehot[:, :, None] * delta[:, None, :]).reshape(u, 128)
     packed = state.packed.at[unique_rows // rpl].add(d_lines, mode="drop")
     # keep the sentinel row zero (defense in depth — pad deltas are

@@ -31,7 +31,8 @@ from paddlebox_tpu.ops.bitpack import (pack_delta_auto, pack_u16m,
                                        pack_u24, unpack_delta16,
                                        unpack_u16m, unpack_u24)
 from paddlebox_tpu.ps.table import (TableState, apply_push,
-                                    fill_oob_pads, gather_full_rows,
+                                    expand_pull, fill_oob_pads,
+                                    gather_full_rows, merge_rows,
                                     pull_values)
 from paddlebox_tpu.train.step import quantize_floats
 
@@ -330,7 +331,10 @@ class ShardedTrainStep:
         # one AoS gather serves the pull AND the push optimizer state
         rows_full = gather_full_rows(table, serve_rows)    # [A2, F]
         serve_vals = pull_values(rows_full, table.mf_dim)  # [A2, D]
-        resp = serve_vals[resp_idx]                        # [N, A, D]
+        # lane-packed expand (ps/table.expand_pull): narrow-row gathers
+        # and their autodiff transposes run at line granularity
+        resp = expand_pull(serve_vals,
+                           resp_idx.reshape(-1)).reshape(n, a, d)
         recv = jax.lax.all_to_all(resp, DATA_AXIS, 0, 0, tiled=True)
         vals_flat = recv.reshape(n * a, d)
 
@@ -339,7 +343,7 @@ class ShardedTrainStep:
         batch_show_clk = jnp.stack([show, clk], axis=1)
 
         def loss_fn(params, vals_flat):
-            values_k = vals_flat[gather_idx]
+            values_k = expand_pull(vals_flat, gather_idx)
             pooled = fused_seqpool_cvm(
                 values_k, segments, batch_show_clk, b, s,
                 self.use_cvm, self.cvm_offset)
@@ -354,9 +358,8 @@ class ShardedTrainStep:
         # ---- push: route grads back to owners, merge, update ----
         g_back = jax.lax.all_to_all(
             g_vals_flat.reshape(n, a, d), DATA_AXIS, 0, 0, tiled=True)
-        g_serve = jax.ops.segment_sum(
-            g_back.reshape(n * a, d), resp_idx.reshape(n * a),
-            num_segments=a2)
+        g_serve = merge_rows(g_back.reshape(n * a, d),
+                             resp_idx.reshape(n * a), num_segments=a2)
         # PushCopy scaling (box_wrapper.cu:368): negate embed grads × global
         # batch size (loss above is the global mean)
         gb = jnp.concatenate(

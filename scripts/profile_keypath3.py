@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""Round-5 probe set 3: merge form/dtype, packed-line expand, dedup sort
+form — the levers left after the decode + gather-extract fixes.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.ps.table import next_bucket_fine
+
+N_ITER = int(os.environ.get("PROF_ITERS", 16))
+B, S, AVG, VOCAB = 4096, 26, 5.0, 100_000
+CAP = 1 << 23
+
+rng = np.random.default_rng(0)
+counts = 1 + rng.poisson(AVG - 1.0, size=(B, S))
+K = int(counts.sum())
+K_pad = next_bucket_fine(4096, K)
+U_pad = 491520
+U_real = 481763
+
+gidx_stack = jnp.asarray(
+    rng.integers(0, U_real, size=(N_ITER, K_pad)).astype(np.int32))
+g_k = jnp.asarray(rng.normal(size=(K_pad, 11)).astype(np.float32))
+rows_np = np.empty((N_ITER, K_pad), np.int32)
+slot_of_key = np.repeat(np.tile(np.arange(S), B), counts.reshape(-1))
+for i in range(N_ITER):
+    k_ids = rng.integers(0, VOCAB, size=K)
+    rows_np[i, :K] = (slot_of_key * VOCAB + k_ids).astype(np.int32) % CAP
+    rows_np[i, K:] = CAP
+rows_stack = jnp.asarray(rows_np)
+
+print(json.dumps({"probe": "shape", "K_pad": K_pad, "U_pad": U_pad}),
+      flush=True)
+
+
+def timeit(name, fn, *args, **extra):
+    r = fn(*args)
+    v = np.asarray(jax.device_get(r)).ravel()
+    t0 = time.perf_counter()
+    r = fn(*args)
+    v = np.asarray(jax.device_get(r)).ravel()
+    dt = (time.perf_counter() - t0) / N_ITER * 1000
+    print(json.dumps({"probe": name, "ms_per_iter": round(dt, 3),
+                      "val": float(v[0]), **extra}), flush=True)
+    return dt
+
+
+@jax.jit
+def p_merge_f32(g_k, gidx_stack):
+    def body(i, acc):
+        g = jax.ops.segment_sum(g_k + acc * 1e-9, gidx_stack[i],
+                                num_segments=U_pad)
+        return acc + g.sum()
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+
+timeit("merge_f32", p_merge_f32, g_k, gidx_stack)
+
+
+@jax.jit
+def p_merge_bf16(g_k, gidx_stack):
+    def body(i, acc):
+        g = jax.ops.segment_sum(
+            (g_k + acc * 1e-9).astype(jnp.bfloat16), gidx_stack[i],
+            num_segments=U_pad)
+        return acc + g.astype(jnp.float32).sum()
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+
+timeit("merge_bf16", p_merge_bf16, g_k, gidx_stack)
+
+
+@jax.jit
+def p_merge_at_add(g_k, gidx_stack):
+    def body(i, acc):
+        g = jnp.zeros((U_pad, 11), jnp.float32).at[gidx_stack[i]].add(
+            g_k + acc * 1e-9)
+        return acc + g.sum()
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+
+timeit("merge_at_add", p_merge_at_add, g_k, gidx_stack)
+
+
+# merge with 16-wide (lane-fraction-aligned) data
+g_k16 = jnp.asarray(rng.normal(size=(K_pad, 16)).astype(np.float32))
+
+@jax.jit
+def p_merge_w16(g_k16, gidx_stack):
+    def body(i, acc):
+        g = jax.ops.segment_sum(g_k16 + acc * 1e-9, gidx_stack[i],
+                                num_segments=U_pad)
+        return acc + g.sum()
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+
+timeit("merge_w16", p_merge_w16, g_k16, gidx_stack)
+
+
+# expand from PACKED 16-lane lines with mask extract (vs [U, 11] rows)
+vals_u = jnp.asarray(rng.normal(size=(U_pad, 11)).astype(np.float32))
+
+@jax.jit
+def p_expand_plain(vals_u, gidx_stack):
+    def body(i, acc):
+        v = vals_u[gidx_stack[i]] + acc * 1e-9
+        return acc + v.sum()
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+
+timeit("expand_plain", p_expand_plain, vals_u, gidx_stack)
+
+vals_packed = jnp.asarray(
+    rng.normal(size=(U_pad // 8, 128)).astype(np.float32))
+
+@jax.jit
+def p_expand_packedlines(vals_packed, gidx_stack):
+    def body(i, acc):
+        g = gidx_stack[i]
+        lines = vals_packed[g // 8]                    # [K, 128]
+        sub = (g % 8).astype(jnp.int32)
+        grouped = lines.reshape(-1, 8, 16)
+        oh = (jnp.arange(8, dtype=jnp.int32)[None, :]
+              == sub[:, None]).astype(lines.dtype)
+        v = jnp.einsum("krf,kr->kf", grouped, oh) + acc * 1e-9
+        return acc + v.sum()
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+
+timeit("expand_packedlines_maskex", p_expand_packedlines, vals_packed,
+       gidx_stack)
+
+
+# dedup: current 2-array sort vs packed single-i64 sort
+from paddlebox_tpu.ops.device_unique import dedup_rows
+
+@jax.jit
+def p_dedup_current(rows_stack):
+    def body(i, acc):
+        u, g = dedup_rows(rows_stack[i], CAP)
+        return acc + (u.sum() + g.sum())
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros((), jnp.int32))
+
+timeit("dedup_current", p_dedup_current, rows_stack)
+
+
+@jax.jit
+def p_dedup_i64pack(rows_stack):
+    def body(i, acc):
+        rows = rows_stack[i]
+        k = rows.shape[0]
+        pos = jnp.arange(k, dtype=jnp.int64)
+        packed = (rows.astype(jnp.int64) << 20) | pos
+        sp = jax.lax.sort(packed)
+        sr = (sp >> 20).astype(jnp.int32)
+        perm = (sp & ((1 << 20) - 1)).astype(jnp.int32)
+        is_first = jnp.concatenate([jnp.ones(1, bool), sr[1:] != sr[:-1]])
+        uid_sorted = jnp.cumsum(is_first.astype(jnp.int32)) - 1
+        gidx = jnp.zeros(k, jnp.int32).at[perm].set(uid_sorted,
+                                                    unique_indices=True)
+        oob = CAP + 1 + jnp.arange(k, dtype=jnp.int32)
+        uniq = oob.at[uid_sorted].set(sr)
+        return acc + (uniq.sum() + gidx.sum())
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros((), jnp.int32))
+
+timeit("dedup_i64pack", p_dedup_i64pack, rows_stack)
+
+print(json.dumps({"probe": "done"}), flush=True)
+
+
+# line-layout merge: scatter-add one-hot-masked [K, 128] line deltas
+# into [U/8, 128] (what autodiff of the packed-line expand produces)
+@jax.jit
+def p_merge_lines(g_k16, gidx_stack):
+    def body(i, acc):
+        g = gidx_stack[i]
+        sub = (g % 8).astype(jnp.int32)
+        oh = (jnp.arange(8, dtype=jnp.int32)[None, :]
+              == sub[:, None]).astype(jnp.float32)       # [K, 8]
+        d = (oh[:, :, None] * (g_k16 + acc * 1e-9)[:, None, :]
+             ).reshape(-1, 128)                          # [K, 128]
+        out = jnp.zeros((U_pad // 8, 128), jnp.float32).at[g // 8].add(d)
+        return acc + out.sum()
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+
+timeit("merge_lines_f32", p_merge_lines, g_k16, gidx_stack)
+
+
+@jax.jit
+def p_merge_f32_sorted_small(g_k, gidx_stack):
+    """Two-level: scatter into [U/64 buckets of 64*11]..."""
+    def body(i, acc):
+        g = gidx_stack[i]
+        col = (g % 64).astype(jnp.int32)
+        oh_cols = col[:, None] * 11 + jnp.arange(11, dtype=jnp.int32)[None, :]
+        out = jnp.zeros((U_pad // 64, 64 * 11), jnp.float32).at[
+            (g // 64)[:, None], oh_cols].add(g_k + acc * 1e-9)
+        return acc + out.sum()
+    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+
+timeit("merge_bucketed64", p_merge_f32_sorted_small, g_k, gidx_stack)
