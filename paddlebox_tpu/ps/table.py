@@ -668,7 +668,11 @@ def merge_rows(values: jax.Array, idx: jax.Array,
     m, d = values.shape
     fp = _f_pad(d) if d <= 128 else 0
     rpl = 128 // fp if fp else 0
-    if not fp or num_segments % rpl:
+    # the line form wins in the RMW-bound regime (large accumulators):
+    # measured 13.3 vs 39.4 ms into 491k segments but ~13 vs 12.6 into
+    # 106k — below the crossover the plain scatter-add is already fast
+    # and the [M, 128] delta materialization is pure overhead
+    if not fp or num_segments % rpl or num_segments <= (1 << 17):
         return jax.ops.segment_sum(values, idx, num_segments=num_segments)
     v = (values if fp == d else
          jnp.pad(values, ((0, 0), (0, fp - d))))
