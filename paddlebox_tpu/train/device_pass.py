@@ -523,20 +523,34 @@ class ResidentPass:
     @staticmethod
     def _encode_segs_slotwire(segs: np.ndarray, meta: np.ndarray,
                               batch_size: int):
-        """SLOT wire for non-trivial segments: ship per-key SLOT ids (u8)
-        plus per-record key COUNTS (u16) instead of u18 segments — the
-        device rebuilds ``segments = record * S + slot`` with one cumsum
-        + searchsorted (≈1 B/key instead of 2.25). Preconditions (else
-        None → the u18 wire): S ≤ 255, per-record counts ≤ 65535, keys
-        grouped by record in record order, and pad_segment == B·S (pads
-        then decode for free: record index saturates at B, slot pads 0)."""
+        """Segment wire for non-trivial layouts, narrowest first.
+
+        GRID wire: when keys are ordered by (record, slot) — the
+        BatchBuilder layout — the whole segment stream collapses to
+        per-(record, slot) key COUNTS, one u8 [B, S] grid: ~S B/record
+        instead of ~1 B/key (ragged at ~5 keys/slot: 130 → 26 B/record).
+        The device rebuilds segments with one grid cumsum + boundary-
+        mark scatter + key cumsum (the same scatter+cumsum identity as
+        the record decode — no searchsorted).
+
+        SLOT wire (fallback): per-key SLOT ids (u8) + per-record key
+        COUNTS (u16) — needs only record-grouping, not slot order.
+
+        Preconditions for either (else None → the u18 wire): S ≤ 255,
+        pad_segment == B·S, keys record-grouped; GRID additionally needs
+        nondecreasing slots within each record and per-cell counts ≤
+        255. Pads decode for free in both (indices saturate at B·S)."""
         nb, k = segs.shape
         b = batch_size
         s = int(meta[0, 1]) // b          # pad_segment == bs * S
         if s <= 0 or s > 255 or int(meta[0, 1]) != b * s:
             return None
-        slot = segs % s
         rec = segs // s
+        # GRID only when it is actually the smaller wire: b*s bytes vs
+        # the SLOT wire's k + 2b per batch (sparse many-slot batches —
+        # avg keys/record below S — would otherwise ship MORE bytes)
+        grid_ok = b * s < k + 2 * b
+        grid = (np.zeros((nb, b * s), np.int64) if grid_ok else None)
         counts = np.zeros((nb, b), np.int64)
         for i in range(nb):
             nk = int(meta[i, 0])
@@ -545,14 +559,26 @@ class ResidentPass:
                 return None               # keys not record-grouped
             if nk and int(r.max()) >= b:
                 return None
-            counts[i] = np.bincount(r, minlength=b)
             if segs[i, nk:].size and (segs[i, nk:] != b * s).any():
                 return None               # pads must be the discard bin
+            # GRID additionally needs the composite segment id itself
+            # to be nondecreasing (slot order within each record)
+            if grid_ok and nk and (np.diff(segs[i, :nk]) < 0).any():
+                grid_ok = False
+            if grid_ok:
+                grid[i] = np.bincount(segs[i, :nk], minlength=b * s)
+                counts[i] = grid[i].reshape(b, s).sum(axis=1)
+            else:
+                counts[i] = np.bincount(r, minlength=b)
+        if grid_ok and int(grid.max()) <= 255:
+            return (grid.reshape(nb, b, s).astype(np.uint8),)
+        # (counts are complete either way: grid-path batches derived
+        # them from their grid row before any fallback flip)
         if int(counts.max()) > 65535:
             return None
         # numpy out, like every sibling encoder — transfer timing stays
         # with the caller
-        return (slot.astype(np.uint8), counts.astype(np.uint16))
+        return (segs % s).astype(np.uint8), counts.astype(np.uint16)
 
     def nbytes(self) -> int:
         """Wire bytes (after upload packing; host estimate before)."""
@@ -613,34 +639,45 @@ class ResidentPassRunner:
         self._jit: Dict[int, object] = {}  # n_steps → compiled runner
 
     @staticmethod
-    def _decode_segs(segs, meta=None):
+    def _decode_segs(segs, meta=None, k_pad=None):
         """segments arrive raw, as a u18-packed pair (ops/bitpack), as
-        the SLOT wire (u8 slots + u16 per-record counts — see
-        _encode_segs_slotwire), or as a bare array (hand-built passes /
-        direct test calls). The pair kinds are distinguished statically
-        by the first leaf's dtype (u18 lows are uint16). The SLOT wire
-        derives S from ``meta``: pad_segment == B·S and B is the counts
-        length — no runner configuration needed."""
+        the GRID wire (u8 [B, S] per-cell key counts), as the SLOT wire
+        (u8 slots + u16 per-record counts — see _encode_segs_slotwire),
+        or as a bare array (hand-built passes / direct test calls). The
+        kinds are distinguished statically by leaf count/dtype/rank
+        (u18 lows are uint16; the GRID leaf is the only 2-D uint8).
+        Both count wires decode with the scatter+cumsum identity —
+        out[p] = #{cells whose cumulative count <= p} == the
+        searchsorted(cum, arange, "right") this replaced, measured 14x
+        faster (56 → 3.9 ms at K=557k, scripts/profile_keypath.py)."""
+
+        def cum_decode(counts_flat, k):
+            # empty cells stack duplicate boundary marks, hence .add;
+            # positions past the total saturate at the cell count
+            cum = jnp.cumsum(counts_flat)
+            marks = jnp.zeros(k, jnp.int32).at[cum].add(1, mode="drop")
+            return jnp.cumsum(marks)
+
         if isinstance(segs, tuple):
+            if (len(segs) == 1 and segs[0].dtype == jnp.uint8
+                    and segs[0].ndim == 2):
+                # GRID wire: segment id = owning (record, slot) cell,
+                # saturating at B*S == pad_segment for pads
+                if k_pad is None:
+                    raise ValueError(
+                        "GRID segment wire needs k_pad (the padded key "
+                        "count) — pass it when calling _decode_segs "
+                        "directly")
+                return cum_decode(segs[0].reshape(-1).astype(jnp.int32),
+                                  k_pad)
             if len(segs) == 2 and segs[0].dtype == jnp.uint8:
                 slot = segs[0].astype(jnp.int32)          # [K]
                 counts = segs[1].astype(jnp.int32)        # [B]
                 k = slot.shape[0]
                 s = meta[1] // counts.shape[0]            # pad_seg // B
-                # rec[p] = #{records whose cumulative count <= p}:
-                # scatter record-boundary marks and prefix-sum them —
-                # identical to searchsorted(cum, arange(k), "right")
-                # (empty records stack duplicate marks, hence .add) but
-                # ~14x faster: the vectorized binary search measured
-                # 56 ms/step at K=557k vs 3.9 ms for scatter+cumsum
-                # (scripts/profile_keypath.py, round 5)
-                cum = jnp.cumsum(counts)
-                marks = jnp.zeros(k, jnp.int32).at[cum].add(
-                    1, mode="drop")
-                rec = jnp.cumsum(marks)
                 # pads: rec saturates at B and slot pads are 0, so the
                 # reconstruction lands exactly on pad_segment == B*S
-                return rec * s + slot
+                return cum_decode(counts, k) * s + slot
             if len(segs) == 2:
                 return unpack_u16m(segs[0], segs[1], 2)
             return segs[0]
@@ -648,7 +685,6 @@ class ResidentPassRunner:
 
     def _make_view(self, uniq_t, gidx_t, floats, meta,
                    segs, qmeta) -> _BatchView:
-        segs = self._decode_segs(segs, meta)
         if self.wire == "compact":
             return self._make_view_compact(uniq_t, gidx_t[0], floats,
                                            meta, segs, qmeta)
@@ -671,7 +707,7 @@ class ResidentPassRunner:
         if self.trivial:
             segments = jnp.where(pos < num_keys, pos, pad_seg)
         else:
-            segments = segs
+            segments = self._decode_segs(segs, meta, k_pad=k)
         key_valid = (pos < num_keys).astype(jnp.float32)
         if floats.dtype == jnp.uint8:  # q8 wire (quantize_floats)
             dense, label, show, clk = dequantize_floats(floats, qmeta)
@@ -703,7 +739,7 @@ class ResidentPassRunner:
             segments = jnp.where(pos < num_keys, pos, pad_seg)
             slot = pos % s
         else:
-            segments = segs
+            segments = self._decode_segs(segs, meta, k_pad=k)
             slot = segments % s
         cb = self.chunk_bits
         stride = cmap.shape[1]
