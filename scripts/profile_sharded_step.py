@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""XPlane op-level attribution of the sharded resident step (round 5):
+where do the ~32 ms/step go that the single-chip step doesn't pay?
+
+Builds the sharded uniform bench shape, stages one resident pass, runs
+it wire-free under jax.profiler, and prints the top device ops by
+self-time.
+"""
+import glob
+import json
+import os
+import sys
+import tempfile
+import time
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+import optax
+
+from bench import build_records
+from paddlebox_tpu.config import FLAGS
+from paddlebox_tpu.data import DataFeedDesc, InMemoryDataset, SlotDef
+from paddlebox_tpu.models import DeepFM
+from paddlebox_tpu.parallel import make_mesh
+from paddlebox_tpu.ps import SparseSGDConfig
+from paddlebox_tpu.ps.sharded import ShardedEmbeddingTable
+from paddlebox_tpu.train.sharded import ShardedTrainer
+
+FLAGS.log_period_steps = 10 ** 9
+FLAGS.auc_device_reduce = True
+bs, n_rec = 8192, 262_144
+slots = [SlotDef("label", "float", 1), SlotDef("dense", "float", 13)]
+slots += [SlotDef(f"C{i}", "uint64") for i in range(1, 27)]
+desc = DataFeedDesc(slots=slots, batch_size=bs, label_slot="label",
+                    key_bucket_min=bs * 26)
+ds = InMemoryDataset(desc)
+ds.records = build_records(n_rec, num_slots=26, vocab_per_slot=100_000,
+                           seed=0)
+ds.columnarize()
+cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=1e-3)
+chips = len(jax.devices())
+mesh = make_mesh(chips)
+table = ShardedEmbeddingTable(chips, mf_dim=8,
+                              capacity_per_shard=(1 << 23) // chips,
+                              cfg=cfg, req_bucket_min=1 << 12,
+                              serve_bucket_min=1 << 12)
+tr = ShardedTrainer(DeepFM(hidden=(512, 256, 128)), table, desc, mesh,
+                    tx=optax.adam(1e-3), float_wire="q8")
+rp = tr.build_resident_pass(ds)
+rp.upload(materialize=True)
+tr.train_pass_resident(rp)          # warm/compile
+t0 = time.perf_counter()
+tr.train_pass_resident(rp)          # wire-free
+wall = time.perf_counter() - t0
+nb = rp.num_batches
+print(json.dumps({"probe": "pass", "wall_s": round(wall, 3),
+                  "ms_per_step": round(wall / nb * 1000, 2),
+                  "n_steps": nb}), flush=True)
+
+d = tempfile.mkdtemp(prefix="pbox_shstep_")
+with jax.profiler.trace(d):
+    tr.train_pass_resident(rp)
+paths = glob.glob(os.path.join(d, "**", "*.xplane.pb"), recursive=True)
+pd = jax.profiler.ProfileData.from_file(sorted(paths)[-1])
+agg = defaultdict(float)
+for plane in pd.planes:
+    if not plane.name.startswith("/device:"):
+        continue
+    for line in plane.lines:
+        if line.name != "XLA Ops":
+            continue
+        for ev in line.events:
+            # strip fusion instance suffixes for aggregation
+            name = ev.name.split(".")[0]
+            agg[name] += float(ev.duration_ns) / 1e6
+top = sorted(agg.items(), key=lambda kv: -kv[1])[:20]
+total = sum(agg.values())
+print(f"total device op ms across pass: {total:.1f} "
+      f"({total / nb:.2f} ms/step)")
+for name, ms in top:
+    print(f"{ms:8.1f} ms  {ms / nb:6.2f} ms/step  {name}")
