@@ -17,7 +17,6 @@ from collections import defaultdict
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
-import numpy as np
 import optax
 
 from bench import build_records
@@ -64,6 +63,8 @@ d = tempfile.mkdtemp(prefix="pbox_shstep_")
 with jax.profiler.trace(d):
     tr.train_pass_resident(rp)
 paths = glob.glob(os.path.join(d, "**", "*.xplane.pb"), recursive=True)
+if not paths:
+    raise FileNotFoundError(f"no xplane.pb under {d} — trace failed?")
 pd = jax.profiler.ProfileData.from_file(sorted(paths)[-1])
 agg = defaultdict(float)
 for plane in pd.planes:
