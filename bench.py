@@ -249,6 +249,23 @@ def xplane_device_busy_sec(trace_dir: str) -> float:
     return busy / 1e9
 
 
+def setup_telemetry() -> None:
+    """Write the run's telemetry JSONL next to the BENCH_*.json artifacts
+    (repo root — same dir as this script), so every bench round carries
+    per-pass stage/queue/HBM attribution for free
+    (scripts/telemetry_report.py renders it). BENCH_TELEMETRY_JSONL
+    overrides the path; =0 disables."""
+    from paddlebox_tpu.obs.hub import get_hub
+    from paddlebox_tpu.obs.sinks import JsonlSink
+    dest = os.environ.get("BENCH_TELEMETRY_JSONL", "")
+    if dest == "0":
+        return
+    path = dest or os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "BENCH_telemetry.jsonl")
+    get_hub().add_sink(JsonlSink(path, truncate=True))
+    print(f"telemetry jsonl: {path}", file=sys.stderr)
+
+
 def main() -> None:
     import optax
     from paddlebox_tpu.config import FLAGS
@@ -256,6 +273,8 @@ def main() -> None:
     from paddlebox_tpu.models import DeepFM
     from paddlebox_tpu.ps import EmbeddingTable, SparseSGDConfig
     from paddlebox_tpu.train import PassPreloader, Trainer
+
+    setup_telemetry()
 
     # workload shape (BASELINE.json ladder): "uniform" = 26 slots, one
     # key each (rung 2 steady state); "ragged" = 26 slots, avg 5

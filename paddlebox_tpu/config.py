@@ -95,6 +95,22 @@ class Flags:
     # re-running XLA at the first pass boundary.
     compilation_cache_dir: str = ""
 
+    # --- telemetry (obs/ TelemetryHub; docs/OBSERVABILITY.md) ---
+    # path → attach a JSONL event sink (one structured record per pass)
+    telemetry_jsonl: str = ""
+    # ≥0 → serve Prometheus text exposition over HTTP (0 = ephemeral
+    # port); -1 disables the endpoint
+    telemetry_prom_port: int = -1
+    # multihost straggler watchdog (obs/watchdog, train/multihost):
+    # shared directory for heartbeat files ("" = watchdog not started
+    # by make_straggler_watchdog unless a dir/store is passed)
+    straggler_heartbeat_dir: str = ""
+    straggler_step_lag: int = 1000
+    straggler_timeout_sec: float = 120.0
+    # >0 → a stall persisting this long arms an abort: the training
+    # thread's next heartbeat raises StragglerTimeout
+    straggler_abort_sec: float = 0.0
+
     # --- runtime ---
     profile: bool = False
     log_period_steps: int = 100

@@ -232,7 +232,8 @@ class InMemoryDataset(Dataset):
                 and self._merge_size is None
                 and self._load_columnar_native()):
             return
-        ch: Channel[SlotRecord] = Channel(capacity=FLAGS.channel_capacity)
+        ch: Channel[SlotRecord] = Channel(capacity=FLAGS.channel_capacity,
+                                          name="dataset.load_records")
         group = self._read_files_into(self.filelist, ch, self.thread_num)
 
         def closer() -> None:
@@ -487,7 +488,8 @@ class QueueDataset(Dataset):
         if not self.filelist:
             raise ValueError("set_filelist first")
         ch: Channel[SlotRecord] = Channel(capacity=FLAGS.channel_capacity,
-                                          block_size=self.desc.batch_size)
+                                          block_size=self.desc.batch_size,
+                                          name="dataset.stream_records")
         group = self._read_files_into(self.filelist, ch, self.thread_num)
 
         def closer() -> None:

@@ -1,0 +1,19 @@
+"""Unified telemetry: typed instruments, run events, Prometheus export,
+straggler watchdog (see docs/OBSERVABILITY.md for the catalog)."""
+
+from paddlebox_tpu.obs.hub import (TelemetryHub, configure_from_flags,
+                                   emit_pass_event, get_hub, reset_hub)
+from paddlebox_tpu.obs.instruments import Counter, Gauge, Histogram
+from paddlebox_tpu.obs.sinks import ChromeSpanSink, JsonlSink, MemorySink
+from paddlebox_tpu.obs.watchdog import (DirHeartbeatStore,
+                                        LocalHeartbeatStore,
+                                        StragglerReport, StragglerTimeout,
+                                        StragglerWatchdog)
+
+__all__ = [
+    "TelemetryHub", "get_hub", "reset_hub", "configure_from_flags",
+    "emit_pass_event", "Counter", "Gauge", "Histogram",
+    "JsonlSink", "MemorySink", "ChromeSpanSink",
+    "StragglerWatchdog", "StragglerReport", "StragglerTimeout",
+    "LocalHeartbeatStore", "DirHeartbeatStore",
+]

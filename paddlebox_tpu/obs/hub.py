@@ -1,0 +1,356 @@
+"""TelemetryHub — the unified observability surface.
+
+One hub per process unifies the pre-existing primitives (``StatRegistry``
+counters, ``StageTimers`` per-pass reports, ``ChromeTraceWriter`` spans,
+``device_mem_used`` HBM probes) behind typed instruments (obs/instruments)
+with pluggable sinks:
+
+- **event sinks** (``JsonlSink``...) get one structured record per
+  pass/alert — the machine-readable PrintSyncTimer;
+- **span sinks** (``ChromeSpanSink``) get completed timed spans;
+- **Prometheus**: ``snapshot_prom()`` renders every instrument (plus the
+  legacy ``STATS`` registry, bridged as ``pbox_stat`` gauges) in text
+  exposition format; ``start_prom_http`` serves it from a background
+  thread.
+
+Hot-loop contract: with no sinks attached the hub is INERT — call sites
+guard on ``hub.active`` (a plain bool attribute, one dict-free attribute
+read) before building any event payload, so default-off telemetry costs
+nothing measurable per step.
+
+Enable via flags: ``FLAGS.telemetry_jsonl=/path/run.jsonl`` attaches a
+JSONL sink, ``FLAGS.telemetry_prom_port>=0`` starts the HTTP endpoint
+(``configure_from_flags`` is called by Trainer init and bench.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Sequence
+
+from paddlebox_tpu.obs.instruments import (Counter, Gauge, Histogram,
+                                           Instrument, iter_prom_lines)
+from paddlebox_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class TelemetryHub:
+    def __init__(self, run_id: Optional[str] = None) -> None:
+        self.run_id = run_id or f"{int(time.time())}-{os.getpid()}"
+        self._lock = threading.Lock()
+        self._instruments: Dict[str, Instrument] = {}
+        self._event_sinks: List = []
+        self._span_sinks: List = []
+        self._prom_server = None
+        self._proc: Optional[int] = None
+        self._seq = 0
+        # fast-path flag: any sink attached / endpoint running. Hot call
+        # sites read this one attribute and skip all payload assembly.
+        self.active = False
+
+    # ---- instruments ---------------------------------------------------
+    def _get(self, cls, name: str, help: str, **kw) -> Instrument:
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = self._instruments[name] = cls(name, help, **kw)
+            elif not isinstance(inst, cls):
+                raise TypeError(f"instrument {name!r} already registered "
+                                f"as {inst.kind}")
+            return inst
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        return self._get(Histogram, name, help,
+                         **({"buckets": buckets} if buckets else {}))
+
+    # ---- sinks ---------------------------------------------------------
+    def _refresh_active(self) -> None:
+        self.active = bool(self._event_sinks or self._span_sinks
+                           or self._prom_server is not None)
+
+    def add_sink(self, sink) -> None:
+        """Attach an event sink (has ``emit(dict)``) or a span sink
+        (has ``span(name, start, dur, attrs)``)."""
+        with self._lock:
+            if hasattr(sink, "span"):
+                self._span_sinks.append(sink)
+            else:
+                self._event_sinks.append(sink)
+            self._refresh_active()
+
+    def remove_sink(self, sink) -> None:
+        with self._lock:
+            for ls in (self._event_sinks, self._span_sinks):
+                if sink in ls:
+                    ls.remove(sink)
+            self._refresh_active()
+
+    def close_sinks(self) -> None:
+        with self._lock:
+            sinks = self._event_sinks + self._span_sinks
+            self._event_sinks = []
+            self._span_sinks = []
+            self._refresh_active()
+        for s in sinks:
+            try:
+                s.close()
+            except Exception:  # a dying sink must not take the run down
+                log.warning("telemetry sink close failed", exc_info=True)
+
+    def event_sinks(self) -> List:
+        return list(self._event_sinks)
+
+    # ---- events --------------------------------------------------------
+    def _process_index(self) -> int:
+        if self._proc is None:
+            try:
+                import jax
+                self._proc = jax.process_index()
+            except Exception:
+                self._proc = 0
+        return self._proc
+
+    def emit(self, event: str, **fields) -> None:
+        """Emit one structured event to every event sink. Timestamps are
+        wall-clock and ``seq`` is a per-hub monotone sequence number, so
+        JSONL consumers can order events even across clock steps."""
+        sinks = self._event_sinks
+        if not sinks:
+            return
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        ev = {"ts": time.time(), "seq": seq, "event": event,
+              "run": self.run_id, "proc": self._process_index()}
+        ev.update(fields)
+        for s in sinks:
+            try:
+                s.emit(ev)
+            except Exception:
+                log.warning("telemetry event sink failed", exc_info=True)
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs) -> Iterator[None]:
+        """Run-scoped timed span → span sinks (no-op without any)."""
+        sinks = self._span_sinks
+        if not sinks:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dur = time.perf_counter() - t0
+            for s in sinks:
+                try:
+                    s.span(name, t0, dur, attrs)
+                except Exception:
+                    log.warning("telemetry span sink failed",
+                                exc_info=True)
+
+    # ---- snapshots -----------------------------------------------------
+    def snapshot(self) -> Dict[str, Dict]:
+        """Structured dump: {name: {kind, series: {label_str: value}}}
+        (histograms dump {sum, count} per series)."""
+        with self._lock:
+            insts = list(self._instruments.values())
+        out: Dict[str, Dict] = {}
+        for inst in insts:
+            series: Dict[str, object] = {}
+            for k, v in inst.series():
+                key = ",".join(f"{n}={val}" for n, val in k)
+                series[key] = ({"sum": v.sum, "count": v.count}
+                               if inst.kind == "histogram" else v)
+            out[inst.name] = {"kind": inst.kind, "series": series}
+        return out
+
+    def snapshot_prom(self) -> str:
+        """Prometheus text exposition of every instrument + the legacy
+        StatRegistry (bridged as ``pbox_stat{name=...}`` gauges)."""
+        with self._lock:
+            insts = sorted(self._instruments.values(),
+                           key=lambda i: i.name)
+        lines: List[str] = []
+        for inst in insts:
+            lines.extend(iter_prom_lines(inst))
+        from paddlebox_tpu.utils.monitor import STATS
+        stats = STATS.snapshot()
+        if stats:
+            lines.append("# TYPE pbox_stat gauge")
+            for name, val in sorted(stats.items()):
+                lines.append(f'pbox_stat{{name="{name}"}} {val}')
+        return "\n".join(lines) + "\n"
+
+    # ---- Prometheus HTTP endpoint --------------------------------------
+    def start_prom_http(self, port: int = 0):
+        """Serve ``snapshot_prom()`` from a daemon thread; returns the
+        server (``server.server_address[1]`` is the bound port — pass
+        port=0 for an ephemeral one). Idempotent."""
+        if self._prom_server is not None:
+            return self._prom_server
+        import http.server
+
+        hub = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                body = hub.snapshot_prom().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        threading.Thread(target=srv.serve_forever, daemon=True,
+                         name="pbox-prom-http").start()
+        with self._lock:
+            self._prom_server = srv
+            self._refresh_active()
+        log.info("prometheus endpoint on :%d", srv.server_address[1])
+        return srv
+
+    def stop_prom_http(self) -> None:
+        with self._lock:
+            srv, self._prom_server = self._prom_server, None
+            self._refresh_active()
+        if srv is not None:
+            srv.shutdown()
+            srv.server_close()
+
+
+_HUB = TelemetryHub()
+_configured_jsonl: Optional[str] = None
+
+
+def get_hub() -> TelemetryHub:
+    return _HUB
+
+
+def reset_hub() -> TelemetryHub:
+    """Fresh global hub (tests). Closes the old hub's sinks/endpoint."""
+    global _HUB, _configured_jsonl
+    _HUB.close_sinks()
+    _HUB.stop_prom_http()
+    _HUB = TelemetryHub()
+    _configured_jsonl = None
+    return _HUB
+
+
+def configure_from_flags() -> TelemetryHub:
+    """Attach flag-selected sinks to the global hub (idempotent; called
+    by Trainer init and bench.py so ``FLAGS_telemetry_jsonl=...`` in the
+    environment is all a run needs)."""
+    global _configured_jsonl
+    from paddlebox_tpu.config import FLAGS
+    hub = _HUB
+    path = FLAGS.telemetry_jsonl
+    if path and path != _configured_jsonl:
+        from paddlebox_tpu.obs.sinks import JsonlSink
+        hub.add_sink(JsonlSink(path))
+        _configured_jsonl = path
+    if FLAGS.telemetry_prom_port >= 0:
+        hub.start_prom_http(FLAGS.telemetry_prom_port)
+    return hub
+
+
+def emit_pass_event(kind: str, metrics: Dict, stage_timers=None,
+                    table=None, examples: Optional[int] = None) -> None:
+    """THE per-pass telemetry record: pass metrics + stage timers +
+    channel gauges + table occupancy + HBM watermarks, in one event and
+    mirrored into instruments for the Prometheus view. Trainers call
+    this at every pass end; it returns immediately when no sink is
+    attached (the no-sink fast path)."""
+    hub = _HUB
+    if not hub.active:
+        return
+    ev: Dict = {"kind": kind}
+    for k in ("batches", "elapsed_sec", "examples_per_sec", "auc",
+              "last_loss", "global_step", "pass_seq"):
+        if k in metrics:
+            ev[k] = metrics[k]
+    if examples is not None:
+        ev["examples"] = examples
+    if stage_timers is not None:
+        ev["stage_sec"] = {k: round(v, 6)
+                           for k, v in stage_timers.as_dict().items()}
+        ev["stage_count"] = stage_timers.counts()
+        h = hub.histogram("pbox_stage_seconds",
+                          "per-pass stage wall seconds")
+        for k, v in ev["stage_sec"].items():
+            h.observe(v, stage=k)
+    # channel gauges (cumulative across the process; consumers diff
+    # between consecutive pass events — scripts/telemetry_report.py)
+    from paddlebox_tpu.utils.channel import channel_stats_snapshot
+    chans = channel_stats_snapshot()
+    if chans:
+        ev["channels"] = chans
+        depth_g = hub.gauge("pbox_channel_depth",
+                            "items queued in named channels")
+        hwm_g = hub.gauge("pbox_channel_high_watermark",
+                          "peak queued items per named channel")
+        bput = hub.counter("pbox_channel_blocked_put_seconds_total",
+                           "producer seconds blocked on a full channel")
+        bget = hub.counter("pbox_channel_blocked_get_seconds_total",
+                           "consumer seconds blocked on an empty channel")
+        for name, st in chans.items():
+            depth_g.set(st["depth"], channel=name)
+            hwm_g.set_max(st["high_watermark"], channel=name)
+            # counters are monotone: add only the delta since last mirror
+            for ctr, key in ((bput, "blocked_put_sec"),
+                             (bget, "blocked_get_sec")):
+                prev = ctr.value(channel=name)
+                if st[key] > prev:
+                    ctr.inc(st[key] - prev, channel=name)
+    # table occupancy (+ the tiered tables' per-pass delta stats)
+    if table is not None:
+        tstats = {}
+        if hasattr(table, "obs_stats"):
+            tstats.update(table.obs_stats())
+        lp = getattr(table, "last_pass_stats", None)
+        if lp:
+            tstats["last_pass"] = dict(lp)
+        if tstats:
+            ev["table"] = tstats
+            if "used" in tstats:
+                hub.gauge("pbox_table_rows_used",
+                          "occupied embedding rows").set(tstats["used"])
+            if "capacity" in tstats:
+                hub.gauge("pbox_table_rows_capacity",
+                          "embedding row capacity").set(tstats["capacity"])
+    # HBM watermarks (zeros on backends without allocator stats, e.g.
+    # virtual CPU devices — the keys still ship so consumers are uniform)
+    try:
+        from paddlebox_tpu.utils.monitor import device_mem_used
+        hbm = device_mem_used()
+    except Exception:
+        hbm = {"bytes_in_use": 0, "peak_bytes_in_use": 0, "bytes_limit": 0}
+    ev["hbm"] = hbm
+    hub.gauge("pbox_hbm_bytes_in_use",
+              "device bytes in use").set(hbm["bytes_in_use"])
+    hub.gauge("pbox_hbm_peak_bytes",
+              "device peak bytes in use").set_max(hbm["peak_bytes_in_use"])
+    hub.counter("pbox_passes_total", "completed passes").inc(kind=kind)
+    if examples:
+        hub.counter("pbox_examples_total",
+                    "examples trained/evaluated").inc(examples)
+    if "examples_per_sec" in ev:
+        hub.gauge("pbox_last_pass_examples_per_sec",
+                  "throughput of the latest pass").set(
+                      ev["examples_per_sec"], kind=kind)
+    hub.emit("pass", **ev)

@@ -1,0 +1,187 @@
+"""Typed telemetry instruments: Counter / Gauge / Histogram.
+
+The reference system's monitors are an untyped int64 registry
+(``StatRegistry``/``STAT_ADD``, platform/monitor.h:80) plus ad-hoc
+per-stage timers. These instruments put a type system on top — monotone
+counters, set-style gauges (with a high-watermark helper for HBM/queue
+peaks), and fixed-bucket histograms — so one snapshot can render as
+structured JSON or Prometheus text exposition (obs/hub.py).
+
+Every instrument is thread-safe and label-aware: a labelless update
+writes the ``()`` series; keyword labels key independent series
+(``counter.inc(3, shard="0")``). Label values are stringified at update
+time so snapshots are stable.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, object]) -> LabelKey:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+class Instrument:
+    """Base: name, help text, per-labelset series under one lock."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "") -> None:
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+
+    def series(self) -> List[Tuple[LabelKey, object]]:
+        raise NotImplementedError
+
+
+class Counter(Instrument):
+    """Monotone float counter (STAT_ADD with labels)."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = "") -> None:
+        super().__init__(name, help)
+        self._values: Dict[LabelKey, float] = {}
+
+    def inc(self, value: float = 1.0, **labels: object) -> None:
+        if value < 0:
+            raise ValueError(f"counter {self.name}: negative inc {value}")
+        k = _label_key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+    def value(self, **labels: object) -> float:
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def series(self) -> List[Tuple[LabelKey, float]]:
+        with self._lock:
+            return sorted(self._values.items())
+
+
+class Gauge(Instrument):
+    """Last-value gauge; ``set_max`` keeps running high watermarks."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = "") -> None:
+        super().__init__(name, help)
+        self._values: Dict[LabelKey, float] = {}
+
+    def set(self, value: float, **labels: object) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = float(value)
+
+    def inc(self, value: float = 1.0, **labels: object) -> None:
+        k = _label_key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+    def set_max(self, value: float, **labels: object) -> None:
+        """Watermark update: keep max(current, value)."""
+        k = _label_key(labels)
+        with self._lock:
+            cur = self._values.get(k)
+            if cur is None or value > cur:
+                self._values[k] = float(value)
+
+    def value(self, **labels: object) -> float:
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def series(self) -> List[Tuple[LabelKey, float]]:
+        with self._lock:
+            return sorted(self._values.items())
+
+
+# seconds-oriented default ladder (stage/pass timings span ms..minutes)
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+class _HistSeries:
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self, nbuckets: int) -> None:
+        self.counts = [0] * nbuckets  # cumulative at export, raw here
+        self.sum = 0.0
+        self.count = 0
+
+
+class Histogram(Instrument):
+    """Fixed-bucket histogram (Prometheus ``le`` semantics at export:
+    bucket counts are cumulative, ``+Inf`` == count)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Optional[Sequence[float]] = None) -> None:
+        super().__init__(name, help)
+        bs = tuple(sorted(buckets)) if buckets else DEFAULT_BUCKETS
+        if not bs:
+            raise ValueError(f"histogram {name}: empty buckets")
+        self.buckets = bs
+        self._series: Dict[LabelKey, _HistSeries] = {}
+
+    def observe(self, value: float, **labels: object) -> None:
+        import bisect
+        k = _label_key(labels)
+        i = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            s = self._series.get(k)
+            if s is None:
+                s = self._series[k] = _HistSeries(len(self.buckets))
+            if i < len(self.buckets):
+                s.counts[i] += 1
+            s.sum += value
+            s.count += 1
+
+    def snapshot(self, **labels: object) -> Dict[str, object]:
+        """{buckets: {le: cumulative_count}, sum, count} for one series."""
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            if s is None:
+                return {"buckets": {}, "sum": 0.0, "count": 0}
+            cum, acc = {}, 0
+            for le, c in zip(self.buckets, s.counts):
+                acc += c
+                cum[le] = acc
+            return {"buckets": cum, "sum": s.sum, "count": s.count}
+
+    def series(self) -> List[Tuple[LabelKey, _HistSeries]]:
+        with self._lock:
+            return sorted(self._series.items(), key=lambda kv: kv[0])
+
+
+def iter_prom_lines(inst: Instrument) -> Iterator[str]:
+    """Prometheus text-exposition lines for one instrument."""
+
+    def fmt_labels(k: LabelKey, extra: str = "") -> str:
+        parts = [f'{n}="{v}"' for n, v in k]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+    if inst.help:
+        yield f"# HELP {inst.name} {inst.help}"
+    yield f"# TYPE {inst.name} {inst.kind}"
+    if isinstance(inst, Histogram):
+        for k, s in inst.series():
+            acc = 0
+            for le, c in zip(inst.buckets, s.counts):
+                acc += c
+                le_lbl = 'le="%s"' % le
+                yield f"{inst.name}_bucket{fmt_labels(k, le_lbl)} {acc}"
+            inf_lbl = 'le="+Inf"'
+            yield f"{inst.name}_bucket{fmt_labels(k, inf_lbl)} {s.count}"
+            yield f"{inst.name}_sum{fmt_labels(k)} {s.sum}"
+            yield f"{inst.name}_count{fmt_labels(k)} {s.count}"
+    else:
+        for k, v in inst.series():
+            yield f"{inst.name}{fmt_labels(k)} {v}"

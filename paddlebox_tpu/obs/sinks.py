@@ -1,0 +1,76 @@
+"""Event/span sinks for the TelemetryHub.
+
+Event sinks receive one dict per emitted event (pass summaries,
+watchdog alerts, warmup outcomes...); span sinks receive completed
+timed spans. ``JsonlSink`` is the structured-log backend (one JSON
+object per line, flushed per event — events fire at pass granularity,
+not per batch, so durability beats buffering); ``MemorySink`` backs
+tests; ``ChromeSpanSink`` adapts the existing
+``utils.profiler.ChromeTraceWriter`` so hub spans land in the same
+chrome://tracing timeline as StageTimers stages.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, List, Optional
+
+
+class JsonlSink:
+    """Append one JSON line per event to ``path``."""
+
+    def __init__(self, path: str, truncate: bool = False) -> None:
+        self.path = path
+        self._lock = threading.Lock()
+        self._fh = open(path, "w" if truncate else "a")
+
+    def emit(self, event: Dict) -> None:
+        line = json.dumps(event, default=str)
+        with self._lock:
+            if self._fh.closed:
+                return
+            self._fh.write(line + "\n")
+            self._fh.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._fh.closed:
+                self._fh.close()
+
+
+class MemorySink:
+    """In-process event buffer (tests, REPL inspection)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.events: List[Dict] = []
+
+    def emit(self, event: Dict) -> None:
+        with self._lock:
+            self.events.append(event)
+
+    def close(self) -> None:
+        pass
+
+
+class ChromeSpanSink:
+    """Span sink → ChromeTraceWriter: hub spans render as X events on
+    the same host-orchestration timeline as StageTimers stages. Pass an
+    explicit writer, or None to follow whatever writer is installed via
+    ``utils.profiler.set_chrome_trace`` at span time."""
+
+    def __init__(self, writer=None) -> None:
+        self._writer = writer
+
+    def span(self, name: str, start_s: float, dur_s: float,
+             attrs: Optional[Dict] = None) -> None:
+        w = self._writer
+        if w is None:
+            from paddlebox_tpu.utils.profiler import chrome_trace
+            w = chrome_trace()
+        if w is not None:
+            w.complete(name, start_s, dur_s, **(attrs or {}))
+
+    def close(self) -> None:
+        pass

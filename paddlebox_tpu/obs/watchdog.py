@@ -1,0 +1,251 @@
+"""Multihost heartbeat + straggler watchdog.
+
+A multi-controller pod (train/multihost.py) fails ugliest when ONE
+process slows down: every collective stalls, and nothing says which
+host. Each process publishes ``(step, wall_ts)`` heartbeats into a
+shared store; a watchdog thread compares the mesh and flags any process
+whose step counter falls behind the front-runner by more than
+``step_lag`` steps or whose heartbeat goes stale past
+``heartbeat_timeout``. Detection logs + emits a ``straggler`` telemetry
+event; with ``abort_after`` set, a stall that persists past the
+deadline makes the NEXT ``beat()`` raise ``StragglerTimeout`` in the
+training thread — the safe place to abort, since raising inside the
+monitor thread would vanish.
+
+Stores: ``LocalHeartbeatStore`` (in-process — tests, single-host
+multi-device) and ``DirHeartbeatStore`` (one JSON file per process in a
+shared directory — NFS/FUSE mounts on real pods; atomic
+write-then-rename so readers never see a torn file).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
+
+from paddlebox_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class StragglerTimeout(RuntimeError):
+    """Raised by ``beat()`` after a stall outlives ``abort_after``."""
+
+
+class StragglerReport(NamedTuple):
+    process: int
+    step: int          # -1: never heartbeat
+    behind: int        # steps behind the front-runner
+    age_sec: float     # seconds since the process's last heartbeat
+    reason: str        # "step_lag" | "stale" | "missing"
+
+
+class LocalHeartbeatStore:
+    """In-process store (tests / single-host)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._beats: Dict[int, Tuple[int, float]] = {}
+
+    def publish(self, process: int, step: int, ts: float) -> None:
+        with self._lock:
+            self._beats[process] = (step, ts)
+
+    def read(self) -> Dict[int, Tuple[int, float]]:
+        with self._lock:
+            return dict(self._beats)
+
+
+class DirHeartbeatStore:
+    """One ``hb_<process>.json`` per process in a shared directory.
+    Reusing a directory across runs is safe: the watchdog's ``check``
+    ignores ranks beyond the current mesh and beats older than its own
+    start (minus the timeout), so prior-run leftovers never report."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+
+    def publish(self, process: int, step: int, ts: float) -> None:
+        final = os.path.join(self.path, f"hb_{process}.json")
+        tmp = final + f".tmp{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump({"process": process, "step": step, "ts": ts}, fh)
+        os.replace(tmp, final)
+
+    def read(self) -> Dict[int, Tuple[int, float]]:
+        out: Dict[int, Tuple[int, float]] = {}
+        try:
+            names = os.listdir(self.path)
+        except OSError:
+            return out
+        for n in names:
+            if not (n.startswith("hb_") and n.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(self.path, n)) as fh:
+                    d = json.load(fh)
+                out[int(d["process"])] = (int(d["step"]), float(d["ts"]))
+            except (OSError, ValueError, KeyError):
+                continue  # torn/foreign file — next poll sees the rename
+        return out
+
+
+class StragglerWatchdog:
+    def __init__(
+        self,
+        store,
+        process_index: int,
+        num_processes: int,
+        step_lag: int = 100,
+        heartbeat_timeout: float = 60.0,
+        poll_interval: float = 5.0,
+        abort_after: Optional[float] = None,
+        on_straggler: Optional[Callable[[List[StragglerReport]], None]]
+        = None,
+        clock: Callable[[], float] = time.time,
+        hub=None,
+    ) -> None:
+        """``clock`` is injectable so tests simulate stalls without
+        sleeping; heartbeats carry this clock's timestamps, so every
+        process of one job must use the same clock source."""
+        self.store = store
+        self.process_index = process_index
+        self.num_processes = num_processes
+        self.step_lag = step_lag
+        self.heartbeat_timeout = heartbeat_timeout
+        self.poll_interval = poll_interval
+        self.abort_after = abort_after
+        self.on_straggler = on_straggler
+        self.clock = clock
+        self._hub = hub
+        self._start_ts = clock()
+        self._stall_since: Optional[float] = None
+        self._abort_exc: Optional[StragglerTimeout] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.last_report: List[StragglerReport] = []
+
+    def _get_hub(self):
+        if self._hub is None:
+            from paddlebox_tpu.obs.hub import get_hub
+            self._hub = get_hub()
+        return self._hub
+
+    # ---- producer side -------------------------------------------------
+    def beat(self, step: int) -> None:
+        """Publish this process's progress; call once per step-window or
+        pass. Raises ``StragglerTimeout`` if the monitor armed an abort."""
+        if self._abort_exc is not None:
+            raise self._abort_exc
+        self.store.publish(self.process_index, int(step), self.clock())
+        hub = self._get_hub()
+        if hub.active:
+            hub.gauge("pbox_multihost_step",
+                      "last heartbeat step per process").set(
+                          int(step), process=self.process_index)
+
+    # ---- monitor side --------------------------------------------------
+    def check(self) -> List[StragglerReport]:
+        """One detection sweep (pure given the store + clock — the unit
+        the tests drive directly). Empty list == healthy mesh."""
+        now = self.clock()
+        beats = self.store.read()
+        # restart hygiene: a reused heartbeat dir holds files from prior
+        # runs — ranks beyond this mesh (elastic downsize) or beats that
+        # predate this watchdog by more than the timeout. They must not
+        # define the front-runner or report as stale: a restarted job
+        # would otherwise chase a step count that only existed in the
+        # old run's leftovers (and abort_after would kill it healthy).
+        # A rank whose only file is pre-run leftover shows up as
+        # "missing" after the grace window instead.
+        fresh_floor = self._start_ts - self.heartbeat_timeout
+        beats = {p: (s, t) for p, (s, t) in beats.items()
+                 if p < self.num_processes and t >= fresh_floor}
+        reports: List[StragglerReport] = []
+        front = max((s for s, _ in beats.values()), default=0)
+        for p in range(self.num_processes):
+            if p not in beats:
+                # a process that never published is only a straggler
+                # once the mesh has had time to come up
+                if beats and now - self._start_ts > self.heartbeat_timeout:
+                    reports.append(StragglerReport(
+                        p, -1, front, now - self._start_ts, "missing"))
+                continue
+            step, ts = beats[p]
+            age = now - ts
+            if front - step > self.step_lag:
+                reports.append(StragglerReport(
+                    p, step, front - step, age, "step_lag"))
+            elif age > self.heartbeat_timeout:
+                reports.append(StragglerReport(
+                    p, step, front - step, age, "stale"))
+        self.last_report = reports
+        return reports
+
+    def _handle(self, reports: List[StragglerReport]) -> None:
+        now = self.clock()
+        if not reports:
+            self._stall_since = None
+            return
+        if self._stall_since is None:
+            self._stall_since = now
+        stalled_for = now - self._stall_since
+        desc = "; ".join(
+            f"proc {r.process}: {r.reason} (step={r.step}, "
+            f"behind={r.behind}, age={r.age_sec:.1f}s)" for r in reports)
+        log.warning("straggler watchdog: %s (stalled %.1fs)", desc,
+                    stalled_for)
+        hub = self._get_hub()
+        if hub.active:
+            hub.counter("pbox_straggler_events_total",
+                        "straggler detections").inc()
+            hub.emit("straggler", stalled_for_sec=round(stalled_for, 3),
+                     stragglers=[r._asdict() for r in reports])
+        if self.on_straggler is not None:
+            self.on_straggler(reports)
+        if (self.abort_after is not None
+                and stalled_for >= self.abort_after
+                and self._abort_exc is None):
+            self._abort_exc = StragglerTimeout(
+                f"mesh stalled {stalled_for:.1f}s "
+                f"(> {self.abort_after}s): {desc}")
+            log.error("straggler watchdog: abort armed — next beat() "
+                      "raises StragglerTimeout")
+            if hub.active:
+                hub.emit("straggler_abort",
+                         stalled_for_sec=round(stalled_for, 3))
+
+    def poll_once(self) -> List[StragglerReport]:
+        """check() + alerting/abort arming — one monitor iteration."""
+        reports = self.check()
+        self._handle(reports)
+        return reports
+
+    def start(self) -> "StragglerWatchdog":
+        """Run the monitor loop in a daemon thread."""
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop() -> None:
+            while not self._stop.wait(self.poll_interval):
+                try:
+                    self.poll_once()
+                except Exception:
+                    log.warning("straggler watchdog poll failed",
+                                exc_info=True)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="pbox-straggler-watchdog")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)

@@ -38,7 +38,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import List, NamedTuple, Optional, Tuple
+from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -279,6 +279,20 @@ class ShardedEmbeddingTable:
     # ---- host save/load mirrors EmbeddingTable, per shard ----
     def feature_count(self) -> int:
         return sum(len(ix) for ix in self.indexes)
+
+    def obs_stats(self) -> Dict[str, float]:
+        """Occupancy gauges for pass events (obs/hub.emit_pass_event):
+        totals across shards plus the fullest shard's fill (the key%N
+        split skews, and one full shard stalls the whole mesh).
+        Subclasses with plan-pending rows (tiered) override to add
+        ``pending``."""
+        per_shard = [len(ix) for ix in self.indexes]
+        used = sum(per_shard)
+        cap = self.capacity * self.n
+        return {"capacity": cap, "used": used,
+                "fill_frac": round(used / max(cap, 1), 6),
+                "max_shard_fill_frac": round(
+                    max(per_shard) / max(self.capacity, 1), 6)}
 
     def _dump(self, path: str, row_filter) -> int:
         data = np.asarray(jax.device_get(self.state.data))

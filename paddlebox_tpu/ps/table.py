@@ -62,6 +62,19 @@ def _lane_onehot(sub: jax.Array, rpl: int, dtype) -> jax.Array:
             == sub.astype(jnp.int32)[:, None]).astype(dtype)
 
 
+def _lane_select(mask: jax.Array, values: jax.Array) -> jax.Array:
+    """Masked lane select: ``where(mask, values, 0)`` with the [N, rpl]
+    one-hot broadcast over the trailing feature axis. Semantically the
+    ``mask * values`` reduce every lane-packing site used to do, but
+    NaN-ISOLATING: ``0 * NaN`` is NaN, so one diverging row's NaN used
+    to bleed into every healthy row sharing its 128-lane storage line
+    (and, through the scatter-add transpose, into their updates) —
+    ``where`` keeps a NaN confined to its own lane span, which is what
+    lets telemetry localize a NaN to ONE key (round-5 advisor finding).
+    Exact f32 either way (select, no arithmetic)."""
+    return jnp.where(mask.astype(bool)[:, :, None], values, 0)
+
+
 def pack_geometry(capacity: int, feat: int):
     """(rows_per_line, f_pad, n_lines) for a [capacity+1, feat] logical
     table stored as [n_lines, 128] lane-aligned lines."""
@@ -364,6 +377,20 @@ def promote_window_delta(index, touched: np.ndarray, capacity: int,
         stats["evicted"] = len(ck)
     rows_new = index.assign(ins_keys)
     touched[rows_new] = False  # freshly loaded = clean
+    from paddlebox_tpu.obs.hub import get_hub
+    hub = get_hub()
+    if hub.active:  # per-pass window accounting → Prometheus counters
+        for k, help_txt in (("staged", "rows fetched+scattered into the "
+                             "HBM window"),
+                            ("resident", "working-set rows already "
+                             "resident at begin_pass"),
+                            ("evicted", "rows evicted under capacity "
+                             "pressure"),
+                            ("evicted_writeback", "dirty evictions "
+                             "written back to the host tier")):
+            if stats[k]:
+                hub.counter(f"pbox_table_{k}_rows_total",
+                            help_txt).inc(stats[k])
     return rows_new, still, stats
 
 
@@ -436,8 +463,9 @@ def gather_full_rows(state: TableState, unique_rows: jax.Array) -> jax.Array:
     grouped = lines.reshape(u, rpl, fp)
     onehot = _lane_onehot(rows % rpl, rpl, lines.dtype)   # [U, rpl]
     # elementwise mask+reduce, NOT einsum (default-precision dot_general
-    # would round through bf16 on TPU)
-    vals = (grouped * onehot[:, :, None]).sum(axis=1)
+    # would round through bf16 on TPU); where-select, NOT multiply, so a
+    # NaN row cannot bleed across its storage line (_lane_select)
+    vals = _lane_select(onehot, grouped).sum(axis=1)
     return vals[:, :state._feat] if fp != state._feat else vals
 
 
@@ -556,15 +584,46 @@ def warmup_begin_scatter(state: TableState, sharded: bool,
     return scatter_logical_rows(state, sh, oob, z, chunk=chunk)
 
 
+def aot_warmup_scatter(shape, dtype, sharded: bool, rpl: int, fp: int,
+                       feat: int, chunk: Optional[int] = None) -> float:
+    """AOT-compile the pass-boundary chunk scatter from
+    ``jax.ShapeDtypeStruct`` inputs — NO device buffers are allocated
+    (the old warmup materialized a throwaway TABLE-SIZED zeros buffer,
+    which could nondeterministically OOM a box whose HBM was already
+    committed to the live table + staging). The AOT executable does NOT
+    land in jit's dispatch cache, so the warmup's value rides the
+    PERSISTENT cache: the real begin_pass deserializes (~0.1-1 s)
+    instead of paying the ~20 s scatter compile — which is why the
+    on-disk cache is enabled HERE, before lowering (tables construct
+    before Trainer init, and jax decides cache put at compile
+    initiation; without this the warmup compiled into the void and
+    still reported ok). Returns compile seconds (telemetry)."""
+    import time as _time
+    from paddlebox_tpu.config import FLAGS as _F
+    from paddlebox_tpu.utils.compile_cache import enable_compilation_cache
+    enable_compilation_cache()
+    c = int(chunk or _F.scatter_chunk_rows)
+    fn = _scatter_chunk_fn(sharded, rpl, fp, feat)
+    sds = jax.ShapeDtypeStruct
+    args = [sds(shape, dtype)]
+    if sharded:
+        args.append(sds((c,), jnp.int32))
+    args += [sds((c,), jnp.int32), sds((c, feat), dtype)]
+    t0 = _time.perf_counter()
+    fn.lower(*args).compile()
+    return _time.perf_counter() - t0
+
+
 def start_scatter_warmup(state: TableState, sharded: bool) -> None:
     """Background-compile the pass-boundary chunk scatter at table
-    construction (FLAGS.warmup_pass_scatter): runs warmup_begin_scatter
-    on a THROWAWAY zero state of the live state's shape — same shapes →
-    same jitted executable, so the real begin_pass hits the compile
-    cache, while the live buffer is never donated behind the backs of
-    trainers that already adopted it. The transient costs one extra
-    table-sized device allocation during construction/staging, before
-    training starts."""
+    construction (FLAGS.warmup_pass_scatter) via ``aot_warmup_scatter``:
+    abstract ShapeDtypeStruct inputs mean the warmup costs ZERO device
+    memory — same shapes → same executable in the (persistent) compile
+    cache, and the live buffer is never donated behind the backs of
+    trainers that already adopted it. Outcome is emitted as a
+    ``scatter_warmup`` telemetry event either way (a silent warmup
+    failure used to be invisible until the first pass boundary stalled
+    ~20 s)."""
     from paddlebox_tpu.config import FLAGS
     if not FLAGS.warmup_pass_scatter:
         return
@@ -575,27 +634,27 @@ def start_scatter_warmup(state: TableState, sharded: bool) -> None:
     dtype = state.packed.dtype
 
     def run() -> None:
+        from paddlebox_tpu.obs.hub import get_hub
+        hub = get_hub()
         try:
-            # call the chunk executable DIRECTLY on a throwaway zeros
-            # buffer (donated) — going through scatter_logical_rows
-            # would add its jnp.copy and peak at 2x table size while
-            # the main thread stages the cold pass
-            from paddlebox_tpu.config import FLAGS as _F
-            c = int(_F.scatter_chunk_rows)
-            fn = _scatter_chunk_fn(sharded, rpl, fp, feat)
-            dummy = jnp.zeros(shape, dtype)
-            r_c = jnp.full((c,), n_lines * rpl, jnp.int32)
-            v_c = jnp.zeros((c, feat), dtype)
-            if sharded:
-                s_c = jnp.full((c,), shape[0], jnp.int32)
-                out = fn(dummy, s_c, r_c, v_c)
-            else:
-                out = fn(dummy, r_c, v_c)
-            jax.block_until_ready(out)
-        except Exception as e:  # OOM mid-construction etc. — warmup only
+            secs = aot_warmup_scatter(shape, dtype, sharded, rpl, fp,
+                                      feat)
+            if hub.active:
+                hub.counter("pbox_scatter_warmup_total",
+                            "pass-scatter warmup attempts").inc(
+                                outcome="ok")
+                hub.emit("scatter_warmup", outcome="ok",
+                         compile_sec=round(secs, 3),
+                         sharded=sharded, feat=feat)
+        except Exception as e:  # warmup only — training still works
             from paddlebox_tpu.utils.logging import get_logger
             get_logger(__name__).warning("pass-scatter warmup failed: %s",
                                          e)
+            if hub.active:
+                hub.counter("pbox_scatter_warmup_total",
+                            "pass-scatter warmup attempts").inc(
+                                outcome="failed")
+                hub.emit("scatter_warmup", outcome="failed", error=str(e))
 
     threading.Thread(target=run, daemon=True).start()
 
@@ -650,8 +709,10 @@ def expand_pull(values_u: jax.Array, gather_idx: jax.Array) -> jax.Array:
     onehot = _lane_onehot(gi % rpl, rpl, lines.dtype)  # [K, rpl]
     # elementwise mask+reduce, NOT einsum: a dot_general would run at
     # default (bf16-pass) matmul precision on TPU and break the exact-
-    # f32 contract of this op and its autodiff transpose
-    vals = (grouped * onehot[:, :, None]).sum(axis=1)
+    # f32 contract of this op and its autodiff transpose; where-select,
+    # NOT multiply, so a NaN unique row stays confined to its own keys
+    # (_lane_select — the transpose derives the same select)
+    vals = _lane_select(onehot, grouped).sum(axis=1)
     return vals[:, :d] if fp != d else vals
 
 
@@ -677,7 +738,7 @@ def merge_rows(values: jax.Array, idx: jax.Array,
     v = (values if fp == d else
          jnp.pad(values, ((0, 0), (0, fp - d))))
     onehot = _lane_onehot(idx % rpl, rpl, v.dtype)      # [M, rpl]
-    d_lines = (onehot[:, :, None] * v[:, None, :]).reshape(m, 128)
+    d_lines = _lane_select(onehot, v[:, None, :]).reshape(m, 128)
     out = jnp.zeros((num_segments // rpl, 128), v.dtype).at[
         idx // rpl].add(d_lines, mode="drop")
     out = out.reshape(num_segments, fp)
@@ -774,12 +835,14 @@ def apply_push(
     ], axis=1)
     rpl, fp, _ = state.geometry
     u = new_mat.shape[0]
-    delta = (new_mat - rows_full) * touched[:, None].astype(new_mat.dtype)
+    # where, not multiply: an untouched row holding NaN would otherwise
+    # turn its masked-out delta into NaN (0 * NaN) and poison the line
+    delta = jnp.where(touched[:, None], new_mat - rows_full, 0)
     if fp != state._feat:
         delta = jnp.concatenate(
             [delta, jnp.zeros((u, fp - state._feat), delta.dtype)], axis=1)
     onehot = _lane_onehot(unique_rows % rpl, rpl, delta.dtype)
-    d_lines = (onehot[:, :, None] * delta[:, None, :]).reshape(u, 128)
+    d_lines = _lane_select(onehot, delta[:, None, :]).reshape(u, 128)
     packed = state.packed.at[unique_rows // rpl].add(d_lines, mode="drop")
     # keep the sentinel row zero (defense in depth — pad deltas are
     # masked, but eval's miss collapse reads it)
@@ -1104,3 +1167,9 @@ class EmbeddingTable:
     @property
     def feature_count(self) -> int:
         return len(self.index)
+
+    def obs_stats(self) -> Dict[str, float]:
+        """Occupancy gauges for pass events (obs/hub.emit_pass_event)."""
+        used = len(self.index)
+        return {"capacity": self.capacity, "used": used,
+                "fill_frac": round(used / max(self.capacity, 1), 6)}

@@ -132,6 +132,14 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         self.last_pass_stats: Dict[str, int] = {}
         start_scatter_warmup(self.state, sharded=True)
 
+    def obs_stats(self) -> Dict[str, float]:
+        out = super().obs_stats()
+        # rows a future pass's plan build assigned before their values
+        # staged — they pin window capacity until begin_pass promotes
+        with self.host_lock:
+            out["pending"] = int(sum(len(p) for p in self._pending))
+        return out
+
     # ---- overlapped plan builds (preload_into_memory) ----------------
     @contextlib.contextmanager
     def plan_scope(self):

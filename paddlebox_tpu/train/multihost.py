@@ -20,7 +20,7 @@ bit-for-bit.
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, Optional
 
 import jax
 import numpy as np
@@ -87,3 +87,47 @@ def globalize_state(mesh: Mesh, state, state_spec) -> ShardedStepState:
         for sub, sp in zip(subtrees, spec_leaves)
     ]
     return jtu.tree_unflatten(spec_def, staged)
+
+
+# ---- heartbeat + straggler watchdog (obs/watchdog) ---------------------
+def make_straggler_watchdog(heartbeat_dir: Optional[str] = None,
+                            start: bool = True, **kwargs):
+    """Build the pod's straggler watchdog for THIS process.
+
+    Every process calls this once after ``jax.distributed.initialize``
+    and then calls ``wd.beat(step)`` once per pass (or step window): the
+    monitor thread flags any process whose step counter falls behind
+    the mesh front-runner by ``FLAGS.straggler_step_lag`` or whose
+    heartbeat goes stale past ``FLAGS.straggler_timeout_sec`` — finally
+    answering "WHICH host is stalling" when a collective hangs. With
+    ``FLAGS.straggler_abort_sec > 0`` a persistent stall makes the next
+    ``beat()`` raise ``StragglerTimeout`` so the launcher (elastic
+    runtime) can replace the rank instead of hanging forever.
+
+    ``heartbeat_dir`` must be shared across hosts (NFS/FUSE); defaults
+    to ``FLAGS.straggler_heartbeat_dir``. Single-process meshes get a
+    process-local store (still useful: stale-heartbeat detection fires
+    when the training thread wedges). ``kwargs`` override any
+    ``StragglerWatchdog`` parameter (tests inject ``clock``)."""
+    from paddlebox_tpu.config import FLAGS
+    from paddlebox_tpu.obs.watchdog import (DirHeartbeatStore,
+                                            LocalHeartbeatStore,
+                                            StragglerWatchdog)
+    hb_dir = heartbeat_dir or FLAGS.straggler_heartbeat_dir
+    if hb_dir:
+        store = DirHeartbeatStore(hb_dir)
+    elif jax.process_count() == 1:
+        store = LocalHeartbeatStore()
+    else:
+        raise ValueError(
+            "multihost watchdog needs a SHARED heartbeat dir: pass "
+            "heartbeat_dir= or set FLAGS.straggler_heartbeat_dir")
+    kw = dict(
+        step_lag=FLAGS.straggler_step_lag,
+        heartbeat_timeout=FLAGS.straggler_timeout_sec,
+        abort_after=(FLAGS.straggler_abort_sec
+                     if FLAGS.straggler_abort_sec > 0 else None))
+    kw.update(kwargs)
+    wd = StragglerWatchdog(store, jax.process_index(),
+                           jax.process_count(), **kw)
+    return wd.start() if start else wd

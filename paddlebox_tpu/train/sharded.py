@@ -712,7 +712,8 @@ class ShardedTrainer:
                 group, self.table.prepare_global(group))
 
         return prefetch_iter(self._group_iter(batches), prep,
-                             capacity=self.prefetch)
+                             capacity=self.prefetch,
+                             name="sharded.prepare")
 
     def train_pass(self, dataset, log_prefix: str = "") -> Dict[str, float]:
         from paddlebox_tpu.metrics import auc_compute
@@ -792,6 +793,10 @@ class ShardedTrainer:
                        if stats is not None else float("nan")))
         log.info("%ssharded pass done: %d global batches, %.0f ex/s, auc=%.4f",
                  log_prefix, nb, out["examples_per_sec"], res.auc)
+        from paddlebox_tpu.obs.hub import emit_pass_event
+        emit_pass_event("train_pass_sharded",
+                        dict(out, global_step=self.global_step),
+                        table=self.table, examples=int(res.ins_num))
         return out
 
     def _finalize_auc(self, auc) -> "AucState":
@@ -929,7 +934,8 @@ class ShardedTrainer:
                 group, self.table.prepare_global_eval(group))
 
         return prefetch_iter(self._group_iter(batches), prep,
-                             capacity=self.prefetch)
+                             capacity=self.prefetch,
+                             name="sharded.prepare_eval")
 
     # ---- device-resident passes over the mesh ----
     def build_resident_pass(self, dataset) -> "ShardedResidentPass":
@@ -1012,6 +1018,10 @@ class ShardedTrainer:
         log.info("%ssharded resident pass: %d global batches, %.0f ex/s, "
                  "auc=%.4f", log_prefix, rp.num_batches,
                  out["examples_per_sec"], res.auc)
+        from paddlebox_tpu.obs.hub import emit_pass_event
+        emit_pass_event("train_pass_resident_sharded",
+                        dict(out, global_step=self.global_step),
+                        table=self.table, examples=rp.num_records)
         return out
 
 

@@ -95,6 +95,11 @@ class Trainer:
         # per-pass stage timers (PrintSyncTimer role, box_wrapper.cc:1182)
         from paddlebox_tpu.utils.profiler import StageTimers
         self.stage_timers = StageTimers()
+        # attach flag-selected telemetry sinks (obs/hub; no-op when the
+        # telemetry flags are off)
+        from paddlebox_tpu.obs.hub import configure_from_flags
+        configure_from_flags()
+        self._pass_seq = 0
 
     # ---- host-side prefetch: batch build + dedup + row assign + H2D ----
     def _prefetch_iter(
@@ -118,8 +123,10 @@ class Trainer:
             with st.stage("h2d"):
                 return t[0], make_device_batch(t[0], t[1])
 
-        prepared = prefetch_iter(batches, do_prep, capacity=self.prefetch)
-        return prefetch_iter(prepared, do_h2d, capacity=self.prefetch)
+        prepared = prefetch_iter(batches, do_prep, capacity=self.prefetch,
+                                 name="trainer.prepare")
+        return prefetch_iter(prepared, do_h2d, capacity=self.prefetch,
+                             name="trainer.h2d")
 
     def set_dump(self, cfg) -> None:
         """Enable per-sample prediction dump for subsequent passes
@@ -144,11 +151,17 @@ class Trainer:
             from paddlebox_tpu.utils.dump import DumpWriter
             dump_writer = DumpWriter(self._dump_cfg)
         n_ex = 0
+        st = self.stage_timers
         for batch, dev in self._prefetch_iter(dataset.batches()):
             n_ex += int((batch.show > 0).sum())
             self.global_step += 1
             rng = jax.random.fold_in(self._rng, self.global_step)
-            self.state, stats = self.step_fn(self.state, dev, rng)
+            # "step" times the jit DISPATCH (host cost of launching the
+            # fused step; device completion is async) — with prepare/h2d
+            # on the prefetch threads, a slow pass now attributes to
+            # host dispatch vs starved prefetch vs device-bound
+            with st.stage("step"):
+                self.state, stats = self.step_fn(self.state, dev, rng)
             nb += 1
             if len(self.metrics):
                 # AddAucMonitor hook: feed registered metric variants.
@@ -156,9 +169,10 @@ class Trainer:
                 # on device, host metrics (wuauc) avoid a round trip;
                 # pred stays the device array (host metrics sync on it).
                 ins_w = (batch.show > 0).astype(np.float32)
-                self.metrics.add_batch(
-                    stats["pred"], batch.label, ins_w,
-                    uid=batch.uid, rank=batch.rank, cmatch=batch.cmatch)
+                with st.stage("metrics"):
+                    self.metrics.add_batch(
+                        stats["pred"], batch.label, ins_w,
+                        uid=batch.uid, rank=batch.rank, cmatch=batch.cmatch)
             if dump_writer is not None and nb % self._dump_cfg.interval == 0:
                 dump_writer.add_batch(
                     batch.ins_ids,
@@ -191,7 +205,22 @@ class Trainer:
                  log_prefix, nb, out["examples_per_sec"], res.auc)
         if FLAGS.profile:
             self.stage_timers.report(log_prefix)  # PrintSyncTimer role
+        self._emit_pass("train_pass", out, n_ex, stage_timers=True)
         return out
+
+    def _emit_pass(self, kind: str, out: Dict[str, float], examples: int,
+                   stage_timers: bool = False) -> None:
+        """Per-pass telemetry record (obs/hub.emit_pass_event); returns
+        immediately when no sink is attached."""
+        from paddlebox_tpu.obs.hub import emit_pass_event, get_hub
+        if not get_hub().active:
+            return
+        self._pass_seq += 1
+        emit_pass_event(
+            kind, dict(out, global_step=self.global_step,
+                       pass_seq=self._pass_seq),
+            stage_timers=self.stage_timers if stage_timers else None,
+            table=self.table, examples=examples)
 
     def _feed_registry_resident(self, rp, preds) -> None:
         """Post-pass metric registry feed (the per-batch AddAucMonitor
@@ -238,8 +267,13 @@ class Trainer:
         want_metrics = len(self.metrics) > 0
         timer = Timer()
         timer.start()
-        rp = (pass_or_dataset if isinstance(pass_or_dataset, ResidentPass)
-              else ResidentPass.build(pass_or_dataset, self.table))
+        self.stage_timers.reset()
+        st = self.stage_timers
+        if isinstance(pass_or_dataset, ResidentPass):
+            rp = pass_or_dataset
+        else:
+            with st.stage("build"):
+                rp = ResidentPass.build(pass_or_dataset, self.table)
         trivial = rp.segs is None
         wire = getattr(rp, "wire", "dedup")
         key = (rp.key_capacity, trivial, wire, rp.chunk_bits)
@@ -250,10 +284,13 @@ class Trainer:
                 num_slots=self.step_fn.num_slots,
                 chunk_bits=getattr(rp, "chunk_bits", None))
             self._resident_runners[key] = runner
-        self.state, preds = runner.run_pass(
-            self.state, rp, self._rng,
-            collect_preds=want_metrics and rp.side is not None)
-        jax.block_until_ready(self.state.step)
+        # "step" covers dispatch + device completion here (the resident
+        # loop is one XLA program; the block is the honest device time)
+        with st.stage("step"):
+            self.state, preds = runner.run_pass(
+                self.state, rp, self._rng,
+                collect_preds=want_metrics and rp.side is not None)
+            jax.block_until_ready(self.state.step)
         rp.mark_trained_rows(self.table)
         if want_metrics:
             if rp.side is None:
@@ -262,7 +299,8 @@ class Trainer:
                     "this pass was built from a non-columnar dataset; "
                     "use train_pass for metric variants here")
             else:
-                self._feed_registry_resident(rp, preds)
+                with st.stage("metrics"):
+                    self._feed_registry_resident(rp, preds)
         self.global_step += rp.num_batches
         timer.pause()
         self.sync_table()
@@ -277,6 +315,8 @@ class Trainer:
         log.info("%sresident pass done: %d batches, %.0f ex/s, auc=%.4f",
                  log_prefix, rp.num_batches, out["examples_per_sec"],
                  res.auc)
+        self._emit_pass("train_pass_resident", out, rp.num_records,
+                        stage_timers=True)
         return out
 
     def eval_pass(self, dataset: Dataset,
@@ -290,15 +330,18 @@ class Trainer:
         self.stage_timers.reset()
         it = self._prefetch_iter(dataset.batches(),
                                  prepare=self.table.prepare_eval)
+        st = self.stage_timers
         for batch, dev in it:
-            auc, pred = self.step_fn.eval(self.state.table,
-                                          self.state.params, auc, dev)
+            with st.stage("step"):
+                auc, pred = self.step_fn.eval(self.state.table,
+                                              self.state.params, auc, dev)
             if len(self.metrics):
                 # test-phase metric feed (same hook as train_pass)
-                self.metrics.add_batch(
-                    pred, batch.label,
-                    (batch.show > 0).astype(np.float32),
-                    uid=batch.uid, rank=batch.rank, cmatch=batch.cmatch)
+                with st.stage("metrics"):
+                    self.metrics.add_batch(
+                        pred, batch.label,
+                        (batch.show > 0).astype(np.float32),
+                        uid=batch.uid, rank=batch.rank, cmatch=batch.cmatch)
             nb += 1
         timer.pause()
         res = auc_compute(auc)
@@ -308,6 +351,8 @@ class Trainer:
                                                       1e-9))
         log.info("%seval pass: %d batches, auc=%.4f", log_prefix, nb,
                  res.auc)
+        self._emit_pass("eval_pass", out, int(res.ins_num),
+                        stage_timers=True)
         return out
 
     def sync_table(self) -> None:

@@ -13,11 +13,14 @@ U = TypeVar("U")
 
 
 def prefetch_iter(items: Iterable[T], prepare: Callable[[T], U],
-                  capacity: int = 4) -> Iterator[U]:
+                  capacity: int = 4,
+                  name: str | None = None) -> Iterator[U]:
     """Yield prepare(item) for each item, with preparation running in a
     producer thread up to `capacity` items ahead. Producer exceptions
-    re-raise at the consumer."""
-    ch: Channel = Channel(capacity=capacity)
+    re-raise at the consumer. ``name`` registers the backing channel's
+    pipeline gauges (depth/high-watermark/blocked time) with the
+    telemetry registry (utils.channel.channel_stats_snapshot)."""
+    ch: Channel = Channel(capacity=capacity, name=name)
     err: list = []
 
     def producer() -> None:

@@ -70,6 +70,18 @@ class ChromeTraceWriter:
                 **({"args": args} if args else {}),
             })
 
+    def complete(self, name: str, start_s: float, dur_s: float,
+                 **args) -> None:
+        """Record an already-timed span (the obs hub's span-sink entry:
+        ``start_s`` is a perf_counter reading from this process)."""
+        self._append({
+            "name": name, "ph": "X", "pid": 0,
+            "tid": threading.get_ident() & 0xFFFF,
+            "ts": (start_s - self._t0) * 1e6,
+            "dur": dur_s * 1e6,
+            **({"args": args} if args else {}),
+        })
+
     def instant(self, name: str, **args) -> None:
         self._append({
             "name": name, "ph": "i", "pid": 0, "s": "g",
@@ -149,6 +161,9 @@ class StageTimers:
 
     def as_dict(self) -> Dict[str, float]:
         return {k: t.elapsed_sec() for k, t in self._timers.items()}
+
+    def counts(self) -> Dict[str, int]:
+        return {k: t.count() for k, t in self._timers.items()}
 
 
 @contextlib.contextmanager

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Render a run's telemetry JSONL as a per-pass summary table.
+
+Usage: python scripts/telemetry_report.py RUN.jsonl [--events]
+
+Reads the event stream the TelemetryHub's JsonlSink wrote
+(FLAGS_telemetry_jsonl=..., or bench.py's BENCH_telemetry.jsonl) and
+prints one row per pass: throughput, stage breakdown, queue stalls
+(diffed from the cumulative channel counters between consecutive pass
+events of the same process), table occupancy and the HBM peak.
+``--events`` appends the non-pass events (stragglers, scatter warmups)
+at the end. Stdlib only — runs anywhere the JSONL lands.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List, Optional
+
+
+def load_events(path: str) -> List[dict]:
+    events = []
+    with open(path) as fh:
+        for ln, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                print(f"warning: {path}:{ln}: bad JSON line skipped",
+                      file=sys.stderr)
+    return events
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024 or unit == "TB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}TB"
+
+
+def _stage_cell(stage_sec: Dict[str, float], top: int = 4) -> str:
+    items = sorted(stage_sec.items(), key=lambda kv: -kv[1])[:top]
+    return " ".join(f"{k}={v:.3f}s" for k, v in items) or "-"
+
+
+def _chan_blocked(ch: Dict[str, dict]) -> Dict[str, float]:
+    return {name: st.get("blocked_put_sec", 0.0)
+            + st.get("blocked_get_sec", 0.0)
+            for name, st in ch.items()}
+
+
+def build_rows(events: List[dict]) -> List[Dict[str, str]]:
+    """Pass events → printable row dicts (the unit tests call this)."""
+    rows = []
+    prev_blocked: Dict[int, Dict[str, float]] = {}  # per process
+    for ev in events:
+        if ev.get("event") != "pass":
+            continue
+        proc = int(ev.get("proc", 0))
+        stall = ""
+        if "channels" in ev:
+            cur = _chan_blocked(ev["channels"])
+            prev = prev_blocked.get(proc, {})
+            delta = sum(v - prev.get(k, 0.0) for k, v in cur.items())
+            depth = sum(st.get("depth", 0)
+                        for st in ev["channels"].values())
+            stall = f"{max(delta, 0.0):.3f}s (depth {int(depth)})"
+            prev_blocked[proc] = cur
+        tbl = ""
+        if "table" in ev:
+            t = ev["table"]
+            if "used" in t and "capacity" in t:
+                tbl = f"{t['used']}/{t['capacity']}"
+            lp = t.get("last_pass")
+            if lp:
+                tbl += (f" (+{lp.get('staged', 0)} staged,"
+                        f" -{lp.get('evicted', 0)} evicted)")
+        hbm = ev.get("hbm", {})
+        rows.append({
+            "pass": str(ev.get("pass_seq", len(rows) + 1)),
+            "proc": str(proc),
+            "kind": str(ev.get("kind", "?")),
+            "batches": str(ev.get("batches", "?")),
+            "examples": str(ev.get("examples", "?")),
+            "ex/s": (f"{ev['examples_per_sec']:.0f}"
+                     if "examples_per_sec" in ev else "?"),
+            "wall": (f"{ev['elapsed_sec']:.3f}s"
+                     if "elapsed_sec" in ev else "?"),
+            "stages": _stage_cell(ev.get("stage_sec", {})),
+            "queue stall": stall or "-",
+            "table": tbl or "-",
+            "hbm peak": _fmt_bytes(hbm.get("peak_bytes_in_use", 0)),
+        })
+    return rows
+
+
+def render_table(rows: List[Dict[str, str]]) -> str:
+    if not rows:
+        return "no pass events"
+    cols = list(rows[0].keys())
+    widths = {c: max(len(c), *(len(r[c]) for r in rows)) for c in cols}
+    lines = ["  ".join(c.ljust(widths[c]) for c in cols),
+             "  ".join("-" * widths[c] for c in cols)]
+    for r in rows:
+        lines.append("  ".join(r[c].ljust(widths[c]) for c in cols))
+    return "\n".join(lines)
+
+
+def render_report(events: List[dict], show_events: bool = False) -> str:
+    rows = build_rows(events)
+    out = [render_table(rows)]
+    passes = [e for e in events if e.get("event") == "pass"]
+    if passes:
+        tot_ex = sum(e.get("examples", 0) or 0 for e in passes)
+        tot_wall = sum(e.get("elapsed_sec", 0.0) or 0.0 for e in passes)
+        out.append("")
+        out.append(f"{len(passes)} passes, {tot_ex} examples, "
+                   f"{tot_wall:.3f}s inside passes"
+                   + (f", {tot_ex / tot_wall:.0f} ex/s overall"
+                      if tot_wall > 0 else ""))
+    other = [e for e in events if e.get("event") != "pass"]
+    if other:
+        counts: Dict[str, int] = {}
+        for e in other:
+            counts[e.get("event", "?")] = counts.get(e.get("event", "?"),
+                                                     0) + 1
+        out.append("other events: " + ", ".join(
+            f"{k}×{v}" for k, v in sorted(counts.items())))
+        if show_events:
+            out.extend(json.dumps(e) for e in other)
+    return "\n".join(out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    show_events = "--events" in argv
+    paths = [a for a in argv if not a.startswith("--")]
+    if not paths:
+        print(__doc__, file=sys.stderr)
+        return 2
+    for path in paths:
+        if len(paths) > 1:
+            print(f"== {path}")
+        print(render_report(load_events(path), show_events))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -308,3 +308,71 @@ def test_merge_multi_models(tmp_path):
     d2 = np.asarray(jax.device_get(a2.state.data))
     r2 = a2.index.lookup(np.array([2], np.uint64))
     np.testing.assert_allclose(d2[r2, 0], 4.0)  # overwritten, not summed
+
+
+def test_nan_row_isolated_to_its_lane_span():
+    """A diverging row's NaN must NOT bleed into healthy rows sharing
+    its 128-lane storage line (the lane-packed gather/expand/push sites
+    select with ``where``, not a 0*NaN multiply) — this is what lets
+    telemetry localize a NaN to ONE key (ISSUE 1 satellite)."""
+    import jax
+    from paddlebox_tpu.ps.table import (TableState, expand_pull,
+                                        gather_full_rows, merge_rows)
+    cap, mf = 15, 8                      # feat 16 → 8 rows per line
+    data = np.zeros((cap + 1, 16), np.float32)
+    data[0, :] = np.nan                  # diverged row 0
+    data[1, 4] = 3.25                    # healthy neighbor, same line
+    ts = TableState.from_logical(data, cap)
+    healthy = np.asarray(gather_full_rows(ts, jnp.array([1], jnp.int32)))
+    assert np.isfinite(healthy).all()
+    assert healthy[0, 4] == 3.25
+    sick = np.asarray(gather_full_rows(ts, jnp.array([0], jnp.int32)))
+    assert np.isnan(sick[0]).all()       # the NaN row still reads NaN
+
+    # expand_pull fwd + transpose: u=16 uniques of D=8 (16 rows/line)
+    vals = np.zeros((16, 8), np.float32)
+    vals[3] = np.nan
+    vals[4] = 7.0
+    gi = jnp.array([4, 4, 3])
+    out = np.asarray(expand_pull(jnp.asarray(vals), gi))
+    assert np.isfinite(out[:2]).all() and np.isnan(out[2]).all()
+
+    def loss(v):
+        return expand_pull(v, gi)[:2].sum()   # healthy keys only
+
+    g = np.asarray(jax.grad(loss)(jnp.asarray(
+        np.where(np.isfinite(vals), vals, 0.0))))
+    assert np.isfinite(g).all()
+    assert g[4].sum() == 16.0            # 2 occurrences × 8 dims
+
+    # merge_rows line form: a NaN contribution stays in its segment
+    m = 4
+    big = 1 << 18                        # above the line-form crossover
+    mvals = np.ones((m, 8), np.float32)
+    mvals[0] = np.nan
+    idx = jnp.array([0, 1, 1, 2])        # rows 0..2 share a line
+    merged = np.asarray(merge_rows(jnp.asarray(mvals), idx, big))
+    assert np.isnan(merged[0]).all()
+    np.testing.assert_allclose(merged[1], 2.0)
+    np.testing.assert_allclose(merged[2], 1.0)
+
+
+def test_push_with_nan_neighbor_keeps_healthy_rows_finite():
+    """apply_push write-back: an untouched NaN row must not poison the
+    touched rows' scatter deltas on the shared line."""
+    from paddlebox_tpu.config import FLAGS
+    from paddlebox_tpu.ps.table import (TableState, apply_push)
+    from paddlebox_tpu.ps.sgd import SparseSGDConfig
+    import jax
+    cap, mf = 15, 8
+    cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=0.0)
+    data = np.zeros((cap + 1, 16), np.float32)
+    data[2, :] = np.nan                  # poisoned row on line 0
+    ts = TableState.from_logical(data, cap)
+    rows = jnp.array([1], jnp.int32)     # touch only the healthy row
+    grads = jnp.ones((1, 3 + mf), jnp.float32)
+    new = apply_push(ts, rows, grads, cfg, jax.random.PRNGKey(0))
+    out = np.asarray(new.data)
+    assert np.isfinite(out[1]).all(), "healthy touched row went NaN"
+    assert np.isnan(out[2]).any(), "NaN row should persist until shrink"
+    assert np.isfinite(out[0]).all() and np.isfinite(out[3:]).all()
