@@ -111,6 +111,33 @@ class Flags:
     # thread's next heartbeat raises StragglerTimeout
     straggler_abort_sec: float = 0.0
 
+    # --- resilience (resilience/; docs/RESILIENCE.md) ---
+    # RetryPolicy.from_flags defaults, applied at the IO seams
+    # (CommandBackend CLI calls, checkpoint file IO, dataset file opens)
+    retry_max_attempts: int = 4
+    retry_base_delay_sec: float = 0.05
+    retry_max_delay_sec: float = 2.0
+    # wall-clock cap for one retried operation (<=0 = no deadline)
+    retry_deadline_sec: float = 30.0
+    # backoff jitter fraction in [0,1]; seeded from FLAGS.seed + site,
+    # so delay sequences are deterministic per run seed
+    retry_jitter: float = 0.25
+    # kill a hung CommandBackend CLI after this many seconds (<=0 = none)
+    command_timeout_sec: float = 300.0
+    # max dataset files quarantined per load before the load fails
+    # (0 = quarantine disabled: first bad file aborts, the seed behavior)
+    poison_budget_files: int = 0
+    # max dropped/corrupt records tolerated per FILE before the file is
+    # declared poisoned and quarantined (-1 = unlimited silent drops,
+    # the seed behavior)
+    poison_budget_records: int = -1
+    # bounded retry-from-last-checkpoint attempts in Trainer.run_pass
+    # (0 = a failed pass raises immediately)
+    pass_retry_limit: int = 0
+    # deterministic fault-injection plan spec (resilience/faults.py
+    # grammar, e.g. "file_mgr.command:fail:nth=1"); "" = no injection
+    fault_plan: str = ""
+
     # --- runtime ---
     profile: bool = False
     log_period_steps: int = 100

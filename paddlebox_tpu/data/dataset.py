@@ -20,7 +20,7 @@ from __future__ import annotations
 import glob as globlib
 import random
 import threading
-from typing import Callable, Iterator, List, Optional, Sequence
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -29,10 +29,31 @@ from paddlebox_tpu.data.batch import BatchBuilder, SlotBatch
 from paddlebox_tpu.data.parser import get_parser
 from paddlebox_tpu.data.record import SlotRecord
 from paddlebox_tpu.data.schema import DataFeedDesc
-from paddlebox_tpu.utils import Channel, stat_add
+from paddlebox_tpu.resilience import faults
+from paddlebox_tpu.resilience.retry import RetryPolicy, TransientError
+from paddlebox_tpu.utils import Channel, ChannelClosed, stat_add
 from paddlebox_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
+
+
+class PoisonedFileError(RuntimeError):
+    """A file blew its per-file poison-record budget
+    (``FLAGS.poison_budget_records``): more lines failed to parse than
+    the budget tolerates — the file is treated as corrupt as a whole and
+    becomes a quarantine candidate."""
+
+    def __init__(self, path: str, bad: int, budget: int) -> None:
+        super().__init__(
+            f"{path}: {bad} unparseable record(s) exceeds the per-file "
+            f"poison budget ({budget}) — file is poisoned")
+        self.path = path
+        self.bad = bad
+
+
+class PoisonBudgetExceeded(RuntimeError):
+    """The load quarantined more files than ``FLAGS.poison_budget_files``
+    allows — the pass is broken beyond graceful degradation."""
 
 
 def shard_filelist(files: Sequence[str], rank: Optional[int] = None,
@@ -94,6 +115,9 @@ class Dataset:
         self.filelist: List[str] = []
         self.thread_num = FLAGS.read_thread_num
         self._builder: Optional[BatchBuilder] = None
+        # files isolated by the current/last load: [(path, error_repr)]
+        self.quarantined_files: List[Tuple[str, str]] = []
+        self._quarantine_lock = threading.Lock()
 
     # --- config surface (mirrors dataset.py setters) ---
     def set_feed_desc(self, desc: DataFeedDesc) -> None:
@@ -128,6 +152,37 @@ class Dataset:
             self._builder = BatchBuilder(self.desc)
         return self._builder
 
+    # --- failure isolation (docs/RESILIENCE.md) ---
+    def _reset_quarantine(self) -> None:
+        with self._quarantine_lock:
+            self.quarantined_files = []
+
+    def _quarantine(self, path: str, exc: BaseException) -> bool:
+        """Try to isolate a per-file failure instead of killing the load.
+        Returns False (caller must abort) when the failure is not
+        file-scoped (consumer gone / interrupt) or the quarantine budget
+        (``FLAGS.poison_budget_files``) is spent."""
+        if not isinstance(exc, Exception) or isinstance(exc, ChannelClosed):
+            return False  # consumer-side close / interrupt: not the file
+        budget = FLAGS.poison_budget_files
+        with self._quarantine_lock:
+            if budget <= 0 or len(self.quarantined_files) >= budget:
+                return False
+            self.quarantined_files.append((path, repr(exc)))
+        log.warning("quarantined bad file %s: %r (budget %d/%d)", path,
+                    exc, len(self.quarantined_files), budget)
+        stat_add("files_quarantined", 1)
+        try:
+            from paddlebox_tpu.obs.hub import get_hub
+            hub = get_hub()
+            hub.counter("pbox_files_quarantined_total",
+                        "dataset files isolated after a failure").inc()
+            if hub.active:
+                hub.emit("file_quarantined", path=path, error=repr(exc))
+        except Exception:
+            log.debug("quarantine telemetry emit failed", exc_info=True)
+        return True
+
     # --- reading ---
     def _read_files_into(self, files: Sequence[str], out: Channel,
                          n_threads: int) -> "ReaderGroup":
@@ -139,49 +194,90 @@ class Dataset:
         group = ReaderGroup()
 
         pipe_cmd = self.desc.pipe_command
+        record_budget = FLAGS.poison_budget_records
+        open_retry = RetryPolicy.from_flags(
+            site="dataset.open", retryable=(OSError, TransientError))
 
-        def parse_lines(parser, lines) -> tuple:
+        def parse_lines(parser, lines, path) -> tuple:
             n_ok = n_bad = 0
             for line in lines:
+                line = faults.inject("parser.record", line, path=path)
                 rec = parser.parse(line)
                 if rec is None:
                     n_bad += 1
+                    if 0 <= record_budget < n_bad:
+                        raise PoisonedFileError(path, n_bad, record_budget)
                     continue
                 out.put(rec)
                 n_ok += 1
             return n_ok, n_bad
 
+        def open_file(path: str, mode: str):
+            # the fault seam sits INSIDE the retried callable, so an
+            # injected (or real) transient open failure exercises the
+            # retry before it can count against the quarantine budget
+            faults.inject("dataset.open", path=path)
+            return open(path, mode)
+
+        def read_one(parser, path: str) -> None:
+            faults.inject("reader.file", path=path)
+            if pipe_cmd:
+                # LoadIntoMemoryByCommand (data_feed.h:1674): the
+                # file streams through a shell command; the parser
+                # consumes its stdout
+                import subprocess
+                with open_retry.call(open_file, path, "rb") as fh:
+                    proc = subprocess.Popen(
+                        pipe_cmd, shell=True, stdin=fh,
+                        stdout=subprocess.PIPE, text=True)
+                    try:
+                        n_ok, n_bad = parse_lines(parser, proc.stdout,
+                                                  path)
+                    except BaseException:
+                        proc.kill()  # don't leak a blocked child
+                        proc.wait()
+                        raise
+                    if proc.wait() != 0:
+                        raise RuntimeError(
+                            f"pipe_command {pipe_cmd!r} failed "
+                            f"(rc={proc.returncode}) on {path}")
+            else:
+                with open_retry.call(open_file, path, "r") as fh:
+                    n_ok, n_bad = parse_lines(parser, fh, path)
+            stat_add("records_parsed", n_ok)
+            stat_add("records_dropped", n_bad)
+            if n_bad:
+                from paddlebox_tpu.obs.hub import get_hub
+                get_hub().counter(
+                    "pbox_records_poisoned_total",
+                    "records dropped as unparseable").inc(n_bad)
+
         def worker() -> None:
-            try:
-                parser = parser_factory()
-                for path in file_ch:
-                    if pipe_cmd:
-                        # LoadIntoMemoryByCommand (data_feed.h:1674): the
-                        # file streams through a shell command; the parser
-                        # consumes its stdout
-                        import subprocess
-                        with open(path, "rb") as fh:
-                            proc = subprocess.Popen(
-                                pipe_cmd, shell=True, stdin=fh,
-                                stdout=subprocess.PIPE, text=True)
-                            try:
-                                n_ok, n_bad = parse_lines(parser,
-                                                          proc.stdout)
-                            except BaseException:
-                                proc.kill()  # don't leak a blocked child
-                                proc.wait()
-                                raise
-                            if proc.wait() != 0:
-                                raise RuntimeError(
-                                    f"pipe_command {pipe_cmd!r} failed "
-                                    f"(rc={proc.returncode}) on {path}")
+            parser = parser_factory()
+            for path in file_ch:
+                try:
+                    read_one(parser, path)
+                except BaseException as e:
+                    # isolate the failure to this file when the poison
+                    # budget allows; surviving readers drain the rest of
+                    # the file list
+                    if self._quarantine(path, e):
+                        continue
+                    budget = FLAGS.poison_budget_files
+                    if (budget > 0 and isinstance(e, Exception)
+                            and not isinstance(e, ChannelClosed)):
+                        # budget was on and is now spent: name the
+                        # condition instead of surfacing whatever the
+                        # last file happened to raise
+                        wrapped = PoisonBudgetExceeded(
+                            f"quarantine budget exhausted "
+                            f"({budget} file(s), FLAGS.poison_budget_"
+                            f"files) and {path} also failed: {e!r}")
+                        wrapped.__cause__ = e
+                        group.errors.append(wrapped)
                     else:
-                        with open(path, "r") as fh:
-                            n_ok, n_bad = parse_lines(parser, fh)
-                    stat_add("records_parsed", n_ok)
-                    stat_add("records_dropped", n_bad)
-            except BaseException as e:
-                group.errors.append(e)
+                        group.errors.append(e)
+                    return
 
         group.threads = [threading.Thread(target=worker, daemon=True)
                          for _ in range(max(1, n_threads))]
@@ -192,7 +288,9 @@ class Dataset:
 
 class ReaderGroup:
     """Reader threads + their errors; join() re-raises the first failure so
-    a dead reader never silently truncates a pass."""
+    a dead reader never silently truncates a pass (per-file failures that
+    fit the poison budget are quarantined by the dataset instead and never
+    reach ``errors``)."""
 
     def __init__(self) -> None:
         self.threads: List[threading.Thread] = []
@@ -224,6 +322,7 @@ class InMemoryDataset(Dataset):
     def load_into_memory(self) -> None:
         if not self.filelist:
             raise ValueError("set_filelist first")
+        self._reset_quarantine()
         # native columnar fast path: only for the plain in-memory dataset —
         # subclasses (PaddleBoxDataset) run record-level pass protocols
         # (global shuffle / key merge) that need SlotRecord objects
@@ -247,6 +346,10 @@ class InMemoryDataset(Dataset):
         self._pass_keys = None
         log.info("loaded %d records from %d files",
                  len(self.records), len(self.filelist))
+        if self.quarantined_files:
+            log.warning("load quarantined %d file(s): %s",
+                        len(self.quarantined_files),
+                        [p for p, _ in self.quarantined_files])
         if self._merge_size is not None:
             self.merge_records_by_insid()
 
@@ -260,12 +363,46 @@ class InMemoryDataset(Dataset):
 
         from paddlebox_tpu.data.columnar import ColumnarRecords
         parser = get_parser(self.desc)
-        probe = parser.parse_file_columnar(self.filelist[0])
-        if probe is None:
-            return False
-        rest = self.filelist[1:]
+
+        def parse_guarded(path: str):
+            """Per-file isolation for the native path: a file whose bulk
+            parse fails is quarantined (budget permitting) instead of
+            killing the load; returns None for a quarantined file."""
+            try:
+                faults.inject("dataset.open", path=path)
+                return parser.parse_file_columnar(path)
+            except Exception as e:
+                if self._quarantine(path, e):
+                    return None
+                if FLAGS.poison_budget_files > 0:
+                    raise PoisonBudgetExceeded(
+                        f"quarantine budget exhausted "
+                        f"({FLAGS.poison_budget_files} file(s), FLAGS."
+                        f"poison_budget_files) and {path} also failed: "
+                        f"{e!r}") from e
+                raise
+
+        # probe the first healthy file for a native fast path at all;
+        # on fallback the per-line path re-reads EVERY file, so any
+        # quarantine state this aborted attempt accumulated is reset
+        # (budget returned, no stale/duplicate entries)
+        probe = None
+        rest: List[str] = []
+        for i, path in enumerate(self.filelist):
+            probe = parse_guarded(path)
+            if probe is not None:
+                rest = list(self.filelist[i + 1:])
+                break
+            if not self.quarantined_files or \
+                    self.quarantined_files[-1][0] != path:
+                self._reset_quarantine()
+                return False  # no native parser — per-line fallback
+        else:
+            self._reset_quarantine()
+            return False  # every file quarantined (or list empty)
         with ThreadPoolExecutor(max(1, self.thread_num)) as ex:
-            chunks = [probe] + list(ex.map(parser.parse_file_columnar, rest))
+            chunks = [probe] + [c for c in ex.map(parse_guarded, rest)
+                                if c is not None]
         n_rec = sum(len(c["label"]) for c in chunks)
         n_drop = sum(int(c.get("dropped", 0)) for c in chunks)
         offsets = np.zeros(n_rec + 1, np.int64)
@@ -487,6 +624,7 @@ class QueueDataset(Dataset):
     def batches(self) -> Iterator[SlotBatch]:
         if not self.filelist:
             raise ValueError("set_filelist first")
+        self._reset_quarantine()
         ch: Channel[SlotRecord] = Channel(capacity=FLAGS.channel_capacity,
                                           block_size=self.desc.batch_size,
                                           name="dataset.stream_records")

@@ -341,6 +341,14 @@ def emit_pass_event(kind: str, metrics: Dict, stage_timers=None,
     except Exception:
         hbm = {"bytes_in_use": 0, "peak_bytes_in_use": 0, "bytes_limit": 0}
     ev["hbm"] = hbm
+    # resilience counters (retries/quarantines/faults/pass retries) ride
+    # every pass event so chaos runs are diagnosable from the JSONL
+    # alone (docs/RESILIENCE.md; zeros ship for consumer uniformity)
+    try:
+        from paddlebox_tpu.resilience.retry import retry_counters
+        ev["resilience"] = retry_counters()
+    except Exception:
+        pass
     hub.gauge("pbox_hbm_bytes_in_use",
               "device bytes in use").set(hbm["bytes_in_use"])
     hub.gauge("pbox_hbm_peak_bytes",

@@ -94,6 +94,40 @@ class DirHeartbeatStore:
         return out
 
 
+def requeue_pass_action(handler: Callable[[List[StragglerReport]], None],
+                        name: str = "requeue_pass"):
+    """Escalation action factory: hand the stalled pass back to a
+    scheduler/launcher callback (e.g. re-enqueue the pass spec so a
+    healthy rank set re-runs it)."""
+    def action(wd: "StragglerWatchdog", reports, stalled_for: float):
+        handler(reports)
+    action.escalation_name = name
+    return action
+
+
+def abort_with_checkpoint_action(save_fn: Callable[[], object],
+                                 name: str = "abort_with_checkpoint"):
+    """Escalation action factory: snapshot state (``save_fn``) and THEN
+    arm the abort, so the StragglerTimeout the training thread sees on
+    its next ``beat()`` loses no progress.
+
+    ``save_fn`` runs on the MONITOR thread while the local training
+    thread may still be mid-pass (e.g. when a *remote* rank is the
+    straggler) — it must be safe under concurrent training: either
+    snapshot pass-boundary state only (a CheckpointManager save of the
+    last synced table is), or set a flag the training loop consumes at
+    its next safe point rather than touching live trainer state."""
+    def action(wd: "StragglerWatchdog", reports, stalled_for: float):
+        try:
+            save_fn()
+        except Exception:
+            log.error("escalation checkpoint save failed — aborting "
+                      "without a fresh snapshot", exc_info=True)
+        wd.arm_abort(reports, stalled_for)
+    action.escalation_name = name
+    return action
+
+
 class StragglerWatchdog:
     def __init__(
         self,
@@ -108,10 +142,20 @@ class StragglerWatchdog:
         = None,
         clock: Callable[[], float] = time.time,
         hub=None,
+        escalations: Optional[List[Tuple[float, Callable]]] = None,
     ) -> None:
         """``clock`` is injectable so tests simulate stalls without
         sleeping; heartbeats carry this clock's timestamps, so every
-        process of one job must use the same clock source."""
+        process of one job must use the same clock source.
+
+        ``escalations`` is a ladder of ``(after_sec, action)`` rungs:
+        once a stall has persisted ``after_sec`` seconds, ``action(wd,
+        reports, stalled_for)`` fires (once per stall episode). Built-in
+        actions: :func:`requeue_pass_action`,
+        :func:`abort_with_checkpoint_action`, and :meth:`arm_abort`
+        (what the legacy ``abort_after=`` shorthand installs). Every
+        detection already logs + emits the ``straggler`` event, so the
+        ladder only needs the *reactions*."""
         self.store = store
         self.process_index = process_index
         self.num_processes = num_processes
@@ -128,6 +172,16 @@ class StragglerWatchdog:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.last_report: List[StragglerReport] = []
+        self.escalations: List[Tuple[float, Callable]] = sorted(
+            escalations or [], key=lambda e: e[0])
+        if abort_after is not None:
+            # legacy shorthand == top rung of the ladder
+            def _abort(wd, reports, stalled):
+                wd.arm_abort(reports, stalled)
+            _abort.escalation_name = "abort"
+            self.escalations.append((abort_after, _abort))
+            self.escalations.sort(key=lambda e: e[0])
+        self._fired_rungs: set = set()
 
     def _get_hub(self):
         if self._hub is None:
@@ -186,10 +240,30 @@ class StragglerWatchdog:
         self.last_report = reports
         return reports
 
+    def arm_abort(self, reports: List[StragglerReport],
+                  stalled_for: float) -> None:
+        """Final escalation rung: the training thread's NEXT ``beat()``
+        raises StragglerTimeout (the safe place to abort — raising in
+        the monitor thread would vanish). Idempotent."""
+        if self._abort_exc is not None:
+            return
+        desc = "; ".join(
+            f"proc {r.process}: {r.reason} (step={r.step}, "
+            f"behind={r.behind}, age={r.age_sec:.1f}s)" for r in reports)
+        self._abort_exc = StragglerTimeout(
+            f"mesh stalled {stalled_for:.1f}s: {desc}")
+        log.error("straggler watchdog: abort armed — next beat() "
+                  "raises StragglerTimeout")
+        hub = self._get_hub()
+        if hub.active:
+            hub.emit("straggler_abort",
+                     stalled_for_sec=round(stalled_for, 3))
+
     def _handle(self, reports: List[StragglerReport]) -> None:
         now = self.clock()
         if not reports:
             self._stall_since = None
+            self._fired_rungs.clear()  # next stall re-climbs the ladder
             return
         if self._stall_since is None:
             self._stall_since = now
@@ -207,17 +281,27 @@ class StragglerWatchdog:
                      stragglers=[r._asdict() for r in reports])
         if self.on_straggler is not None:
             self.on_straggler(reports)
-        if (self.abort_after is not None
-                and stalled_for >= self.abort_after
-                and self._abort_exc is None):
-            self._abort_exc = StragglerTimeout(
-                f"mesh stalled {stalled_for:.1f}s "
-                f"(> {self.abort_after}s): {desc}")
-            log.error("straggler watchdog: abort armed — next beat() "
-                      "raises StragglerTimeout")
+        # climb the escalation ladder: each rung fires once per stall
+        for i, (after_sec, action) in enumerate(self.escalations):
+            if i in self._fired_rungs or stalled_for < after_sec:
+                continue
+            self._fired_rungs.add(i)
+            name = getattr(action, "escalation_name",
+                           getattr(action, "__name__", f"rung{i}"))
+            log.warning("straggler escalation %r fired "
+                        "(stalled %.1fs >= %.1fs)", name, stalled_for,
+                        after_sec)
             if hub.active:
-                hub.emit("straggler_abort",
+                hub.counter("pbox_straggler_escalations_total",
+                            "escalation rungs fired").inc(action=name)
+                hub.emit("straggler_escalation", action=name,
+                         after_sec=after_sec,
                          stalled_for_sec=round(stalled_for, 3))
+            try:
+                action(self, reports, stalled_for)
+            except Exception:
+                log.error("straggler escalation %r failed", name,
+                          exc_info=True)
 
     def poll_once(self) -> List[StragglerReport]:
         """check() + alerting/abort arming — one monitor iteration."""

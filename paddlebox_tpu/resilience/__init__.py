@@ -1,0 +1,39 @@
+"""Resilience layer: retry/backoff policies, deterministic fault
+injection, and pass-level recovery primitives.
+
+The reference system survives day-scale production runs because AIBox
+tolerates flaky AFS/HDFS IO and node hiccups around the BoxPS core
+(SURVEY.md §5; the hadoop CLI is retried at the shell layer and a bad
+pass is re-fed). This package gives the TPU-native stack the same
+property, provably:
+
+- :mod:`paddlebox_tpu.resilience.retry` — ``RetryPolicy``: exponential
+  backoff with seeded jitter, attempt/deadline caps, and a
+  retryable-exception classification, applied at the IO seams
+  (CommandBackend, checkpoint file IO, dataset file opens).
+- :mod:`paddlebox_tpu.resilience.faults` — ``FaultPlan``: a
+  deterministic, seed-driven fault-injection harness installable at the
+  FileMgr/parser/checkpoint seams so recovery paths are exercised by
+  tests (tests/test_resilience.py, scripts/chaos_check.py) instead of
+  hoped-for.
+
+Everything emits through the obs/ TelemetryHub (``pbox_retry_*``,
+``pbox_files_quarantined_total``, ``pbox_faults_injected_total``,
+``pbox_pass_retries_total`` — docs/RESILIENCE.md has the catalog).
+"""
+
+from paddlebox_tpu.resilience.retry import (RetryExhausted, RetryPolicy,
+                                            TransientError, is_retryable)
+from paddlebox_tpu.resilience.faults import (FaultPlan, FaultSpec,
+                                             InjectedCrash, InjectedFault,
+                                             TransientInjectedError,
+                                             active_plan, clear_plan,
+                                             inject, install_plan,
+                                             installed)
+
+__all__ = [
+    "RetryPolicy", "RetryExhausted", "TransientError", "is_retryable",
+    "FaultPlan", "FaultSpec", "InjectedFault", "InjectedCrash",
+    "TransientInjectedError", "inject", "install_plan", "clear_plan",
+    "active_plan", "installed",
+]

@@ -22,18 +22,48 @@ the delta chain up to the requested step. Retention keeps the last
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import pickle
 import shutil
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import jax
 import numpy as np
 
+from paddlebox_tpu.resilience import faults
+from paddlebox_tpu.resilience.retry import RetryPolicy
 from paddlebox_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
+
+#: files whose content digests are recorded in meta.json and verified
+#: on restore (meta.json itself can't self-checksum)
+_CHECKSUMMED = ("sparse.npz", "sparse_delta.npz", "dense.pkl")
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint file's content digest does not match its meta.json
+    record — the chain link is corrupt and must not be restored."""
+
+
+def _digest(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        while True:
+            buf = fh.read(chunk)
+            if not buf:
+                break
+            h.update(buf)
+    return h.hexdigest()
+
+
+def _io_retry() -> RetryPolicy:
+    """Checkpoint file IO runs under the flag-configured retry policy
+    (transient NFS/FUSE hiccups on shared checkpoint roots)."""
+    return RetryPolicy.from_flags(site="checkpoint.io",
+                                  retryable=(OSError,))
 
 
 class CheckpointManager:
@@ -86,8 +116,30 @@ class CheckpointManager:
         return steps[-1] if steps else None
 
     def _meta(self, step: int) -> dict:
-        with open(os.path.join(self._dir(step), "meta.json")) as fh:
-            return json.load(fh)
+        def read() -> dict:
+            path = os.path.join(self._dir(step), "meta.json")
+            faults.inject("checkpoint.io", path=path)
+            with open(path) as fh:
+                return json.load(fh)
+        return _io_retry().call(read)
+
+    def verify(self, step: int) -> None:
+        """Check every checksummed file in ``ckpt-<step>`` against its
+        meta.json digest; raises ``CheckpointCorruptError`` on mismatch.
+        Checkpoints written before checksums existed (no ``checksums``
+        key) verify trivially."""
+        meta = self._meta(step)
+        d = self._dir(step)
+        for name, want in meta.get("checksums", {}).items():
+            p = os.path.join(d, name)
+            got = _io_retry().call(_digest, p)
+            if got != want:
+                raise CheckpointCorruptError(
+                    f"checkpoint {d}/{name} is corrupt: sha256 {got[:12]}… "
+                    f"!= recorded {want[:12]}… — refuse to restore this "
+                    f"chain link. Delete {d} and restore an older "
+                    "base (restore(step=...)), or resave from a healthy "
+                    "trainer.")
 
     # ---- save ----
     def save(self, trainer, step: Optional[int] = None,
@@ -122,21 +174,35 @@ class CheckpointManager:
             n = trainer.table.save_delta(os.path.join(tmp, "sparse_delta.npz"))
         else:
             n = trainer.table.save_base(os.path.join(tmp, "sparse.npz"))
-        with open(os.path.join(tmp, "dense.pkl"), "wb") as fh:
-            if hasattr(trainer, "dense_snapshot"):
-                # pod-safe hook: per-shard AUC leaves are not host-
-                # addressable on a multi-controller mesh
-                blob = trainer.dense_snapshot()
-            else:
-                blob = jax.device_get(
-                    (trainer.state.params, trainer.state.opt_state,
-                     trainer.state.auc))
-            pickle.dump(blob, fh)
+        def write_dense() -> None:
+            faults.inject("checkpoint.io", path=os.path.join(tmp,
+                                                             "dense.pkl"))
+            with open(os.path.join(tmp, "dense.pkl"), "wb") as fh:
+                if hasattr(trainer, "dense_snapshot"):
+                    # pod-safe hook: per-shard AUC leaves are not host-
+                    # addressable on a multi-controller mesh
+                    blob = trainer.dense_snapshot()
+                else:
+                    blob = jax.device_get(
+                        (trainer.state.params, trainer.state.opt_state,
+                         trainer.state.auc))
+                pickle.dump(blob, fh)
+        _io_retry().call(write_dense)
+        # content digests: restore refuses a bit-rotted chain link
+        # instead of silently loading garbage rows
+        checksums: Dict[str, str] = {
+            name: _digest(os.path.join(tmp, name))
+            for name in _CHECKSUMMED
+            if os.path.isfile(os.path.join(tmp, name))}
         with open(os.path.join(tmp, "meta.json"), "w") as fh:
             json.dump({"step": step, "kind": "delta" if delta else "base",
                        "base_step": base_step,
                        "prev_step": prev_step if delta else None,
-                       "sparse_rows": n}, fh)
+                       "sparse_rows": n, "checksums": checksums}, fh)
+        # chaos seam: a "fail" fault here models the process dying after
+        # writing the temp dir but BEFORE the atomic publish — recovery
+        # must come from the rename convention (tests/test_resilience.py)
+        faults.inject("checkpoint.save_commit", step=step)
         final = self._dir(step)
         if os.path.isdir(final):
             # move the old dir aside BEFORE the swap — a crash between the
@@ -192,6 +258,8 @@ class CheckpointManager:
         if target is None:
             return None
         chain = self._chain(target)
+        for s in chain:  # verify the WHOLE chain before touching state
+            self.verify(s)
         first = True
         for s in chain:
             d = self._dir(s)
@@ -203,8 +271,12 @@ class CheckpointManager:
                 trainer.table.load(os.path.join(d, "sparse_delta.npz"),
                                    merge=True)
             first = False
-        with open(os.path.join(self._dir(target), "dense.pkl"), "rb") as fh:
-            params, opt_state, auc = pickle.load(fh)
+        def read_dense():
+            path = os.path.join(self._dir(target), "dense.pkl")
+            faults.inject("checkpoint.io", path=path)
+            with open(path, "rb") as fh:
+                return pickle.load(fh)
+        params, opt_state, auc = _io_retry().call(read_dense)
         if hasattr(trainer, "dense_snapshot"):
             # the trainer handles placement itself (pod staging) — a
             # device_put here would just round-trip device→host→device

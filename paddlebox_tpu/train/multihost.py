@@ -108,7 +108,10 @@ def make_straggler_watchdog(heartbeat_dir: Optional[str] = None,
     to ``FLAGS.straggler_heartbeat_dir``. Single-process meshes get a
     process-local store (still useful: stale-heartbeat detection fires
     when the training thread wedges). ``kwargs`` override any
-    ``StragglerWatchdog`` parameter (tests inject ``clock``)."""
+    ``StragglerWatchdog`` parameter (tests inject ``clock``; pass
+    ``escalations=[(after_sec, action), ...]`` for the staged
+    emit→requeue→abort-with-checkpoint ladder — obs/watchdog has the
+    built-in action factories)."""
     from paddlebox_tpu.config import FLAGS
     from paddlebox_tpu.obs.watchdog import (DirHeartbeatStore,
                                             LocalHeartbeatStore,

@@ -99,6 +99,10 @@ class Trainer:
         # telemetry flags are off)
         from paddlebox_tpu.obs.hub import configure_from_flags
         configure_from_flags()
+        # install the env-selected fault plan (no-op without
+        # FLAGS.fault_plan; chaos runs need no code changes)
+        from paddlebox_tpu.resilience.faults import install_from_flags
+        install_from_flags()
         self._pass_seq = 0
 
     # ---- host-side prefetch: batch build + dedup + row assign + H2D ----
@@ -207,6 +211,56 @@ class Trainer:
             self.stage_timers.report(log_prefix)  # PrintSyncTimer role
         self._emit_pass("train_pass", out, n_ex, stage_timers=True)
         return out
+
+    def run_pass(self, dataset: Dataset, checkpoint=None,
+                 log_prefix: str = "", resident: bool = False,
+                 max_retries: Optional[int] = None) -> Dict[str, float]:
+        """``train_pass`` with bounded retry-from-last-checkpoint
+        (docs/RESILIENCE.md §pass-level recovery).
+
+        A pass that dies on a *recoverable* error (transient IO /
+        injected fault / nan-inf guard) is retried up to
+        ``FLAGS.pass_retry_limit`` (override with ``max_retries``)
+        times. With a ``checkpoint`` (CheckpointManager), each retry
+        first rolls the trainer back to the last consistent step, so a
+        partially-applied pass never compounds; without one the retry
+        re-runs from current state (logged — only safe for idempotent
+        passes). Non-recoverable errors and exhausted budgets raise."""
+        from paddlebox_tpu.resilience import faults
+        from paddlebox_tpu.resilience.retry import is_retryable
+        limit = (FLAGS.pass_retry_limit if max_retries is None
+                 else max_retries)
+        attempt = 0
+        while True:
+            try:
+                faults.inject("trainer.pass", attempt=attempt)
+                if resident:
+                    return self.train_pass_resident(dataset, log_prefix)
+                return self.train_pass(dataset, log_prefix)
+            except Exception as e:
+                recoverable = is_retryable(e) or isinstance(e, NanInfError)
+                if attempt >= limit or not recoverable:
+                    raise
+                attempt += 1
+                from paddlebox_tpu.obs.hub import get_hub
+                hub = get_hub()
+                hub.counter("pbox_pass_retries_total",
+                            "pass-level recovery retries").inc()
+                if hub.active:
+                    hub.emit("pass_retry", attempt=attempt, limit=limit,
+                             error=repr(e),
+                             global_step=self.global_step)
+                if checkpoint is not None:
+                    restored = checkpoint.restore(self)
+                    log.warning(
+                        "%spass failed (%r) — rolled back to step %s, "
+                        "retry %d/%d", log_prefix, e, restored, attempt,
+                        limit)
+                else:
+                    log.warning(
+                        "%spass failed (%r) — no checkpoint manager, "
+                        "retrying from current state (%d/%d)",
+                        log_prefix, e, attempt, limit)
 
     def _emit_pass(self, kind: str, out: Dict[str, float], examples: int,
                    stage_timers: bool = False) -> None:

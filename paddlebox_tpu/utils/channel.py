@@ -144,6 +144,18 @@ class Channel(Generic[T]):
             self._not_empty.notify_all()
             self._not_full.notify_all()
 
+    def cancel(self) -> None:
+        """CONSUMER-side close: mark closed and drop whatever is queued,
+        so a producer blocked on a full channel unblocks promptly (its
+        pending ``put`` raises ChannelClosed) and nothing is retained
+        for a consumer that has walked away. ``close()`` keeps drain
+        semantics for the normal producer-side end-of-stream."""
+        with self._lock:
+            self._closed = True
+            self._q.clear()
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+
     @property
     def closed(self) -> bool:
         return self._closed

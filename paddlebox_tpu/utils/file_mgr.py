@@ -22,6 +22,7 @@ import shutil
 import subprocess
 from typing import Callable, Dict, List, Optional, Tuple
 
+from paddlebox_tpu.resilience.retry import TransientError
 from paddlebox_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -104,26 +105,66 @@ class LocalBackend:
         return n
 
 
+class TransientCommandError(TransientError):
+    """A CLI invocation failed transiently (nonzero rc, timeout, or the
+    binary itself failed to launch) — retryable by RetryPolicy."""
+
+
 class CommandBackend:
     """Remote storage driven by a CLI (``hadoop fs`` style), mirroring the
     reference's pipe-command approach to AFS/HDFS. Only the operations the
     pipeline needs are mapped; unmapped ops raise NotImplementedError.
 
     Receives the FULL URI (scheme included) — hadoop-style CLIs resolve
-    scheme-less paths relative to the user's remote home dir."""
+    scheme-less paths relative to the user's remote home dir.
+
+    Resilience (docs/RESILIENCE.md): every invocation runs under a
+    ``RetryPolicy`` (FLAGS.retry_* knobs unless one is passed) with a
+    subprocess timeout (``FLAGS.command_timeout_sec``) so a hung CLI is
+    killed and retried instead of wedging the pipeline; the
+    ``file_mgr.command`` fault-injection seam fires before each spawn."""
 
     wants_full_uri = True
 
-    def __init__(self, cmd_prefix: List[str]) -> None:
+    def __init__(self, cmd_prefix: List[str], retry=None,
+                 timeout: Optional[float] = None) -> None:
+        from paddlebox_tpu.config import FLAGS
+        from paddlebox_tpu.resilience.retry import RetryPolicy
         self.prefix = list(cmd_prefix)
+        self.timeout = (FLAGS.command_timeout_sec if timeout is None
+                        else timeout)
+        self.retry = retry or RetryPolicy.from_flags(
+            site="file_mgr.command")
+
+    def _run_once(self, *args: str) -> Tuple[int, str, str]:
+        """One CLI invocation → (rc, stdout, stderr). Spawn failures and
+        timeouts surface as TransientCommandError (retryable); the rc is
+        returned raw so callers can classify (``-test`` rc=1 means
+        "absent", not "broken")."""
+        from paddlebox_tpu.resilience.faults import inject
+        inject("file_mgr.command", op=args[0] if args else "")
+        cmd = self.prefix + list(args)
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True,
+                timeout=self.timeout if self.timeout > 0 else None)
+        except subprocess.TimeoutExpired as e:
+            raise TransientCommandError(
+                f"{' '.join(cmd)}: timed out after {self.timeout}s") from e
+        except OSError as e:
+            raise TransientCommandError(f"{' '.join(cmd)}: {e}") from e
+        return proc.returncode, proc.stdout, proc.stderr
 
     def _run(self, *args: str) -> str:
-        proc = subprocess.run(self.prefix + list(args),
-                              capture_output=True, text=True)
-        if proc.returncode != 0:
-            raise RuntimeError(
-                f"{' '.join(self.prefix + list(args))}: {proc.stderr}")
-        return proc.stdout
+        """Invoke the CLI under the retry policy; any nonzero rc is
+        treated as transient and retried up to the policy's caps."""
+        def attempt() -> str:
+            rc, out, err = self._run_once(*args)
+            if rc != 0:
+                raise TransientCommandError(
+                    f"{' '.join(self.prefix + list(args))}: rc={rc}: {err}")
+            return out
+        return self.retry.call(attempt)
 
     def list_dir(self, path: str) -> List[str]:
         return [line.split()[-1].rsplit("/", 1)[-1]
@@ -131,18 +172,42 @@ class CommandBackend:
                 if line and not line.startswith("Found")]
 
     def exists(self, path: str) -> bool:
-        try:
-            self._run("-test", "-e", path)
-            return True
-        except RuntimeError:
-            return False
+        """``-test -e`` semantics: rc=0 present, rc=1 absent. Any OTHER
+        failure (connection refused, CLI crash, timeout) is retried and
+        ultimately RAISES — reporting a flaky cluster as "file does not
+        exist" silently corrupts checkpoint/dataset decisions."""
+        def attempt() -> bool:
+            rc, _, err = self._run_once("-test", "-e", path)
+            if rc == 0:
+                return True
+            if rc == 1:
+                return False
+            raise TransientCommandError(
+                f"{' '.join(self.prefix)} -test -e {path}: rc={rc}: {err}")
+        return self.retry.call(attempt)
 
     def download(self, remote: str, local: str) -> bool:
         self._run("-get", remote, local)
         return True
 
     def upload(self, local: str, remote: str) -> bool:
-        self._run("-put", local, remote)
+        """Crash-safe put: write a ``.tmp`` remote name, then rename —
+        mirroring the local ``os.replace`` convention checkpoints rely
+        on, so a crash mid-upload never leaves a torn final file."""
+        tmp = f"{remote}.tmp-{os.getpid()}"
+        self._run("-put", local, tmp)
+        try:
+            self._run("-mv", tmp, remote)
+        except BaseException:
+            try:  # best-effort: don't litter tmp files on failure
+                self._run("-rm", "-r", tmp)
+            except Exception:
+                log.warning("orphan upload temp left behind: %s", tmp)
+            raise
+        return True
+
+    def rename(self, src: str, dst: str) -> bool:
+        self._run("-mv", src, dst)
         return True
 
     def remove(self, path: str) -> bool:

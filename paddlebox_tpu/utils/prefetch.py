@@ -6,7 +6,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Iterable, Iterator, TypeVar
 
-from paddlebox_tpu.utils.channel import Channel
+from paddlebox_tpu.utils.channel import Channel, ChannelClosed
 
 T = TypeVar("T")
 U = TypeVar("U")
@@ -19,7 +19,14 @@ def prefetch_iter(items: Iterable[T], prepare: Callable[[T], U],
     producer thread up to `capacity` items ahead. Producer exceptions
     re-raise at the consumer. ``name`` registers the backing channel's
     pipeline gauges (depth/high-watermark/blocked time) with the
-    telemetry registry (utils.channel.channel_stats_snapshot)."""
+    telemetry registry (utils.channel.channel_stats_snapshot).
+
+    Abandon-safe: if the consumer walks away early (break / exception /
+    GeneratorExit), the ``finally`` cancels the channel so a producer
+    blocked on ``put`` unblocks promptly (ChannelClosed == normal
+    shutdown, not an error), and ``items`` is closed when it is itself a
+    generator — so chained prefetch stages unwind transitively instead
+    of leaking blocked threads."""
     ch: Channel = Channel(capacity=capacity, name=name)
     err: list = []
 
@@ -27,15 +34,29 @@ def prefetch_iter(items: Iterable[T], prepare: Callable[[T], U],
         try:
             for it in items:
                 ch.put(prepare(it))
+        except ChannelClosed:
+            pass  # consumer cancelled the channel — normal abandon path
         except BaseException as e:
             err.append(e)
         finally:
             ch.close()
+            close = getattr(items, "close", None)
+            if close is not None:
+                try:  # unwind an upstream generator (chained stages)
+                    close()
+                except BaseException as e:
+                    if not err:
+                        err.append(e)
 
     th = threading.Thread(target=producer, daemon=True)
     th.start()
-    for out in ch:
-        yield out
-    th.join()
+    try:
+        for out in ch:
+            yield out
+    finally:
+        # consumer-side close: without this, an abandoned generator left
+        # the producer blocked on ch.put forever
+        ch.cancel()
+        th.join()
     if err:
         raise err[0]

@@ -20,3 +20,12 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # chaos: seeded fault-injection recovery tests (tests/test_resilience,
+    # scripts/chaos_check). Fast ones run in tier-1; long soak variants
+    # carry `slow` as well and stay out of the default selection.
+    config.addinivalue_line(
+        "markers",
+        "chaos: seeded fault-injection / recovery tests (resilience)")

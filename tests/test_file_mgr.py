@@ -71,6 +71,8 @@ def test_command_backend_registration(tmp_path):
         "    dst = strip(args[2])\n"
         "    os.makedirs(os.path.dirname(dst), exist_ok=True)\n"
         "    shutil.copy(args[1], dst); sys.exit(0)\n"
+        "if args[0] == '-mv':\n"
+        "    os.replace(strip(args[1]), strip(args[2])); sys.exit(0)\n"
         "sys.exit(2)\n")
     import sys
 

@@ -1,0 +1,773 @@
+"""Resilience layer (paddlebox_tpu/resilience): retry/backoff policy
+semantics, deterministic fault injection, CommandBackend hardening,
+dataset quarantine + poison budgets, checkpoint checksums + mid-save
+crash recovery, pass-level retry, watchdog escalation ladder, and the
+prefetch producer-leak regression (ISSUE 2 acceptance surface)."""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import optax
+import pytest
+
+from paddlebox_tpu.config import flags_scope
+from paddlebox_tpu.data import DataFeedDesc, DatasetFactory
+from paddlebox_tpu.data.criteo import generate_criteo_files
+from paddlebox_tpu.data.dataset import (PoisonBudgetExceeded,
+                                        PoisonedFileError)
+from paddlebox_tpu.obs import (LocalHeartbeatStore, MemorySink,
+                               StragglerTimeout, StragglerWatchdog,
+                               TelemetryHub, get_hub, reset_hub)
+from paddlebox_tpu.obs.watchdog import (abort_with_checkpoint_action,
+                                        requeue_pass_action)
+from paddlebox_tpu.resilience.faults import (FaultPlan, InjectedCrash,
+                                             TransientInjectedError,
+                                             inject, installed)
+from paddlebox_tpu.resilience.retry import (RetryExhausted, RetryPolicy,
+                                            TransientError, is_retryable)
+from paddlebox_tpu.utils.channel import Channel, ChannelClosed
+from paddlebox_tpu.utils.file_mgr import (CommandBackend,
+                                          TransientCommandError)
+from paddlebox_tpu.utils.prefetch import prefetch_iter
+
+
+@pytest.fixture()
+def fresh_hub():
+    hub = reset_hub()
+    yield hub
+    reset_hub()
+
+
+def _nosleep_policy(**kw):
+    kw.setdefault("base_delay", 0.001)
+    kw.setdefault("sleep", lambda s: None)
+    return RetryPolicy(**kw)
+
+
+# ---- RetryPolicy -------------------------------------------------------
+def test_retry_succeeds_after_transient():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TransientError("hiccup")
+        return "ok"
+
+    assert _nosleep_policy(max_attempts=4).call(flaky) == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_non_retryable_propagates_untouched():
+    def bad():
+        raise ValueError("programming error")
+
+    with pytest.raises(ValueError):
+        _nosleep_policy().call(bad)
+
+    # deterministic fs outcomes never retry even where OSError does
+    assert not is_retryable(FileNotFoundError("x"))
+    assert is_retryable(ConnectionResetError("x"))
+    calls = []
+
+    def missing():
+        calls.append(1)
+        raise FileNotFoundError("gone")
+
+    with pytest.raises(FileNotFoundError):
+        _nosleep_policy(retryable=(OSError,)).call(missing)
+    assert len(calls) == 1
+
+
+def test_retry_exhausts_attempts():
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise TransientError("down")
+
+    with pytest.raises(RetryExhausted) as ei:
+        _nosleep_policy(max_attempts=3).call(always)
+    assert len(calls) == 3
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.last, TransientError)
+    assert isinstance(ei.value.__cause__, TransientError)
+
+
+def test_retry_deadline_caps_wall_time():
+    clk = {"t": 0.0}
+
+    def clock():
+        return clk["t"]
+
+    def sleep(s):
+        clk["t"] += s
+
+    calls = []
+
+    def always():
+        calls.append(1)
+        clk["t"] += 1.0
+        raise TransientError("down")
+
+    p = RetryPolicy(max_attempts=100, base_delay=1.0, max_delay=1.0,
+                    deadline=3.5, jitter=0.0, sleep=sleep, clock=clock)
+    with pytest.raises(RetryExhausted) as ei:
+        p.call(always)
+    assert "deadline" in str(ei.value)
+    assert len(calls) < 5
+
+
+def test_retry_jitter_deterministic_per_seed_and_site():
+    a = list(RetryPolicy(site="s1", seed=7, max_attempts=6).delays())
+    b = list(RetryPolicy(site="s1", seed=7, max_attempts=6).delays())
+    c = list(RetryPolicy(site="s2", seed=7, max_attempts=6).delays())
+    d = list(RetryPolicy(site="s1", seed=8, max_attempts=6).delays())
+    assert a == b
+    assert a != c and a != d
+    # backoff grows and respects the cap
+    nojit = list(RetryPolicy(site="s", jitter=0.0, max_attempts=8,
+                             base_delay=0.05, max_delay=0.4).delays())
+    assert nojit == [0.05, 0.1, 0.2, 0.4, 0.4, 0.4, 0.4]
+
+
+def test_retry_counter_and_event(fresh_hub):
+    sink = MemorySink()
+    fresh_hub.add_sink(sink)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 2:
+            raise TransientError("hiccup")
+        return "ok"
+
+    _nosleep_policy(site="test.seam").call(flaky)
+    assert fresh_hub.counter("pbox_retry_attempts_total").value(
+        site="test.seam") == 1
+    evs = [e for e in sink.events if e["event"] == "retry"]
+    assert evs and evs[0]["site"] == "test.seam" and evs[0]["attempt"] == 1
+
+
+# ---- FaultPlan ---------------------------------------------------------
+def test_fault_plan_parse():
+    plan = FaultPlan.parse(
+        "seed=9; a.b:fail:nth=2,times=3,exc=crash; "
+        "c.d:corrupt:match=*bad*; e.f:slow:delay=0.01")
+    assert plan.seed == 9
+    kinds = [(s.site, s.kind) for s in plan.specs]
+    assert kinds == [("a.b", "fail"), ("c.d", "corrupt"), ("e.f", "slow")]
+    assert plan.specs[0].nth == 2 and plan.specs[0].times == 3
+    assert plan.specs[0].exc == "crash"
+    with pytest.raises(ValueError):
+        FaultPlan.parse("justasite")
+    with pytest.raises(ValueError):
+        FaultPlan.parse("a.b:explode")
+    with pytest.raises(ValueError):
+        FaultPlan.parse("a.b:fail:bogus=1")
+    assert FaultPlan.parse("  ").specs == []
+
+
+def test_fault_nth_times_and_match():
+    plan = FaultPlan.parse("s:fail:nth=2,times=2")
+    with installed(plan):
+        inject("s")                      # call 1: no fire
+        for _ in range(2):               # calls 2,3 fire
+            with pytest.raises(TransientInjectedError):
+                inject("s")
+        inject("s")                      # call 4: past the window
+    assert plan.stats()["s:fail"] == {"calls": 4, "fired": 2}
+
+    plan2 = FaultPlan.parse("s:fail:match=*bad*,times=0")
+    with installed(plan2):
+        inject("s", path="/data/good.txt")   # no match, not even a call
+        with pytest.raises(TransientInjectedError):
+            inject("s", path="/data/bad.txt")
+        with pytest.raises(TransientInjectedError):
+            inject("s", path="/data/also_bad.txt")  # times=0: every call
+    assert plan2.stats()["s:fail"]["fired"] == 2
+
+
+def test_fault_corrupt_and_crash_kinds():
+    plan = FaultPlan.parse("c:corrupt; k:fail:exc=crash")
+    with installed(plan):
+        got = inject("c", "hello line")
+        assert got != "hello line" and "CORRUPT" in got
+        with pytest.raises(InjectedCrash):
+            inject("k")
+
+
+def test_fault_install_scoping():
+    outer = FaultPlan.parse("s:fail:nth=1")
+    inner = FaultPlan.parse("")
+    with installed(outer):
+        with installed(inner):
+            inject("s")  # inner (empty) plan shadows outer: no fire
+        with pytest.raises(TransientInjectedError):
+            inject("s")  # outer restored
+    inject("s")  # nothing installed
+    assert outer.stats()["s:fail"]["fired"] == 1
+
+
+def test_fault_probability_deterministic():
+    def run():
+        plan = FaultPlan.parse("s:fail:p=0.5,times=0", seed=3)
+        fired = []
+        with installed(plan):
+            for i in range(50):
+                try:
+                    inject("s")
+                    fired.append(0)
+                except TransientInjectedError:
+                    fired.append(1)
+        return fired
+
+    a, b = run(), run()
+    assert a == b and 0 < sum(a) < 50
+
+
+# ---- CommandBackend hardening -----------------------------------------
+def _shim(tmp_path, body: str) -> list:
+    sh = tmp_path / "shim.py"
+    sh.write_text("import os, shutil, sys\nargs = sys.argv[1:]\n" + body)
+    return [sys.executable, str(sh)]
+
+
+def test_command_transient_failure_retried(fresh_hub, tmp_path):
+    plan = FaultPlan.parse("file_mgr.command:fail:nth=1")
+    be = CommandBackend(["true"], retry=_nosleep_policy(
+        site="file_mgr.command", max_attempts=3))
+    with installed(plan):
+        assert be.exists("afs://whatever") is True  # retried through fault
+    assert plan.stats()["file_mgr.command:fail"]["fired"] == 1
+    assert fresh_hub.counter("pbox_retry_attempts_total").value(
+        site="file_mgr.command") == 1
+    assert fresh_hub.counter("pbox_faults_injected_total").value(
+        site="file_mgr.command", kind="fail") == 1
+
+
+def test_command_timeout_is_transient(tmp_path):
+    be = CommandBackend(["bash", "-c", "sleep 5", "shim"], timeout=0.2,
+                        retry=_nosleep_policy(site="file_mgr.command",
+                                              max_attempts=2))
+    t0 = time.monotonic()
+    with pytest.raises(RetryExhausted) as ei:
+        be._run("-ls", "x")
+    assert isinstance(ei.value.last, TransientCommandError)
+    assert "timed out" in str(ei.value.last)
+    assert time.monotonic() - t0 < 3.0
+
+
+def test_exists_distinguishes_absent_from_failure(tmp_path):
+    cmd = _shim(tmp_path,
+                "if args[0] == '-test':\n"
+                "    p = args[2]\n"
+                "    sys.exit(1 if 'absent' in p else "
+                "(0 if 'present' in p else 2))\n"
+                "sys.exit(2)\n")
+    be = CommandBackend(cmd, retry=_nosleep_policy(
+        site="file_mgr.command", max_attempts=2))
+    assert be.exists("afs://present/file") is True
+    assert be.exists("afs://absent/file") is False
+    # rc=2 (cluster trouble) must RAISE, never report "does not exist"
+    with pytest.raises(RetryExhausted) as ei:
+        be.exists("afs://broken/file")
+    assert isinstance(ei.value.last, TransientCommandError)
+
+
+def test_upload_puts_tmp_then_renames(tmp_path):
+    oplog = tmp_path / "ops.log"
+    cmd = _shim(tmp_path,
+                f"open({str(oplog)!r}, 'a').write(' '.join(args) + '\\n')\n"
+                "def strip(p):\n"
+                "    assert p.startswith('afs://'), p\n"
+                "    return p[len('afs://'):]\n"
+                "if args[0] == '-put':\n"
+                "    dst = strip(args[2])\n"
+                "    os.makedirs(os.path.dirname(dst), exist_ok=True)\n"
+                "    shutil.copy(args[1], dst); sys.exit(0)\n"
+                "if args[0] == '-mv':\n"
+                "    os.replace(strip(args[1]), strip(args[2]))\n"
+                "    sys.exit(0)\n"
+                "sys.exit(2)\n")
+    be = CommandBackend(cmd, retry=_nosleep_policy(max_attempts=1))
+    src = tmp_path / "model.bin"
+    src.write_bytes(b"payload")
+    dst = tmp_path / "remote" / "model.bin"
+    assert be.upload(str(src), f"afs://{dst}")
+    assert dst.read_bytes() == b"payload"
+    ops = [l.split() for l in oplog.read_text().splitlines()]
+    assert ops[0][0] == "-put" and ".tmp-" in ops[0][2]
+    assert ops[1][0] == "-mv" and ops[1][2] == f"afs://{dst}"
+    assert not any(".tmp-" in str(p) for p in (tmp_path / "remote").iterdir())
+
+
+# ---- prefetch producer-leak regression --------------------------------
+def test_prefetch_consumer_abandon_unblocks_producer():
+    produced = []
+    upstream_closed = threading.Event()
+
+    def items():
+        try:
+            for i in range(1000):
+                produced.append(i)
+                yield i
+        finally:
+            upstream_closed.set()
+
+    it = prefetch_iter(items(), lambda x: x, capacity=2)
+    got = [next(it) for _ in range(3)]
+    assert got == [0, 1, 2]
+    it.close()  # consumer walks away (break/GeneratorExit path)
+    # the fix: producer unblocks from ch.put and the upstream generator
+    # is closed; before it, the producer thread blocked forever
+    assert upstream_closed.wait(5.0), "producer thread leaked"
+    assert len(produced) < 1000
+
+
+def test_prefetch_chained_abandon_unwinds_transitively():
+    inner_closed = threading.Event()
+
+    def items():
+        try:
+            for i in range(1000):
+                yield i
+        finally:
+            inner_closed.set()
+
+    stage1 = prefetch_iter(items(), lambda x: x + 1, capacity=2)
+    stage2 = prefetch_iter(stage1, lambda x: x * 2, capacity=2)
+    assert next(stage2) == 2
+    stage2.close()
+    assert inner_closed.wait(5.0), "chained producer leaked"
+
+
+def test_prefetch_normal_completion_and_error_still_work():
+    assert list(prefetch_iter(range(10), lambda x: x * x,
+                              capacity=3)) == [x * x for x in range(10)]
+
+    def boom(x):
+        if x == 3:
+            raise RuntimeError("prepare failed")
+        return x
+
+    with pytest.raises(RuntimeError, match="prepare failed"):
+        list(prefetch_iter(range(10), boom, capacity=2))
+
+
+def test_channel_cancel_unblocks_blocked_put():
+    ch = Channel(capacity=1)
+    ch.put("a")
+    state = {}
+
+    def producer():
+        try:
+            ch.put("b")  # blocks: channel full
+            state["out"] = "returned"
+        except ChannelClosed:
+            state["out"] = "closed"
+
+    th = threading.Thread(target=producer, daemon=True)
+    th.start()
+    time.sleep(0.05)
+    ch.cancel()
+    th.join(timeout=5.0)
+    assert not th.is_alive()
+    assert state["out"] == "closed"
+    assert len(ch) == 0  # cancel drops queued items
+
+
+# ---- dataset quarantine + poison budgets ------------------------------
+def _mini_files(tmp_path, n=3, rows=40):
+    return generate_criteo_files(str(tmp_path / "data"), num_files=n,
+                                 rows_per_file=rows, vocab_per_slot=50,
+                                 seed=11)
+
+
+def _mk_ds(files, kind="InMemoryDataset", bs=16):
+    desc = DataFeedDesc.criteo(batch_size=bs)
+    ds = DatasetFactory().create_dataset(kind, desc)
+    ds.set_filelist(files)
+    return ds
+
+
+@pytest.mark.chaos
+def test_quarantine_isolates_corrupt_file(tmp_path):
+    files = _mini_files(tmp_path)
+    with open(files[1], "w") as fh:
+        fh.write("this is not criteo at all\n" * 10)
+    with flags_scope(native_parse=False, poison_budget_files=1,
+                     poison_budget_records=0):
+        ds = _mk_ds(files)
+        ds.load_into_memory()
+    assert [p for p, _ in ds.quarantined_files] == [files[1]]
+    assert len(ds) == 80  # the two healthy files fully loaded
+    # a second clean load resets the quarantine list
+    with flags_scope(native_parse=False, poison_budget_files=1):
+        ds.set_filelist([files[0]])
+        ds.load_into_memory()
+    assert ds.quarantined_files == []
+
+
+def test_quarantine_disabled_aborts_on_corrupt_file(tmp_path):
+    files = _mini_files(tmp_path)
+    with open(files[1], "w") as fh:
+        fh.write("garbage\n" * 5)
+    with flags_scope(native_parse=False, poison_budget_files=0,
+                     poison_budget_records=0):
+        ds = _mk_ds(files)
+        with pytest.raises(PoisonedFileError):
+            ds.load_into_memory()
+
+
+def test_record_budget_tolerates_within_limit(tmp_path):
+    files = _mini_files(tmp_path, n=1)
+    with open(files[0], "a") as fh:
+        fh.write("bad line one\nbad line two\n")
+    with flags_scope(native_parse=False, poison_budget_records=2):
+        ds = _mk_ds(files)
+        ds.load_into_memory()  # exactly at budget: tolerated
+    assert len(ds) == 40 and ds.quarantined_files == []
+    with flags_scope(native_parse=False, poison_budget_records=1,
+                     poison_budget_files=0):
+        ds2 = _mk_ds(files)
+        with pytest.raises(PoisonedFileError):
+            ds2.load_into_memory()
+
+
+@pytest.mark.chaos
+def test_quarantine_missing_file_survivors_drain(tmp_path):
+    files = _mini_files(tmp_path)
+    bad = str(tmp_path / "data" / "no_such_file.txt")
+    filelist = [files[0], bad, files[1], files[2]]
+    with flags_scope(native_parse=False, poison_budget_files=1):
+        ds = _mk_ds(filelist)
+        ds.load_into_memory()
+    assert [p for p, _ in ds.quarantined_files] == [bad]
+    assert len(ds) == 120  # surviving readers drained every healthy file
+
+
+@pytest.mark.chaos
+def test_queue_dataset_quarantines_midstream(tmp_path):
+    files = _mini_files(tmp_path)
+    with open(files[1], "w") as fh:
+        fh.write("junk\n" * 8)
+    with flags_scope(native_parse=False, poison_budget_files=1,
+                     poison_budget_records=0):
+        ds = _mk_ds(files, kind="QueueDataset", bs=16)
+        n = sum(b.label.shape[0] for b in ds.batches())
+    assert n == 80
+    assert [p for p, _ in ds.quarantined_files] == [files[1]]
+
+
+@pytest.mark.chaos
+def test_fault_corrupt_record_quarantines_exact_file(tmp_path, fresh_hub):
+    """ISSUE 2 acceptance: a seeded corrupt-record fault poisons exactly
+    the targeted file; the quarantine list and counters are
+    deterministic across runs with the same seed."""
+    files = _mini_files(tmp_path)
+    target = os.path.basename(files[2])
+
+    def run():
+        reset_hub()
+        plan = FaultPlan.parse(
+            f"parser.record:corrupt:match=*{target}*", seed=5)
+        with flags_scope(native_parse=False, poison_budget_files=2,
+                         poison_budget_records=0, read_thread_num=4):
+            ds = _mk_ds(files)
+            with installed(plan):
+                ds.load_into_memory()
+        return ([p for p, _ in ds.quarantined_files], len(ds),
+                plan.stats())
+
+    q1, n1, s1 = run()
+    q2, n2, s2 = run()
+    assert q1 == q2 == [files[2]]
+    assert n1 == n2 == 80
+    assert s1 == s2
+    assert s1["parser.record:corrupt"]["fired"] >= 1
+    assert get_hub().counter("pbox_files_quarantined_total").value() == 1
+
+
+def test_poison_budget_exceeded_names_condition(tmp_path):
+    """Blowing the FILE budget surfaces as PoisonBudgetExceeded (cause
+    chained), not whichever error the last bad file happened to raise."""
+    files = _mini_files(tmp_path)
+    for f in (files[0], files[1]):
+        with open(f, "w") as fh:
+            fh.write("junk\n" * 3)
+    with flags_scope(native_parse=False, poison_budget_files=1,
+                     poison_budget_records=0):
+        ds = _mk_ds(files)
+        with pytest.raises(PoisonBudgetExceeded) as ei:
+            ds.load_into_memory()
+    assert isinstance(ei.value.__cause__, PoisonedFileError)
+    assert len(ds.quarantined_files) == 1  # the budgeted one
+
+
+@pytest.mark.chaos
+def test_transient_open_fault_retried_not_quarantined(tmp_path,
+                                                      fresh_hub):
+    """An injected transient open failure exercises the dataset.open
+    RetryPolicy and never reaches the quarantine budget."""
+    files = _mini_files(tmp_path, n=1)
+    plan = FaultPlan.parse("dataset.open:fail:nth=1")
+    with flags_scope(native_parse=False, poison_budget_files=1,
+                     retry_base_delay_sec=0.001, read_thread_num=2):
+        ds = _mk_ds(files)
+        with installed(plan):
+            ds.load_into_memory()
+    assert ds.quarantined_files == []
+    assert len(ds) == 40
+    assert fresh_hub.counter("pbox_retry_attempts_total").value(
+        site="dataset.open") == 1
+
+
+def test_native_load_quarantines_unreadable_file(tmp_path):
+    """The native-columnar fast path isolates per-file failures too."""
+    files = _mini_files(tmp_path)
+    bad = str(tmp_path / "data" / "missing.txt")
+    with flags_scope(poison_budget_files=1):
+        ds = _mk_ds([files[0], bad, files[2]])
+        ds.load_into_memory()
+    assert [p for p, _ in ds.quarantined_files] == [bad]
+    assert len(ds) == 80
+
+
+# ---- trainer/checkpoint chaos -----------------------------------------
+@pytest.fixture()
+def trainer_setup(tmp_path):
+    from paddlebox_tpu.models import CtrDnn
+    from paddlebox_tpu.ps import EmbeddingTable, SparseSGDConfig
+    from paddlebox_tpu.train import Trainer
+
+    files = generate_criteo_files(str(tmp_path / "data"), num_files=1,
+                                  rows_per_file=200, vocab_per_slot=30,
+                                  seed=3)
+    desc = DataFeedDesc.criteo(batch_size=32)
+    desc.key_bucket_min = 2048
+    ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+
+    def mk():
+        cfg = SparseSGDConfig(mf_create_thresholds=0.0,
+                              mf_initial_range=0.0)
+        t = EmbeddingTable(mf_dim=4, capacity=1 << 12, cfg=cfg,
+                           unique_bucket_min=2048)
+        return Trainer(CtrDnn(hidden=(8,)), t, desc, tx=optax.adam(1e-2))
+
+    return ds, mk, str(tmp_path / "ckpt")
+
+
+@pytest.mark.chaos
+def test_checkpoint_mid_save_crash_restores_last_consistent(trainer_setup):
+    from paddlebox_tpu.train.checkpoint import CheckpointManager
+    ds, mk, root = trainer_setup
+    tr = mk()
+    cm = CheckpointManager(root)
+    tr.train_pass(ds)
+    cm.save(tr)
+    good_step = tr.global_step
+    tr.train_pass(ds)
+    plan = FaultPlan.parse("checkpoint.save_commit:fail:nth=1,exc=crash")
+    with installed(plan):
+        with pytest.raises(InjectedCrash):
+            cm.save(tr)
+    # a fresh manager (the restarted process) recovers the last
+    # consistent checkpoint; the torn temp dir is ignored
+    cm2 = CheckpointManager(root)
+    tr2 = mk()
+    assert cm2.restore(tr2) == good_step
+    assert tr2.global_step == good_step
+
+
+def test_checkpoint_checksum_rejects_corruption(trainer_setup):
+    from paddlebox_tpu.train.checkpoint import (CheckpointCorruptError,
+                                                CheckpointManager)
+    ds, mk, root = trainer_setup
+    tr = mk()
+    cm = CheckpointManager(root)
+    tr.train_pass(ds)
+    path = cm.save(tr)
+    meta = cm._meta(tr.global_step)
+    assert set(meta["checksums"]) == {"sparse.npz", "dense.pkl"}
+    # flip bytes in the sparse payload → restore must refuse, loudly
+    target = os.path.join(path, "sparse.npz")
+    blob = bytearray(open(target, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(target, "wb") as fh:
+        fh.write(bytes(blob))
+    tr2 = mk()
+    with pytest.raises(CheckpointCorruptError, match="corrupt"):
+        cm.restore(tr2)
+
+
+def test_checkpoint_without_checksums_still_restores(trainer_setup):
+    """Pre-checksum checkpoints (no ``checksums`` key) verify trivially."""
+    import json
+    from paddlebox_tpu.train.checkpoint import CheckpointManager
+    ds, mk, root = trainer_setup
+    tr = mk()
+    cm = CheckpointManager(root)
+    tr.train_pass(ds)
+    cm.save(tr)
+    mp = os.path.join(cm._dir(tr.global_step), "meta.json")
+    meta = json.load(open(mp))
+    del meta["checksums"]
+    with open(mp, "w") as fh:
+        json.dump(meta, fh)
+    tr2 = mk()
+    assert cm.restore(tr2) == tr.global_step
+
+
+@pytest.mark.chaos
+def test_run_pass_retries_from_checkpoint(trainer_setup, fresh_hub):
+    from paddlebox_tpu.train.checkpoint import CheckpointManager
+    ds, mk, root = trainer_setup
+    sink = MemorySink()
+    fresh_hub.add_sink(sink)
+    tr = mk()
+    cm = CheckpointManager(root)
+    tr.run_pass(ds)
+    cm.save(tr)
+    saved_step = tr.global_step
+    plan = FaultPlan.parse("trainer.pass:fail:nth=1")  # 1st attempt dies
+    with installed(plan):
+        out = tr.run_pass(ds, checkpoint=cm, max_retries=1)
+    assert np.isfinite(out["last_loss"])
+    # rollback happened: the retried pass re-ran from the saved step
+    assert tr.global_step == saved_step + out["batches"]
+    assert fresh_hub.counter("pbox_pass_retries_total").value() == 1
+    evs = [e for e in sink.events if e["event"] == "pass_retry"]
+    assert evs and evs[0]["attempt"] == 1
+    # pass events carry the resilience counter block
+    pevs = [e for e in sink.events if e["event"] == "pass"]
+    assert pevs and pevs[-1]["resilience"]["pass_retries"] == 1
+
+
+def test_run_pass_exhausted_budget_raises(trainer_setup):
+    ds, mk, _ = trainer_setup
+    tr = mk()
+    plan = FaultPlan.parse("trainer.pass:fail:times=0")
+    with installed(plan):
+        with pytest.raises(TransientInjectedError):
+            tr.run_pass(ds, max_retries=2)
+    assert plan.stats()["trainer.pass:fail"]["fired"] == 3
+
+
+def test_run_pass_non_recoverable_raises_immediately(trainer_setup):
+    ds, mk, _ = trainer_setup
+    tr = mk()
+    plan = FaultPlan.parse("trainer.pass:fail:exc=crash")
+    with installed(plan):
+        with pytest.raises(InjectedCrash):
+            tr.run_pass(ds, max_retries=5)
+    assert plan.stats()["trainer.pass:fail"]["fired"] == 1
+
+
+# ---- watchdog escalation ladder ---------------------------------------
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _stalled_watchdog(clock, store, **kw):
+    wd = StragglerWatchdog(store, process_index=0, num_processes=2,
+                           step_lag=10, heartbeat_timeout=30.0,
+                           clock=clock, hub=TelemetryHub(), **kw)
+    store.publish(0, 100, clock())
+    store.publish(1, 0, clock())  # 100 behind: permanent straggler
+    return wd
+
+
+def test_escalation_ladder_fires_in_order():
+    clock = FakeClock()
+    store = LocalHeartbeatStore()
+    fired = []
+    saves = []
+    wd = _stalled_watchdog(
+        clock, store,
+        escalations=[
+            (10.0, requeue_pass_action(lambda reps: fired.append(
+                ("requeue", reps[0].process)))),
+            (20.0, abort_with_checkpoint_action(
+                lambda: saves.append("ckpt"))),
+        ])
+    wd.poll_once()                     # detection at t0: no rung yet
+    assert fired == [] and saves == []
+    clock.t += 12
+    wd.poll_once()                     # past rung 1 only
+    assert fired == [("requeue", 1)] and saves == []
+    assert wd._abort_exc is None
+    clock.t += 10
+    wd.poll_once()                     # past rung 2: snapshot then abort
+    assert saves == ["ckpt"]
+    with pytest.raises(StragglerTimeout):
+        wd.beat(101)
+    # rungs fire once per stall episode
+    clock.t += 5
+    wd.poll_once()
+    assert fired == [("requeue", 1)] and saves == ["ckpt"]
+
+
+def test_escalation_resets_when_stall_clears():
+    clock = FakeClock()
+    store = LocalHeartbeatStore()
+    fired = []
+    wd = _stalled_watchdog(
+        clock, store,
+        escalations=[(10.0, requeue_pass_action(
+            lambda reps: fired.append(clock.t)))])
+    wd.poll_once()
+    clock.t += 15
+    wd.poll_once()
+    assert len(fired) == 1
+    store.publish(1, 95, clock())      # straggler catches up
+    wd.poll_once()                     # healthy: ladder resets
+    clock.t += 5
+    store.publish(1, 0, clock())       # regression? no — step going
+    store.publish(1, 0, clock())       # backwards reads as behind again
+    store.publish(0, 200, clock())
+    wd.poll_once()                     # new stall episode begins
+    clock.t += 15
+    wd.poll_once()
+    assert len(fired) == 2
+
+
+def test_legacy_abort_after_still_works():
+    clock = FakeClock()
+    store = LocalHeartbeatStore()
+    wd = _stalled_watchdog(clock, store, abort_after=20.0)
+    wd.poll_once()
+    wd.beat(101)
+    clock.t += 25
+    wd.poll_once()
+    with pytest.raises(StragglerTimeout):
+        wd.beat(102)
+
+
+def test_escalation_emits_events():
+    clock = FakeClock()
+    store = LocalHeartbeatStore()
+    hub = TelemetryHub()
+    sink = MemorySink()
+    hub.add_sink(sink)
+    wd = StragglerWatchdog(store, 0, 2, step_lag=10, clock=clock, hub=hub,
+                           escalations=[(5.0, requeue_pass_action(
+                               lambda reps: None))])
+    store.publish(0, 100, clock())
+    store.publish(1, 0, clock())
+    wd.poll_once()
+    clock.t += 6
+    wd.poll_once()
+    evs = [e for e in sink.events if e["event"] == "straggler_escalation"]
+    assert evs and evs[0]["action"] == "requeue_pass"
+    assert hub.counter("pbox_straggler_escalations_total").value(
+        action="requeue_pass") == 1
