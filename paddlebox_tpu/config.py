@@ -138,6 +138,23 @@ class Flags:
     # grammar, e.g. "file_mgr.command:fail:nth=1"); "" = no injection
     fault_plan: str = ""
 
+    # --- preemption & mid-pass resume (resilience/preemption,
+    # resilience/consensus; docs/RESILIENCE.md) ---
+    # install SIGTERM/SIGINT -> graceful-stop handlers at Trainer init;
+    # the loop then halts at a batch boundary with an emergency
+    # checkpoint + resume cursor instead of dying mid-step
+    graceful_shutdown: bool = False
+    # >0: periodic in-pass checkpoint (delta + cursor.json) every N
+    # batches, so a preempted pass replays seconds, not hours; needs
+    # run_pass(checkpoint=...) and an in-memory dataset
+    ckpt_every_batches: int = 0
+    # shared dir (NFS/FUSE) for multihost-consistent recovery: restore-
+    # step agreement + shared quarantine ("" = consensus helpers must be
+    # constructed explicitly)
+    restore_consensus_dir: str = ""
+    # how long a consensus gather waits for the full mesh to publish
+    consensus_timeout_sec: float = 60.0
+
     # --- runtime ---
     profile: bool = False
     log_period_steps: int = 100

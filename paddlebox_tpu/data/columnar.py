@@ -141,10 +141,11 @@ class ColumnarRecords:
         )
 
     def batches(self, desc: DataFeedDesc, num_slots: int,
-                drop_last: bool = False) -> Iterator[SlotBatch]:
+                drop_last: bool = False,
+                start_batch: int = 0) -> Iterator[SlotBatch]:
         bs = desc.batch_size
         r = self.num_records
-        for i in range(0, r, bs):
+        for i in range(start_batch * bs, r, bs):
             j = min(i + bs, r)
             if j - i < bs and drop_last:
                 return

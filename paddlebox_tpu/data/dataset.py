@@ -110,6 +110,12 @@ def _slots_shuffle_columnar(col, sel_slots: np.ndarray, d: np.ndarray):
 class Dataset:
     """Base: file list + schema + threaded readers."""
 
+    #: True when ``batches(start_batch=k)`` is deterministic — the
+    #: in-memory datasets, whose batch order is a pure function of
+    #: (filelist, seed). Streaming readers interleave threads, so the
+    #: mid-pass resume cursor (docs/RESILIENCE.md) only applies here.
+    supports_cursor_resume = False
+
     def __init__(self, desc: Optional[DataFeedDesc] = None) -> None:
         self.desc = desc or DataFeedDesc()
         self.filelist: List[str] = []
@@ -139,6 +145,17 @@ class Dataset:
     def set_glob(self, pattern: str, shard_by_rank: bool = False) -> None:
         self.set_filelist(sorted(globlib.glob(pattern)),
                           shard_by_rank=shard_by_rank)
+
+    def filelist_fingerprint(self) -> str:
+        """Order-sensitive digest of the pass's file list — the resume
+        cursor's identity check (checkpoint ``cursor.json``): a cursor
+        only applies to a pass over the SAME files in the same order."""
+        import hashlib
+        h = hashlib.sha256()
+        for p in self.filelist:
+            h.update(p.encode())
+            h.update(b"\0")
+        return h.hexdigest()[:16]
 
     def set_batch_size(self, bs: int) -> None:
         self.desc.batch_size = bs
@@ -313,6 +330,12 @@ class InMemoryDataset(Dataset):
     def __init__(self, desc: Optional[DataFeedDesc] = None) -> None:
         super().__init__(desc)
         self.records: List[SlotRecord] = []
+        # whether the loaded order is a pure function of (filelist,
+        # seed): the native columnar load concatenates per-file chunks
+        # in filelist order; the threaded per-line path's channel
+        # fan-in order is timing-dependent unless ONE reader drains the
+        # list. Cursor resume (docs/RESILIENCE.md) keys off this.
+        self._det_order = True
         self._pass_keys: Optional[np.ndarray] = None
         self.columnar = None  # ColumnarRecords once columnarize()d
         self._fea_eval = False
@@ -344,6 +367,7 @@ class InMemoryDataset(Dataset):
         self.records = list(ch)
         group.join()  # re-raise reader errors
         self._pass_keys = None
+        self._det_order = self.thread_num <= 1
         log.info("loaded %d records from %d files",
                  len(self.records), len(self.filelist))
         if self.quarantined_files:
@@ -425,6 +449,7 @@ class InMemoryDataset(Dataset):
             timestamp=np.zeros(n_rec, np.int64))
         self.records = []
         self._pass_keys = None
+        self._det_order = True  # chunks concatenate in filelist order
         stat_add("records_parsed", n_rec)
         stat_add("records_dropped", n_drop)
         log.info("native-parsed %d records from %d files (columnar, "
@@ -441,6 +466,16 @@ class InMemoryDataset(Dataset):
             self.records, self.desc.dense_dim)
         if release_records:
             self.records = []
+
+    @property
+    def supports_cursor_resume(self) -> bool:
+        """True when ``batches(start_batch=k)`` reproduces the original
+        stream: the native columnar load (filelist-order concat) always
+        does; the threaded per-line path only with ONE reader thread —
+        multi-thread channel fan-in order is timing-dependent, so a
+        resumed process could not rebuild the same batch order and the
+        cursor would splice two different streams."""
+        return self._det_order
 
     def release_memory(self) -> None:
         self.records = []
@@ -603,14 +638,19 @@ class InMemoryDataset(Dataset):
             return self.columnar.num_records
         return len(self.records)
 
-    def batches(self, drop_last: bool = False) -> Iterator[SlotBatch]:
+    def batches(self, drop_last: bool = False,
+                start_batch: int = 0) -> Iterator[SlotBatch]:
+        """``start_batch=k`` skips the first k batches WITHOUT building
+        them (cursor resume: the skipped prefix was already trained
+        before the preemption — docs/RESILIENCE.md)."""
         if self.columnar is not None:
             yield from self.columnar.batches(
-                self.desc, len(self.desc.sparse_slots), drop_last)
+                self.desc, len(self.desc.sparse_slots), drop_last,
+                start_batch=start_batch)
             return
         bs = self.desc.batch_size
         n = len(self.records)
-        for i in range(0, n, bs):
+        for i in range(start_batch * bs, n, bs):
             chunk = self.records[i:i + bs]
             if len(chunk) < bs and drop_last:
                 return
@@ -621,7 +661,12 @@ class QueueDataset(Dataset):
     """Streaming dataset: batches come off the reader channel without
     materializing the pass (reference dataset.py:1191)."""
 
-    def batches(self) -> Iterator[SlotBatch]:
+    def batches(self, start_batch: int = 0) -> Iterator[SlotBatch]:
+        if start_batch:
+            raise ValueError(
+                "QueueDataset streams through threaded readers — batch "
+                "order is not deterministic, so cursor resume "
+                "(start_batch) needs an in-memory dataset")
         if not self.filelist:
             raise ValueError("set_filelist first")
         self._reset_quarantine()

@@ -70,11 +70,12 @@ class DirHeartbeatStore:
         os.makedirs(path, exist_ok=True)
 
     def publish(self, process: int, step: int, ts: float) -> None:
-        final = os.path.join(self.path, f"hb_{process}.json")
-        tmp = final + f".tmp{os.getpid()}"
-        with open(tmp, "w") as fh:
-            json.dump({"process": process, "step": step, "ts": ts}, fh)
-        os.replace(tmp, final)
+        from paddlebox_tpu.utils.fsio import atomic_write_json
+        # no fsync: heartbeats are ephemeral liveness signals — a beat
+        # lost to a crash is exactly what the watchdog detects anyway
+        atomic_write_json(os.path.join(self.path, f"hb_{process}.json"),
+                          {"process": process, "step": step, "ts": ts},
+                          fsync=False)
 
     def read(self) -> Dict[int, Tuple[int, float]]:
         out: Dict[int, Tuple[int, float]] = {}

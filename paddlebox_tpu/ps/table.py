@@ -997,24 +997,38 @@ class EmbeddingTable:
             out["opt_ext"] = sub[:, mf_end:]
         return out
 
-    def save_base(self, path: str) -> int:
-        """Full model dump (day-level batch model). Returns rows saved."""
+    def save_base(self, path: str, clear_touched: bool = True) -> int:
+        """Full model dump (day-level batch model). Returns rows saved.
+
+        ``clear_touched=False`` = a MID-PASS snapshot (checkpoint resume
+        cursor): the touched set is prepare-time bookkeeping, and with a
+        prefetch pipeline running ahead a mid-pass clear would drop rows
+        that are assigned but not yet pushed from every later delta.
+        Only pass-boundary saves (pipeline drained) may clear."""
         with self.host_lock:
             keys, rows = self.index.items()
-            # clear only snapshotted rows under the lock (rows touched by
-            # a concurrent preload keep their delta flag)
-            self._touched[rows] = False
+            if clear_touched:
+                # clear only snapshotted rows under the lock (rows touched
+                # by a concurrent preload keep their delta flag)
+                self._touched[rows] = False
         data = self._gather_host(rows)
         np.savez_compressed(path, keys=keys, **data)
         return len(keys)
 
-    def save_delta(self, path: str) -> int:
-        """Incremental dump of rows touched since last save ("xbox delta")."""
+    def save_delta(self, path: str, clear_touched: bool = True) -> int:
+        """Incremental dump of rows touched since last save ("xbox delta").
+
+        With ``clear_touched=False`` (mid-pass cursor checkpoints) the
+        flags survive, so successive in-pass deltas are CUMULATIVE over
+        the pass — a superset each time, which keeps the chain correct
+        while the prefetch pipeline's prepare-ahead makes any mid-pass
+        flag clearing unsound (see save_base)."""
         with self.host_lock:
             keys, rows = self.index.items()
             mask = self._touched[rows]
             keys, rows = keys[mask], rows[mask]
-            self._touched[rows] = False
+            if clear_touched:
+                self._touched[rows] = False
         data = self._gather_host(rows)
         np.savez_compressed(path, keys=keys, **data)
         return len(keys)

@@ -16,6 +16,12 @@ property, provably:
   FileMgr/parser/checkpoint seams so recovery paths are exercised by
   tests (tests/test_resilience.py, scripts/chaos_check.py) instead of
   hoped-for.
+- :mod:`paddlebox_tpu.resilience.preemption` — graceful shutdown:
+  SIGTERM/SIGINT → stop flag → emergency checkpoint + mid-pass resume
+  cursor + resume marker (``PreemptedError``, ``EXIT_RESUME``).
+- :mod:`paddlebox_tpu.resilience.consensus` — shared-dir consensus for
+  multihost-consistent recovery: every process restores the same agreed
+  step and drops the same quarantined files (SPMD batch identity).
 
 Everything emits through the obs/ TelemetryHub (``pbox_retry_*``,
 ``pbox_files_quarantined_total``, ``pbox_faults_injected_total``,
@@ -30,10 +36,25 @@ from paddlebox_tpu.resilience.faults import (FaultPlan, FaultSpec,
                                              active_plan, clear_plan,
                                              inject, install_plan,
                                              installed)
+from paddlebox_tpu.resilience.preemption import (EXIT_RESUME,
+                                                 PreemptedError,
+                                                 clear_stop,
+                                                 install_signal_handlers,
+                                                 request_stop,
+                                                 stop_requested)
+from paddlebox_tpu.resilience.consensus import (ConsensusTimeout,
+                                                DirConsensusStore,
+                                                RestoreConsensus,
+                                                consensus_restore,
+                                                sync_shared_quarantine)
 
 __all__ = [
     "RetryPolicy", "RetryExhausted", "TransientError", "is_retryable",
     "FaultPlan", "FaultSpec", "InjectedFault", "InjectedCrash",
     "TransientInjectedError", "inject", "install_plan", "clear_plan",
     "active_plan", "installed",
+    "PreemptedError", "EXIT_RESUME", "request_stop", "stop_requested",
+    "clear_stop", "install_signal_handlers",
+    "RestoreConsensus", "DirConsensusStore", "ConsensusTimeout",
+    "consensus_restore", "sync_shared_quarantine",
 ]

@@ -23,7 +23,14 @@ site                        seam
 ``checkpoint.io``           checkpoint meta/dense file reads+writes
 ``checkpoint.save_commit``  just before the atomic rename that publishes
                             a checkpoint (``fail`` == crash mid-save)
+``checkpoint.cursor``       resume-cursor save/load (cursor.json)
 ``trainer.pass``            start of every Trainer.run_pass attempt
+``preempt.signal``          the batch-boundary stop poll; a ``fail``
+                            fault here IS a simulated SIGTERM — it
+                            becomes a graceful stop request, never an
+                            exception (resilience/preemption)
+``restore.consensus``       every shared-dir consensus publish
+                            (restore-step / quarantine agreement)
 ==========================  =============================================
 
 Fault kinds: ``fail`` (raise — ``exc=transient|crash|os`` picks the

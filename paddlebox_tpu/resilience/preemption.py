@@ -1,0 +1,217 @@
+"""Graceful preemption handling — stop flags, signal handlers, resume
+markers (docs/RESILIENCE.md §Preemption & mid-pass resume).
+
+On TPU pods the dominant failure mode is not a flaky syscall but
+*preemption*: the scheduler reclaims the slice mid-pass with a SIGTERM
+and a short grace window. This module turns that signal into a clean
+shutdown protocol:
+
+1. :func:`install_signal_handlers` converts SIGTERM/SIGINT into a
+   process-wide **stop flag** (``request_stop`` — also callable
+   programmatically, the seam tests and chaos runs use).
+2. The training loop polls :func:`stop_requested` at every batch
+   boundary (``Trainer.train_pass``), finishes the in-flight step,
+   writes an *emergency checkpoint* with a mid-pass resume cursor
+   (train/checkpoint.py ``cursor.json``), and raises
+   :class:`PreemptedError` — which ``Trainer.run_pass`` never retries
+   (a deliberate shutdown is not a failure).
+3. A **resume marker** (``RESUME.json`` next to the checkpoints) plus
+   the distinct :data:`EXIT_RESUME` exit code (75, ``EX_TEMPFAIL``)
+   tell the launcher "restart me and resume", distinguishing
+   preemption from a real crash.
+
+Chaos seam: a ``fail`` fault at ``preempt.signal`` models SIGTERM
+delivery — :func:`stop_requested` converts it into ``request_stop``
+instead of letting it propagate, so a seeded plan preempts the loop at
+an exact batch boundary deterministically
+(``preempt.signal:fail:nth=K`` + scripts/preempt_check.py).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from typing import Dict, Optional
+
+from paddlebox_tpu.resilience import faults
+from paddlebox_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+#: distinct exit code for "preempted, restart and resume" (EX_TEMPFAIL —
+#: launchers treat it as retriable, unlike a crash's nonzero codes)
+EXIT_RESUME = 75
+
+#: marker file written next to the checkpoints on graceful shutdown
+RESUME_MARKER = "RESUME.json"
+
+
+class PreemptedError(RuntimeError):
+    """Raised at a batch boundary after a stop request. NOT a failure:
+    ``Trainer.run_pass`` re-raises it untouched (never retried), and the
+    launcher exits :data:`EXIT_RESUME`. Carries the resume position."""
+
+    def __init__(self, msg: str, step: Optional[int] = None,
+                 batch_index: Optional[int] = None,
+                 checkpoint_path: Optional[str] = None) -> None:
+        super().__init__(msg)
+        self.step = step
+        self.batch_index = batch_index
+        self.checkpoint_path = checkpoint_path
+
+    @property
+    def checkpointed(self) -> bool:
+        return self.checkpoint_path is not None
+
+
+_STOP = threading.Event()
+_LOCK = threading.Lock()
+_REASON: Optional[str] = None
+_INSTALLED: Dict[int, object] = {}  # signum -> previous handler
+#: set by the SIGNAL HANDLER only — a plain (GIL-atomic) assignment.
+#: The handler runs on the main thread between bytecodes and may
+#: interrupt code holding _LOCK, the telemetry hub's lock, or the
+#: logging lock; touching ANY of those from the handler could deadlock
+#: the process during its grace window. The next stop poll drains this
+#: into a full request_stop() from normal thread context.
+_SIG_PENDING: Optional[str] = None
+
+
+def request_stop(reason: str = "request_stop") -> None:
+    """Arm the stop flag (idempotent — the first reason wins). The
+    programmatic seam for tests, fault injection, and launchers that
+    learn about preemption out-of-band (e.g. a metadata-server notice
+    ahead of the SIGTERM)."""
+    global _REASON
+    with _LOCK:
+        first = not _STOP.is_set()
+        if first:
+            _REASON = reason
+        _STOP.set()
+    if not first:
+        return
+    log.warning("stop requested (%s): training will halt at the next "
+                "batch boundary with an emergency checkpoint", reason)
+    try:
+        from paddlebox_tpu.obs.hub import get_hub
+        hub = get_hub()
+        hub.counter("pbox_preempt_requests_total",
+                    "graceful-shutdown requests received").inc()
+        if hub.active:
+            hub.emit("preempt_requested", reason=reason)
+    except Exception:
+        log.debug("preempt telemetry emit failed", exc_info=True)
+
+
+def _drain_signal() -> None:
+    """Promote a handler-recorded signal into a full stop request —
+    from NORMAL thread context, where locks/logging/telemetry are
+    safe."""
+    global _SIG_PENDING
+    reason = _SIG_PENDING
+    if reason is not None:
+        _SIG_PENDING = None
+        request_stop(reason)
+
+
+def stop_requested() -> bool:
+    """The batch-boundary poll. Also hosts the ``preempt.signal`` chaos
+    seam: an injected ``fail`` fault here IS a simulated SIGTERM — it
+    becomes a stop request, never an exception (every ``exc=`` variant,
+    including the plain-``OSError`` one, which is not an InjectedFault
+    subclass)."""
+    _drain_signal()
+    try:
+        faults.inject("preempt.signal")
+    except (faults.InjectedFault, OSError) as e:
+        request_stop(f"injected:{e}")
+    return _STOP.is_set()
+
+
+def stop_pending() -> bool:
+    """Flag state WITHOUT the chaos seam — for polls that are not batch
+    boundaries (e.g. ``run_pass``'s between-pass check), so a seeded
+    ``preempt.signal:fail:nth=K`` still means "the K-th BATCH
+    boundary"."""
+    _drain_signal()
+    return _STOP.is_set()
+
+
+def stop_reason() -> Optional[str]:
+    return _REASON
+
+
+def clear_stop() -> None:
+    """Reset the flag (a restarted in-process run; tests)."""
+    global _REASON, _SIG_PENDING
+    with _LOCK:
+        _STOP.clear()
+        _REASON = None
+        _SIG_PENDING = None
+
+
+def _handler(signum, frame) -> None:
+    """LOCK-FREE by design: runs on the main thread between bytecodes
+    and may interrupt code holding any lock (telemetry hub, logging,
+    this module's own) — so it only records the signal with plain
+    assignments; the next stop poll does the real work."""
+    global _SIG_PENDING
+    if (_STOP.is_set() or _SIG_PENDING is not None) \
+            and signum == signal.SIGINT:
+        # a second ctrl-C means "now" — restore default behavior
+        raise KeyboardInterrupt
+    _SIG_PENDING = f"signal:{signal.Signals(signum).name}"
+
+
+def install_signal_handlers(signums=(signal.SIGTERM,
+                                     signal.SIGINT)) -> bool:
+    """Route SIGTERM/SIGINT into :func:`request_stop`. Idempotent; must
+    run on the main thread (returns False elsewhere — e.g. a trainer
+    constructed inside a worker thread — rather than raising). Enabled
+    by ``FLAGS.graceful_shutdown`` at Trainer init."""
+    try:
+        for s in signums:
+            if s in _INSTALLED:
+                continue
+            _INSTALLED[s] = signal.signal(s, _handler)
+        return True
+    except ValueError:
+        log.warning("signal handlers need the main thread — graceful "
+                    "shutdown will rely on request_stop() only")
+        return False
+
+
+def uninstall_signal_handlers() -> None:
+    for s, prev in list(_INSTALLED.items()):
+        try:
+            signal.signal(s, prev)
+        except (ValueError, TypeError):
+            pass
+        del _INSTALLED[s]
+
+
+# ---- resume marker -----------------------------------------------------
+def write_resume_marker(root: str, **info) -> str:
+    """Atomically publish ``RESUME.json`` under ``root`` (the checkpoint
+    root) so the launcher knows this exit expects a resume. ``info``
+    typically carries step / batch_index / reason."""
+    from paddlebox_tpu.utils.fsio import atomic_write_json
+    os.makedirs(root, exist_ok=True)
+    return atomic_write_json(os.path.join(root, RESUME_MARKER),
+                             dict(info, exit_code=EXIT_RESUME))
+
+
+def read_resume_marker(root: str) -> Optional[dict]:
+    from paddlebox_tpu.utils.fsio import read_json
+    return read_json(os.path.join(root, RESUME_MARKER))
+
+
+def clear_resume_marker(root: str) -> bool:
+    """Consume the marker (the resumed run calls this once it has
+    adopted the cursor). Returns True if a marker was removed."""
+    try:
+        os.unlink(os.path.join(root, RESUME_MARKER))
+        return True
+    except OSError:
+        return False

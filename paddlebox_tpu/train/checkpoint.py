@@ -39,8 +39,9 @@ from paddlebox_tpu.utils.logging import get_logger
 log = get_logger(__name__)
 
 #: files whose content digests are recorded in meta.json and verified
-#: on restore (meta.json itself can't self-checksum)
-_CHECKSUMMED = ("sparse.npz", "sparse_delta.npz", "dense.pkl")
+#: on restore (meta.json itself is covered by the meta.sha256 sidecar)
+_CHECKSUMMED = ("sparse.npz", "sparse_delta.npz", "dense.pkl",
+                "cursor.json", "metrics.pkl")
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -66,10 +67,35 @@ def _io_retry() -> RetryPolicy:
                                   retryable=(OSError,))
 
 
+def _fsync_path(path: str) -> None:
+    """Best-effort durability flush for a file OR directory (directory
+    fsync flushes its entries, i.e. renames). Best-effort because some
+    FUSE/NFS mounts — the very deployment target of this hardening —
+    reject fsync; the write-then-rename convention still holds there,
+    so a refusal must not fail the save."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 class CheckpointManager:
     def __init__(self, root: str, keep: int = 3) -> None:
         self.root = root
         self.keep = keep
+        # the step this manager's TRAINER STATE descends from: set by
+        # restore() and save(). After a rollback-restore to an older
+        # step, the next delta must link to THAT step — not to
+        # latest_step(), which may still point at a newer checkpoint of
+        # the abandoned timeline (chaining through it would replay
+        # abandoned state into the restore).
+        self._lineage_tip: Optional[int] = None
         os.makedirs(root, exist_ok=True)
         self._recover()
 
@@ -93,13 +119,24 @@ class CheckpointManager:
         return os.path.join(self.root, f"ckpt-{step:012d}")
 
     def steps(self) -> List[int]:
+        """Steps with a complete-looking ``ckpt-*`` dir. A dir missing
+        its ``meta.json`` (a half-deleted checkpoint — retention or an
+        operator interrupted mid-rmtree) is skipped with a warning
+        instead of blowing up the next ``_retain``/``restore``."""
         out = []
         for name in os.listdir(self.root):
-            if name.startswith("ckpt-"):
-                try:
-                    out.append(int(name[5:]))
-                except ValueError:
-                    pass
+            if not name.startswith("ckpt-"):
+                continue
+            try:
+                s = int(name[5:])
+            except ValueError:
+                continue
+            if not os.path.isfile(os.path.join(self.root, name,
+                                               "meta.json")):
+                log.warning("ignoring half-deleted checkpoint %s "
+                            "(no meta.json)", name)
+                continue
+            out.append(s)
         return sorted(out)
 
     def latest_step(self) -> Optional[int]:
@@ -107,7 +144,7 @@ class CheckpointManager:
         try:
             with open(p) as fh:
                 s = int(fh.read().strip())
-            if os.path.isdir(self._dir(s)):
+            if os.path.isfile(os.path.join(self._dir(s), "meta.json")):
                 return s
         except (OSError, ValueError):
             pass
@@ -126,10 +163,23 @@ class CheckpointManager:
     def verify(self, step: int) -> None:
         """Check every checksummed file in ``ckpt-<step>`` against its
         meta.json digest; raises ``CheckpointCorruptError`` on mismatch.
-        Checkpoints written before checksums existed (no ``checksums``
-        key) verify trivially."""
-        meta = self._meta(step)
+        meta.json itself is covered by its ``meta.sha256`` sidecar, so a
+        torn meta write is detected like any other corrupt chain link.
+        Checkpoints written before checksums/sidecars existed verify
+        trivially."""
         d = self._dir(step)
+        side = os.path.join(d, "meta.sha256")
+        if os.path.isfile(side):
+            want = _io_retry().call(
+                lambda: open(side).read().strip())
+            got = _io_retry().call(_digest, os.path.join(d, "meta.json"))
+            if got != want:
+                raise CheckpointCorruptError(
+                    f"checkpoint {d}/meta.json is torn/corrupt: sha256 "
+                    f"{got[:12]}… != sidecar {want[:12]}… — refuse to "
+                    f"trust this chain link. Delete {d} and restore an "
+                    "older base, or resave from a healthy trainer.")
+        meta = self._meta(step)
         for name, want in meta.get("checksums", {}).items():
             p = os.path.join(d, name)
             got = _io_retry().call(_digest, p)
@@ -143,12 +193,24 @@ class CheckpointManager:
 
     # ---- save ----
     def save(self, trainer, step: Optional[int] = None,
-             delta: bool = False) -> str:
+             delta: bool = False, cursor: Optional[dict] = None,
+             metrics=None) -> str:
         """Snapshot the trainer. ``delta=True`` = save_delta (rows touched
-        since the previous save) referencing the most recent base."""
+        since the previous save) referencing the most recent base.
+
+        ``cursor`` marks a MID-PASS checkpoint: the dict (pass position —
+        ``Trainer._pass_cursor``) lands in ``cursor.json`` so a restart
+        resumes the pass from this batch instead of replaying it;
+        ``metrics`` (a MetricRegistry) snapshots the host-side metric
+        accumulators alongside (``metrics.pkl``). Checkpoints without a
+        cursor are pass-boundary checkpoints."""
         step = trainer.global_step if step is None else step
         base_step = None
-        prev_step = self.latest_step()  # chain link for gap detection
+        # chain link: the state we descend from — the last step this
+        # manager saved or restored (falls back to latest_step() for a
+        # fresh manager continuing an existing root)
+        prev_step = (self._lineage_tip if self._lineage_tip is not None
+                     else self.latest_step())
         if prev_step == step:
             # re-save at the same step: the predecessor is whatever the
             # existing checkpoint pointed at (never itself — _chain loops)
@@ -170,10 +232,18 @@ class CheckpointManager:
         shutil.rmtree(tmp, ignore_errors=True)
         os.makedirs(tmp)
         trainer.sync_table()
+        # mid-pass (cursor) saves must not clear the table's touched
+        # set: with the prefetch pipeline preparing ahead, a mid-pass
+        # clear drops assigned-but-not-yet-pushed rows from every later
+        # delta. A table type without the kwarg fails loudly here —
+        # silently clearing would corrupt the chain.
+        kw = {} if cursor is None else {"clear_touched": False}
         if delta:
-            n = trainer.table.save_delta(os.path.join(tmp, "sparse_delta.npz"))
+            n = trainer.table.save_delta(
+                os.path.join(tmp, "sparse_delta.npz"), **kw)
         else:
-            n = trainer.table.save_base(os.path.join(tmp, "sparse.npz"))
+            n = trainer.table.save_base(os.path.join(tmp, "sparse.npz"),
+                                        **kw)
         def write_dense() -> None:
             faults.inject("checkpoint.io", path=os.path.join(tmp,
                                                              "dense.pkl"))
@@ -188,6 +258,16 @@ class CheckpointManager:
                          trainer.state.auc))
                 pickle.dump(blob, fh)
         _io_retry().call(write_dense)
+        if cursor is not None:
+            def write_cursor() -> None:
+                path = os.path.join(tmp, "cursor.json")
+                faults.inject("checkpoint.cursor", path=path, op="save")
+                with open(path, "w") as fh:
+                    json.dump(cursor, fh)
+            _io_retry().call(write_cursor)
+            if metrics is not None and len(metrics):
+                with open(os.path.join(tmp, "metrics.pkl"), "wb") as fh:
+                    pickle.dump(metrics, fh)
         # content digests: restore refuses a bit-rotted chain link
         # instead of silently loading garbage rows
         checksums: Dict[str, str] = {
@@ -199,6 +279,16 @@ class CheckpointManager:
                        "base_step": base_step,
                        "prev_step": prev_step if delta else None,
                        "sparse_rows": n, "checksums": checksums}, fh)
+        # meta.sha256 sidecar: a torn meta.json write is detected on
+        # verify like any other corrupt chain link
+        with open(os.path.join(tmp, "meta.sha256"), "w") as fh:
+            fh.write(_digest(os.path.join(tmp, "meta.json")))
+        # crash consistency: flush file contents AND the temp dir's
+        # entries before the publish rename — otherwise a power cut
+        # after os.replace could expose a ckpt dir with empty files
+        for name in os.listdir(tmp):
+            _fsync_path(os.path.join(tmp, name))
+        _fsync_path(tmp)
         # chaos seam: a "fail" fault here models the process dying after
         # writing the temp dir but BEFORE the atomic publish — recovery
         # must come from the rename convention (tests/test_resilience.py)
@@ -214,25 +304,61 @@ class CheckpointManager:
             shutil.rmtree(aside, ignore_errors=True)
         else:
             os.replace(tmp, final)
+        _fsync_path(self.root)  # persist the publish rename itself
+        self._lineage_tip = step
         self._write_latest(step)
         self._retain()
-        log.info("checkpoint %s saved at step %d (%d sparse rows)",
-                 "delta" if delta else "base", step, n)
+        log.info("checkpoint %s saved at step %d (%d sparse rows%s)",
+                 "delta" if delta else "base", step, n,
+                 ", mid-pass cursor" if cursor is not None else "")
         return final
 
     def _write_latest(self, step: int) -> None:
         tmp = os.path.join(self.root, ".LATEST.tmp")
         with open(tmp, "w") as fh:
             fh.write(str(step))
+            fh.flush()
+            try:
+                os.fsync(fh.fileno())
+            except OSError:
+                pass  # best-effort (FUSE): rename stays atomic
         os.replace(tmp, os.path.join(self.root, "LATEST"))
 
     def _latest_base(self) -> Optional[int]:
         for s in reversed(self.steps()):
-            if self._meta(s)["kind"] == "base":
-                return s
+            try:
+                if self._meta(s)["kind"] == "base":
+                    return s
+            except (OSError, ValueError, KeyError) as e:
+                # a half-deleted/corrupt dir must not kill save/_retain
+                log.warning("skipping unreadable checkpoint %d while "
+                            "looking for a base: %r", s, e)
         return None
 
+    def has_base(self) -> bool:
+        """True once a base checkpoint exists (delta saves are legal)."""
+        return self._latest_base() is not None
+
     def _retain(self) -> None:
+        # finish/clean interrupted re-saves too (same logic as init):
+        # a long-running process otherwise accumulates aside dirs from
+        # crashes it survived without re-instantiating the manager
+        self._recover()
+        # sweep half-deleted carcasses: steps() hides meta-less dirs
+        # from restore, but their payloads (GBs of sparse.npz) must
+        # not accumulate on disk forever
+        for name in os.listdir(self.root):
+            if not name.startswith("ckpt-") or ".old-" in name:
+                continue
+            try:
+                int(name[5:])
+            except ValueError:
+                continue
+            if not os.path.isfile(os.path.join(self.root, name,
+                                               "meta.json")):
+                log.warning("removing half-deleted checkpoint %s", name)
+                shutil.rmtree(os.path.join(self.root, name),
+                              ignore_errors=True)
         steps = self.steps()
         if len(steps) <= self.keep:
             return
@@ -243,11 +369,92 @@ class CheckpointManager:
         for s in kept.copy():
             try:
                 kept.update(self._chain(s))
-            except (FileNotFoundError, OSError):
-                pass
+            except (OSError, ValueError, KeyError):
+                pass  # broken/half-deleted link: keep what we can
         for s in steps:
             if s not in kept:
                 shutil.rmtree(self._dir(s), ignore_errors=True)
+
+    # ---- mid-pass cursor (docs/RESILIENCE.md §Preemption) ----
+    def load_cursor(self, step: Optional[int] = None) -> Optional[dict]:
+        """The resume cursor stored with ``ckpt-<step>`` (default:
+        latest), or None for a pass-boundary checkpoint / no checkpoint.
+        An unreadable cursor is treated as absent (the pass replays from
+        this step's state) rather than fatal."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None
+        path = os.path.join(self._dir(step), "cursor.json")
+        faults.inject("checkpoint.cursor", path=path, op="load")
+        if not os.path.isfile(path):
+            return None
+        try:
+            with open(path) as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            log.warning("unreadable cursor.json at step %s — ignoring "
+                        "(full pass replay)", step)
+            return None
+
+    def load_metrics(self, step: Optional[int] = None):
+        """The MetricRegistry snapshot stored with a mid-pass
+        checkpoint, or None."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None
+        path = os.path.join(self._dir(step), "metrics.pkl")
+        if not os.path.isfile(path):
+            return None
+        try:
+            with open(path, "rb") as fh:
+                return pickle.load(fh)
+        except (OSError, ValueError, pickle.UnpicklingError):
+            log.warning("unreadable metrics.pkl at step %s — metric "
+                        "accumulators restart for this pass", step)
+            return None
+
+    def latest_boundary_step(self) -> Optional[int]:
+        """Newest checkpoint WITHOUT a cursor — the last pass-boundary
+        state, the safe rollback target when a mid-pass cursor can't be
+        applied (e.g. the dataset changed)."""
+        for s in reversed(self.steps()):
+            if not os.path.isfile(os.path.join(self._dir(s),
+                                               "cursor.json")):
+                return s
+        return None
+
+    def verified_steps(self) -> List[int]:
+        """Every step whose ENTIRE base+delta chain verifies locally —
+        what a process publishes into the restore consensus
+        (resilience/consensus.consensus_restore): agreeing over full
+        sets lets the mesh pick a step that exists EVERYWHERE even when
+        retention windows drifted apart."""
+        out: List[int] = []
+        verified: Dict[int, bool] = {}
+
+        def ok(link: int) -> bool:
+            if link not in verified:
+                try:
+                    self.verify(link)
+                    verified[link] = True
+                except Exception as e:
+                    log.warning("step %d fails local verification (%r)",
+                                link, e)
+                    verified[link] = False
+            return verified[link]
+
+        for s in self.steps():
+            try:
+                if all(ok(link) for link in self._chain(s)):
+                    out.append(s)
+            except Exception as e:
+                log.warning("step %d has a broken chain (%r)", s, e)
+        return out
+
+    def latest_verified_step(self) -> Optional[int]:
+        """Newest step whose whole chain verifies locally, or None."""
+        steps = self.verified_steps()
+        return steps[-1] if steps else None
 
     # ---- restore ----
     def restore(self, trainer, step: Optional[int] = None) -> Optional[int]:
@@ -285,6 +492,7 @@ class CheckpointManager:
             trainer.restore_state(jax.device_put(params),
                                   jax.device_put(opt_state),
                                   jax.device_put(auc), target)
+        self._lineage_tip = target
         log.info("restored step %d (chain: %s)", target, chain)
         return target
 
@@ -308,9 +516,44 @@ class CheckpointManager:
                 raise ValueError(
                     f"delta checkpoint {cur} has no prev_step link — "
                     "unsupported checkpoint format")
-            if prev == cur or not os.path.isdir(self._dir(prev)):
+            if prev >= cur:
+                # a delta can only descend from an OLDER state; a
+                # forward link means a foreign/abandoned-timeline meta
+                raise ValueError(
+                    f"delta checkpoint {cur} links forward to {prev} — "
+                    "corrupt or abandoned-timeline chain; restore an "
+                    "older base or resave")
+            if not os.path.isdir(self._dir(prev)):
                 raise FileNotFoundError(
                     f"checkpoint chain broken: {cur} needs {prev} "
                     "(deleted or lost) — restore an older base or resave")
             chain.insert(0, prev)
             cur = prev
+
+
+def state_digest(trainer) -> str:
+    """sha256 over the trainer's LOGICAL state: every table row keyed and
+    sorted by feasign (row-id assignment order cancels out — a resumed
+    run allocates rows in a different order than an uninterrupted one),
+    plus the dense params / optimizer / AUC pytree leaves. Two trainers
+    with equal digests hold byte-identical model state; the preemption
+    e2e (tests/test_preemption.py, scripts/preempt_check.py) asserts
+    resume-from-cursor reproduces the uninterrupted digest exactly."""
+    import numpy as _np
+    trainer.sync_table()
+    table = trainer.table
+    h = hashlib.sha256()
+    with table.host_lock:
+        keys, rows = table.index.items()
+    order = _np.argsort(keys)
+    keys, rows = keys[order], rows[order]
+    h.update(_np.ascontiguousarray(keys).tobytes())
+    blob = table._gather_host(rows)
+    for f in sorted(blob):
+        h.update(f.encode())
+        h.update(_np.ascontiguousarray(blob[f]).tobytes())
+    for leaf in jax.tree_util.tree_leaves(
+            jax.device_get((trainer.state.params, trainer.state.opt_state,
+                            trainer.state.auc))):
+        h.update(_np.ascontiguousarray(_np.asarray(leaf)).tobytes())
+    return h.hexdigest()

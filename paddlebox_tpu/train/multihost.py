@@ -134,3 +134,38 @@ def make_straggler_watchdog(heartbeat_dir: Optional[str] = None,
     wd = StragglerWatchdog(store, jax.process_index(),
                            jax.process_count(), **kw)
     return wd.start() if start else wd
+
+
+# ---- consistent recovery (resilience/consensus) ------------------------
+def make_restore_consensus(consensus_dir: Optional[str] = None, **kwargs):
+    """Build this process's restore-consensus client (same shared-dir
+    pattern as the heartbeat store). Every process constructs one after
+    ``jax.distributed.initialize`` and recovers through it:
+
+        consensus = make_restore_consensus()
+        step = consensus_restore(cm, trainer, consensus)   # agreed min
+        sync_shared_quarantine(ds, consensus)              # same drops
+
+    so every rank restores the SAME step and drops the SAME quarantined
+    files — preserving the byte-identical-batches SPMD contract above.
+    ``consensus_dir`` must be shared across hosts (NFS/FUSE); defaults
+    to ``FLAGS.restore_consensus_dir``. ``kwargs`` override any
+    ``RestoreConsensus`` parameter (tests inject clocks/timeouts).
+    ``epoch`` defaults to the launcher-provided ``PBOX_RESTORE_EPOCH``
+    env (its restart counter) so directory reuse across episodes is
+    safe by default; the digest-confirm barrier inside every gather
+    additionally guarantees stale files can only cause a loud retry /
+    timeout, never a silent divergent agreement."""
+    import os
+    from paddlebox_tpu.config import FLAGS
+    from paddlebox_tpu.resilience.consensus import (DirConsensusStore,
+                                                    RestoreConsensus)
+    d = consensus_dir or FLAGS.restore_consensus_dir
+    if not d:
+        raise ValueError(
+            "restore consensus needs a SHARED dir: pass consensus_dir= "
+            "or set FLAGS.restore_consensus_dir")
+    kwargs.setdefault("epoch",
+                      int(os.environ.get("PBOX_RESTORE_EPOCH", "0")))
+    return RestoreConsensus(DirConsensusStore(d), jax.process_index(),
+                            jax.process_count(), **kwargs)

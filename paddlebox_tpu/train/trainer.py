@@ -103,6 +103,11 @@ class Trainer:
         # FLAGS.fault_plan; chaos runs need no code changes)
         from paddlebox_tpu.resilience.faults import install_from_flags
         install_from_flags()
+        # graceful preemption: SIGTERM/SIGINT become a stop flag the
+        # pass loop honors at batch boundaries (resilience/preemption)
+        if FLAGS.graceful_shutdown:
+            from paddlebox_tpu.resilience import preemption
+            preemption.install_signal_handlers()
         self._pass_seq = 0
 
     # ---- host-side prefetch: batch build + dedup + row assign + H2D ----
@@ -142,9 +147,24 @@ class Trainer:
         from paddlebox_tpu.utils.dump import dump_param
         return dump_param(self.state.params, path)
 
-    def train_pass(self, dataset: Dataset,
-                   log_prefix: str = "") -> Dict[str, float]:
-        """One pass over the dataset — train_from_dataset analogue."""
+    def train_pass(self, dataset: Dataset, log_prefix: str = "",
+                   checkpoint=None,
+                   start_cursor: Optional[dict] = None
+                   ) -> Dict[str, float]:
+        """One pass over the dataset — train_from_dataset analogue.
+
+        Preemption-safe (docs/RESILIENCE.md §Preemption & mid-pass
+        resume): the loop polls the graceful-stop flag at every batch
+        boundary; a stop finishes the in-flight step, writes an
+        emergency checkpoint with a resume cursor (when ``checkpoint``
+        is a CheckpointManager and the dataset's batch order is
+        deterministic) and raises ``PreemptedError``. With
+        ``FLAGS.ckpt_every_batches > 0`` the same cursor checkpoint is
+        also written periodically, bounding replay after a HARD kill.
+        ``start_cursor`` (from ``CheckpointManager.load_cursor``)
+        resumes a preempted pass: the already-trained batch prefix is
+        skipped instead of replayed."""
+        from paddlebox_tpu.resilience import preemption
         timer = Timer()
         timer.start()
         self.stage_timers.reset()  # this pass's stages only (report below)
@@ -156,7 +176,20 @@ class Trainer:
             dump_writer = DumpWriter(self._dump_cfg)
         n_ex = 0
         st = self.stage_timers
-        for batch, dev in self._prefetch_iter(dataset.batches()):
+        skip = 0
+        if start_cursor is not None:
+            skip = int(start_cursor.get("batch_index", 0))
+            log.info("%sresuming pass from cursor: skipping %d "
+                     "already-trained batches (step %d)", log_prefix,
+                     skip, self.global_step)
+        cursor_ok = (checkpoint is not None
+                     and getattr(dataset, "supports_cursor_resume",
+                                 False))
+        every = FLAGS.ckpt_every_batches if cursor_ok else 0
+        last_save = (-1, None)  # (batch_index, path) of the newest save
+        for batch, dev in self._prefetch_iter(
+                dataset.batches(start_batch=skip) if skip
+                else dataset.batches()):
             n_ex += int((batch.show > 0).sum())
             self.global_step += 1
             rng = jax.random.fold_in(self._rng, self.global_step)
@@ -193,11 +226,71 @@ class Trainer:
                 if nb % FLAGS.log_period_steps == 0:
                     log.info("%spass step %d loss=%.5f", log_prefix,
                              self.global_step, loss)
+            # ---- batch boundary: periodic cursor checkpoint + stop poll
+            if every > 0 and nb % every == 0:
+                last_save = (skip + nb,
+                             self._save_inpass(checkpoint, dataset,
+                                               skip + nb,
+                                               reason="periodic"))
+            if preemption.stop_requested():
+                # the dispatched step is already folded into self.state;
+                # snapshot it, mark the restart, and exit the pass
+                if dump_writer is not None:
+                    dump_writer.close()  # flush buffered dump records
+                path = None
+                if cursor_ok:
+                    if last_save[0] == skip + nb:
+                        # the periodic save already snapshotted THIS
+                        # boundary — a second save at the same step
+                        # would only churn (or demote a base to delta)
+                        path = last_save[1]
+                        from paddlebox_tpu.obs.hub import get_hub
+                        if get_hub().active:
+                            get_hub().emit(
+                                "emergency_checkpoint",
+                                reason="preempt", reused=True,
+                                batch_index=int(skip + nb),
+                                global_step=int(self.global_step),
+                                path=path)
+                    else:
+                        path = self._save_inpass(checkpoint, dataset,
+                                                 skip + nb,
+                                                 reason="preempt")
+                    preemption.write_resume_marker(
+                        checkpoint.root, step=int(self.global_step),
+                        batch_index=skip + nb,
+                        reason=preemption.stop_reason())
+                else:
+                    log.warning(
+                        "%sstop requested but no checkpoint manager / "
+                        "deterministic dataset — exiting WITHOUT an "
+                        "emergency checkpoint (pass will replay)",
+                        log_prefix)
+                raise preemption.PreemptedError(
+                    f"preempted ({preemption.stop_reason()}) at batch "
+                    f"{skip + nb}, step {self.global_step}"
+                    + ("" if path is None else f"; emergency checkpoint "
+                       f"{path}"),
+                    step=int(self.global_step), batch_index=skip + nb,
+                    checkpoint_path=path)
         last_loss = float(stats["loss"]) if stats is not None else float("nan")
         if dump_writer is not None:
             dump_writer.close()
         timer.pause()
         self.sync_table()
+        if cursor_ok and (last_save[0] >= 0 or skip > 0):
+            # the pass completed after writing (or resuming from) a
+            # mid-pass cursor checkpoint: publish a pass-boundary
+            # checkpoint so the newest restorable state carries NO
+            # cursor (a later rollback must not resume into a pass that
+            # already finished)
+            try:
+                checkpoint.save(self, delta=checkpoint.has_base())
+            except ValueError:
+                # the cadence hit the pass length exactly and the save
+                # at this step is the first BASE — a delta re-save over
+                # it is refused, so supersede it with a fresh base
+                checkpoint.save(self, delta=False)
         res = auc_compute(self.state.auc)
         out = res.as_dict()
         # ex/s counts THIS pass's instances (res.ins_num is cumulative
@@ -212,33 +305,210 @@ class Trainer:
         self._emit_pass("train_pass", out, n_ex, stage_timers=True)
         return out
 
+    # ---- mid-pass resume cursor glue (docs/RESILIENCE.md) ----
+    def _pass_cursor(self, dataset, batch_index: int) -> dict:
+        """The resume cursor stored with an in-pass checkpoint: enough
+        to restart THIS pass at ``batch_index`` — the file-list identity
+        + quarantine decisions pin the data, global_step pins both the
+        trainer position and the per-step rng fold
+        (``fold_in(rng, global_step)``), and the AUC/metric accumulators
+        ride the checkpoint itself (dense.pkl / metrics.pkl)."""
+        return {
+            "pass_seq": int(self._pass_seq) + 1,
+            "fingerprint": dataset.filelist_fingerprint(),
+            "files_consumed": len(getattr(dataset, "filelist", [])),
+            "batch_index": int(batch_index),
+            "global_step": int(self.global_step),
+            "rng_fold": int(self.global_step),
+            "quarantined_files": sorted(
+                p for p, _ in getattr(dataset, "quarantined_files", [])),
+        }
+
+    def _save_inpass(self, checkpoint, dataset, batch_index: int,
+                     reason: str) -> str:
+        """Write a mid-pass checkpoint (delta once a base exists) with
+        the resume cursor + metric snapshot."""
+        path = checkpoint.save(
+            self, delta=checkpoint.has_base(),
+            cursor=self._pass_cursor(dataset, batch_index),
+            metrics=self.metrics if len(self.metrics) else None)
+        from paddlebox_tpu.obs.hub import get_hub
+        hub = get_hub()
+        event = ("emergency_checkpoint" if reason == "preempt"
+                 else "inpass_checkpoint")
+        hub.counter("pbox_inpass_checkpoints_total",
+                    "mid-pass cursor checkpoints written").inc(
+                        reason=reason)
+        if hub.active:
+            hub.emit(event, reason=reason, batch_index=int(batch_index),
+                     global_step=int(self.global_step), path=path)
+        return path
+
+    def _adopt_cursor(self, checkpoint, dataset,
+                      step: Optional[int] = None) -> Optional[dict]:
+        """Cursor for the trainer's CURRENT position, validated against
+        this dataset. Returns the cursor to resume from, or None for a
+        full pass. A cursor at our step whose data identity mismatches
+        (different file list / different quarantine outcome) is
+        dangerous — resuming would splice two different batch streams —
+        so the trainer rolls BACK to the latest pass-boundary
+        checkpoint instead. The same applies when the dataset cannot
+        resume at all (non-deterministic batch order): the trainer
+        sits on MID-PASS state, and training a "full" pass from it
+        would double-train the consumed prefix."""
+        cur = checkpoint.load_cursor(step)
+        if cur is None:
+            return None
+        if int(cur.get("global_step", -1)) != int(self.global_step):
+            return None  # cursor belongs to a different position
+        reason = None
+        if not getattr(dataset, "supports_cursor_resume", False):
+            reason = ("dataset batch order is not deterministic "
+                      "(supports_cursor_resume is False)")
+        else:
+            fp = dataset.filelist_fingerprint()
+            quar = sorted(p for p, _ in dataset.quarantined_files)
+            if (cur.get("fingerprint") != fp
+                    or sorted(cur.get("quarantined_files", [])) != quar):
+                reason = "fingerprint/quarantine changed"
+        if reason is not None:
+            boundary = checkpoint.latest_boundary_step()
+            if boundary is None:
+                # no pass-boundary state exists: replaying a "full"
+                # pass from mid-pass state would double-train the
+                # consumed prefix — unrecoverable automatically
+                raise RuntimeError(
+                    f"mid-pass cursor cannot be resumed ({reason}) and "
+                    "no pass-boundary checkpoint exists to roll back "
+                    "to — restart from scratch or restore the original "
+                    "file list / deterministic load settings")
+            log.warning(
+                "mid-pass cursor at step %s cannot be resumed (%s) — "
+                "rolling back to pass-boundary step %s",
+                self.global_step, reason, boundary)
+            checkpoint.restore(self, step=boundary)
+            return None
+        mr = checkpoint.load_metrics(step)
+        if mr is not None:
+            self.metrics = mr
+        from paddlebox_tpu.resilience import preemption
+        preemption.clear_resume_marker(checkpoint.root)
+        from paddlebox_tpu.obs.hub import get_hub
+        hub = get_hub()
+        hub.counter("pbox_cursor_resumes_total",
+                    "passes resumed mid-pass from a cursor").inc()
+        if hub.active:
+            hub.emit("cursor_resume",
+                     global_step=int(self.global_step),
+                     batch_index=int(cur.get("batch_index", 0)),
+                     pass_seq=cur.get("pass_seq"))
+        return cur
+
+    def _reject_cursor_state(self, checkpoint) -> None:
+        """Resident-mode guard: a trainer sitting on a MID-PASS cursor
+        checkpoint cannot hand the pass to ``train_pass_resident`` (one
+        device program — no mid-pass entry point); training a "full"
+        pass from mid-pass state would double-train the consumed
+        prefix. Roll back to the pass boundary, or refuse."""
+        cur = checkpoint.load_cursor()
+        if cur is None or int(cur.get("global_step", -1)) \
+                != int(self.global_step):
+            return
+        boundary = checkpoint.latest_boundary_step()
+        if boundary is None:
+            raise RuntimeError(
+                "trainer state is mid-pass (cursor checkpoint) but "
+                "resident passes cannot resume mid-pass, and no "
+                "pass-boundary checkpoint exists to roll back to — "
+                "finish the pass in streaming mode first")
+        log.warning(
+            "mid-pass cursor at step %s cannot feed a resident pass — "
+            "rolling back to pass-boundary step %s", self.global_step,
+            boundary)
+        checkpoint.restore(self, step=boundary)
+
     def run_pass(self, dataset: Dataset, checkpoint=None,
                  log_prefix: str = "", resident: bool = False,
                  max_retries: Optional[int] = None) -> Dict[str, float]:
-        """``train_pass`` with bounded retry-from-last-checkpoint
-        (docs/RESILIENCE.md §pass-level recovery).
+        """``train_pass`` with bounded retry-from-last-checkpoint and
+        cursor-aware recovery (docs/RESILIENCE.md §pass-level recovery,
+        §Preemption & mid-pass resume).
 
         A pass that dies on a *recoverable* error (transient IO /
-        injected fault / nan-inf guard) is retried up to
-        ``FLAGS.pass_retry_limit`` (override with ``max_retries``)
-        times. With a ``checkpoint`` (CheckpointManager), each retry
-        first rolls the trainer back to the last consistent step, so a
-        partially-applied pass never compounds; without one the retry
-        re-runs from current state (logged — only safe for idempotent
-        passes). Non-recoverable errors and exhausted budgets raise."""
-        from paddlebox_tpu.resilience import faults
+        injected fault) is retried up to ``FLAGS.pass_retry_limit``
+        (override with ``max_retries``) times. With a ``checkpoint``
+        (CheckpointManager), each retry first rolls the trainer back to
+        the last consistent step — and when that step carries a mid-pass
+        cursor matching this dataset, the retry REPLAYS ONLY the batches
+        after it instead of the whole pass. The same applies on entry:
+        a freshly-restored trainer sitting on a cursor checkpoint
+        resumes the interrupted pass seamlessly. A ``NanInfError`` is
+        only recoverable when a checkpoint can roll the poisoned state
+        back — without one, retrying from live NaN state would just
+        re-diverge, so it raises immediately. ``PreemptedError`` (a
+        deliberate graceful shutdown) is never retried.
+
+        Resident passes run as ONE device program and cannot stop at a
+        batch boundary; the stop flag is honored at PASS granularity
+        instead — checked before every attempt, so a preempted
+        resident job exits (with an inter-pass checkpoint) before
+        dispatching the next pass."""
+        from paddlebox_tpu.resilience import faults, preemption
+        from paddlebox_tpu.resilience.preemption import PreemptedError
         from paddlebox_tpu.resilience.retry import is_retryable
         limit = (FLAGS.pass_retry_limit if max_retries is None
                  else max_retries)
         attempt = 0
+        start_cursor = None
+        if checkpoint is not None:
+            if resident:
+                self._reject_cursor_state(checkpoint)
+            else:
+                # restart path: a launcher that restored to a mid-pass
+                # checkpoint resumes the interrupted pass here
+                start_cursor = self._adopt_cursor(checkpoint, dataset)
         while True:
             try:
+                if preemption.stop_pending():
+                    # graceful stop BETWEEN passes/attempts (the only
+                    # stop point a resident pass has). Without an
+                    # adopted cursor the state sits at a pass boundary
+                    # — snapshot it; with one, the mid-pass checkpoint
+                    # already on disk covers the state.
+                    path = None
+                    if checkpoint is not None:
+                        if start_cursor is None:
+                            path = checkpoint.save(
+                                self, delta=checkpoint.has_base())
+                        preemption.write_resume_marker(
+                            checkpoint.root, step=int(self.global_step),
+                            reason=preemption.stop_reason())
+                    raise PreemptedError(
+                        f"preempted ({preemption.stop_reason()}) "
+                        f"before pass dispatch at step "
+                        f"{self.global_step}",
+                        step=int(self.global_step),
+                        checkpoint_path=path)
                 faults.inject("trainer.pass", attempt=attempt)
                 if resident:
                     return self.train_pass_resident(dataset, log_prefix)
-                return self.train_pass(dataset, log_prefix)
+                return self.train_pass(dataset, log_prefix,
+                                       checkpoint=checkpoint,
+                                       start_cursor=start_cursor)
+            except PreemptedError:
+                raise  # deliberate shutdown — the launcher handles it
             except Exception as e:
-                recoverable = is_retryable(e) or isinstance(e, NanInfError)
+                # NaN needs a real rollback TARGET, not just a manager:
+                # with nothing saved yet, restore() is a no-op and every
+                # retry would replay from the poisoned live state. And
+                # the target must be a PASS BOUNDARY — a mid-pass cursor
+                # checkpoint may itself hold the poison (params go NaN
+                # one batch before the loss guard can see it)
+                recoverable = (is_retryable(e)
+                               or (isinstance(e, NanInfError)
+                                   and checkpoint is not None
+                                   and checkpoint.latest_boundary_step()
+                                   is not None))
                 if attempt >= limit or not recoverable:
                     raise
                 attempt += 1
@@ -251,11 +521,28 @@ class Trainer:
                              error=repr(e),
                              global_step=self.global_step)
                 if checkpoint is not None:
-                    restored = checkpoint.restore(self)
+                    if isinstance(e, NanInfError):
+                        # mid-pass snapshots are suspect (see above):
+                        # roll all the way back to the clean boundary
+                        restored = checkpoint.restore(
+                            self, step=checkpoint.latest_boundary_step())
+                        start_cursor = None
+                    elif resident:
+                        restored = checkpoint.restore(self)
+                        self._reject_cursor_state(checkpoint)
+                        start_cursor = None
+                    else:
+                        restored = checkpoint.restore(self)
+                        start_cursor = self._adopt_cursor(checkpoint,
+                                                          dataset,
+                                                          restored)
                     log.warning(
-                        "%spass failed (%r) — rolled back to step %s, "
-                        "retry %d/%d", log_prefix, e, restored, attempt,
-                        limit)
+                        "%spass failed (%r) — rolled back to step %s%s, "
+                        "retry %d/%d", log_prefix, e, restored,
+                        ("" if start_cursor is None else
+                         f" (cursor: batch "
+                         f"{start_cursor.get('batch_index')})"),
+                        attempt, limit)
                 else:
                     log.warning(
                         "%spass failed (%r) — no checkpoint manager, "

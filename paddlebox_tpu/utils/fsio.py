@@ -1,0 +1,42 @@
+"""Crash-safe small-file IO shared by the shared-dir protocols.
+
+One implementation of the write-tmp → flush → fsync → ``os.replace``
+publish used by the heartbeat store (obs/watchdog), the restore
+consensus (resilience/consensus), and the resume marker
+(resilience/preemption): readers never see a torn file, and the payload
+is durable before the rename makes it visible.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def atomic_write_json(path: str, payload: dict,
+                      fsync: bool = True) -> str:
+    """Atomically publish ``payload`` as JSON at ``path``. The temp
+    file carries the writer's pid so concurrent writers (one per
+    process in the shared-dir protocols) never collide."""
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh)
+        if fsync:
+            fh.flush()
+            try:
+                os.fsync(fh.fileno())
+            except OSError:
+                pass  # some FUSE mounts reject fsync; rename still atomic
+    os.replace(tmp, path)
+    return path
+
+
+def read_json(path: str):
+    """Read a JSON file published by :func:`atomic_write_json`;
+    returns None on a missing/torn/foreign file (the caller's next
+    poll sees the completed rename)."""
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None

@@ -110,6 +110,23 @@ def render_table(rows: List[Dict[str, str]]) -> str:
     return "\n".join(lines)
 
 
+#: preemption / recovery lifecycle events rendered as their own
+#: timeline (docs/RESILIENCE.md §Preemption & mid-pass resume)
+RECOVERY_EVENTS = ("preempt_requested", "emergency_checkpoint",
+                   "inpass_checkpoint", "cursor_resume",
+                   "restore_consensus", "pass_retry")
+
+
+def _fmt_recovery(ev: dict) -> str:
+    name = ev.get("event", "?")
+    bits = []
+    for k in ("reason", "kind", "global_step", "batch_index", "agreed",
+              "attempt"):
+        if k in ev:
+            bits.append(f"{k}={ev[k]}")
+    return f"{name}({', '.join(bits)})" if bits else name
+
+
 def render_report(events: List[dict], show_events: bool = False) -> str:
     rows = build_rows(events)
     out = [render_table(rows)]
@@ -122,6 +139,10 @@ def render_report(events: List[dict], show_events: bool = False) -> str:
                    f"{tot_wall:.3f}s inside passes"
                    + (f", {tot_ex / tot_wall:.0f} ex/s overall"
                       if tot_wall > 0 else ""))
+    recovery = [e for e in events if e.get("event") in RECOVERY_EVENTS]
+    if recovery:
+        out.append("recovery: " + " -> ".join(_fmt_recovery(e)
+                                              for e in recovery))
     other = [e for e in events if e.get("event") != "pass"]
     if other:
         counts: Dict[str, int] = {}

@@ -1,0 +1,1086 @@
+"""Preemption survival kit (ISSUE 3 acceptance surface): graceful
+shutdown (stop flag, SIGTERM), emergency checkpoints with mid-pass
+resume cursors, cursor-aware ``run_pass`` recovery, checkpoint
+crash-consistency hardening (meta sidecar, half-deleted dirs), and
+multihost-consistent recovery (restore-step consensus + shared
+quarantine)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import optax
+import pytest
+
+from paddlebox_tpu.config import flags_scope
+from paddlebox_tpu.data import DataFeedDesc, DatasetFactory
+from paddlebox_tpu.data.criteo import generate_criteo_files
+from paddlebox_tpu.obs import MemorySink, get_hub, reset_hub
+from paddlebox_tpu.resilience import preemption
+from paddlebox_tpu.resilience.consensus import (ConsensusTimeout,
+                                                DirConsensusStore,
+                                                RestoreConsensus,
+                                                sync_shared_quarantine)
+from paddlebox_tpu.resilience.faults import FaultPlan, installed
+from paddlebox_tpu.resilience.preemption import PreemptedError
+from paddlebox_tpu.train.checkpoint import (CheckpointCorruptError,
+                                            CheckpointManager,
+                                            state_digest)
+from paddlebox_tpu.train.trainer import NanInfError
+
+
+@pytest.fixture(autouse=True)
+def clean_preempt_state():
+    preemption.clear_stop()
+    yield
+    preemption.clear_stop()
+    preemption.uninstall_signal_handlers()
+
+
+@pytest.fixture()
+def fresh_hub():
+    hub = reset_hub()
+    yield hub
+    reset_hub()
+
+
+# ---- stop flag / marker API -------------------------------------------
+def test_request_stop_roundtrip(fresh_hub):
+    sink = MemorySink()
+    fresh_hub.add_sink(sink)
+    assert not preemption.stop_requested()
+    preemption.request_stop("unit-test")
+    assert preemption.stop_requested()
+    assert preemption.stop_reason() == "unit-test"
+    preemption.request_stop("second")  # first reason wins
+    assert preemption.stop_reason() == "unit-test"
+    preemption.clear_stop()
+    assert not preemption.stop_requested()
+    evs = [e for e in sink.events if e["event"] == "preempt_requested"]
+    assert len(evs) == 1 and evs[0]["reason"] == "unit-test"
+    assert fresh_hub.counter("pbox_preempt_requests_total").value() == 1
+
+
+def test_injected_fault_becomes_stop_request():
+    plan = FaultPlan.parse("preempt.signal:fail:nth=3")
+    with installed(plan):
+        assert not preemption.stop_requested()   # call 1
+        assert not preemption.stop_requested()   # call 2
+        assert preemption.stop_requested()       # call 3: fault -> stop
+    assert "injected" in preemption.stop_reason()
+    assert plan.stats()["preempt.signal:fail"]["fired"] == 1
+
+
+def test_signal_handler_is_lock_free(fresh_hub):
+    """The handler runs on the main thread between bytecodes and may
+    interrupt code HOLDING the telemetry/logging/module locks — it must
+    not acquire any itself (a deadlock there burns the whole grace
+    window). The real work happens at the next poll."""
+    import paddlebox_tpu.resilience.preemption as pre
+    sink = MemorySink()
+    fresh_hub.add_sink(sink)
+    with fresh_hub._lock:          # simulate: interrupted mid-emit
+        pre._handler(signal.SIGTERM.value, None)   # must not block
+        assert pre._SIG_PENDING == "signal:SIGTERM"
+        assert not [e for e in sink.events
+                    if e["event"] == "preempt_requested"]
+    assert preemption.stop_pending()               # drained at poll
+    assert preemption.stop_reason() == "signal:SIGTERM"
+    assert [e for e in sink.events if e["event"] == "preempt_requested"]
+
+
+def test_resume_marker_roundtrip(tmp_path):
+    root = str(tmp_path / "ckpt")
+    assert preemption.read_resume_marker(root) is None
+    preemption.write_resume_marker(root, step=42, batch_index=7,
+                                   reason="signal:SIGTERM")
+    m = preemption.read_resume_marker(root)
+    assert m["step"] == 42 and m["batch_index"] == 7
+    assert m["exit_code"] == preemption.EXIT_RESUME == 75
+    assert preemption.clear_resume_marker(root)
+    assert preemption.read_resume_marker(root) is None
+    assert not preemption.clear_resume_marker(root)  # already gone
+
+
+# ---- batch skipping (cursor substrate) --------------------------------
+def _mini_files(tmp_path, n=2, rows=80, seed=11):
+    return generate_criteo_files(str(tmp_path / "data"), num_files=n,
+                                 rows_per_file=rows, vocab_per_slot=40,
+                                 seed=seed)
+
+
+def _batches_equal(a, b):
+    return (np.array_equal(a.keys, b.keys)
+            and np.array_equal(a.label, b.label)
+            and np.array_equal(a.dense, b.dense))
+
+
+@pytest.mark.parametrize("native", [False, True])
+def test_start_batch_skips_exact_prefix(tmp_path, native):
+    files = _mini_files(tmp_path)
+    desc = DataFeedDesc.criteo(batch_size=16)
+    with flags_scope(native_parse=native):
+        ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        if not native:
+            assert ds.columnar is None  # exercise the record path
+        full = list(ds.batches())
+        tail = list(ds.batches(start_batch=3))
+    assert len(tail) == len(full) - 3
+    assert all(_batches_equal(x, y) for x, y in zip(full[3:], tail))
+
+
+def test_threaded_record_load_disables_cursor_resume(tmp_path):
+    """Multi-thread per-line loads have timing-dependent record order —
+    a cursor over them would splice two different streams, so resume
+    support must reflect load determinism."""
+    files = _mini_files(tmp_path)
+    desc = DataFeedDesc.criteo(batch_size=16)
+
+    def load(native, threads):
+        with flags_scope(native_parse=native, read_thread_num=threads):
+            ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+            ds.set_filelist(files)
+            ds.load_into_memory()
+            return ds
+
+    assert load(native=True, threads=8).supports_cursor_resume
+    assert load(native=False, threads=1).supports_cursor_resume
+    assert not load(native=False, threads=8).supports_cursor_resume
+
+
+def test_queue_dataset_refuses_cursor_resume(tmp_path):
+    files = _mini_files(tmp_path)
+    desc = DataFeedDesc.criteo(batch_size=16)
+    ds = DatasetFactory().create_dataset("QueueDataset", desc)
+    ds.set_filelist(files)
+    assert not ds.supports_cursor_resume
+    with pytest.raises(ValueError, match="deterministic"):
+        next(ds.batches(start_batch=1))
+
+
+def test_filelist_fingerprint_is_order_sensitive(tmp_path):
+    files = _mini_files(tmp_path)
+    desc = DataFeedDesc.criteo(batch_size=16)
+    ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+    ds.set_filelist(files)
+    fp = ds.filelist_fingerprint()
+    ds.set_filelist(list(reversed(files)))
+    assert ds.filelist_fingerprint() != fp
+    ds.set_filelist(files)
+    assert ds.filelist_fingerprint() == fp
+
+
+# ---- trainer fixtures --------------------------------------------------
+@pytest.fixture()
+def trainer_setup(tmp_path):
+    from paddlebox_tpu.models import CtrDnn
+    from paddlebox_tpu.ps import EmbeddingTable, SparseSGDConfig
+
+    files = generate_criteo_files(str(tmp_path / "data"), num_files=2,
+                                  rows_per_file=160, vocab_per_slot=30,
+                                  seed=3)
+    desc = DataFeedDesc.criteo(batch_size=32)
+    desc.key_bucket_min = 2048
+    cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=0.0)
+
+    def mk():
+        from paddlebox_tpu.train import Trainer
+        t = EmbeddingTable(mf_dim=4, capacity=1 << 12, cfg=cfg,
+                           unique_bucket_min=2048)
+        return Trainer(CtrDnn(hidden=(8,)), t, desc, tx=optax.adam(1e-2),
+                       seed=0)
+
+    def mkds(filelist=None):
+        ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+        ds.set_filelist(filelist or files)
+        ds.load_into_memory()
+        return ds
+
+    return files, mk, mkds, str(tmp_path / "ckpt")
+
+
+# ---- preemption e2e ----------------------------------------------------
+@pytest.mark.chaos
+def test_preempt_writes_emergency_ckpt_and_is_not_retried(trainer_setup,
+                                                          fresh_hub):
+    files, mk, mkds, root = trainer_setup
+    sink = MemorySink()
+    fresh_hub.add_sink(sink)
+    ds = mkds()
+    tr = mk()
+    cm = CheckpointManager(root)
+    plan = FaultPlan.parse("preempt.signal:fail:nth=4")
+    with installed(plan):
+        with pytest.raises(PreemptedError) as ei:
+            # max_retries high on purpose: a graceful stop must NOT be
+            # treated as a recoverable pass failure
+            tr.run_pass(ds, checkpoint=cm, max_retries=5)
+    assert ei.value.checkpointed and ei.value.batch_index == 4
+    cur = cm.load_cursor()
+    assert cur is not None
+    assert cur["batch_index"] == 4
+    assert cur["global_step"] == tr.global_step == 4
+    assert cur["fingerprint"] == ds.filelist_fingerprint()
+    marker = preemption.read_resume_marker(root)
+    assert marker and marker["exit_code"] == preemption.EXIT_RESUME
+    names = [e["event"] for e in sink.events]
+    assert "preempt_requested" in names
+    assert "emergency_checkpoint" in names
+    assert "pass_retry" not in names  # never retried
+    assert fresh_hub.counter("pbox_inpass_checkpoints_total").value(
+        reason="preempt") == 1
+
+
+@pytest.mark.chaos
+def test_resume_from_cursor_matches_uninterrupted_run(trainer_setup,
+                                                      fresh_hub):
+    """THE acceptance criterion: preempt mid-pass -> restart -> resume
+    from the cursor replays ONLY the remaining batches, and the final
+    sparse + dense state is byte-identical to an uninterrupted run."""
+    files, mk, mkds, root = trainer_setup
+    sink = MemorySink()
+    fresh_hub.add_sink(sink)
+    ds = mkds()
+
+    baseline = mk()
+    out = baseline.train_pass(ds)
+    want_digest = state_digest(baseline)
+    total = int(out["batches"])
+
+    preemption.clear_stop()
+    with flags_scope(ckpt_every_batches=3):
+        tr = mk()
+        cm = CheckpointManager(root)
+        plan = FaultPlan.parse("preempt.signal:fail:nth=5")
+        with installed(plan):
+            with pytest.raises(PreemptedError):
+                tr.run_pass(ds, checkpoint=cm)
+
+        # "restarted process": fresh trainer + manager + dataset
+        preemption.clear_stop()
+        tr2 = mk()
+        cm2 = CheckpointManager(root)
+        restored = cm2.restore(tr2)
+        assert restored == 5
+        ds2 = mkds()
+        out2 = tr2.run_pass(ds2, checkpoint=cm2)
+    assert int(out2["batches"]) == total - 5  # prefix skipped, not replayed
+    assert tr2.global_step == baseline.global_step
+    assert state_digest(tr2) == want_digest
+    assert preemption.read_resume_marker(root) is None  # consumed
+    assert any(e["event"] == "cursor_resume" for e in sink.events)
+    # the resumed pass ended cleanly: newest checkpoint is pass-boundary
+    assert cm2.load_cursor() is None
+
+
+@pytest.mark.chaos
+def test_periodic_inpass_ckpt_bounds_replay_after_crash(trainer_setup):
+    """A HARD kill (no graceful window) between periodic cursor saves
+    replays only the tail since the last one."""
+    files, mk, mkds, root = trainer_setup
+    ds = mkds()
+    baseline = mk()
+    out = baseline.train_pass(ds)
+    want_digest = state_digest(baseline)
+    total = int(out["batches"])
+
+    with flags_scope(ckpt_every_batches=2):
+        tr = mk()
+        cm = CheckpointManager(root)
+        # stop after batch 7: periodic cursor saves exist at 2/4/6 plus
+        # the emergency save at 7
+        try:
+            with installed(FaultPlan.parse("preempt.signal:fail:nth=7")):
+                tr.run_pass(ds, checkpoint=cm)
+        except PreemptedError:
+            pass
+        # simulate the kill arriving before the emergency save finished:
+        # restart from the PERIODIC checkpoint instead
+        preemption.clear_stop()
+        tr2 = mk()
+        cm2 = CheckpointManager(root)
+        steps = cm2.steps()
+        periodic = steps[-2]  # last periodic save before the emergency
+        assert cm2.restore(tr2, step=periodic) == periodic
+        cur = cm2.load_cursor(periodic)
+        assert cur is not None and cur["batch_index"] == periodic
+        ds2 = mkds()
+        out2 = tr2.train_pass(ds2, start_cursor=cur)
+    assert int(out2["batches"]) == total - cur["batch_index"]
+    assert state_digest(tr2) == want_digest
+
+
+@pytest.mark.chaos
+def test_run_pass_retry_resumes_from_cursor(trainer_setup):
+    """A recoverable mid-pass failure with in-pass checkpoints rolls
+    back to the cursor and replays the tail, not the whole pass."""
+    files, mk, mkds, root = trainer_setup
+    ds = mkds()
+    baseline = mk()
+    out = baseline.train_pass(ds)
+    want_digest = state_digest(baseline)
+
+    with flags_scope(ckpt_every_batches=3):
+        tr = mk()
+        cm = CheckpointManager(root)
+        # second attempt only: the first attempt trains 10 batches with
+        # periodic saves, then the injected transient kills attempt 1 at
+        # its very end via the trainer.pass seam of attempt 2's entry...
+        # simpler: fail the FIRST attempt entry after priming a cursor
+        # checkpoint by preempting a primer run
+        plan = FaultPlan.parse("preempt.signal:fail:nth=6")
+        with installed(plan):
+            with pytest.raises(PreemptedError):
+                tr.run_pass(ds, checkpoint=cm)
+        preemption.clear_stop()
+        # now a transient failure on the next attempt: run_pass restores
+        # the emergency checkpoint and adopts its cursor
+        tr2 = mk()
+        cm2 = CheckpointManager(root)
+        assert cm2.restore(tr2) == 6
+        ds2 = mkds()
+        plan2 = FaultPlan.parse("trainer.pass:fail:nth=1")
+        with installed(plan2):
+            out2 = tr2.run_pass(ds2, checkpoint=cm2, max_retries=1)
+    assert int(out2["batches"]) == int(out["batches"]) - 6
+    assert state_digest(tr2) == want_digest
+
+
+@pytest.mark.chaos
+def test_cursor_mismatch_rolls_back_to_pass_boundary(trainer_setup):
+    """A cursor that does not match the dataset (different file list)
+    must NOT be resumed into — the trainer rolls back to the latest
+    pass-boundary checkpoint and replays the full pass."""
+    files, mk, mkds, root = trainer_setup
+    ds = mkds()
+    tr = mk()
+    cm = CheckpointManager(root, keep=10)
+    tr.run_pass(ds, checkpoint=cm)
+    cm.save(tr)                      # pass-boundary checkpoint
+    boundary = tr.global_step
+    plan = FaultPlan.parse("preempt.signal:fail:nth=3")
+    with installed(plan):
+        with pytest.raises(PreemptedError):
+            tr.run_pass(ds, checkpoint=cm)   # mid-pass ckpt @ boundary+3
+    preemption.clear_stop()
+
+    tr2 = mk()
+    cm2 = CheckpointManager(root, keep=10)
+    assert cm2.restore(tr2) == boundary + 3
+    other = mkds([files[0]])         # DIFFERENT file list
+    out = tr2.run_pass(other, checkpoint=cm2)
+    # rolled back to the boundary, then trained other's full pass
+    assert tr2.global_step == boundary + int(out["batches"])
+    assert int(out["batches"]) == 5  # 160 rows / 32
+
+
+@pytest.mark.chaos
+def test_stop_honored_between_passes_and_for_resident(trainer_setup):
+    """The stop flag must also stop runs whose passes cannot stop at a
+    batch boundary (resident mode = one device program) — run_pass
+    checks it before every dispatch and snapshots the pass-boundary
+    state."""
+    files, mk, mkds, root = trainer_setup
+    ds = mkds()
+    tr = mk()
+    cm = CheckpointManager(root)
+    tr.run_pass(ds, checkpoint=cm)
+    step = tr.global_step
+    preemption.request_stop("scheduler notice")
+    with pytest.raises(PreemptedError) as ei:
+        tr.run_pass(ds, checkpoint=cm, resident=True)
+    assert ei.value.checkpointed and ei.value.step == step
+    assert cm.latest_step() == step          # boundary snapshot written
+    assert cm.load_cursor() is None
+    assert preemption.read_resume_marker(root) is not None
+
+
+@pytest.mark.chaos
+def test_resident_restart_on_cursor_rolls_back_to_boundary(
+        trainer_setup):
+    """A resident run restarted onto a mid-pass cursor checkpoint must
+    not train a full pass from mid-pass state — it rolls back to the
+    pass boundary (resident passes have no mid-pass entry point)."""
+    files, mk, mkds, root = trainer_setup
+    ds = mkds()
+    tr = mk()
+    cm = CheckpointManager(root, keep=10)
+    tr.run_pass(ds, checkpoint=cm)
+    cm.save(tr)                                    # boundary @ 10
+    boundary = tr.global_step
+    with installed(FaultPlan.parse("preempt.signal:fail:nth=3")):
+        with pytest.raises(PreemptedError):
+            tr.run_pass(ds, checkpoint=cm)         # cursor @ 13
+    preemption.clear_stop()
+    tr2 = mk()
+    cm2 = CheckpointManager(root, keep=10)
+    assert cm2.restore(tr2) == boundary + 3
+    ran = []
+    tr2.train_pass_resident = lambda d, lp="": (ran.append(1)
+                                                or {"batches": 10})
+    out = tr2.run_pass(ds, checkpoint=cm2, resident=True)
+    assert ran and out == {"batches": 10}
+    assert tr2.global_step == boundary             # rolled back first
+    # without any boundary checkpoint it refuses instead
+    tr3 = mk()
+    cm3 = CheckpointManager(root + "_nb")
+    with installed(FaultPlan.parse("preempt.signal:fail:nth=3")):
+        with pytest.raises(PreemptedError):
+            tr3.run_pass(ds, checkpoint=cm3)
+    preemption.clear_stop()
+    tr4 = mk()
+    cm4 = CheckpointManager(root + "_nb")
+    cm4.restore(tr4)
+    with pytest.raises(RuntimeError, match="resident"):
+        tr4.run_pass(ds, checkpoint=cm4, resident=True)
+
+
+@pytest.mark.chaos
+def test_preempt_on_periodic_save_boundary_reuses_checkpoint(
+        trainer_setup):
+    """Preemption landing on the SAME boundary as a periodic save must
+    not re-save (a delta re-save over a fresh base would be refused) —
+    the periodic checkpoint already holds the cursor."""
+    files, mk, mkds, root = trainer_setup
+    ds = mkds()
+    with flags_scope(ckpt_every_batches=4):
+        tr = mk()
+        cm = CheckpointManager(root)
+        # nth=4 == the first periodic cadence: both fire at batch 4
+        with installed(FaultPlan.parse("preempt.signal:fail:nth=4")):
+            with pytest.raises(PreemptedError) as ei:
+                tr.run_pass(ds, checkpoint=cm)
+    assert ei.value.checkpointed and ei.value.batch_index == 4
+    cur = cm.load_cursor()
+    assert cur is not None and cur["batch_index"] == 4
+    # resume still works end to end
+    preemption.clear_stop()
+    tr2 = mk()
+    cm2 = CheckpointManager(root)
+    assert cm2.restore(tr2) == 4
+    out = tr2.run_pass(mkds(), checkpoint=cm2)
+    assert int(out["batches"]) == 6
+
+
+@pytest.mark.chaos
+def test_boundary_save_when_cadence_hits_pass_length(trainer_setup):
+    """Cadence dividing the pass length exactly: the end-of-pass
+    boundary publish lands on the same step as the final periodic save
+    (which may be the first BASE) and must supersede it, not crash."""
+    files, mk, mkds, root = trainer_setup
+    ds = mkds()
+    with flags_scope(ckpt_every_batches=5):   # 10 batches: saves at 5, 10
+        tr = mk()
+        cm = CheckpointManager(root)
+        out = tr.run_pass(ds, checkpoint=cm)
+    assert int(out["batches"]) == 10
+    assert cm.load_cursor() is None           # boundary superseded 10's cursor
+    tr2 = mk()
+    assert cm.restore(tr2) == 10
+
+
+@pytest.mark.chaos
+def test_emergency_cursor_superseded_without_cadence(trainer_setup):
+    """ckpt_every_batches=0: a preempted pass leaves only the emergency
+    cursor checkpoint; after the resumed pass completes, the newest
+    checkpoint must be cursor-free — a LATER pass's rollback must not
+    resume into the finished pass (discarding its training)."""
+    files, mk, mkds, root = trainer_setup
+    ds = mkds()
+    tr = mk()
+    cm = CheckpointManager(root)
+    with installed(FaultPlan.parse("preempt.signal:fail:nth=3")):
+        with pytest.raises(PreemptedError):
+            tr.run_pass(ds, checkpoint=cm)
+    preemption.clear_stop()
+    tr2 = mk()
+    cm2 = CheckpointManager(root)
+    cm2.restore(tr2)
+    tr2.run_pass(mkds(), checkpoint=cm2)      # resumes, completes
+    assert cm2.load_cursor() is None          # cursor superseded
+    # a transient failure in the NEXT pass must replay that pass fully
+    with installed(FaultPlan.parse("trainer.pass:fail:nth=1")):
+        out = tr2.run_pass(mkds(), checkpoint=cm2, max_retries=1)
+    assert int(out["batches"]) == 10
+
+
+@pytest.mark.chaos
+def test_preempt_at_final_batch_resumes_to_clean_boundary(trainer_setup):
+    """SIGTERM at the LAST batch boundary: the cursor covers the whole
+    pass, so the resumed 'pass' trains zero batches — it must still
+    publish a cursor-free boundary checkpoint (a later pass's rollback
+    must not re-adopt the stale cursor and train nothing / roll back
+    past the finished pass)."""
+    files, mk, mkds, root = trainer_setup
+    ds = mkds()
+    baseline = mk()
+    out = baseline.train_pass(ds)
+    want = state_digest(baseline)
+    tr = mk()
+    cm = CheckpointManager(root)
+    nth = int(out["batches"])  # stop poll at the final boundary
+    with installed(FaultPlan.parse(f"preempt.signal:fail:nth={nth}")):
+        with pytest.raises(PreemptedError) as ei:
+            tr.run_pass(ds, checkpoint=cm)
+    assert ei.value.batch_index == nth
+    preemption.clear_stop()
+    tr2 = mk()
+    cm2 = CheckpointManager(root)
+    cm2.restore(tr2)
+    out2 = tr2.run_pass(mkds(), checkpoint=cm2)
+    assert int(out2["batches"]) == 0           # nothing left to replay
+    assert state_digest(tr2) == want
+    assert cm2.load_cursor() is None           # stale cursor superseded
+    # and the NEXT pass trains fully even through a transient retry
+    with installed(FaultPlan.parse("trainer.pass:fail:nth=1")):
+        out3 = tr2.run_pass(mkds(), checkpoint=cm2, max_retries=1)
+    assert int(out3["batches"]) == nth
+
+
+@pytest.mark.chaos
+def test_nondeterministic_restart_rolls_back_not_splices(trainer_setup):
+    """A restart whose dataset CANNOT resume (non-deterministic load)
+    while the trainer sits on mid-pass state must not silently replay a
+    full pass on top of it (double-training the prefix): with no
+    boundary checkpoint it refuses; with one it rolls back."""
+    files, mk, mkds, root = trainer_setup
+    ds = mkds()
+    tr = mk()
+    cm = CheckpointManager(root, keep=10)
+    with installed(FaultPlan.parse("preempt.signal:fail:nth=3")):
+        with pytest.raises(PreemptedError):
+            tr.run_pass(ds, checkpoint=cm)
+    preemption.clear_stop()
+    tr2 = mk()
+    cm2 = CheckpointManager(root, keep=10)
+    assert cm2.restore(tr2) == 3
+    # restarted process loads via the THREADED record path: order is
+    # not reproducible, so the cursor cannot be applied
+    with flags_scope(native_parse=False):   # read_thread_num default 8
+        nd = mkds()
+    assert not nd.supports_cursor_resume
+    with pytest.raises(RuntimeError, match="cannot be resumed"):
+        tr2.run_pass(nd, checkpoint=cm2)    # no boundary ckpt -> refuse
+    # with a boundary checkpoint it rolls back instead
+    tr3 = mk()
+    cm3 = CheckpointManager(root + "_b", keep=10)
+    tr3.run_pass(ds, checkpoint=cm3)
+    cm3.save(tr3)                           # boundary at step 10
+    with installed(FaultPlan.parse("preempt.signal:fail:nth=3")):
+        with pytest.raises(PreemptedError):
+            tr3.run_pass(ds, checkpoint=cm3)
+    preemption.clear_stop()
+    tr4 = mk()
+    cm4 = CheckpointManager(root + "_b", keep=10)
+    assert cm4.restore(tr4) == 13
+    with flags_scope(native_parse=False):
+        nd2 = mkds()
+    out = tr4.run_pass(nd2, checkpoint=cm4)
+    assert tr4.global_step == 10 + int(out["batches"])  # from boundary
+
+
+def test_preempt_fault_os_exc_still_graceful():
+    """Every exc= variant of a preempt.signal fail fault must become a
+    stop request — including exc=os, whose OSError is not an
+    InjectedFault subclass."""
+    plan = FaultPlan.parse("preempt.signal:fail:nth=1,exc=os")
+    with installed(plan):
+        assert preemption.stop_requested()
+    assert "injected" in preemption.stop_reason()
+
+
+def test_consensus_restore_survives_drifted_retention(trainer_setup,
+                                                      tmp_path):
+    """Ranks whose newest checkpoints drifted apart (crash timing /
+    corruption) agree on the newest step that exists on BOTH — not a
+    min() that one rank may no longer hold."""
+    files, mk, mkds, root = trainer_setup
+    ds = mkds()
+    roots = [str(tmp_path / "r0"), str(tmp_path / "r1")]
+    cms = []
+    for r in roots:
+        t = mk()
+        cm = CheckpointManager(r, keep=10)
+        t.train_pass(ds)
+        cm.save(t)            # step 10 on both
+        t.train_pass(ds)
+        cm.save(t)            # step 20 on both
+        cms.append(cm)
+    # rank 1's newest checkpoint is corrupt -> its verified set is {10}
+    target = os.path.join(cms[1]._dir(20), "dense.pkl")
+    blob = bytearray(open(target, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(target, "wb") as fh:
+        fh.write(bytes(blob))
+    assert cms[0].verified_steps() == [10, 20]
+    assert cms[1].verified_steps() == [10]
+
+    from paddlebox_tpu.resilience.consensus import consensus_restore
+    store = DirConsensusStore(str(tmp_path / "consensus"))
+    fresh = [mk(), mk()]
+    got = _run_ranks([
+        lambda: consensus_restore(cms[0], fresh[0],
+                                  RestoreConsensus(store, 0, 2,
+                                                   timeout=20)),
+        lambda: consensus_restore(cms[1], fresh[1],
+                                  RestoreConsensus(store, 1, 2,
+                                                   timeout=20)),
+    ])
+    assert got == [10, 10]
+    assert fresh[0].global_step == fresh[1].global_step == 10
+
+
+def test_shared_quarantine_refuses_streaming_dataset(tmp_path):
+    desc = DataFeedDesc.criteo(batch_size=16)
+    ds = DatasetFactory().create_dataset("QueueDataset", desc)
+    store = DirConsensusStore(str(tmp_path / "c"))
+    with pytest.raises(TypeError, match="in-memory"):
+        sync_shared_quarantine(ds, RestoreConsensus(store, 0, 1,
+                                                    timeout=5))
+
+
+# ---- satellite: NaN recoverability ------------------------------------
+def test_nan_without_checkpoint_raises_immediately(trainer_setup):
+    """A NanInfError with no checkpoint manager must not be retried:
+    the live state is already poisoned and a retry would train garbage
+    (ISSUE 3 satellite — trainer.py:241)."""
+    files, mk, mkds, root = trainer_setup
+    ds = mkds()
+    tr = mk()
+    calls = []
+
+    def poisoned(*a, **kw):
+        calls.append(1)
+        raise NanInfError("nan/inf loss at step 3")
+
+    tr.train_pass = poisoned
+    with pytest.raises(NanInfError):
+        tr.run_pass(ds, max_retries=3)
+    assert len(calls) == 1  # no retry without a rollback target
+
+    # an EMPTY manager is not a rollback target either: restore() would
+    # be a no-op and every retry would replay from the poisoned state
+    tr_e = mk()
+    cm_empty = CheckpointManager(root + "_empty")
+    calls_e = []
+
+    def poisoned_e(*a, **kw):
+        calls_e.append(1)
+        raise NanInfError("nan/inf loss")
+
+    tr_e.train_pass = poisoned_e
+    with pytest.raises(NanInfError):
+        tr_e.run_pass(ds, checkpoint=cm_empty, max_retries=3)
+    assert len(calls_e) == 1
+
+    # WITH a checkpoint the rollback makes NaN recoverable (PR 2
+    # semantics preserved)
+    tr2 = mk()
+    cm = CheckpointManager(root)
+    tr2.run_pass(ds)
+    cm.save(tr2)
+    calls2 = []
+    real2 = tr2.train_pass
+
+    def poisoned_once(*a, **kw):
+        calls2.append(1)
+        if len(calls2) == 1:
+            raise NanInfError("nan/inf loss")
+        return real2(*a, **kw)
+
+    tr2.train_pass = poisoned_once
+    out = tr2.run_pass(ds, checkpoint=cm, max_retries=1)
+    assert len(calls2) == 2 and np.isfinite(out["last_loss"])
+
+
+# ---- satellite: checkpoint hardening ----------------------------------
+def test_meta_sidecar_detects_torn_meta(trainer_setup):
+    files, mk, mkds, root = trainer_setup
+    ds = mkds()
+    tr = mk()
+    cm = CheckpointManager(root)
+    tr.train_pass(ds)
+    path = cm.save(tr)
+    assert os.path.isfile(os.path.join(path, "meta.sha256"))
+    # tamper with meta.json (a torn/partial write) — restore must refuse
+    mp = os.path.join(path, "meta.json")
+    meta = json.load(open(mp))
+    meta["sparse_rows"] = 0
+    with open(mp, "w") as fh:
+        json.dump(meta, fh)
+    tr2 = mk()
+    with pytest.raises(CheckpointCorruptError, match="meta.json"):
+        cm.restore(tr2)
+
+
+def test_half_deleted_ckpt_dir_is_skipped(trainer_setup):
+    files, mk, mkds, root = trainer_setup
+    ds = mkds()
+    tr = mk()
+    cm = CheckpointManager(root, keep=10)
+    tr.train_pass(ds)
+    cm.save(tr)
+    good = tr.global_step
+    tr.train_pass(ds)
+    cm.save(tr)
+    # half-delete the NEWER checkpoint (rmtree died after meta.json)
+    os.unlink(os.path.join(cm._dir(tr.global_step), "meta.json"))
+    cm2 = CheckpointManager(root, keep=10)
+    assert cm2.steps() == [good]
+    assert cm2.latest_step() == good          # LATEST pointer bypassed
+    assert cm2._latest_base() == good
+    tr2 = mk()
+    assert cm2.restore(tr2) == good
+    # another save still works: _retain walks past the carcass
+    tr2.train_pass(ds)
+    cm2.save(tr2)
+    assert good in cm2.steps()
+
+
+def test_delta_after_rollback_links_to_restored_step(trainer_setup,
+                                                     tmp_path):
+    """After a rollback-restore to an older step, the next delta must
+    chain to THAT step — not to a newer checkpoint of the abandoned
+    timeline (which would replay abandoned state into any restore)."""
+    files, mk, mkds, root = trainer_setup
+    ds = mkds()
+    tr = mk()
+    cm = CheckpointManager(root, keep=10)
+    tr.run_pass(ds, checkpoint=cm)
+    cm.save(tr)                                  # boundary base @ 10
+    with installed(FaultPlan.parse("preempt.signal:fail:nth=3")):
+        with pytest.raises(PreemptedError):
+            tr.run_pass(ds, checkpoint=cm)       # cursor delta @ 13
+    preemption.clear_stop()
+
+    # restart; a SHORTER dataset (2 batches) changes the fingerprint ->
+    # rollback to boundary 10, then train to step 12 (< abandoned 13)
+    short = generate_criteo_files(str(tmp_path / "short"), num_files=1,
+                                  rows_per_file=64, vocab_per_slot=30,
+                                  seed=4)
+    tr2 = mk()
+    cm2 = CheckpointManager(root, keep=10)
+    assert cm2.restore(tr2) == 13
+    other = mkds(short)
+    out = tr2.run_pass(other, checkpoint=cm2)    # rolls back to 10
+    assert tr2.global_step == 12 and int(out["batches"]) == 2
+    cm2.save(tr2, delta=True)
+    meta = cm2._meta(12)
+    assert meta["prev_step"] == 10               # NOT the abandoned 13
+    tr3 = mk()
+    assert cm2.restore(tr3, step=12) == 12
+    assert tr3.global_step == 12
+
+
+def test_latest_verified_step_skips_corrupt_chain(trainer_setup):
+    files, mk, mkds, root = trainer_setup
+    ds = mkds()
+    tr = mk()
+    cm = CheckpointManager(root, keep=10)
+    tr.train_pass(ds)
+    cm.save(tr)
+    good = tr.global_step
+    tr.train_pass(ds)
+    cm.save(tr)
+    bad = tr.global_step
+    # corrupt the newest checkpoint's payload
+    target = os.path.join(cm._dir(bad), "sparse.npz")
+    blob = bytearray(open(target, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(target, "wb") as fh:
+        fh.write(bytes(blob))
+    assert cm.latest_verified_step() == good
+
+
+# ---- multihost-consistent recovery ------------------------------------
+def _run_ranks(fns, timeout=30.0):
+    """Run one callable per rank concurrently (the consensus gathers
+    block until the full mesh publishes)."""
+    out = {}
+    errs = []
+
+    def runner(i, fn):
+        try:
+            out[i] = fn()
+        except BaseException as e:  # surfaced below
+            errs.append(e)
+
+    threads = [threading.Thread(target=runner, args=(i, fn), daemon=True)
+               for i, fn in enumerate(fns)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+    assert not any(t.is_alive() for t in threads), "consensus deadlocked"
+    if errs:
+        raise errs[0]
+    return [out[i] for i in range(len(fns))]
+
+
+def test_consensus_restore_agrees_on_min_step(trainer_setup, tmp_path,
+                                              fresh_hub):
+    """2-process consensus: ranks with different newest checkpoints both
+    restore the same agreed (min) step."""
+    files, mk, mkds, root = trainer_setup
+    sink = MemorySink()
+    fresh_hub.add_sink(sink)
+    ds = mkds()
+    roots = [str(tmp_path / "ckpt_r0"), str(tmp_path / "ckpt_r1")]
+    trainers, cms = [], []
+    for r in roots:
+        t = mk()
+        cm = CheckpointManager(r, keep=10)
+        t.train_pass(ds)
+        cm.save(t)
+        trainers.append(t)
+        cms.append(cm)
+    common = trainers[0].global_step
+    assert trainers[1].global_step == common
+    # rank 0 got one more save in before the crash; rank 1 did not
+    trainers[0].train_pass(ds)
+    cms[0].save(trainers[0])
+
+    from paddlebox_tpu.resilience.consensus import consensus_restore
+    store = DirConsensusStore(str(tmp_path / "consensus"))
+    fresh = [mk(), mk()]
+
+    def restore_rank(i):
+        c = RestoreConsensus(store, i, 2, timeout=20)
+        return consensus_restore(cms[i], fresh[i], c)
+
+    got = _run_ranks([lambda: restore_rank(0), lambda: restore_rank(1)])
+    assert got == [common, common]
+    assert fresh[0].global_step == fresh[1].global_step == common
+    evs = [e for e in sink.events if e["event"] == "restore_consensus"]
+    assert len(evs) == 2 and all(e["agreed"] == common for e in evs)
+
+
+def test_consensus_fresh_start_when_any_rank_empty(tmp_path):
+    store = DirConsensusStore(str(tmp_path / "c"))
+
+    def rank(i, step):
+        return RestoreConsensus(store, i, 2,
+                                timeout=20).agree_restore_step(step)
+
+    got = _run_ranks([lambda: rank(0, None), lambda: rank(1, 7)])
+    assert got == [None, None]
+
+
+def test_consensus_timeout_names_missing_rank(tmp_path):
+    store = DirConsensusStore(str(tmp_path / "c"))
+    c = RestoreConsensus(store, 0, 2, timeout=0.2, poll_interval=0.01)
+    with pytest.raises(ConsensusTimeout, match=r"\[1\]"):
+        c.agree_restore_step(3)
+
+
+@pytest.mark.chaos
+def test_shared_quarantine_preserves_batch_identity(tmp_path, fresh_hub):
+    """2-process quarantine consensus: a file fault on ONE process's
+    load ends with BOTH processes dropping the same file — batch streams
+    stay byte-identical (the SPMD contract)."""
+    files = generate_criteo_files(str(tmp_path / "data"), num_files=3,
+                                  rows_per_file=48, vocab_per_slot=30,
+                                  seed=9)
+    desc = DataFeedDesc.criteo(batch_size=16)
+
+    def mkds():
+        ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+        ds.set_filelist(files)
+        return ds
+
+    # ONE reader thread: the record-path load order is then a pure
+    # function of the filelist, so the non-reloading originator and the
+    # reloading peer must produce identical streams
+    with flags_scope(native_parse=False, poison_budget_files=1,
+                     poison_budget_records=0, read_thread_num=1):
+        ds0, ds1 = mkds(), mkds()
+        target = os.path.basename(files[1])
+        plan = FaultPlan.parse(
+            f"parser.record:corrupt:match=*{target}*,times=0", seed=5)
+        with installed(plan):
+            ds0.load_into_memory()   # only "rank 0" hits the fault
+        ds1.load_into_memory()
+        assert [p for p, _ in ds0.quarantined_files] == [files[1]]
+        assert ds1.quarantined_files == []
+        assert len(ds0) != len(ds1)  # contract broken before the sync
+
+        store = DirConsensusStore(str(tmp_path / "consensus"))
+        got = _run_ranks([
+            lambda: sync_shared_quarantine(
+                ds0, RestoreConsensus(store, 0, 2, timeout=20)),
+            lambda: sync_shared_quarantine(
+                ds1, RestoreConsensus(store, 1, 2, timeout=20)),
+        ])
+    assert got[0] == got[1] == [files[1]]
+    assert [p for p, _ in ds0.quarantined_files] == [files[1]]
+    assert [p for p, _ in ds1.quarantined_files] == [files[1]]
+    b0, b1 = list(ds0.batches()), list(ds1.batches())
+    assert len(b0) == len(b1) > 0
+    assert all(_batches_equal(x, y) for x, y in zip(b0, b1))
+
+
+def test_shared_quarantine_noop_when_all_healthy(tmp_path):
+    files = generate_criteo_files(str(tmp_path / "data"), num_files=2,
+                                  rows_per_file=32, vocab_per_slot=30,
+                                  seed=9)
+    desc = DataFeedDesc.criteo(batch_size=16)
+
+    def load():
+        ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        return ds
+
+    ds0, ds1 = load(), load()
+    n0 = len(ds0)
+    store = DirConsensusStore(str(tmp_path / "consensus"))
+    got = _run_ranks([
+        lambda: sync_shared_quarantine(
+            ds0, RestoreConsensus(store, 0, 2, timeout=20)),
+        lambda: sync_shared_quarantine(
+            ds1, RestoreConsensus(store, 1, 2, timeout=20)),
+    ])
+    assert got == [[], []]
+    assert len(ds0) == n0  # converged in one round, nothing reloaded
+
+
+# ---- real SIGTERM, real process ----------------------------------------
+_WORKER = textwrap.dedent("""
+    import json, os, signal, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import optax
+
+    from paddlebox_tpu.config import FLAGS
+    from paddlebox_tpu.data import DataFeedDesc, DatasetFactory
+    from paddlebox_tpu.models import CtrDnn
+    from paddlebox_tpu.ps import EmbeddingTable, SparseSGDConfig
+    from paddlebox_tpu.resilience import preemption
+    from paddlebox_tpu.resilience.preemption import PreemptedError
+    from paddlebox_tpu.train import Trainer
+    from paddlebox_tpu.train.checkpoint import (CheckpointManager,
+                                                state_digest)
+
+    phase, data_dir, ckpt_root, out_path, beacon = sys.argv[1:6]
+    FLAGS.graceful_shutdown = True       # Trainer init installs handlers
+    FLAGS.ckpt_every_batches = 4
+
+    desc = DataFeedDesc.criteo(batch_size=32)
+    desc.key_bucket_min = 2048
+    cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=0.0)
+
+    def mk():
+        table = EmbeddingTable(mf_dim=4, capacity=1 << 13, cfg=cfg,
+                               unique_bucket_min=2048)
+        return Trainer(CtrDnn(hidden=(8,)), table, desc,
+                       tx=optax.adam(1e-2), seed=0)
+
+    ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+    files = sorted(os.path.join(data_dir, f)
+                   for f in os.listdir(data_dir))
+    ds.set_filelist(files)
+    ds.load_into_memory()
+
+    if phase == "run":
+        # baseline digest first (uninterrupted, same seed/state zero)
+        base = mk()
+        out_base = base.train_pass(ds)
+        with open(out_path, "w") as fh:
+            json.dump({"baseline_digest": state_digest(base),
+                       "total_batches": out_base["batches"]}, fh)
+        # now the preemptable run: slow the pass down and beacon the
+        # parent so its SIGTERM lands mid-pass
+        orig = ds.batches
+        def slow_batches(start_batch=0):
+            for i, b in enumerate(orig(start_batch=start_batch)):
+                if i == 1:
+                    open(beacon, "w").write("mid-pass")
+                time.sleep(0.05)
+                yield b
+        ds.batches = slow_batches
+        trainer = mk()
+        cm = CheckpointManager(ckpt_root)
+        try:
+            trainer.run_pass(ds, checkpoint=cm)
+        except PreemptedError as e:
+            assert e.checkpointed, "no emergency checkpoint"
+            sys.exit(preemption.EXIT_RESUME)
+        sys.exit(3)  # pass finished before the signal landed
+
+    if phase == "resume":
+        marker = preemption.read_resume_marker(ckpt_root)
+        trainer = mk()
+        cm = CheckpointManager(ckpt_root)
+        restored = cm.restore(trainer)
+        out = trainer.run_pass(ds, checkpoint=cm)
+        with open(out_path, "w") as fh:
+            json.dump({"digest": state_digest(trainer),
+                       "restored": restored,
+                       "had_marker": marker is not None,
+                       "marker_cleared":
+                           preemption.read_resume_marker(ckpt_root)
+                           is None,
+                       "replayed_batches": out["batches"],
+                       "global_step": trainer.global_step}, fh)
+        sys.exit(0)
+""")
+
+
+@pytest.mark.chaos
+def test_real_sigterm_graceful_shutdown_and_resume(tmp_path):
+    """A real SIGTERM to a real training process: the handler converts
+    it to a graceful stop, the process exits EXIT_RESUME with an
+    emergency checkpoint, and a restarted process resumes to the exact
+    uninterrupted state."""
+    data_dir = str(tmp_path / "data")
+    generate_criteo_files(data_dir, num_files=2, rows_per_file=320,
+                          vocab_per_slot=40, seed=3)
+    ckpt_root = str(tmp_path / "ckpt")
+    worker = str(tmp_path / "worker.py")
+    with open(worker, "w") as fh:
+        fh.write(_WORKER)
+    beacon = str(tmp_path / "beacon")
+    run_out = str(tmp_path / "run.json")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+
+    proc = subprocess.Popen(
+        [sys.executable, worker, "run", data_dir, ckpt_root, run_out,
+         beacon],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    deadline = time.monotonic() + 120
+    while not os.path.exists(beacon):
+        assert proc.poll() is None, \
+            f"worker died early:\n{proc.stdout.read()}"
+        assert time.monotonic() < deadline, "beacon never appeared"
+        time.sleep(0.02)
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=120)
+    assert proc.returncode == preemption.EXIT_RESUME, \
+        f"rc={proc.returncode}\n{out}"
+    baseline = json.load(open(run_out))
+    marker = json.load(open(os.path.join(ckpt_root, "RESUME.json")))
+    assert marker["exit_code"] == preemption.EXIT_RESUME
+
+    res_out = str(tmp_path / "resume.json")
+    rc = subprocess.run(
+        [sys.executable, worker, "resume", data_dir, ckpt_root, res_out,
+         beacon],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=180)
+    assert rc.returncode == 0, rc.stdout
+    resumed = json.load(open(res_out))
+    assert resumed["had_marker"] and resumed["marker_cleared"]
+    assert resumed["replayed_batches"] < baseline["total_batches"]
+    assert resumed["global_step"] == baseline["total_batches"]
+    assert resumed["digest"] == baseline["baseline_digest"]

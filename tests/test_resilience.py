@@ -607,7 +607,8 @@ def test_checkpoint_checksum_rejects_corruption(trainer_setup):
 
 
 def test_checkpoint_without_checksums_still_restores(trainer_setup):
-    """Pre-checksum checkpoints (no ``checksums`` key) verify trivially."""
+    """Pre-checksum checkpoints (no ``checksums`` key, no ``meta.sha256``
+    sidecar — the pre-hardening era wrote neither) verify trivially."""
     import json
     from paddlebox_tpu.train.checkpoint import CheckpointManager
     ds, mk, root = trainer_setup
@@ -620,6 +621,7 @@ def test_checkpoint_without_checksums_still_restores(trainer_setup):
     del meta["checksums"]
     with open(mp, "w") as fh:
         json.dump(meta, fh)
+    os.unlink(os.path.join(cm._dir(tr.global_step), "meta.sha256"))
     tr2 = mk()
     assert cm.restore(tr2) == tr.global_step
 
