@@ -77,6 +77,15 @@ class Flags:
     # tunneled/remote devices where the bucket pull is dead weight.
     auc_device_reduce: bool = False
 
+    # --- async pass epilogue (ps/epilogue; docs/PERFORMANCE.md) ---
+    # end_pass snapshots touched rows, dispatches the D2H gather, and
+    # hands the HostStore write-back to a background worker so pass N+1
+    # trains while pass N drains; every host-tier read and lifecycle op
+    # fences first (bit-for-bit identical to the synchronous path —
+    # scripts/pipeline_check.py is the gate). False = write back inline
+    # before end_pass returns (the pre-overlap behavior).
+    async_end_pass: bool = True
+
     # --- pass-boundary scatter (ps/table.scatter_logical_rows) ---
     # fixed chunk size for the begin_pass delta scatter: one compiled
     # executable per table geometry instead of one per delta size (the

@@ -325,6 +325,15 @@ def emit_pass_event(kind: str, metrics: Dict, stage_timers=None,
         lp = getattr(table, "last_pass_stats", None)
         if lp:
             tstats["last_pass"] = dict(lp)
+        # async pass epilogue (ps/epilogue): cumulative write-back /
+        # fence-wait / overlap seconds ride every pass event so the
+        # JSONL alone shows how much end_pass left the critical path
+        # (pbox_endpass_* gauges mirror from the epilogue itself)
+        eps = getattr(table, "endpass_stats", None)
+        if eps is not None:
+            tstats["endpass"] = {k: (round(v, 6)
+                                     if isinstance(v, float) else v)
+                                 for k, v in eps().items()}
         if tstats:
             ev["table"] = tstats
             if "used" in tstats:

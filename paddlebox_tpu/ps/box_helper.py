@@ -110,7 +110,12 @@ class BoxPSHelper:
     def end_pass(self, ds: Optional[PaddleBoxDataset] = None,
                  need_save_delta: bool = False,
                  delta_path: Optional[str] = None) -> int:
-        """Write the working set back; optionally dump the xbox delta."""
+        """Close the pass. With the async epilogue (ps/epilogue,
+        FLAGS.async_end_pass) ``table.end_pass()`` returns in dispatch
+        time and the HBM→host write-back drains in the background —
+        the delta dump below fences implicitly (every HostStore read
+        entry point drains the epilogue first), so the saved delta
+        always contains the full pass."""
         if self.trainer is not None:
             self.trainer.sync_table()
         n = self.table.end_pass()
@@ -118,6 +123,13 @@ class BoxPSHelper:
             path = delta_path or f"xbox_delta_pass{self.pass_id}.npz"
             self._store().save_delta(path)
         return n
+
+    def fence(self) -> None:
+        """Drain the table's async end_pass epilogue (no-op for tables
+        without one); surfaces the first write-back failure."""
+        f = getattr(self.table, "fence", None)
+        if f is not None:
+            f()
 
     # ---- model lifecycle (box_helper_py.cc:70-165) ----
     def save_base(self, path: str) -> int:
@@ -149,12 +161,14 @@ class BoxPSHelper:
 
     def load_model(self, path: str, merge: bool = False) -> int:
         self._check_no_pass("load_model")
+        self.fence()  # an in-flight write-back must not land atop a load
         n = self._store().load(path, merge=merge)
         self._invalidate_window()
         return n
 
     def shrink_table(self, **kw) -> int:
         self._check_no_pass("shrink_table")
+        self.fence()  # decay/score must see every written-back row
         store = self._store()
         if store is self.table:  # tiered: scores with its own cfg coeffs
             return store.shrink(**kw)

@@ -1,0 +1,235 @@
+"""Asynchronous pass epilogue: serialized background end-pass write-back.
+
+The reference overlaps its PRE-build thread against the open pass
+(ps_gpu_wrapper.cc:913); this module overlaps the EPILOGUE too — the
+``EndPass`` HBM→host dump (ps_gpu_wrapper.cc:983) leaves the critical
+path, so device compute for pass N+1 starts while pass N's touched rows
+are still draining to the host tier.
+
+Contract (the tables build on it — ps/tiered.py, ps/pass_table.py):
+
+- ``submit(fn)`` enqueues one write-back job and returns immediately.
+  Jobs run STRICTLY IN SUBMISSION ORDER on a single worker, so two
+  overlapping passes' write-backs of the same key land oldest-first and
+  the host tier never observes a reordering.
+- ``fence()`` blocks until every submitted job completed, then re-raises
+  the first job failure (once). Every correctness surface must fence
+  before reading or wholesale-mutating the host tier — the tables route
+  all HostStore *read* entry points through ``HostStore.read_barrier``,
+  so ``save``/``shrink``/``merge_model``/checkpoint capture/serving
+  fetches each drain the epilogue implicitly.
+- A job failure is NEVER silent: it is held until the next
+  ``fence()``/``submit()`` surfaces it (the ``endpass.writeback`` fault
+  seam in the tables exercises exactly this path).
+
+The D2H gather itself is dispatched by the CALLER (end_pass) against the
+then-current immutable device buffers — only the blocking ``device_get``
+and the host-store update run here. Dispatch-before-return matters: a
+later jit step may DONATE the table buffer, so the gather must already
+be enqueued against it when end_pass returns.
+
+Telemetry (docs/OBSERVABILITY.md, docs/PERFORMANCE.md): write-back /
+fence-wait second counters, queue-depth gauge, and the cumulative
+overlapped-seconds gauge ``pbox_endpass_overlap_sec`` = write-back time
+that ran while nothing was fenced on it (the seconds the async epilogue
+actually bought).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Callable, Deque, Dict, Optional, Tuple
+
+from paddlebox_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class EndPassWritebackError(RuntimeError):
+    """An asynchronous end-pass write-back failed. Raised at the first
+    fence after the failure (host reads, the next stage fetch, save /
+    shrink / checkpoint capture, or the next end_pass submit) — the
+    failed pass's touched rows did NOT reach the host tier; recover by
+    restoring a checkpoint, never by continuing."""
+
+
+class PassEpilogue:
+    """Single-lane background worker serializing end-pass write-backs."""
+
+    def __init__(self, name: str = "endpass") -> None:
+        self.name = name
+        self._cv = threading.Condition(threading.Lock())
+        self._jobs: Deque[Tuple[Callable[[], None], str]] = \
+            collections.deque()
+        self._submitted = 0
+        self._done = 0
+        self._running = False   # a drainer thread is live
+        self._error: Optional[BaseException] = None
+        # telemetry accumulators (read via stats(); the hub mirrors are
+        # updated inline, guarded on hub.active)
+        self.jobs_run = 0
+        self.total_writeback_sec = 0.0
+        self.total_fence_wait_sec = 0.0
+        # fence waits on the MAIN thread only — the pipeline's critical
+        # path. A stage thread fencing before its host fetch also waits,
+        # but that wait itself overlaps training, so it must not count
+        # against the overlap the epilogue bought.
+        self.critical_fence_wait_sec = 0.0
+        self.last_writeback_sec = 0.0
+
+    # ---- submission ----------------------------------------------------
+    def submit(self, fn: Callable[[], None], label: str = "") -> None:
+        """Enqueue a write-back job; returns immediately. Raises the
+        previous job failure first (continuing to train atop a lost
+        write-back would compound the damage silently)."""
+        with self._cv:
+            self._raise_pending_locked()
+            self._jobs.append((fn, label))
+            self._submitted += 1
+            depth = len(self._jobs)
+            if not self._running:
+                self._running = True
+                threading.Thread(target=self._drain, daemon=True,
+                                 name=f"pbox-{self.name}").start()
+        self._mirror_depth(depth)
+
+    def _drain(self) -> None:
+        while True:
+            with self._cv:
+                if not self._jobs:
+                    self._running = False
+                    self._cv.notify_all()
+                    return
+                fn, label = self._jobs.popleft()
+            t0 = time.perf_counter()
+            try:
+                fn()
+            except BaseException as e:  # held for the next fence
+                log.error("async end_pass write-back failed (%s): %r",
+                          label or self.name, e)
+                with self._cv:
+                    if self._error is None:
+                        self._error = e
+            dur = time.perf_counter() - t0
+            with self._cv:
+                self._done += 1
+                self.jobs_run += 1
+                self.last_writeback_sec = dur
+                self.total_writeback_sec += dur
+                depth = len(self._jobs)
+                self._cv.notify_all()
+            self._mirror_job(dur, depth)
+
+    # ---- fencing -------------------------------------------------------
+    @property
+    def pending(self) -> int:
+        with self._cv:
+            return self._submitted - self._done
+
+    def fence(self) -> None:
+        """Wait for every submitted write-back to land, then surface the
+        first failure (once). Cheap when nothing is queued: one lock
+        round-trip."""
+        t0 = time.perf_counter()
+        critical = threading.current_thread() is threading.main_thread()
+        with self._cv:
+            if self._done >= self._submitted and self._error is None:
+                return
+            while self._done < self._submitted:
+                self._cv.wait()
+            waited = time.perf_counter() - t0
+            self.total_fence_wait_sec += waited
+            if critical:
+                self.critical_fence_wait_sec += waited
+            err = self._take_error_locked()
+        if waited > 1e-4:
+            self._mirror_fence(waited)
+        if err is not None:
+            raise err
+
+    def _take_error_locked(self) -> Optional[BaseException]:
+        err, self._error = self._error, None
+        if err is None:
+            return None
+        if isinstance(err, EndPassWritebackError):
+            return err
+        out = EndPassWritebackError(
+            f"async end_pass write-back failed ({self.name}): {err!r} — "
+            "the pass's touched rows did not reach the host tier")
+        out.__cause__ = err
+        return out
+
+    def _raise_pending_locked(self) -> None:
+        err = self._take_error_locked()
+        if err is not None:
+            raise err
+
+    # ---- telemetry -----------------------------------------------------
+    def stats(self) -> Dict[str, float]:
+        """Cumulative accounting; ``overlap_sec`` = write-back seconds
+        that never blocked the MAIN thread (writeback − critical fence
+        waits, clamped ≥ 0) — the seconds the async epilogue took off
+        the pass critical path. Background-thread fence waits (a stage
+        fetch draining first) are reported separately: they themselves
+        overlap training."""
+        with self._cv:
+            return {
+                "pending": self._submitted - self._done,
+                "jobs_run": self.jobs_run,
+                "writeback_sec": self.total_writeback_sec,
+                "fence_wait_sec": self.total_fence_wait_sec,
+                "critical_fence_wait_sec": self.critical_fence_wait_sec,
+                "last_writeback_sec": self.last_writeback_sec,
+                "overlap_sec": max(
+                    0.0, self.total_writeback_sec
+                    - self.critical_fence_wait_sec),
+            }
+
+    def _mirror_depth(self, depth: int) -> None:
+        hub = self._hub()
+        if hub is not None:
+            hub.gauge("pbox_endpass_queue_depth",
+                      "end-pass write-back jobs queued").set(depth)
+
+    def _mirror_job(self, dur: float, depth: int) -> None:
+        hub = self._hub()
+        if hub is None:
+            return
+        hub.counter("pbox_endpass_writebacks_total",
+                    "async end-pass write-back jobs completed").inc()
+        hub.counter("pbox_endpass_writeback_seconds_total",
+                    "seconds spent in end-pass write-back jobs").inc(dur)
+        hub.gauge("pbox_endpass_queue_depth",
+                  "end-pass write-back jobs queued").set(depth)
+        with self._cv:
+            overlap = max(0.0, self.total_writeback_sec
+                          - self.critical_fence_wait_sec)
+        hub.gauge("pbox_endpass_overlap_sec",
+                  "cumulative end-pass write-back seconds overlapped "
+                  "with the next pass (writeback - fence waits)"
+                  ).set(overlap)
+
+    def _mirror_fence(self, waited: float) -> None:
+        hub = self._hub()
+        if hub is None:
+            return
+        hub.counter("pbox_endpass_fence_wait_seconds_total",
+                    "seconds callers blocked on the epilogue fence"
+                    ).inc(waited)
+        # a critical fence just consumed overlap — refresh the gauge so
+        # it tracks stats() (job completion alone would leave it stale)
+        with self._cv:
+            overlap = max(0.0, self.total_writeback_sec
+                          - self.critical_fence_wait_sec)
+        hub.gauge("pbox_endpass_overlap_sec",
+                  "cumulative end-pass write-back seconds overlapped "
+                  "with the next pass (writeback - fence waits)"
+                  ).set(overlap)
+
+    @staticmethod
+    def _hub():
+        from paddlebox_tpu.obs.hub import get_hub
+        hub = get_hub()
+        return hub if hub.active else None

@@ -19,13 +19,14 @@ TableState by PassScopedTable; spill granularity is the pass, not the key.
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
 from paddlebox_tpu.config import FLAGS
 from paddlebox_tpu.ps.kv import make_kv
-from paddlebox_tpu.ps.table import TWO_D_FIELDS, FIELDS
+from paddlebox_tpu.ps.table import (TWO_D_FIELDS, FIELDS,
+                                    store_fields_from_rows)
 from paddlebox_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -57,6 +58,18 @@ class HostStore:
         self._lock = threading.Lock()
         self._spill_files: list = []  # active disk-tier files (spill_cold)
         self._spill_keys: Dict[str, np.ndarray] = {}  # path → spilled keys
+        # async-epilogue fence (ps/epilogue.PassEpilogue.fence, installed
+        # by the pass-window tables): EVERY read/wholesale-mutate entry
+        # point drains in-flight end_pass write-backs first, so no
+        # consumer — save/shrink/merge/serving fetch/len — can observe a
+        # partially written-back pass. ``update`` deliberately does NOT
+        # barrier: the epilogue worker itself lands rows through it.
+        self.read_barrier: Optional[Callable[[], None]] = None
+
+    def _barrier(self) -> None:
+        b = self.read_barrier
+        if b is not None:
+            b()
 
     def _shape(self, field: str, n: int) -> Tuple[int, ...]:
         if field == "opt_ext":
@@ -80,6 +93,7 @@ class HostStore:
         self._alloc = new
 
     def __len__(self) -> int:
+        self._barrier()
         return len(self.index)
 
     # ---- pass staging ----
@@ -89,6 +103,7 @@ class HostStore:
         live only in a disk-tier spill file are promoted transparently
         first (the LoadSSD2Mem step of the pass lifecycle), so
         PassScopedTable.stage never trains a spilled feature from zero."""
+        self._barrier()  # in-flight end_pass write-backs land first
         keys_u64 = np.ascontiguousarray(keys, np.uint64)
         if self._spill_files:
             with self._lock:
@@ -119,6 +134,16 @@ class HostStore:
             for f in self.fields:
                 self._arr[f][rows] = data[f]
             self._touched[rows] = True
+
+    def update_rows(self, keys: np.ndarray, sub: np.ndarray,
+                    slot_override: Optional[np.ndarray] = None) -> None:
+        """Batched write-back of gathered LOGICAL rows ``[k, feat]``
+        (gather_full_rows layout) — the async-epilogue fast path: one
+        call converts fields and lands the whole shard delta under a
+        single lock acquisition, instead of the caller assembling a
+        field dict first."""
+        self.update(keys, store_fields_from_rows(
+            sub, self.mf_dim, self.opt_ext, slot_override=slot_override))
 
     # ---- shared helpers (score / eviction / dump format) ----
     def _score(self, rows: np.ndarray, nonclk_coeff: float,
@@ -192,6 +217,7 @@ class HostStore:
     def save_base(self, path: str) -> int:
         """Full model dump — includes rows currently spilled to disk
         tiers, so the exported base is always the COMPLETE model."""
+        self._barrier()
         with self._lock:
             keys, rows = self.index.items()
             n = self._dump(path, keys, rows,
@@ -206,6 +232,7 @@ class HostStore:
         """(keys, {field: values}) snapshot — base includes disk-spilled
         rows so the export is the COMPLETE model; ``delta`` restricts to
         rows touched since the last export/save and clears their flags."""
+        self._barrier()
         with self._lock:
             keys, rows = self.index.items()
             if delta:
@@ -227,7 +254,8 @@ class HostStore:
                     merge: bool = False) -> int:
         """Write rows wholesale (load semantics); merge=False resets the
         store first. Missing/mismatched opt_ext starts fresh."""
-        with self._lock:
+        self._barrier()  # an in-flight write-back must not land AFTER
+        with self._lock:  # a reset/load overwrote the store
             if not merge:
                 self.index = make_kv(self.capacity)
                 for f in self.fields:
@@ -249,6 +277,7 @@ class HostStore:
         live weights/optimizer state; unseen keys insert wholesale."""
         if len(keys) == 0:
             return 0
+        self._barrier()
         keys = np.ascontiguousarray(keys, np.uint64)
         with self._lock:
             existing = self.index.lookup(keys) >= 0
@@ -266,6 +295,7 @@ class HostStore:
         return len(keys)
 
     def save_delta(self, path: str) -> int:
+        self._barrier()
         with self._lock:
             keys, rows = self.index.items()
             m = self._touched[rows]
@@ -290,6 +320,7 @@ class HostStore:
         self._arr[f][rows] = blob[f][sel]
 
     def load(self, path: str, merge: bool = False) -> int:
+        self._barrier()  # same reset-vs-in-flight hazard as import_rows
         blob = np.load(path)
         keys = blob["keys"]
         with self._lock:
@@ -321,6 +352,7 @@ class HostStore:
         ``save_base`` merges spill files in so exports stay complete."""
         if not path.endswith(".npz"):
             path += ".npz"  # savez appends it; the registry must match
+        self._barrier()
         with self._lock:
             if path in self._spill_files:
                 raise ValueError(
@@ -354,6 +386,7 @@ class HostStore:
         (_spill_keys — the file itself is immutable): a later shrink of a
         promoted key can never resurrect its stale spilled copy into a
         base export, and no call ever rewrites a spill file."""
+        self._barrier()  # "RAM wins" needs in-flight rows IN RAM first
         blob = np.load(path)  # immutable file: safe to read unlocked
         dkeys = blob["keys"]
         if len(dkeys) == 0:
@@ -397,6 +430,7 @@ class HostStore:
         thr = (FLAGS.shrink_delete_threshold
                if delete_threshold is None else delete_threshold)
         dk = FLAGS.show_click_decay_rate if decay is None else decay
+        self._barrier()  # decay/score must see every written-back row
         with self._lock:
             keys, rows = self.index.items()
             if len(keys) == 0:

@@ -235,7 +235,28 @@ class MultiMfTieredShardedTable(MultiMfShardedTable):
         return sum(t.begin_pass() for t in self.tables)
 
     def end_pass(self) -> int:
+        # each class table closes + submits its own async epilogue job;
+        # fence() below drains all of them (checkpoint/lifecycle callers)
         return sum(t.end_pass() for t in self.tables)
+
+    def fence(self) -> None:
+        """Drain every class table's async end_pass epilogue (surfaces
+        the first write-back failure — see ps/epilogue.py)."""
+        for t in self.tables:
+            t.fence()
+
+    def endpass_stats(self) -> dict:
+        """Epilogue accounting aggregated across the dim classes:
+        additive fields sum (counts stay ints); ``last_writeback_sec``
+        takes the max — summing per-class "last job" durations would
+        fabricate a duration no job had."""
+        parts = [t.endpass_stats() for t in self.tables]
+        out: dict = {}
+        for k in parts[0] if parts else ():
+            vals = [p[k] for p in parts]
+            out[k] = (max(vals) if k == "last_writeback_sec"
+                      else sum(vals))
+        return out
 
     def spill_cold(self, path_prefix: str, threshold: float) -> int:
         return sum(t.spill_cold(f"{path_prefix}.mf{d}", threshold)

@@ -29,6 +29,7 @@ lifecycle methods).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, Optional
 
 import jax
@@ -36,14 +37,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddlebox_tpu.config import FLAGS
+from paddlebox_tpu.ps.epilogue import PassEpilogue
 from paddlebox_tpu.ps.host_store import HostStore
 from paddlebox_tpu.ps.kv import make_kv
 from paddlebox_tpu.ps.sgd import SparseSGDConfig
-from paddlebox_tpu.ps.table import (EmbeddingTable, promote_window_delta,
+from paddlebox_tpu.ps.table import (EmbeddingTable,
+                                    dispatch_packed_row_gather,
+                                    promote_window_delta,
                                     rows_from_store_fields,
                                     scatter_logical_rows,
-                                    start_scatter_warmup,
-                                    store_fields_from_rows)
+                                    start_scatter_warmup)
 from paddlebox_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -98,26 +101,35 @@ class PassScopedTable(EmbeddingTable):
         self._stage_thread: Optional[threading.Thread] = None
         self._stage_exc: Optional[BaseException] = None
         self.in_pass = False
+        # async pass epilogue (ps/epilogue — the single-chip mirror of
+        # the tiered table's): end_pass snapshots + dispatches, the
+        # worker drains; every HostStore read entry point fences first
+        self._epilogue = PassEpilogue(name="pass-endpass")
+        host.read_barrier = self._epilogue.fence
         # per-pass delta accounting (same keys as the tiered table)
         self.last_pass_stats: Dict[str, int] = {}
         start_scatter_warmup(self.state, sharded=False)
+
+    def fence(self) -> None:
+        """Drain the asynchronous end_pass write-back and surface the
+        first failure (ps/epilogue.PassEpilogue.fence). Implicit on
+        every ``self.host`` read entry point."""
+        self._epilogue.fence()
+
+    def endpass_stats(self) -> Dict[str, float]:
+        """Cumulative epilogue accounting (obs/hub pass events, bench)."""
+        return self._epilogue.stats()
 
     # ---- host field <-> logical row conversion --------------------------
     def _logical_rows(self, vals: Dict[str, np.ndarray]) -> np.ndarray:
         return rows_from_store_fields(vals, self.mf_dim, self.opt_ext)
 
-    def _store_fields(self, sub: np.ndarray,
-                      rows: np.ndarray) -> Dict[str, np.ndarray]:
-        """Slot comes from host metadata (the device column is not
-        maintained — EmbeddingTable._gather_host does the same)."""
-        return store_fields_from_rows(
-            sub, self.mf_dim, self.opt_ext,
-            slot_override=self.slot_host[rows].astype(np.float32))
-
     def _gather_rows_device(self, rows: np.ndarray) -> np.ndarray:
         """Device-side row gather → host [k, feat]: D2H wire is the
-        gathered rows, not the whole table."""
-        return np.asarray(jax.device_get(self.state.data[rows]))
+        gathered rows, not the whole table (shared jitted bucketed
+        gather — ps/table.dispatch_packed_row_gather)."""
+        dev, k = dispatch_packed_row_gather(self.state, None, rows)
+        return np.asarray(jax.device_get(dev))[:k]
 
     # ---- feed-pass staging (BeginFeedPass/EndFeedPass) ----
     def stage(self, pass_keys: np.ndarray, background: bool = True) -> None:
@@ -186,12 +198,19 @@ class PassScopedTable(EmbeddingTable):
         self._stage = None
 
         with self.host_lock:
+            if len(self.index) + len(st.new_keys) > self.capacity:
+                # promote may EVICT under capacity pressure: order the
+                # dirty-evictee write-backs (and released rows' later
+                # re-fetches) after the in-flight epilogue (see the
+                # tiered table's identical fence)
+                self._epilogue.fence()
             rows_new, still, stats = promote_window_delta(
                 self.index, self._touched, self.capacity,
                 st.keys, st.new_keys,
                 gather_rows=self._gather_rows_device,
-                writeback=lambda ks, rs, sub:
-                    self.host.update(ks, self._store_fields(sub, rs)),
+                writeback=lambda ks, rs, sub: self.host.update_rows(
+                    ks, sub,
+                    slot_override=self.slot_host[rs].astype(np.float32)),
                 on_freed=lambda freed:
                     self.slot_host.__setitem__(freed, 0))
             ins_vals = {f: v[still] for f, v in st.values.items()}
@@ -209,22 +228,50 @@ class PassScopedTable(EmbeddingTable):
         return len(st.keys)
 
     def end_pass(self) -> int:
-        """Write back only the rows touched since the last write-back;
-        the window stays resident for the next pass's reuse."""
+        """Close the pass and write back ASYNCHRONOUSLY (the tiered
+        table's epilogue contract, single chip — see
+        TieredShardedEmbeddingTable.end_pass): snapshot touched rows +
+        slot metadata, dispatch the D2H gather against the immutable
+        device buffers, and drain on the background epilogue;
+        ``FLAGS.async_end_pass=False`` runs the job inline. Write-back
+        stays touched-rows-sized; the window stays resident."""
         if not self.in_pass:
             raise RuntimeError("end_pass without begin_pass")
+        t0 = time.perf_counter()
+        job = None
         with self.host_lock:
             keys, rows = self.index.items()
             m = self._touched[rows]
             keys, rows = keys[m], rows[m]
             if len(rows):
-                sub = self._gather_rows_device(rows)
-                self.host.update(keys, self._store_fields(sub, rows))
+                # dispatch now (buffer-donation safety), pull on the
+                # worker; slot metadata snapshots HERE — slot_host may
+                # be rewritten by the next pass's prepare before the
+                # write-back lands
+                sub_dev, k = dispatch_packed_row_gather(self.state, None,
+                                                        rows)
+                slots = self.slot_host[rows].astype(np.float32)
                 self._touched[rows] = False
+
+                def job(keys=keys, sub_dev=sub_dev, k=k,
+                        slots=slots) -> None:
+                    from paddlebox_tpu.resilience import faults
+                    faults.inject("endpass.writeback", op="single",
+                                  rows=len(keys))
+                    sub = np.asarray(jax.device_get(sub_dev))[:k]
+                    self.host.update_rows(keys, sub, slot_override=slots)
         self.in_pass = False
         self.last_pass_stats["written_back"] = len(keys)
-        log.info("end_pass: %d touched rows written back to host store",
-                 len(keys))
+        if job is not None:
+            if FLAGS.async_end_pass:
+                self._epilogue.submit(job, label="end_pass")
+            else:
+                job()
+        self.last_pass_stats["end_pass_submit_sec"] = round(
+            time.perf_counter() - t0, 6)
+        log.info("end_pass: %d touched rows -> host store (%s)",
+                 len(keys),
+                 "async" if FLAGS.async_end_pass else "sync")
         return len(keys)
 
     def drop_window(self) -> None:
@@ -238,6 +285,7 @@ class PassScopedTable(EmbeddingTable):
             raise RuntimeError(
                 "drop_window while a pass is open — the window's updates "
                 "are not in the host store yet; end_pass first")
+        self.fence()  # the dropped window's write-backs must land first
         try:
             if self._stage_thread is not None or self._stage is not None:
                 self.wait_stage_done()

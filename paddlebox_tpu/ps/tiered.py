@@ -31,6 +31,24 @@ writes back only rows touched since the last write-back. Host↔HBM wire
 per pass is therefore proportional to the working-set DELTA, not its
 size.
 
+ASYNC EPILOGUE (ps/epilogue.py; docs/PERFORMANCE.md): ``end_pass``
+snapshots the touched-row indices, DISPATCHES the D2H gathers against
+the then-current (immutable) device buffers, clears the flags, and
+returns — the blocking pull + HostStore write-back drain on a single
+serialized background worker, overlapping pass N+1's begin/train.
+``fence()`` orders every consumer: all HostStore read entry points
+drain the epilogue first (HostStore.read_barrier), ``begin_pass``
+fences before capacity-pressure eviction (write-back/write-back
+ordering), and checkpoint capture / save / shrink / merge_model /
+load / drop_window fence too, so the old bit-for-bit delta==full
+semantics hold unchanged (scripts/pipeline_check.py gates this). A
+write-back failure surfaces at the next fence as
+``EndPassWritebackError`` — never as silent row loss. Overlapping
+``begin_pass`` reconciles against in-flight write-backs by
+construction: its staged values were fetched for keys OUTSIDE the open
+window (the write-back set is resident-only), and any fetch that could
+observe a stale host row happens behind the read barrier.
+
 OVERLAPPED staging (pre_build_thread, ps_gpu_wrapper.cc:913): ``stage``
 is legal while a pass is OPEN. Keys missing from the window are by
 definition outside the open pass's write-back set, so fetching them
@@ -65,20 +83,25 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import time
 from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from paddlebox_tpu.config import FLAGS
+from paddlebox_tpu.ps.epilogue import PassEpilogue
 from paddlebox_tpu.ps.host_store import HostStore
 from paddlebox_tpu.ps.sharded import ShardedEmbeddingTable
 from paddlebox_tpu.ps.sgd import SparseSGDConfig
-from paddlebox_tpu.ps.table import (HostKV, promote_window_delta,
+from paddlebox_tpu.ps.table import (HostKV, dispatch_packed_row_gather,
+                                    promote_window_delta,
                                     rows_from_store_fields,
                                     scatter_logical_rows,
                                     start_scatter_warmup,
                                     store_fields_from_rows)
+from paddlebox_tpu.resilience import faults
 from paddlebox_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -121,10 +144,23 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         self._stage: Optional[_ShardStage] = None
         self._stage_thread: Optional[threading.Thread] = None
         self._stage_exc: Optional[BaseException] = None
+        # async pass epilogue (ps/epilogue): end_pass hands the D2H pull
+        # + host write-back to this worker; every HostStore read entry
+        # point drains it first (read_barrier), so no consumer observes
+        # a partially written-back pass
+        self._epilogue = PassEpilogue(name="tiered-endpass")
+        for h in self.hosts:
+            if h is not None:
+                h.read_barrier = self._epilogue.fence
         # keys assigned by a future pass's plan build (plan_scope)
-        # whose values haven't been promoted yet — sorted per shard
+        # whose values haven't been promoted yet: a consolidated sorted
+        # array per shard + O(1)-append chunk lists merged lazily by
+        # _pending_of (the hot plan-assign path no longer rebuilds the
+        # sorted array under host_lock per call — ADVICE r5)
         self._pending: List[np.ndarray] = [np.empty(0, np.uint64)
                                            for _ in range(self.n)]
+        self._pending_chunks: List[List[np.ndarray]] = [
+            [] for _ in range(self.n)]
         # per-pass delta accounting (asserted by tests, reported by
         # bench): resident = working-set keys already in the window,
         # staged = keys fetched+scattered, evicted / evicted_writeback,
@@ -137,8 +173,22 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         # rows a future pass's plan build assigned before their values
         # staged — they pin window capacity until begin_pass promotes
         with self.host_lock:
-            out["pending"] = int(sum(len(p) for p in self._pending))
+            out["pending"] = int(sum(len(self._pending_of(s))
+                                     for s in range(self.n)))
         return out
+
+    # ---- async epilogue fence ----------------------------------------
+    def fence(self) -> None:
+        """Drain the asynchronous end_pass write-back and surface the
+        first failure. Called implicitly by every HostStore read entry
+        point (read_barrier), by lifecycle ops, and by checkpoint
+        capture; callers that white-box the host tiers directly should
+        fence first."""
+        self._epilogue.fence()
+
+    def endpass_stats(self) -> Dict[str, float]:
+        """Cumulative epilogue accounting (obs/hub pass events, bench)."""
+        return self._epilogue.stats()
 
     # ---- overlapped plan builds (preload_into_memory) ----------------
     @contextlib.contextmanager
@@ -153,57 +203,110 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         tls = self._plan_tls
         tls.depth = getattr(tls, "depth", 0) + 1
         outer_added = getattr(tls, "added", None)
-        tls.added = [np.empty(0, np.uint64) for _ in range(self.n)]
+        tls.added = [[] for _ in range(self.n)]
         try:
             yield
             if outer_added is not None:  # propagate to the outer scope
                 for s in range(self.n):
-                    outer_added[s] = np.union1d(outer_added[s],
-                                                tls.added[s])
+                    # chunk OBJECTS propagate (identity is what the
+                    # outer scope's rollback removes from the queue)
+                    outer_added[s].extend(tls.added[s])
         except BaseException:
-            with self.host_lock:
-                for s in range(self.n):
-                    ks = tls.added[s]
-                    if not len(ks):
-                        continue
-                    self._pending[s] = self._pending[s][
-                        ~np.isin(self._pending[s], ks)]
-                    # ALSO release the rows this build assigned:
-                    # unpinned-but-still-assigned keys would read as
-                    # resident at a later pass's reconcile and silently
-                    # keep their zero rows over the staged values.
-                    # Keys a concurrent streaming assign trained
-                    # meanwhile (touched) stay — releasing a row whose
-                    # updates await write-back would corrupt it; they
-                    # follow the normal resident-is-fresher rule.
-                    rows = self.indexes[s].lookup(ks)
-                    ok = rows >= 0
-                    ks, rows = ks[ok], rows[ok]
-                    untouched = ~self._touched[s][rows]
-                    if untouched.any():
-                        self.indexes[s].release(ks[untouched])
+            self._rollback_plan(tls.added)
             raise
         finally:
             tls.depth -= 1
             tls.added = outer_added
 
+    def _rollback_plan(self, added_chunks: List[List[np.ndarray]]) -> None:
+        """Undo a failed plan build's pending records. The expensive
+        set-differences run OUTSIDE host_lock (ADVICE r5): lock pass 1
+        drops this scope's unmerged chunks (by object identity) and
+        releases the build's untrained rows; the consolidated-array
+        filter computes unlocked and lands with a pointer swap, with an
+        identity check catching a racing consolidation."""
+        added = [np.unique(np.concatenate(ch)) if ch
+                 else np.empty(0, np.uint64) for ch in added_chunks]
+        own = [set(map(id, ch)) for ch in added_chunks]
+        snap: List[Optional[np.ndarray]] = [None] * self.n
+        with self.host_lock:
+            for s in range(self.n):
+                ks = added[s]
+                if not len(ks):
+                    continue
+                self._pending_chunks[s] = [
+                    c for c in self._pending_chunks[s]
+                    if id(c) not in own[s]]
+                snap[s] = self._pending[s]
+                # ALSO release the rows this build assigned:
+                # unpinned-but-still-assigned keys would read as
+                # resident at a later pass's reconcile and silently
+                # keep their zero rows over the staged values.
+                # Keys a concurrent streaming assign trained
+                # meanwhile (touched) stay — releasing a row whose
+                # updates await write-back would corrupt it; they
+                # follow the normal resident-is-fresher rule.
+                rows = self.indexes[s].lookup(ks)
+                ok = rows >= 0
+                ks, rows = ks[ok], rows[ok]
+                untouched = ~self._touched[s][rows]
+                if untouched.any():
+                    self.indexes[s].release(ks[untouched])
+        filtered: List[Optional[np.ndarray]] = [None] * self.n
+        for s in range(self.n):
+            p = snap[s]
+            if p is None or not len(p) or not len(added[s]):
+                filtered[s] = p
+                continue
+            filtered[s] = p[~np.isin(p, added[s])]
+        with self.host_lock:
+            for s in range(self.n):
+                if snap[s] is None:
+                    continue
+                if self._pending[s] is snap[s]:
+                    self._pending[s] = filtered[s]
+                else:  # a reader consolidated between the locks — redo
+                    self._pending[s] = self._pending[s][
+                        ~np.isin(self._pending[s], added[s])]
+
     def _note_plan_assigned(self, s: int, new_keys: np.ndarray) -> None:
-        # under host_lock (prepare_global holds it around the assign)
-        self._pending[s] = np.union1d(self._pending[s], new_keys)
+        # under host_lock (prepare_global holds it around the assign).
+        # O(1) list-append: the old per-call np.union1d rebuilt the
+        # sorted pending array on the preloader thread while holding
+        # host_lock, serializing against the open pass's streaming
+        # assigns (ADVICE r5); readers consolidate once via _pending_of
+        self._pending_chunks[s].append(new_keys)
         added = getattr(self._plan_tls, "added", None)
         if added is not None:
-            added[s] = np.union1d(added[s], new_keys)
+            added[s].append(new_keys)
+
+    def _pending_of(self, s: int) -> np.ndarray:
+        """Shard s's consolidated sorted pending keys (caller holds
+        host_lock): lazily merges the plan-assign chunks, once per
+        reader instead of once per assign."""
+        ch = self._pending_chunks[s]
+        if ch:
+            self._pending[s] = np.union1d(self._pending[s],
+                                          np.concatenate(ch))
+            ch.clear()
+        return self._pending[s]
 
     def _unpin_pending(self, s: int, keys: np.ndarray) -> None:
         """Remove ``keys`` from shard s's pending set (under host_lock):
         their values were promoted (begin_pass) or written back
         (end_pass), so the usual resident-is-fresher reconcile and
         eviction rules apply to them again."""
-        if len(self._pending[s]) and len(keys):
-            self._pending[s] = self._pending[s][
-                ~np.isin(self._pending[s], keys)]
+        pend = self._pending_of(s)
+        if len(pend) and len(keys):
+            self._pending[s] = pend[~np.isin(pend, keys)]
 
     # ------------------------------------------------------------------
+    def _gather_rows_sync(self, s: int, rows: np.ndarray) -> np.ndarray:
+        """Blocking [k, feat] row gather from shard s (eviction
+        write-back path) via the shared jitted bucketed gather."""
+        dev, k = dispatch_packed_row_gather(self.state, s, rows)
+        return np.asarray(jax.device_get(dev))[:k]
+
     def _split_by_owner(self, keys: np.ndarray) -> List[np.ndarray]:
         keys = np.unique(np.ascontiguousarray(keys, np.uint64))
         owners = (keys % np.uint64(self.n)).astype(np.int64)
@@ -244,8 +347,9 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
             for s in range(self.n):
                 ks = per_shard[s]
                 miss = self.indexes[s].lookup(ks) < 0
-                if len(self._pending[s]):
-                    miss |= np.isin(ks, self._pending[s])
+                pend = self._pending_of(s)
+                if len(pend):
+                    miss |= np.isin(ks, pend)
                 new.append(ks[miss])
         self._stage_exc = None
 
@@ -312,15 +416,24 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         val_l: List[np.ndarray] = []
         total = 0
         with self.host_lock:
+            if any(len(self.indexes[s]) + len(st.new_keys[s])
+                   > self.capacity for s in range(self.n)):
+                # capacity pressure → promote may EVICT: a dirty
+                # evictee's write-back and pass N's in-flight epilogue
+                # write-back could reorder on the host store, and a
+                # released row's stale host value must be fully landed
+                # before a later stage re-fetches it — fence first
+                # (the common non-evicting boundary stays fence-free)
+                self._epilogue.fence()
             for s in range(self.n):
                 rows_new, still, st_s = promote_window_delta(
                     self.indexes[s], self._touched[s], self.capacity,
                     st.keys[s], st.new_keys[s],
-                    gather_rows=lambda rs, s=s: np.asarray(
-                        jax.device_get(self.state.data[s][rs])),
+                    gather_rows=lambda rs, s=s: self._gather_rows_sync(
+                        s, rs),
                     writeback=lambda ks, rs, sub, s=s:
-                        self.hosts[s].update(ks, self._store_fields(sub)),
-                    pending=self._pending[s])
+                        self.hosts[s].update_rows(ks, sub),
+                    pending=self._pending_of(s))
                 # pending keys promoted by THIS pass leave the pending
                 # set; keys a concurrent plan build (the pass after
                 # next) recorded stay pinned until their own begin
@@ -346,32 +459,64 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         return total
 
     def end_pass(self) -> int:
-        """Write back only the rows touched since the last write-back
-        (HBM→host gather is touched-rows-sized, not window-sized); the
-        window stays resident for the next pass's reuse."""
+        """Close the pass and WRITE BACK ASYNCHRONOUSLY: snapshot the
+        touched-row indices, dispatch the D2H gathers against the
+        current (immutable) device buffers, clear the flags, and hand
+        the blocking pull + HostStore update to the background epilogue
+        — end_pass returns in dispatch time, and pass N+1's begin/train
+        overlap the drain (``fence()`` orders every consumer; see
+        ps/epilogue.py). ``FLAGS.async_end_pass=False`` runs the same
+        job inline (the pre-overlap behavior, bit-for-bit identical —
+        scripts/pipeline_check.py gates it). The gather stays
+        touched-rows-sized, not window-sized, and now runs OUTSIDE
+        host_lock; the window stays resident for the next pass's
+        reuse."""
         if not self.in_pass:
             raise RuntimeError("end_pass without begin_pass")
         total = 0
+        t0 = time.perf_counter()
+        jobs: List[tuple] = []
         with self.host_lock:
             for s in range(self.n):
                 keys, rows = self.indexes[s].items()
                 m = self._touched[s][rows]
                 keys, rows = keys[m], rows[m]
                 if len(rows):
-                    sub = np.asarray(
-                        jax.device_get(self.state.data[s][rows]))
-                    self.hosts[s].update(keys, self._store_fields(sub))
+                    # DISPATCH the device gather now — the captured
+                    # buffers are immutable and the dispatch pins them,
+                    # so a later jit step donating the (possibly same)
+                    # live table buffer cannot invalidate this read
+                    jobs.append((s, keys, dispatch_packed_row_gather(
+                        self.state, s, rows)))
                     self._touched[s][rows] = False
                     # a PENDING key that trained anyway (a key outside
-                    # its pass's staged set) was just written back — the
+                    # its pass's staged set) is being written back — the
                     # host value is authoritative again, so the usual
                     # resident-is-fresher reconcile may resume for it
                     self._unpin_pending(s, keys)
                 total += len(rows)
         self.in_pass = False
         self.last_pass_stats["written_back"] = total
-        log.info("end_pass: %d touched rows written back to %d host stores",
-                 total, self.n)
+
+        if jobs:
+            def run(jobs=jobs) -> None:
+                for s, keys, (sub_dev, k) in jobs:
+                    # chaos seam: a mid-write-back failure must surface
+                    # at the fence, never as silent row loss
+                    faults.inject("endpass.writeback", op=f"shard{s}",
+                                  shard=s, rows=len(keys))
+                    sub = np.asarray(jax.device_get(sub_dev))[:k]
+                    self.hosts[s].update_rows(keys, sub)
+
+            if FLAGS.async_end_pass:
+                self._epilogue.submit(run, label="end_pass")
+            else:
+                run()
+        self.last_pass_stats["end_pass_submit_sec"] = round(
+            time.perf_counter() - t0, 6)
+        log.info("end_pass: %d touched rows -> %d host stores (%s)",
+                 total, self.n,
+                 "async" if FLAGS.async_end_pass else "sync")
         return total
 
     def drop_window(self) -> None:
@@ -388,6 +533,7 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         read as fresh zero rows if a later mid-pass assign reuses them
         before a scatter initializes them)."""
         self._no_pass("drop_window")
+        self.fence()  # the dropped window's write-backs must land first
         try:
             if self._stage_thread is not None or self._stage is not None:
                 self.wait_stage_done()
@@ -402,6 +548,7 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
                 self._touched[:] = False
                 self._pending = [np.empty(0, np.uint64)
                                  for _ in range(self.n)]
+                self._pending_chunks = [[] for _ in range(self.n)]
                 self.state = self.state.with_packed(
                     jnp.zeros_like(self.state.packed))
 

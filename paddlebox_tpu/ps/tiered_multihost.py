@@ -150,7 +150,7 @@ class MultihostTieredShardedTable(TieredShardedEmbeddingTable):
                     self.indexes[s], self._touched[s], self.capacity,
                     st.keys[s], st.new_keys[s],
                     gather_rows=gather, writeback=writeback,
-                    pending=self._pending[s])
+                    pending=self._pending_of(s))
                 # pending keys promoted by THIS pass leave the pending
                 # set (same bookkeeping as the single-controller table;
                 # identical on every process per the SPMD host contract)
@@ -178,6 +178,12 @@ class MultihostTieredShardedTable(TieredShardedEmbeddingTable):
         return total
 
     def end_pass(self) -> int:
+        # SYNCHRONOUS on purpose: the pass lifecycle is collective here
+        # (every process must agree the write-back landed before any
+        # process's next collective op), so the single-controller async
+        # epilogue does not apply; fence() is inherited and trivially
+        # idle. The owned-shard gathers are already small on-device row
+        # gathers, not window-sized pulls.
         if not self.in_pass:
             raise RuntimeError("end_pass without begin_pass")
         total = 0
@@ -211,6 +217,7 @@ class MultihostTieredShardedTable(TieredShardedEmbeddingTable):
                 self._touched[:] = False
                 self._pending = [np.empty(0, np.uint64)
                                  for _ in range(self.n)]
+                self._pending_chunks = [[] for _ in range(self.n)]
                 zeros = {
                     self._shard_id(sh): jax.device_put(
                         np.zeros(sh.data.shape, sh.data.dtype), sh.device)

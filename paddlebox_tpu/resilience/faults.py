@@ -31,6 +31,12 @@ site                        seam
                             exception (resilience/preemption)
 ``restore.consensus``       every shared-dir consensus publish
                             (restore-step / quarantine agreement)
+``endpass.writeback``       each async end-pass write-back job before
+                            the D2H pull lands rows in the host tier
+                            (ps/tiered.py, ps/pass_table.py): a ``fail``
+                            surfaces at the next epilogue fence as
+                            ``EndPassWritebackError`` — never as silent
+                            row loss
 ==========================  =============================================
 
 Fault kinds: ``fail`` (raise — ``exc=transient|crash|os`` picks the

@@ -232,6 +232,13 @@ class CheckpointManager:
         shutil.rmtree(tmp, ignore_errors=True)
         os.makedirs(tmp)
         trainer.sync_table()
+        # drain the async pass epilogue (ps/epilogue) before capturing:
+        # a checkpoint published over an in-flight (or silently failed)
+        # end_pass write-back would snapshot a host tier missing the
+        # pass's rows — preemption/emergency saves come through here too
+        fence = getattr(trainer.table, "fence", None)
+        if fence is not None:
+            fence()
         # mid-pass (cursor) saves must not clear the table's touched
         # set: with the prefetch pipeline preparing ahead, a mid-pass
         # clear drops assigned-but-not-yet-pushed rows from every later

@@ -823,7 +823,18 @@ class ResidentPassRunner:
 class PassPreloader:
     """Double-buffered pass pipeline — preload_into_memory /
     wait_feed_pass_done (box_wrapper.h:1142-1156) for resident passes:
-    builds + uploads pass k+1 in a background thread while pass k trains."""
+    builds + uploads pass k+1 in a background thread while pass k trains.
+
+    With the tiered tables' ASYNC EPILOGUE (ps/epilogue,
+    FLAGS.async_end_pass) the pipeline is three-deep at steady state:
+    pass k-1's end_pass write-back drains on the epilogue worker, pass
+    k trains on device, and this preloader builds/stages pass k+1 —
+    the pass boundary costs one reconcile+scatter, with both the
+    prologue fetch and the epilogue D2H off the critical path. The
+    epilogue's fence rules keep it safe: a plan build here only
+    assigns value-less PENDING rows (plan_scope), and the overlapped
+    ``stage`` fetch drains in-flight write-backs before reading the
+    host tier (HostStore.read_barrier)."""
 
     def __init__(self, datasets: Iterator[Dataset], table=None,
                  floats_dtype=np.float32, build_fn=None,
@@ -876,10 +887,23 @@ class PassPreloader:
         return True
 
     def wait(self) -> Optional[ResidentPass]:
-        """Block until the preloaded pass is staged (WaitFeedPassDone)."""
+        """Block until the preloaded pass is staged (WaitFeedPassDone).
+        The blocked seconds are the pipeline's prologue stall — exported
+        as ``pbox_preload_wait_seconds_total`` so a starved pipeline
+        (build slower than train) is visible next to the epilogue's
+        fence-wait counter (docs/PERFORMANCE.md)."""
         if self._thread is None:
             return None
+        import time as _time
+        t0 = _time.perf_counter()
         self._thread.join()
+        waited = _time.perf_counter() - t0
+        from paddlebox_tpu.obs.hub import get_hub
+        hub = get_hub()
+        if hub.active and waited > 1e-4:
+            hub.counter("pbox_preload_wait_seconds_total",
+                        "seconds the trainer blocked on pass preload"
+                        ).inc(waited)
         self._thread = None
         if self._err is not None:
             err, self._err = self._err, None

@@ -833,6 +833,15 @@ class ShardedTrainer:
     def sync_table(self) -> None:
         self.table.state = self.state.table
 
+    def fence_table(self) -> None:
+        """Drain the table's async end_pass epilogue (ps/epilogue);
+        surfaces the first write-back failure. Checkpoint capture and
+        every host-tier read fence implicitly — this is the explicit
+        hook for scripts/benches that white-box the host stores."""
+        fence = getattr(self.table, "fence", None)
+        if fence is not None:
+            fence()
+
     def adopt_table(self) -> None:
         """Point the jit state at the table's (re)built device state —
         called after a tiered table's begin_pass promotes a new pass

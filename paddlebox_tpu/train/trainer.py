@@ -701,6 +701,16 @@ class Trainer:
         facade (for save/shrink/load host ops)."""
         self.table.state = self.state.table
 
+    def fence_table(self) -> None:
+        """Drain the table's async end_pass epilogue (ps/epilogue) and
+        surface the first write-back failure; no-op for tables without
+        one. NOT called at pass boundaries — that would re-serialize
+        the overlap; checkpoint capture and host-tier reads fence
+        themselves."""
+        fence = getattr(self.table, "fence", None)
+        if fence is not None:
+            fence()
+
     def restore_state(self, params, opt_state, auc, step: int) -> None:
         """Rebind dense + metric state after a checkpoint restore (the
         table was already loaded); CheckpointManager's trainer hook."""
@@ -721,6 +731,10 @@ class Trainer:
     def save(self, prefix: str) -> None:
         import pickle
         self.sync_table()
+        # pass-window tables: drain the async end_pass epilogue so the
+        # dump never races an in-flight write-back (CheckpointManager
+        # fences the same way)
+        self.fence_table()
         self.table.save_base(prefix + ".sparse.npz")
         with open(prefix + ".dense.pkl", "wb") as fh:
             pickle.dump(jax.device_get((self.state.params,

@@ -1,0 +1,53 @@
+"""JAX version-compat shims.
+
+The codebase targets the modern ``jax.shard_map`` API (mesh/in_specs/
+out_specs kwargs + ``check_vma``); older jax releases ship the same
+machinery as ``jax.experimental.shard_map.shard_map`` with the
+``check_vma`` knob named ``check_rep``. ``install()`` publishes a
+translating wrapper as ``jax.shard_map`` when the attribute is missing,
+so every call site (and the tests) runs unchanged on both lines.
+Installed from ``paddlebox_tpu/__init__`` — importing any subpackage is
+enough.
+
+Deliberate tradeoff: this mutates the global ``jax`` namespace (only
+when the attribute is MISSING — a real ``jax.shard_map`` is never
+touched). A repo-local wrapper would avoid that but couldn't cover the
+test suite's direct ``jax.shard_map`` calls; the shim mirrors the
+modern keyword-only signature and passes unknown kwargs through, so a
+third-party caller on legacy jax gets at worst the same TypeError the
+legacy API would raise for an unsupported feature.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def _needs_shim() -> bool:
+    try:
+        jax.shard_map  # jax >= 0.6 exports it at top level
+        return False
+    except AttributeError:
+        return True
+
+
+def install() -> None:
+    """Idempotently publish ``jax.shard_map`` on older jax."""
+    if not _needs_shim():
+        return
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    def shard_map(f=None, *, mesh, in_specs, out_specs, check_vma=None,
+                  **kw):
+        # the modern check_vma flag was called check_rep on the legacy
+        # API; identical meaning for our uses (disable the replication/
+        # varying-mesh-axes check)
+        if check_vma is not None and "check_rep" not in kw:
+            kw["check_rep"] = check_vma
+        if f is None:  # decorator form: jax.shard_map(mesh=...)(f)
+            return lambda g: _legacy(g, mesh=mesh, in_specs=in_specs,
+                                     out_specs=out_specs, **kw)
+        return _legacy(f, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, **kw)
+
+    jax.shard_map = shard_map

@@ -79,6 +79,13 @@ def build_rows(events: List[dict]) -> List[Dict[str, str]]:
             if lp:
                 tbl += (f" (+{lp.get('staged', 0)} staged,"
                         f" -{lp.get('evicted', 0)} evicted)")
+            eps = t.get("endpass")
+            if eps and eps.get("jobs_run"):
+                # async epilogue (docs/PERFORMANCE.md): cumulative
+                # write-back vs the part that never blocked the main
+                # thread — ovl ≈ wb means the epilogue is free
+                tbl += (f" [wb {eps.get('writeback_sec', 0):.2f}s"
+                        f" ovl {eps.get('overlap_sec', 0):.2f}s]")
         hbm = ev.get("hbm", {})
         rows.append({
             "pass": str(ev.get("pass_seq", len(rows) + 1)),

@@ -134,6 +134,9 @@ def test_trainer_lr_map_freezes_param(ctr_dataset):
     assert moved > 0
 
 
+@pytest.mark.slow  # seed-broken (no jax.shard_map) until the
+# jax_compat shim; recovered, but heavy on the virtual-CPU mesh —
+# out of the tier-1 wall budget, runs in the slow tier
 @pytest.mark.parametrize("zero1", [False, True])
 def test_sharded_trainer_lr_map(ctr_dataset, zero1):
     """Mesh trainer (psum and zero1 flat chunks): frozen param holds at

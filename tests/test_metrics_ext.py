@@ -251,6 +251,9 @@ def test_registry_skips_metric_missing_side_channel():
     assert reg.get_metric_msg("m")["ins_num"] == 0
 
 
+@pytest.mark.slow  # seed-broken (no jax.shard_map) until the
+# jax_compat shim; recovered, but heavy on the virtual-CPU mesh —
+# out of the tier-1 wall budget, runs in the slow tier
 def test_registry_on_sharded_trainer():
     """Metric variants accumulate on the MESH trainer: the per-device-row
     AddAucMonitor feed matches the single-chip trainer's registry on the
@@ -302,6 +305,8 @@ def test_registry_on_sharded_trainer():
     assert abs(wm["wuauc"] - ws["wuauc"]) < 0.08, (wm, ws)
 
 
+@pytest.mark.slow  # same budget rationale as the sharded-trainer
+# registry test above
 def test_registry_on_mesh_resident_pass():
     """Metric variants accumulate in the MESH RESIDENT pass: predictions
     are collected inside the fori_loop (device-sharded [nb, N, B]) and

@@ -1,0 +1,55 @@
+"""Tier-1 wiring of scripts/pipeline_check.py — the deterministic
+async-epilogue gate (ISSUE 4): async==sync host-tier digest over a
+3-pass tiered job with overlapped staging, and measured end_pass
+overlap > 0. The standalone script runs a bigger variant; this is the
+fast non-slow gate."""
+
+import numpy as np
+
+from scripts.pipeline_check import host_tier_digest, run_check
+
+
+def test_pipeline_check_gate():
+    out = run_check(passes=3, shards=4, keys_per_pass=256,
+                    capacity_per_shard=512)
+    assert out["ok"]
+    assert out["rows"] > 0
+    eps = out["async_endpass"]
+    assert eps["jobs_run"] >= 3
+    assert eps["overlap_sec"] > 0.0
+    assert eps["pending"] == 0
+
+
+def test_host_tier_digest_is_order_insensitive():
+    """The digest must hash logical content, not insertion order —
+    async and sync runs may land rows in different row ids."""
+    from paddlebox_tpu.ps import SparseSGDConfig
+    from paddlebox_tpu.ps.tiered import TieredShardedEmbeddingTable
+
+    def mk(order):
+        t = TieredShardedEmbeddingTable(
+            2, mf_dim=2, capacity_per_shard=64,
+            cfg=SparseSGDConfig(mf_create_thresholds=0.0,
+                                mf_initial_range=0.0))
+        for ks in order:
+            f = {"show": np.ones(len(ks), np.float32),
+                 "clk": np.zeros(len(ks), np.float32),
+                 "delta_score": np.zeros(len(ks), np.float32),
+                 "slot": np.zeros(len(ks), np.float32),
+                 "embed_w": ks.astype(np.float32),
+                 "embed_g2sum": np.zeros(len(ks), np.float32),
+                 "embedx_w": np.zeros((len(ks), 2), np.float32),
+                 "embedx_g2sum": np.zeros(len(ks), np.float32),
+                 "mf_size": np.zeros(len(ks), np.float32)}
+            for s in range(2):
+                sel = ks[ks % np.uint64(2) == s]
+                t.hosts[s].update(sel, {k: (v[ks % np.uint64(2) == s]
+                                            if v.ndim else v)
+                                        for k, v in f.items()})
+        return t
+
+    a = np.arange(1, 9, dtype=np.uint64)
+    b = np.arange(9, 17, dtype=np.uint64)
+    d1 = host_tier_digest(mk([a, b]))
+    d2 = host_tier_digest(mk([b, a]))
+    assert d1 == d2

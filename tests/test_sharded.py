@@ -327,6 +327,10 @@ def test_sharded_save_delta_and_reset_load(mesh, tmp_path):
     assert np.all(w0[mask] == 0.0), "stale device rows survived reset load"
 
 
+@pytest.mark.slow  # seed-broken (no jax.shard_map) until the
+# jax_compat shim; recovered, but heavy on the virtual-CPU mesh —
+# out of the tier-1 wall budget, runs in the slow tier (zero1 parity
+# is also pinned by the lr_map zero1 variant there)
 def test_zero1_matches_replicated_dense_update(mesh):
     """ZeRO-1 (opt-state sharded over flat param chunks, reference
     boxps_worker.cc:601 sharding stage) must produce the same params as
@@ -356,6 +360,8 @@ def test_zero1_matches_replicated_dense_update(mesh):
         np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-6)
 
 
+@pytest.mark.slow  # same budget rationale — the resident mesh path
+# stays covered in tier-1 by test_sharded_resident_matches_streaming
 def test_sharded_resident_non_trivial_segments(mesh):
     """Mesh resident pass with MULTI-KEY slots (non-trivial segments —
     the wire ships a segment stream instead of deriving from meta):
@@ -521,6 +527,7 @@ def test_sharded_pass_preloader(mesh, tmp_path):
     assert all(np.isfinite(r["auc"]) for r in results)
 
 
+@pytest.mark.slow  # same budget rationale as above
 def test_sharded_eval_pass_and_checkpoint(mesh, tmp_path):
     """Forward-only mesh eval + CheckpointManager save/restore round trip
     on the sharded trainer."""
@@ -621,6 +628,7 @@ def test_sharded_resident_scale(mesh, tmp_path):
     assert results[-1]["auc"] > 0.55
 
 
+@pytest.mark.slow  # same budget rationale as above
 def test_sharded_resident_q8_wire_learns(mesh, tmp_path):
     """The sharded q8 float wire (dense int8 affine + u8 lsc, decoded in
     _decode_wire_step) trains and tracks the f32 wire's AUC."""

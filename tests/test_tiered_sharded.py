@@ -441,6 +441,95 @@ def test_delta_staging_equals_full_staging(mesh, tmp_path):
             np.testing.assert_array_equal(a[f], b[f], err_msg=f"s{s} {f}")
 
 
+def test_async_epilogue_parity_bit_identical(mesh, tmp_path):
+    """ISSUE 4 parity suite: overlapped end_pass/begin_pass (async
+    epilogue ON, the default) over 4 passes with ~90% key overlap must
+    be BIT-IDENTICAL to the synchronous path — same dense params, same
+    host-tier values, same staged-delta accounting — and the async run
+    must actually run background write-back jobs."""
+    built = [_write_overlap_pass(tmp_path, p, vocab=100, step=10)
+             for p in range(4)]
+    datasets = [b[0] for b in built]
+    desc = built[0][1]
+
+    def run(async_mode):
+        with flags_scope(async_end_pass=async_mode):
+            t = TieredShardedEmbeddingTable(
+                N, mf_dim=4, capacity_per_shard=2048, cfg=_cfg(),
+                req_bucket_min=256, serve_bucket_min=256)
+            with flags_scope(log_period_steps=10000):
+                tr = ShardedTrainer(DeepFM(hidden=(16, 16)), t, desc,
+                                    mesh, tx=optax.adam(2e-3))
+            h = BoxPSHelper(t, trainer=tr)
+            staged = []
+            for i, ds in enumerate(datasets):
+                h.begin_pass(ds)
+                staged.append(t.last_pass_stats["staged"])
+                if i + 1 < len(datasets):
+                    h.stage_pass(datasets[i + 1])  # overlapped fetch
+                tr.train_pass(ds)
+                h.end_pass(ds)  # async: returns before write-back lands
+            t.fence()
+            return t, tr, staged
+
+    ta, tr_a, staged_a = run(False)   # synchronous oracle
+    tb, tr_b, staged_b = run(True)    # async epilogue (default)
+    assert staged_b == staged_a, (staged_b, staged_a)
+    assert tb.endpass_stats()["jobs_run"] >= len(datasets)
+    assert ta.endpass_stats()["jobs_run"] == 0  # sync ran inline
+    for x, y in zip(jax.tree.leaves(tr_a.state.params),
+                    jax.tree.leaves(tr_b.state.params)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    for s in range(N):
+        ka, fa = ta.hosts[s].export_rows()
+        kb, fb = tb.hosts[s].export_rows()
+        oa, ob = np.argsort(ka), np.argsort(kb)
+        np.testing.assert_array_equal(ka[oa], kb[ob])
+        assert np.abs(fa["embed_w"]).sum() > 0  # actually trained
+        for f in ta.hosts[s].fields:
+            np.testing.assert_array_equal(fa[f][oa], fb[f][ob],
+                                          err_msg=f"s{s} {f}")
+
+
+def test_async_writeback_failure_surfaces_at_fence(mesh):
+    """A mid-write-back failure (endpass.writeback seam) must surface
+    LOUDLY at the fence — through an explicit fence(), AND through the
+    implicit read barrier on any host-tier access — never as silent
+    row loss; once surfaced, the error is consumed."""
+    from paddlebox_tpu.ps.epilogue import EndPassWritebackError
+    from paddlebox_tpu.resilience.faults import FaultPlan, installed
+
+    def check(surface):
+        """One failing end_pass; ``surface(table)`` must raise the held
+        error. The plan stays installed until the background job ran
+        (the surface call fences)."""
+        table = TieredShardedEmbeddingTable(
+            N, mf_dim=2, capacity_per_shard=64, cfg=_cfg())
+        keys = np.arange(1, 33, dtype=np.uint64)
+        table.begin_pass(keys)
+        from paddlebox_tpu.ps.table import FIELD_COL
+        data = np.asarray(jax.device_get(table.state.data)).copy()
+        with table.host_lock:
+            for s in range(N):
+                _, rows = table.indexes[s].items()
+                data[s][rows, FIELD_COL["embed_w"]] = 3.0
+                table._touched[s][rows] = True
+        data[:, table.capacity, :] = 0.0
+        table.state = type(table.state).from_logical(
+            data, table.capacity, ext=table.opt_ext)
+        with installed(FaultPlan.parse(
+                "endpass.writeback:fail:nth=1,exc=crash")):
+            table.end_pass()       # submit succeeds; the JOB fails
+            with pytest.raises(EndPassWritebackError):
+                surface(table)
+        return table
+
+    t1 = check(lambda t: t.fence())          # explicit fence
+    t1.fence()                               # surfaced once — consumed
+    check(lambda t: t.feature_count())       # implicit read barrier
+    check(lambda t: t.save_delta("/tmp/never_epilogue.npz"))  # capture
+
+
 def test_overlap_stage_reconciles_mid_pass_assign(mesh):
     """The overlap race, resolved by the begin_pass reconcile: key K is
     staged for pass 2 while pass 1 is open (host value fetched), then
@@ -648,8 +737,10 @@ def test_tiered_preloader_overlapped_plan_build(mesh, tmp_path):
         if pre.start_next() and i + 1 < len(datasets):
             hb.stage_pass(datasets[i + 1])   # host fetch overlaps too
         tr_b.train_pass_resident(rp)         # the PREBUILT pass
-        pending_seen = max(pending_seen,
-                           sum(len(p) for p in tb._pending))
+        with tb.host_lock:  # consolidated view (plan assigns append
+            pending_seen = max(  # O(1) chunks; _pending_of merges them)
+                pending_seen,
+                sum(len(tb._pending_of(s)) for s in range(tb.n)))
         hb.end_pass(ds)
     # the mechanism actually engaged: some future-pass keys were
     # plan-assigned as pending before their begin_pass
