@@ -458,18 +458,23 @@ def main() -> None:
         wire = os.environ.get("BENCH_FLOAT_WIRE", "q8")
         wire = {"bf16": jnp.bfloat16, "f32": np.float32}.get(wire, wire)
         blockp = os.environ.get("BENCH_BLOCK_PRELOAD", "0") == "1"
-        pre = (PassPreloader(datasets, build_fn=build_fn)
+        debug = os.environ.get("BENCH_DEBUG", "0") == "1"
+        no_overlap = os.environ.get("BENCH_NO_OVERLAP", "0") == "1"
+        # pipeline depth: FLAGS.preload_depth unless overridden;
+        # BENCH_NO_OVERLAP = the manual kick-per-pass control (depth 0)
+        depth = (0 if no_overlap else
+                 int(os.environ.get("BENCH_PRELOAD_DEPTH",
+                                    str(FLAGS.preload_depth))))
+        pre = (PassPreloader(datasets, build_fn=build_fn, depth=depth)
                if build_fn is not None else
                PassPreloader(datasets, table, floats_dtype=wire,
-                             block_transfers=blockp))
+                             block_transfers=blockp, depth=depth))
         pre.start_next()
         rp = pre.wait()
         pre.start_next()
         tr.train_pass_resident(rp)          # warmup/compile pass
         # per-pass wall includes that pass's preload wait
         walls_l, waits_l, trains_l, rates_l, wire_l = [], [], [], [], []
-        debug = os.environ.get("BENCH_DEBUG", "0") == "1"
-        no_overlap = os.environ.get("BENCH_NO_OVERLAP", "0") == "1"
         max_passes = int(os.environ.get("BENCH_MAX_PASSES",
                                         str(max(12, num_passes))))
         budget_s = float(os.environ.get("BENCH_WALL_BUDGET_SEC", "180"))
@@ -550,13 +555,20 @@ def main() -> None:
                       file=sys.stderr)
             finally:
                 shutil.rmtree(xdir, ignore_errors=True)
-        # drain the in-flight preload before the wire-free rerun: the
-        # cycled dataset source ALWAYS has a next pass building, and its
-        # background batch-build + H2D upload would contaminate dev_only
-        # (deflating device_only_ex_per_sec / device_busy_frac)
-        rp_next = pre.wait()
-        if rp_next is not None and getattr(rp_next, "dev", None) is not None:
-            jax.block_until_ready(jax.tree.leaves(rp_next.dev))
+        # quiesce the pipeline before the wire-free rerun: the cycled
+        # dataset source ALWAYS has passes building ahead, and their
+        # background batch-build + H2D upload would contaminate
+        # dev_only (deflating device_only_ex_per_sec /
+        # device_busy_frac). stop() halts the worker (an in-flight
+        # build aborts or completes), drain() joins it, and the
+        # remaining staged passes' transfers are waited out.
+        pre.drain()
+        while True:
+            rp_next = pre.wait()
+            if rp_next is None:
+                break
+            if getattr(rp_next, "dev", None) is not None:
+                jax.block_until_ready(jax.tree.leaves(rp_next.dev))
         # device-only rate: re-run the LAST staged pass (its wire is
         # already resident, so nothing rides the tunnel) — the clean
         # numerator for MFU / duty-cycle attribution. TWO reruns, the
@@ -589,6 +601,18 @@ def main() -> None:
             passes=n_meas,
             passes_dropped=n_dropped,
             estimate_stable=stable,
+            # deep pass pipeline attribution (ISSUE 5 / BENCH_r06):
+            # depth, total prologue stall over the measured passes, and
+            # the per-stage build-seconds breakdown so a starved
+            # pipeline names its slow stage (front/dedup/pack/h2d)
+            preload_depth=pre.depth if not no_overlap else 0,
+            preload_depth_clamped=pre.depth_clamped,
+            prologue_wait_sec_total=round(sum(waits_l), 4),
+            preload_builds=pre.builds,
+            preload_build_sec_total=round(pre.build_sec_total, 4),
+            preload_build_stage_sec={
+                k: round(v, 4)
+                for k, v in sorted(pre.build_stage_sec.items())},
             per_pass_wall_sec=[round(w, 3) for w in walls_l],
             per_pass_wait_sec=[round(w, 3) for w in waits_l],
             per_pass_train_sec=[round(w, 3) for w in trains_l],

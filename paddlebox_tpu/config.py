@@ -96,6 +96,33 @@ class Flags:
     # compile (utils/compile_cache + ps/tiered)
     warmup_pass_scatter: bool = True
 
+    # --- deep pass preload pipeline (train/device_pass.PassPreloader;
+    # docs/PERFORMANCE.md §Deep pass pipeline) ---
+    # passes in flight (building or staged) ahead of training; 1 = the
+    # old double-buffer. The effective depth self-clamps under the HBM
+    # budget below.
+    preload_depth: int = 2
+    # staged-pass HBM budget: the preloader estimates bytes per staged
+    # pass from the first build and clamps its effective depth to
+    # max(1, budget // bytes_per_pass) — loudly, instead of OOMing
+    # (<= 0 disables the guard)
+    preload_hbm_budget_mb: int = 4096
+    # index pack/upload chunk (batches): uniq/gidx blocks encode and
+    # start their H2D transfer as each chunk completes instead of after
+    # the full pack (<= 0 = whole pass, the pre-pipeline behavior)
+    preload_pack_chunk_batches: int = 8
+    # whole-pass bulk key assignment: one assign round-trip under
+    # host_lock per pass instead of one per batch (False = the serial
+    # per-batch path, bit-compatible reference)
+    bulk_pass_assign: bool = True
+    # q8 float wire on NON-columnar re-iterable datasets: True streams
+    # per-column min/max batch-by-batch and casts on a second walk —
+    # no full-pass f32 staging, but heavy-tailed columns lose
+    # quantize_floats' winsorized-range clip and the batches rebuild
+    # twice. False restores the staged whole-pass quantization
+    # (winsorize + one walk, at the full-pass f32 host cost).
+    q8_streaming_front: bool = True
+
     # --- XLA persistent compilation cache (utils/compile_cache) ---
     # "" = auto (<tmp>/paddlebox_tpu_xla_cache, honoring
     # JAX_COMPILATION_CACHE_DIR); "off" disables. Enabled by

@@ -116,6 +116,13 @@ class Dataset:
     #: mid-pass resume cursor (docs/RESILIENCE.md) only applies here.
     supports_cursor_resume = False
 
+    #: True when ``batches()`` may be called more than once and yields
+    #: the SAME stream each time (the loaded order is frozen in
+    #: memory). Streaming datasets consume their readers. Two-phase
+    #: pass builds (the q8 streaming front, train/device_pass._front)
+    #: key off this.
+    supports_reiteration = False
+
     def __init__(self, desc: Optional[DataFeedDesc] = None) -> None:
         self.desc = desc or DataFeedDesc()
         self.filelist: List[str] = []
@@ -476,6 +483,12 @@ class InMemoryDataset(Dataset):
         resumed process could not rebuild the same batch order and the
         cursor would splice two different streams."""
         return self._det_order
+
+    # once loaded, the record/columnar order is frozen in memory, so
+    # batches() replays the same stream regardless of how deterministic
+    # the LOAD itself was (supports_cursor_resume is about reloading in
+    # a fresh process; this is about re-walking this one)
+    supports_reiteration = True
 
     def release_memory(self) -> None:
         self.records = []

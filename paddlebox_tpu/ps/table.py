@@ -458,6 +458,24 @@ def host_pull_block(vals: np.ndarray, mf_dim: int) -> np.ndarray:
          vals[:, NUM_FIXED:mf_end] * gate], axis=1)
 
 
+def dedup_first_seen(keys: np.ndarray
+                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Dedup ``keys`` in FIRST-SEEN order → (uniq, first_idx, inv).
+
+    The bulk pass-assign front half (EmbeddingTable.bulk_assign_unique):
+    dedup runs OUTSIDE host_lock, and first-seen order makes the single
+    bulk ``index.assign`` allocate new rows in exactly the order a
+    serial batch-by-batch walk of the native hash index would (the
+    native assign_unique is first-occurrence by construction), so bulk
+    and per-batch builds are row-for-row identical there."""
+    uniq_s, first_s, inv_s = np.unique(keys, return_index=True,
+                                       return_inverse=True)
+    order = np.argsort(first_s, kind="stable")
+    rank = np.empty(len(order), np.int64)
+    rank[order] = np.arange(len(order), dtype=np.int64)
+    return uniq_s[order], first_s[order], rank[inv_s]
+
+
 def fill_oob_pads(unique_rows: np.ndarray, u: int, capacity: int) -> None:
     """Fill positions [u:] with DISTINCT out-of-bounds row ids (> capacity).
 
@@ -982,6 +1000,37 @@ class EmbeddingTable:
         """Record each unique row's slot (first key occurrence wins via
         the reversed assignment). Caller holds host_lock."""
         self.slot_host[rows[inv[::-1]]] = slot_of_key[::-1]
+
+    def bulk_assign_unique(self, keys: np.ndarray,
+                           slot_of_key: np.ndarray
+                           ) -> Tuple[np.ndarray, np.ndarray]:
+        """Whole-PASS bulk row assignment (the resident-pass build's
+        critical path): dedup the concatenated key stream outside
+        ``host_lock`` (first-seen order — see dedup_first_seen), then
+        ONE index round-trip under the lock instead of one per batch.
+        Returns (rows of the first-seen uniques, inverse). Slot
+        metadata records the key's PASS-level first-occurrence slot;
+        the serial per-batch path nets out to the last batch's
+        first occurrence instead — identical under the one-slot-per-key
+        contract (CTR feasigns are slot-qualified,
+        Dataset.pass_key_slots), which is the only input either path
+        supports.
+
+        Arena tables assign slotted so first-seen keys land in their
+        slot's arena (same rationale as the per-batch dedup path:
+        slotless assigns would poison the compact wire forever)."""
+        keys = np.ascontiguousarray(keys, np.uint64)
+        uniq, first_idx, inv = dedup_first_seen(keys)
+        slots_first = slot_of_key[first_idx]
+        with self.host_lock:
+            if getattr(self.index, "arena_enabled", False):
+                rows, _ = self.index.assign_slotted(
+                    uniq, slots_first.astype(np.uint16, copy=False))
+            else:
+                rows = self.index.assign(uniq)
+            self.slot_host[rows] = slots_first.astype(np.int16,
+                                                      copy=False)
+        return rows, inv
 
     def prepare(self, batch: SlotBatch) -> PullIndex:
         valid = batch.keys[:batch.num_keys]

@@ -76,7 +76,12 @@ boundary is then reconcile-only — plan construction, host fetch AND
 upload all ride the previous pass's training. Capacity contract: the
 window must hold the UNION of the open pass's and the planned pass's
 working sets (pending rows are pinned; promotion raises when eviction
-cannot free enough).
+cannot free enough). With a DEPTH-N preloader (train/device_pass,
+FLAGS.preload_depth) several future passes' plans can be pending at
+once — plan builds stay serialized in pass order on the preloader
+worker, each bracketed in its own ``plan_scope``, and keys recorded by
+a later pass's plan stay pinned until THAT pass's begin_pass; the
+capacity union extends over every queued pass accordingly.
 """
 
 from __future__ import annotations

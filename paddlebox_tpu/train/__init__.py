@@ -3,7 +3,9 @@ from paddlebox_tpu.train.trainer import Trainer
 from paddlebox_tpu.train.dense_modes import (AsyncDenseTable, KStepParamSync,
                                              build_lr_scales,
                                              lr_map_transform)
-from paddlebox_tpu.train.device_pass import (PassPreloader, ResidentPass,
+from paddlebox_tpu.train.device_pass import (PassPreloader,
+                                             PreloadBuildAborted,
+                                             ResidentPass,
                                              ResidentPassRunner)
 from paddlebox_tpu.train.checkpoint import CheckpointManager
 from paddlebox_tpu.train.multi_mf_step import (MultiMfTrainStep,
@@ -14,6 +16,7 @@ from paddlebox_tpu.train.multi_mf_sharded import MultiMfShardedTrainer
 __all__ = ["TrainStep", "DeviceBatch", "make_device_batch", "Trainer",
            "AsyncDenseTable", "KStepParamSync", "build_lr_scales",
            "lr_map_transform",
-           "PassPreloader", "ResidentPass", "ResidentPassRunner",
+           "PassPreloader", "PreloadBuildAborted", "ResidentPass",
+           "ResidentPassRunner",
            "CheckpointManager", "MultiMfTrainStep", "MultiMfTrainer",
            "ShardedTrainer", "MultiMfShardedTrainer"]

@@ -22,26 +22,57 @@ hooks, dynamic NaN aborts mid-pass) still runs via Trainer.train_pass.
 
 from __future__ import annotations
 
+import collections
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, Iterator, Optional, Tuple
+from typing import Dict, Iterator, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from paddlebox_tpu.config import FLAGS
 from paddlebox_tpu.data.dataset import Dataset
-from paddlebox_tpu.ops.bitpack import (pack_delta_auto, pack_u12,
-                                       pack_u16m, pack_u18, pack_u24,
-                                       unpack_delta16, unpack_u12,
-                                       unpack_u16m, unpack_u18,
-                                       unpack_u24)
+from paddlebox_tpu.ops.bitpack import (pack_delta, pack_delta_auto,
+                                       pack_u12, pack_u16m, pack_u18,
+                                       pack_u24, unpack_delta16,
+                                       unpack_u12, unpack_u16m,
+                                       unpack_u18, unpack_u24)
 from paddlebox_tpu.ops.device_unique import dedup_rows
 from paddlebox_tpu.train.step import (dequantize_floats, pack_floats,
                                       quantize_floats, unpack_floats)
 from paddlebox_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
+
+
+class PreloadBuildAborted(RuntimeError):
+    """A background pass build observed the graceful-stop flag between
+    stages and aborted (resilience/preemption): a 2 s build must not eat
+    the SIGTERM grace window. Raised only on NON-main threads (an inline
+    main-thread build keeps the run_pass stop protocol in charge); the
+    preloader treats it as a clean end-of-stream, never an error."""
+
+
+_PRELOAD_TLS = threading.local()  # .abort: callable set on worker threads
+
+
+def poll_preload_abort() -> None:
+    """Stop poll for background pass builds — called between build
+    stages (front/dedup/pack) and periodically inside long loops.
+    Honors both the process-wide graceful-stop flag and the owning
+    preloader's stop() (via a worker thread-local). A no-op on the
+    main thread and when no stop is pending."""
+    abort = getattr(_PRELOAD_TLS, "abort", None)
+    if abort is not None and abort():
+        raise PreloadBuildAborted("pass build aborted (preloader stop)")
+    if threading.current_thread() is threading.main_thread():
+        return
+    from paddlebox_tpu.resilience import preemption
+    if preemption.stop_pending():
+        raise PreloadBuildAborted(
+            f"pass build aborted ({preemption.stop_reason()})")
 
 
 class ResidentPass:
@@ -91,6 +122,10 @@ class ResidentPass:
         self.chunk_bits: Optional[int] = None
         # columnar side channels for the post-pass metric feed (or None)
         self.side = side
+        # per-stage build seconds (front/dedup/pack/h2d), set by
+        # build_streamed — the preloader mirrors them into
+        # pbox_preload_build_seconds_total{stage=...}
+        self.build_stats: Optional[Dict[str, float]] = None
 
     @property
     def num_batches(self) -> int:
@@ -137,44 +172,143 @@ class ResidentPass:
         on this runtime (measured: the H2D transfer streams while the
         host packs; per-array forced fetches cost a ~0.25 s round-trip
         each). The float block is put before dedup/pack begin, so its
-        transfer rides under the host build; the index blocks are put
-        once packing completes (their encode depends on the whole-pass
-        u_pad/format choice), so their transfer overlaps only the
-        encode tail — pass wall ≈ host build + index transfer, with the
-        float transfer and all sync round-trips hidden. (Chunk-wise
-        index packing could hide ~0.5 s more behind the dedup phase but
-        needs a chunked runner — revisit with the compact-rows wire.)
-        The only blocking wait is one ``block_until_ready`` at the end.
-        Wire format matches upload() exactly; the returned pass is
-        already staged (dev set)."""
+        transfer rides under the host build; the index blocks upload
+        CHUNKED (FLAGS.preload_pack_chunk_batches): the wire format is
+        chosen once from the dedup results (exactly the choice
+        _encode_uniq/_encode_gidx would make on the whole pass), then
+        each chunk of batches packs on the thread pool, encodes, and
+        starts its H2D transfer while later chunks are still packing —
+        pass wall ≈ host build with the tail chunk's transfer exposed,
+        instead of build + full index transfer. The device stitches the
+        chunks with one concatenate per wire leaf. The only blocking
+        wait is one ``block_until_ready`` at the end. Wire bytes match
+        upload() exactly; the returned pass is already staged (dev
+        set).
+
+        On a background (preloader) thread the build polls the
+        graceful-stop flag between stages; an abort waits out the
+        already-issued transfers (no orphan H2D competing with the
+        emergency checkpoint) before raising PreloadBuildAborted.
+
+        Per-stage seconds land in ``rp.build_stats``
+        (front/dedup/pack/h2d — docs/PERFORMANCE.md telemetry)."""
+        stats: Dict[str, float] = {}
+        t0 = time.perf_counter()
         per_batch, floats, qmeta, trivial, nrec, side = cls._front(
             dataset, floats_dtype)
+        stats["front"] = time.perf_counter() - t0
         floats_t = jax.device_put(floats)
         qm = jax.device_put(np.zeros((2, 0), np.float32)
                             if qmeta is None else qmeta)
+        issued: List = [floats_t, qm]
+        try:
+            rp = cls._build_streamed_tail(
+                per_batch, floats, qmeta, trivial, nrec, side, table,
+                floats_t, qm, threads, block, stats, issued)
+        except PreloadBuildAborted:
+            # drain the transfers this build already issued: an orphan
+            # H2D in flight would contend with the emergency
+            # checkpoint's D2H during the grace window
+            jax.block_until_ready(list(jax.tree.leaves(issued)))
+            raise
+        rp.build_stats = stats
+        return rp
+
+    @classmethod
+    def _build_streamed_tail(cls, per_batch, floats, qmeta, trivial,
+                             nrec, side, table, floats_t, qm,
+                             threads: int, block: bool,
+                             stats: Dict[str, float],
+                             issued: List) -> "ResidentPass":
         if getattr(table.index, "arena_enabled", False):
             rp = cls._compact_tail(per_batch, floats, qmeta, trivial,
                                    nrec, table, floats_t, qm,
-                                   block=block, side=side)
+                                   block=block, side=side, stats=stats)
             if rp is not None:
                 return rp
             log.warning("compact wire unavailable for this pass "
                         "(foreign rows or width overflow); using dedup "
                         "wire")
+        poll_preload_abort()
+        t0 = time.perf_counter()
         dedup, u_pad, k_max = cls._dedup_phase(per_batch, table, threads)
-        uniq, gidx, meta, segs = cls._pack_chunk(
-            per_batch, dedup, u_pad, k_max, trivial, table.capacity)
-        uniq_t = tuple(jax.device_put(a)
-                       for a in cls._encode_uniq(uniq, meta))
-        gidx_t = tuple(jax.device_put(a) for a in cls._encode_gidx(gidx))
+        stats["dedup"] = time.perf_counter() - t0
+        poll_preload_abort()
+        # wire formats decided ONCE from the dedup results — the exact
+        # choice _encode_uniq/_encode_gidx make on the whole pass, so
+        # per-chunk encodes are mutually consistent and byte-identical
+        # to upload()
+        ufmt = cls._choose_uniq_fmt(dedup, u_pad, table.capacity)
+        gfmt = cls._choose_gidx_fmt(per_batch, dedup, k_max)
+        nb = len(per_batch)
+        step = FLAGS.preload_pack_chunk_batches
+        step = nb if step <= 0 else min(step, nb)
+        t_pack = t_h2d = 0.0
+        uniq_parts: List[tuple] = []
+        gidx_parts: List[tuple] = []
+        host_parts: List[tuple] = []
+        with ThreadPoolExecutor(max_workers=threads) as pool:
+            futs = [pool.submit(cls._pack_chunk, per_batch[a:a + step],
+                                dedup[a:a + step], u_pad, k_max,
+                                trivial, table.capacity)
+                    for a in range(0, nb, step)]
+            for f in futs:
+                t0 = time.perf_counter()
+                uniq_c, gidx_c, meta_c, segs_c = f.result()
+                t_pack += time.perf_counter() - t0
+                poll_preload_abort()
+                # host encode is pack work; only the device_put
+                # dispatch books as h2d (the stage split exists so a
+                # starved pipeline names its slow stage correctly)
+                t0 = time.perf_counter()
+                ue = cls._encode_uniq_fmt(ufmt, uniq_c, meta_c)
+                ge = cls._encode_gidx_fmt(gfmt, gidx_c)
+                t_pack += time.perf_counter() - t0
+                t0 = time.perf_counter()
+                up = tuple(jax.device_put(a) for a in ue)
+                gp = tuple(jax.device_put(a) for a in ge)
+                issued.extend(up)
+                issued.extend(gp)
+                uniq_parts.append(up)
+                gidx_parts.append(gp)
+                host_parts.append((uniq_c, gidx_c, meta_c, segs_c))
+                t_h2d += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        if len(host_parts) == 1:
+            uniq, gidx, meta, segs = host_parts[0]
+            uniq_t, gidx_t = uniq_parts[0], gidx_parts[0]
+            t_pack += time.perf_counter() - t0
+        else:
+            uniq = np.concatenate([p[0] for p in host_parts])
+            gidx = np.concatenate([p[1] for p in host_parts])
+            meta = np.concatenate([p[2] for p in host_parts])
+            segs = (None if trivial else
+                    np.concatenate([p[3] for p in host_parts]))
+            t_pack += time.perf_counter() - t0
+            # stitch the staged chunks device-side: one concatenate per
+            # wire leaf, dispatched against the in-flight transfers
+            # (device work → the h2d stage, like the puts it chases)
+            t0 = time.perf_counter()
+            uniq_t = tuple(jnp.concatenate([p[j] for p in uniq_parts],
+                                           axis=0)
+                           for j in range(len(uniq_parts[0])))
+            gidx_t = tuple(jnp.concatenate([p[j] for p in gidx_parts],
+                                           axis=0)
+                           for j in range(len(gidx_parts[0])))
+            t_h2d += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        segs_enc = (None if segs is None else
+                    cls._encode_segs_or_fallback(segs, meta, floats))
+        t_pack += time.perf_counter() - t0
+        t0 = time.perf_counter()
         segs_t = ((jax.device_put(np.zeros((1, 1), np.int32)),)
-                  if segs is None else
-                  tuple(jax.device_put(a)
-                        for a in cls._encode_gidx(segs)))
+                  if segs_enc is None else
+                  tuple(jax.device_put(a) for a in segs_enc))
         rp = cls(uniq, gidx, floats, meta, segs, nrec, qmeta=qmeta,
                  side=side)
         rp.dev = (uniq_t, gidx_t, floats_t, jax.device_put(meta),
                   segs_t, qm)
+        issued.extend(jax.tree.leaves(rp.dev))
         if block:
             jax.block_until_ready(list(jax.tree.leaves(rp.dev)))
         # block=False: transfers are ISSUED (device_put is eager/async)
@@ -182,13 +316,79 @@ class ResidentPass:
         # thread is free to start the NEXT pass's host build while this
         # pass's bytes are still on the wire (PassPreloader does this,
         # overlapping host build k+2 with transfer k+1 and training k)
+        stats["h2d"] = t_h2d + (time.perf_counter() - t0)
+        stats["pack"] = t_pack
         return rp
+
+    @classmethod
+    def _encode_segs_or_fallback(cls, segs, meta, floats):
+        enc = cls._encode_segs_slotwire(segs, meta, floats.shape[1])
+        return enc if enc is not None else cls._encode_gidx(segs)
+
+    @classmethod
+    def _choose_uniq_fmt(cls, dedup, u_pad: int, cap: int) -> str:
+        """The whole-pass uniq wire decision, computed from the dedup
+        results BEFORE packing (so chunks can encode+upload as they
+        complete): exactly _encode_uniq's preference order — u8 deltas,
+        u16 deltas, 16+8-bit halves, raw int32. Exception counts equal
+        pack_delta's (per-row gaps over the real ascending prefix), and
+        the u24 bound covers the fill_oob_pads tail (max pad id =
+        cap + (u_pad - u))."""
+        exc8 = exc16 = 0
+        vmax = 0
+        for uniq_s, _ in dedup:
+            u = len(uniq_s)
+            d = np.diff(uniq_s.astype(np.int64, copy=False))
+            exc8 = max(exc8, int((d >= (1 << 8)).sum()))
+            exc16 = max(exc16, int((d >= (1 << 16)).sum()))
+            if u:
+                vmax = max(vmax, int(uniq_s[-1]))
+            if u < u_pad:
+                vmax = max(vmax, cap + (u_pad - u))
+        if exc8 <= cls._EXC8:
+            return "d8"
+        if exc16 <= cls._EXC:
+            return "d16"
+        return "u24" if vmax < (1 << 24) else "raw"
+
+    @staticmethod
+    def _choose_gidx_fmt(per_batch, dedup, k_max: int) -> str:
+        """_encode_gidx's decision from dedup stats: per-batch max gidx
+        is u (the pad value) when the batch has key pads, else u - 1
+        (ranks are dense in [0, u))."""
+        gmax = 0
+        for (keys, *_), (uniq_s, _) in zip(per_batch, dedup):
+            u = len(uniq_s)
+            gmax = max(gmax, u if len(keys) < k_max else u - 1)
+        return ("u18" if gmax < (1 << 18) and k_max % 4 == 0
+                else "raw")
+
+    @classmethod
+    def _encode_uniq_fmt(cls, fmt: str, uniq: np.ndarray,
+                         meta: np.ndarray):
+        """Encode a chunk in the pre-chosen whole-pass format (the
+        chunked twin of _encode_uniq — same bytes, decided once)."""
+        if fmt == "d8":
+            out = pack_delta(uniq, meta[:, 2], cls._EXC8, bits=8)
+        elif fmt == "d16":
+            out = pack_delta(uniq, meta[:, 2], cls._EXC, bits=16)
+        elif fmt == "u24":
+            return pack_u24(uniq)
+        else:
+            return (uniq,)
+        assert out is not None, "pre-chosen delta wire must fit"
+        return out
+
+    @staticmethod
+    def _encode_gidx_fmt(fmt: str, gidx: np.ndarray):
+        return pack_u18(gidx) if fmt == "u18" else (gidx,)
 
     @classmethod
     def _compact_tail(cls, per_batch, floats, qmeta, trivial: bool,
                       nrec: int, table, floats_t, qm,
                       block: bool = True,
-                      side: Optional[Dict] = None
+                      side: Optional[Dict] = None,
+                      stats: Optional[Dict[str, float]] = None
                       ) -> Optional["ResidentPass"]:
         """COMPACT wire for slot-arena tables: ship per-key slot-LOCAL
         rows (≈17 bits at CTR scale — at/near the wire's entropy floor)
@@ -211,21 +411,43 @@ class ResidentPass:
         rows_g = np.full((nb, k_max), cap + 1, np.int32)
         meta = np.zeros((nb, 4), np.int32)
         segs = None if trivial else np.empty((nb, k_max), np.int32)
+        t0 = time.perf_counter()
+        bulk = FLAGS.bulk_pass_assign
+        if bulk:
+            # whole-pass bulk assign: ONE host_lock round-trip for the
+            # pass instead of nb (assign_slotted walks keys in order,
+            # so allocation is identical to the per-batch loop)
+            keys_all = np.concatenate([k for k, *_ in per_batch])
+            slots_all = np.concatenate([s for _, s, *_ in per_batch])
+            with table.host_lock:
+                r_all, l_all = table.index.assign_slotted(
+                    keys_all, slots_all.astype(np.uint16, copy=False))
+                table.slot_host[r_all] = slots_all
+            if (l_all < 0).any():
+                return None
+            bounds = np.cumsum([0] + [len(k) for k, *_ in per_batch])
         for i, (keys, slot_of_key, _, pad_seg, seg_arr) in \
                 enumerate(per_batch):
             nk = len(keys)
-            su = slot_of_key.astype(np.uint16, copy=False)
-            with table.host_lock:
-                r, l = table.index.assign_slotted(keys, su)
-                table.slot_host[r] = slot_of_key
-            if (l < 0).any():
-                return None
+            if bulk:
+                a = bounds[i]
+                r, l = r_all[a:a + nk], l_all[a:a + nk]
+            else:
+                su = slot_of_key.astype(np.uint16, copy=False)
+                with table.host_lock:
+                    r, l = table.index.assign_slotted(keys, su)
+                    table.slot_host[r] = slot_of_key
+                if (l < 0).any():
+                    return None
             locs[i, :nk] = l
             rows_g[i, :nk] = r
             meta[i] = (nk, pad_seg, 0, 0)
             if segs is not None:
                 segs[i, :nk] = seg_arr
                 segs[i, nk:] = pad_seg
+        if stats is not None:  # key-assignment stage (the dedup twin)
+            stats["dedup"] = time.perf_counter() - t0
+        t0 = time.perf_counter()
         bits = max(int(locs.max()).bit_length(), 1)
         if bits > 24:
             return None
@@ -259,6 +481,8 @@ class ResidentPass:
         rp.chunk_bits = int(table.arena_chunk_bits)
         rp.dev = (loc_t, (jax.device_put(cmap),), floats_t,
                   jax.device_put(meta), segs_t, qm)
+        if stats is not None:  # encode + transfer dispatch
+            stats["pack"] = time.perf_counter() - t0
         if block:
             jax.block_until_ready(list(jax.tree.leaves(rp.dev)))
         return rp
@@ -289,14 +513,25 @@ class ResidentPass:
         col = getattr(dataset, "columnar", None)
         if col is not None:
             return cls._front_columnar(dataset, col, floats_dtype)
+        if (floats_dtype == "q8" and FLAGS.q8_streaming_front
+                and getattr(dataset, "supports_reiteration", False)):
+            # two-phase streaming front: per-column range stats
+            # accumulate batch by batch, then a second walk casts each
+            # batch straight to the u8 wire — the host never holds a
+            # full-pass f32 float block just for the range stats
+            # (FLAGS.q8_streaming_front=False restores the staged
+            # whole-pass quantization and its winsorized range)
+            return cls._front_q8_streaming(dataset)
         per_batch = []
         floats_l = []
         trivial = True
         nrec = 0
-        # q8 needs whole-pass f32 staging for the range stats; other
-        # wires cast per batch so the host never holds a full f32 copy
+        # q8 without a re-iterable dataset stages the whole pass f32
+        # for the range stats; other wires cast per batch so the host
+        # never holds a full f32 copy
         batch_dtype = np.float32 if floats_dtype == "q8" else floats_dtype
         for b in dataset.batches():
+            poll_preload_abort()
             nk = b.num_keys
             slot_of_key = (b.segments[:nk] % b.num_slots).astype(np.int16)
             per_batch.append((b.keys[:nk], slot_of_key, b.key_capacity,
@@ -313,6 +548,88 @@ class ResidentPass:
         if floats_dtype == "q8":
             floats, qmeta = cls._encode_floats(floats, floats_dtype)
         return per_batch, floats, qmeta, trivial, nrec, None
+
+    @classmethod
+    def _front_q8_streaming(cls, dataset: Dataset):
+        """q8 front without the whole-pass f32 staging: phase 1 walks
+        the batches collecting the key views + per-column min/max over
+        REAL rows (show > 0, the quantize_floats ``valid`` contract) +
+        the exact-u8 label/show/clk checks; phase 2 re-walks the same
+        (deterministic, in-memory) batch stream and casts each batch
+        straight into the u8 block with the pass-level qmeta. Peak host
+        float memory is one batch f32 + the u8 block instead of the
+        full pass in f32.
+
+        Divergence from the staged path, by design: the winsorized
+        range (quantize_floats' [0.1, 99.9]-percentile clip for
+        outlier-dominated columns) needs the full value distribution,
+        which streaming min/max cannot see — heavy-tailed columns keep
+        the raw min/max range here. When the data doesn't fit the u8
+        wire at all, phase 2 falls back to the bf16 cast, exactly like
+        _encode_floats."""
+        per_batch = []
+        trivial = True
+        nrec = 0
+        lo = hi = None
+        n_valid = 0
+        first_row = None
+        fits = True
+        for b in dataset.batches():
+            poll_preload_abort()
+            nk = b.num_keys
+            slot_of_key = (b.segments[:nk] % b.num_slots).astype(np.int16)
+            per_batch.append((b.keys[:nk], slot_of_key, b.key_capacity,
+                              b.pad_segment,
+                              b.segments[:nk].astype(np.int32,
+                                                     copy=False)))
+            nrec += int((b.show > 0).sum())
+            trivial = trivial and getattr(b, "segments_trivial", False)
+            d = b.dense.astype(np.float32, copy=False)
+            if fits:
+                lsc = np.stack([b.label, b.show, b.clk], axis=1)
+                if (not np.isfinite(d).all() or (lsc < 0).any()
+                        or (lsc > 255).any()
+                        or (lsc != np.rint(lsc)).any()):
+                    fits = False
+            if first_row is None and d.shape[0]:
+                first_row = d[:1].copy()
+            valid = b.show > 0
+            if valid.any():
+                stat = d[valid]
+                n_valid += stat.shape[0]
+                blo, bhi = stat.min(axis=0), stat.max(axis=0)
+                lo = blo if lo is None else np.minimum(lo, blo)
+                hi = bhi if hi is None else np.maximum(hi, bhi)
+        if not per_batch:
+            raise ValueError("empty pass")
+        if n_valid == 0:  # quantize_floats' stat = d[:1] fallback
+            lo = first_row.min(axis=0)
+            hi = first_row.max(axis=0)
+        if not fits:
+            log.warning("q8 float wire: data out of range, using bf16")
+            floats = np.stack([
+                pack_floats(b.dense, b.label, b.show, b.clk,
+                            dtype=jnp.bfloat16)
+                for b in dataset.batches()])
+            return per_batch, floats, None, trivial, nrec, None
+        scale = ((hi - lo) / 255.0)
+        scale = np.where(scale > 0, scale, 1.0).astype(np.float32)
+        lo = lo.astype(np.float32)
+        qmeta = np.stack([scale, lo])
+        floats_u8 = None
+        for i, b in enumerate(dataset.batches()):
+            poll_preload_abort()
+            d = b.dense.astype(np.float32, copy=False)
+            q = np.clip(np.rint((d - lo[None, :]) / scale[None, :]),
+                        0, 255)
+            block = np.concatenate(
+                [q, np.stack([b.label, b.show, b.clk], axis=1)],
+                axis=1).astype(np.uint8)
+            if floats_u8 is None:
+                floats_u8 = np.zeros((len(per_batch),) + block.shape,
+                                     np.uint8)
+            floats_u8[i] = block
+        return per_batch, floats_u8, qmeta, trivial, nrec, None
 
     @classmethod
     def _front_columnar(cls, dataset: Dataset, col, floats_dtype):
@@ -394,11 +711,52 @@ class ResidentPass:
 
     @classmethod
     def _dedup_phase(cls, per_batch, table, threads: int = 4):
-        """Per-batch dedup + row assignment (the FeedPass registration +
-        DedupKeysAndFillIdx steps): the native index assigns serially
-        under the table lock (deterministic row order), the sort/rank
-        work fans out over a thread pool (numpy releases the GIL).
-        Returns ([(uniq_sorted, gidx)] per batch, u_pad, k_max)."""
+        """Pass-level dedup + row assignment (the FeedPass registration +
+        DedupKeysAndFillIdx steps). Returns
+        ([(uniq_sorted, gidx)] per batch, u_pad, k_max).
+
+        BULK path (FLAGS.bulk_pass_assign, default): concatenate every
+        batch's keys, ONE first-seen dedup + assign round-trip under
+        host_lock (EmbeddingTable.bulk_assign_unique — the dedup itself
+        runs outside the lock), then the per-batch sort/rank splits fan
+        out over a thread pool (numpy releases the GIL). The old path
+        acquired host_lock once PER BATCH with the index assign inside
+        — nb serialized lock round-trips on the preloader thread,
+        measured as the dominant prologue stall (BENCH_r05). New-row
+        allocation order is first-seen over the pass, matching a serial
+        batch walk of the native (first-occurrence) index row for row.
+
+        SERIAL fallback (flag off, or tables without bulk_assign_unique):
+        the per-batch assign loop, unchanged."""
+        bulk = getattr(table, "bulk_assign_unique", None)
+        if FLAGS.bulk_pass_assign and bulk is not None:
+            keys_all = np.concatenate([k for k, *_ in per_batch])
+            slots_all = np.concatenate([s for _, s, *_ in per_batch])
+            rows_u, inv = bulk(keys_all, slots_all)
+            rows_of_key = rows_u[inv]
+            bounds = np.cumsum([0] + [len(k) for k, *_ in per_batch])
+            poll_preload_abort()
+
+            def batch_dedup(a, b):
+                u, g = np.unique(rows_of_key[a:b], return_inverse=True)
+                return (u.astype(np.int32, copy=False),
+                        g.astype(np.int32, copy=False))
+
+            with ThreadPoolExecutor(max_workers=threads) as pool:
+                dedup = list(pool.map(
+                    batch_dedup, bounds[:-1], bounds[1:]))
+        else:
+            dedup = cls._dedup_serial(per_batch, table, threads)
+        u_max = max(len(u) + 1 for u, _ in dedup)
+        from paddlebox_tpu.ps.table import next_bucket_fine
+        u_pad = next_bucket_fine(table.unique_bucket_min, u_max)
+        k_max = max(kc for _, _, kc, _, _ in per_batch)
+        return dedup, u_pad, k_max
+
+    @classmethod
+    def _dedup_serial(cls, per_batch, table, threads: int = 4):
+        """The per-batch assign loop (pre-bulk reference): one
+        host_lock acquisition + index round-trip per batch."""
 
         def sort_rank(rows_u, inv):
             u = len(rows_u)
@@ -424,12 +782,7 @@ class ResidentPass:
                     # slot = host metadata (slot_host), not wire bytes
                     table.record_slots(rows_u, inv, slot_of_key)
                 futs.append(pool.submit(sort_rank, rows_u, inv))
-            dedup = [f.result() for f in futs]
-        u_max = max(len(u) + 1 for u, _ in dedup)
-        from paddlebox_tpu.ps.table import next_bucket_fine
-        u_pad = next_bucket_fine(table.unique_bucket_min, u_max)
-        k_max = max(kc for _, _, kc, _, _ in per_batch)
-        return dedup, u_pad, k_max
+            return [f.result() for f in futs]
 
     @classmethod
     def _pack_chunk(cls, per_batch, dedup, u_pad: int, k_max: int,
@@ -821,28 +1174,58 @@ class ResidentPassRunner:
 
 
 class PassPreloader:
-    """Double-buffered pass pipeline — preload_into_memory /
+    """Depth-N pass pipeline — preload_into_memory /
     wait_feed_pass_done (box_wrapper.h:1142-1156) for resident passes:
-    builds + uploads pass k+1 in a background thread while pass k trains.
+    ONE persistent worker thread builds + uploads passes ahead of
+    training through a bounded queue of ``depth`` passes
+    (FLAGS.preload_depth, default 2). Pass k+2's build starts the
+    moment k+1's finishes — no join-per-consume, so a slow build no
+    longer serializes into the next pass boundary (the depth-1
+    alternating-stall pattern of BENCH_r05).
 
     With the tiered tables' ASYNC EPILOGUE (ps/epilogue,
-    FLAGS.async_end_pass) the pipeline is three-deep at steady state:
-    pass k-1's end_pass write-back drains on the epilogue worker, pass
-    k trains on device, and this preloader builds/stages pass k+1 —
-    the pass boundary costs one reconcile+scatter, with both the
-    prologue fetch and the epilogue D2H off the critical path. The
-    epilogue's fence rules keep it safe: a plan build here only
-    assigns value-less PENDING rows (plan_scope), and the overlapped
-    ``stage`` fetch drains in-flight write-backs before reading the
-    host tier (HostStore.read_barrier)."""
+    FLAGS.async_end_pass) the steady-state pipeline is FOUR-deep: pass
+    k-1's end_pass write-back drains on the epilogue worker, pass k
+    trains on device, pass k+1 sits staged in HBM, and this worker
+    builds pass k+2 — the pass boundary costs one reconcile+scatter,
+    with the prologue build, the H2D wire and the epilogue D2H all off
+    the critical path. The epilogue's fence rules keep it safe: a plan
+    build here only assigns value-less PENDING rows (plan_scope — legal
+    for several queued future passes at once; the window must hold the
+    union of the open pass's and every queued pass's working set), and
+    the overlapped ``stage`` fetch drains in-flight write-backs before
+    reading the host tier (HostStore.read_barrier).
+
+    HBM budget guard: after each build the staged wire bytes
+    (``rp.nbytes()``) are measured and the EFFECTIVE depth clamps to
+    ``max(1, budget // bytes_per_pass)`` (FLAGS.preload_hbm_budget_mb)
+    — an oversized pass degrades the pipeline to double-buffering,
+    loudly, instead of stacking passes until HBM OOMs. The clamp is
+    monotone (never re-raises) so one giant pass bounds the rest of
+    the run conservatively.
+
+    Preemption: the worker polls the graceful-stop flag before every
+    build, and the builders poll it between stages
+    (poll_preload_abort) — on request_stop the pipeline stops building
+    within one stage, already-staged passes stay consumable, and
+    ``drain()`` joins the worker so no orphan H2D is in flight at
+    emergency-checkpoint time.
+
+    A build failure is held and re-raised by the ``wait()`` that would
+    have returned that pass — passes built BEFORE the failure are
+    served first (they are valid), and every wait() after the raise
+    returns None."""
 
     def __init__(self, datasets: Iterator[Dataset], table=None,
                  floats_dtype=np.float32, build_fn=None,
-                 block_transfers: bool = False) -> None:
+                 block_transfers: bool = False,
+                 depth: Optional[int] = None,
+                 hbm_budget_bytes: Optional[int] = None) -> None:
         """``build_fn(dataset) -> pass`` overrides the default single-chip
         ResidentPass builder — e.g.
-        ``build_fn=sharded_trainer.build_resident_pass`` double-buffers
-        mesh passes the same way."""
+        ``build_fn=sharded_trainer.build_resident_pass`` pipelines mesh
+        passes the same way. ``depth`` overrides FLAGS.preload_depth;
+        ``hbm_budget_bytes`` overrides FLAGS.preload_hbm_budget_mb."""
         if table is None and build_fn is None:
             raise ValueError("need a table or a build_fn")
         self._it = iter(datasets)
@@ -850,64 +1233,261 @@ class PassPreloader:
         self._floats_dtype = floats_dtype
         self._build_fn = build_fn
         self._block = block_transfers
-        self._next = None
-        self._thread: Optional[threading.Thread] = None
+        depth = FLAGS.preload_depth if depth is None else depth
+        # depth=0 → MANUAL mode: the worker builds one pass per
+        # start_next() credit instead of free-running (the depth-1
+        # era's strict kick-per-pass protocol; bench's no-overlap
+        # control uses it)
+        self._manual = depth == 0
+        self._credits = 0
+        self.depth = max(1, depth)
+        self._budget = (FLAGS.preload_hbm_budget_mb * (1 << 20)
+                        if hbm_budget_bytes is None else hbm_budget_bytes)
+        self._cv = threading.Condition()
+        self._q: collections.deque = collections.deque()
+        self._building = False
+        self._exhausted = False   # source iterator drained
+        self._stopped = False     # stop()/abort — no further builds
         self._err: Optional[BaseException] = None
+        self._worker: Optional[threading.Thread] = None
+        self._effective_depth = self.depth
+        self.depth_clamped = False
+        # cumulative per-stage build seconds + build count (bench)
+        self.build_stage_sec: Dict[str, float] = {}
+        self.builds = 0
+        self.build_sec_total = 0.0
+        self.wait_sec_total = 0.0
 
-    def _load(self, ds: Dataset) -> None:
+    # ---- worker --------------------------------------------------------
+    def _build(self, ds: Dataset):
+        if self._build_fn is not None:
+            rp = self._build_fn(ds)
+            # forced materialization moves the pass's bytes NOW, riding
+            # alongside the open pass's compute (see
+            # ResidentPass.upload); a lazy upload would instead
+            # serialize into that pass's first step
+            rp.upload(materialize=True)
+            return rp
+        # build+upload overlapped; transfers stay IN FLIGHT
+        # (block=False) so this thread can start the next pass's host
+        # build immediately — the training step consuming the pass
+        # waits on its own args
+        return ResidentPass.build_streamed(
+            ds, self._table, floats_dtype=self._floats_dtype,
+            block=self._block)
+
+    def _run(self) -> None:
+        from paddlebox_tpu.resilience import preemption
+        # lets the builders' stage polls see THIS preloader's stop()
+        # (poll_preload_abort) so an in-flight build aborts promptly
+        _PRELOAD_TLS.abort = lambda: self._stopped
+        while True:
+            with self._cv:
+                while not self._stopped and (
+                        len(self._q) + (1 if self._building else 0)
+                        >= self._effective_depth
+                        or (self._manual and self._credits <= 0)):
+                    self._cv.wait()
+                if self._stopped:
+                    return
+                if self._manual:
+                    self._credits -= 1
+                self._building = True
+            rp = None
+            try:
+                if preemption.stop_pending():
+                    raise PreloadBuildAborted(
+                        f"preload stopped ({preemption.stop_reason()})")
+                ds = next(self._it, None)
+                if ds is None:
+                    with self._cv:
+                        self._building = False
+                        self._exhausted = True
+                        self._cv.notify_all()
+                    return
+                t0 = time.perf_counter()
+                rp = self._build(ds)
+                self._note_built(rp, time.perf_counter() - t0)
+            except PreloadBuildAborted as e:
+                log.warning("pass preload pipeline stopped: %s", e)
+                with self._cv:
+                    self._building = False
+                    self._stopped = True
+                    self._cv.notify_all()
+                return
+            except BaseException as e:  # held for the consuming wait()
+                with self._cv:
+                    self._building = False
+                    self._err = e
+                    self._cv.notify_all()
+                return
+            with self._cv:
+                self._building = False
+                dropped = self._stopped
+                if not dropped:
+                    self._q.append(rp)
+                depth = len(self._q)
+                self._cv.notify_all()
+            if dropped:
+                # drained mid-build: wait out the pass's issued
+                # transfers before dropping it, so drain() really means
+                # "no preload H2D in flight"
+                dev = getattr(rp, "dev", None)
+                if dev is not None:
+                    jax.block_until_ready(list(jax.tree.leaves(dev)))
+                return
+            self._mirror_queue(depth)
+
+    def _note_built(self, rp, build_sec: float) -> None:
+        """Accounting + the HBM budget clamp, off the queue lock."""
+        self.builds += 1
+        self.build_sec_total += build_sec
+        stages = getattr(rp, "build_stats", None)
+        hub = self._hub()
+        if stages:
+            for stage, sec in stages.items():
+                self.build_stage_sec[stage] = \
+                    self.build_stage_sec.get(stage, 0.0) + sec
+                if hub is not None:
+                    hub.counter(
+                        "pbox_preload_build_seconds_total",
+                        "pass preload build seconds by stage"
+                        ).inc(sec, stage=stage)
+        if hub is not None:
+            hub.counter("pbox_preload_builds_total",
+                        "passes built by the preload pipeline").inc()
+        if self._budget <= 0:
+            return
         try:
-            if self._build_fn is not None:
-                rp = self._build_fn(ds)
-                # forced materialization moves pass k+1's bytes NOW,
-                # riding alongside pass k's compute (see
-                # ResidentPass.upload); a lazy upload would instead
-                # serialize into k+1's first step
-                rp.upload(materialize=True)
-            else:
-                # build+upload overlapped; transfers stay IN FLIGHT
-                # (block=False) so this thread can start the next
-                # pass's host build immediately — the training step
-                # consuming the pass waits on its own args
-                rp = ResidentPass.build_streamed(
-                    ds, self._table, floats_dtype=self._floats_dtype,
-                    block=self._block)
-            self._next = rp
-        except BaseException as e:  # surfaces on next()
-            self._err = e
+            nbytes = int(rp.nbytes())
+        except Exception:
+            return  # passes without a wire-bytes estimate stay unguarded
+        if nbytes <= 0:
+            return
+        fit = max(1, int(self._budget // nbytes))
+        with self._cv:
+            if fit >= self._effective_depth:
+                return
+            self._effective_depth = fit
+            self.depth_clamped = True
+        log.warning(
+            "preload HBM budget: a staged pass is ~%.1f MB but the "
+            "budget is %.1f MB — clamping preload depth %d -> %d "
+            "(raise FLAGS.preload_hbm_budget_mb to restore the deeper "
+            "pipeline)", nbytes / 1e6, self._budget / 1e6, self.depth,
+            fit)
+        if self._hub() is not None:
+            self._hub().counter(
+                "pbox_preload_depth_clamps_total",
+                "preload depth reductions forced by the HBM budget"
+                ).inc()
 
+    # ---- consumer ------------------------------------------------------
     def start_next(self) -> bool:
-        """Kick off background build+upload of the next dataset."""
-        ds = next(self._it, None)
-        if ds is None:
-            return False
-        self._next = None
-        self._thread = threading.Thread(target=self._load, args=(ds,),
-                                        daemon=True)
-        self._thread.start()
-        return True
+        """Ensure the pipeline worker is running. Returns False only
+        when the source is KNOWN exhausted and nothing remains to hand
+        out — i.e. the next ``wait()`` would return None. (Compat shim
+        for the depth-1 era's kick-per-pass protocol: extra calls are
+        free, and lockstep start_next/wait loops keep working.)"""
+        with self._cv:
+            if self._manual:
+                self._credits += 1
+                self._cv.notify_all()
+        if self._worker is None:
+            self._worker = threading.Thread(
+                target=self._run, daemon=True, name="pbox-preload")
+            self._worker.start()
+        with self._cv:
+            return not (self._exhausted and not self._q
+                        and not self._building and self._err is None)
 
     def wait(self) -> Optional[ResidentPass]:
-        """Block until the preloaded pass is staged (WaitFeedPassDone).
-        The blocked seconds are the pipeline's prologue stall — exported
-        as ``pbox_preload_wait_seconds_total`` so a starved pipeline
+        """Block until the next pipelined pass is staged
+        (WaitFeedPassDone) and pop it; None at end-of-stream (or after
+        ``stop()``/a raised build failure). The blocked seconds are the
+        pipeline's prologue stall — exported as
+        ``pbox_preload_wait_seconds_total`` so a starved pipeline
         (build slower than train) is visible next to the epilogue's
         fence-wait counter (docs/PERFORMANCE.md)."""
-        if self._thread is None:
+        if self._worker is None:
             return None
-        import time as _time
-        t0 = _time.perf_counter()
-        self._thread.join()
-        waited = _time.perf_counter() - t0
+        t0 = time.perf_counter()
+        err = None
+        with self._cv:
+            while (not self._q and not self._exhausted
+                   and not self._stopped and self._err is None):
+                self._cv.wait()
+            waited = time.perf_counter() - t0
+            if self._q:
+                rp = self._q.popleft()
+            else:
+                rp = None
+                if self._err is not None:
+                    # the failure surfaces exactly where the broken
+                    # pass would have been consumed; later waits → None
+                    err, self._err = self._err, None
+                    self._stopped = True
+            depth = len(self._q)
+            self._cv.notify_all()  # a build slot just freed
+        self.wait_sec_total += waited
+        hub = self._hub()
+        if hub is not None:
+            if waited > 1e-4:
+                hub.counter("pbox_preload_wait_seconds_total",
+                            "seconds the trainer blocked on pass preload"
+                            ).inc(waited)
+            hub.gauge("pbox_preload_queue_depth",
+                      "staged passes queued ahead of training"
+                      ).set(depth)
+        if err is not None:
+            raise err
+        if rp is not None:
+            rp.upload()  # no-op unless a build_fn skipped it
+        return rp
+
+    # ---- shutdown ------------------------------------------------------
+    def stop(self) -> None:
+        """Stop building: no new builds start; an in-flight build
+        aborts at its next stage poll. Already-staged passes remain
+        consumable via wait()."""
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """stop() + join the worker, then settle the staged passes'
+        transfers — after this returns, no preload H2D is in flight
+        (the graceful-shutdown hook: call before the emergency
+        checkpoint's D2H so they don't contend for the wire)."""
+        self.stop()
+        w = self._worker
+        if w is not None and w.is_alive():
+            w.join(timeout)
+        # queued passes were built with block=False, so their wire may
+        # still be in flight even though the build finished; they stay
+        # consumable — we only wait the transfers out
+        with self._cv:
+            staged = list(self._q)
+        for rp in staged:
+            dev = getattr(rp, "dev", None)
+            if dev is not None:
+                jax.block_until_ready(list(jax.tree.leaves(dev)))
+
+    @property
+    def staged(self) -> int:
+        """Passes currently staged (built, unconsumed)."""
+        with self._cv:
+            return len(self._q)
+
+    def _mirror_queue(self, depth: int) -> None:
+        hub = self._hub()
+        if hub is not None:
+            hub.gauge("pbox_preload_queue_depth",
+                      "staged passes queued ahead of training"
+                      ).set(depth)
+
+    @staticmethod
+    def _hub():
         from paddlebox_tpu.obs.hub import get_hub
         hub = get_hub()
-        if hub.active and waited > 1e-4:
-            hub.counter("pbox_preload_wait_seconds_total",
-                        "seconds the trainer blocked on pass preload"
-                        ).inc(waited)
-        self._thread = None
-        if self._err is not None:
-            err, self._err = self._err, None
-            raise err
-        if self._next is not None:
-            self._next.upload()  # no-op unless build_fn skipped it
-        return self._next
+        return hub if hub.active else None

@@ -954,7 +954,12 @@ class ShardedTrainer:
         reconciles with their staged host values — which makes
         ``PassPreloader(build_fn=trainer.build_resident_pass)`` legal
         over a pass-window table (preload_into_memory,
-        box_wrapper.h:1142-1156)."""
+        box_wrapper.h:1142-1156). Depth-N preloaders may hold SEVERAL
+        future passes' plans pending at once — each build gets its own
+        plan_scope bracket, pendings promote at their own begin_pass,
+        and the window capacity contract grows to the union of the
+        open pass's and every queued pass's working set
+        (ps/tiered.py module docstring)."""
         scope = getattr(self.table, "plan_scope", None)
         if scope is None:
             return ShardedResidentPass.build(dataset, self)
@@ -1071,11 +1076,21 @@ class ShardedResidentPass:
     def build(cls, dataset, trainer: "ShardedTrainer"
               ) -> "ShardedResidentPass":
         from paddlebox_tpu.ps.table import next_bucket_fine
+        from paddlebox_tpu.train.device_pass import poll_preload_abort
         table = trainer.table
         groups = list(trainer._group_iter(dataset.batches()))
         if not groups:
             raise ValueError("empty pass")
-        plans = [table.prepare_global(g) for g in groups]
+        # a background (preloader) build polls the stop flag between
+        # groups — routing-plan prep is the mesh build's long stage, and
+        # a SIGTERM must not wait out a multi-second plan build; the
+        # plan_scope bracket in build_resident_pass rolls the aborted
+        # build's pending rows back
+        plans = []
+        for g in groups:
+            poll_preload_abort()
+            plans.append(table.prepare_global(g))
+        poll_preload_abort()
         # ONE uniform shape per pass either way → the FINE bucket ladder
         # (≤~6% padding) replaces the streaming pow2 buckets (≤100%) for
         # the staged wire. Plans re-PAD host-side (pure array surgery —

@@ -660,6 +660,65 @@ class Trainer:
                         stage_timers=True)
         return out
 
+    def train_passes_resident(self, datasets: Iterable[Dataset],
+                              depth: Optional[int] = None,
+                              floats_dtype=np.float32,
+                              checkpoint=None,
+                              log_prefix: str = "") -> list:
+        """Drive device-resident passes through the depth-N preload
+        pipeline (train/device_pass.PassPreloader,
+        FLAGS.preload_depth): builds for passes k+1..k+depth run on the
+        pipeline worker while pass k trains, so the prologue build
+        leaves the pass critical path (docs/PERFORMANCE.md §Deep pass
+        pipeline). Returns the per-pass result dicts.
+
+        Preemption-safe at PASS granularity: the stop flag is checked
+        before every dispatch; on a stop the preloader DRAINS first (no
+        orphan preload H2D contending with the checkpoint's D2H), a
+        boundary checkpoint is written when a manager is given, and
+        ``PreemptedError`` raises — the run_pass contract."""
+        from paddlebox_tpu.resilience import preemption
+        from paddlebox_tpu.resilience.preemption import PreemptedError
+        from paddlebox_tpu.train.device_pass import PassPreloader
+        pre = PassPreloader(iter(datasets), self.table,
+                            floats_dtype=floats_dtype, depth=depth)
+        pre.start_next()
+        results = []
+        try:
+            while True:
+                rp = pre.wait()
+                # a stop with an empty queue also lands here (the
+                # worker aborts its build and wait() returns None) —
+                # it must still raise, not return as if complete
+                if rp is None and not preemption.stop_pending():
+                    break
+                if preemption.stop_pending():
+                    pre.drain()
+                    if rp is not None and getattr(rp, "dev", None) \
+                            is not None:
+                        # the popped pass left the queue before drain()
+                        # could settle it — wait its wire out too
+                        jax.block_until_ready(
+                            list(jax.tree.leaves(rp.dev)))
+                    path = None
+                    if checkpoint is not None:
+                        path = checkpoint.save(
+                            self, delta=checkpoint.has_base())
+                        preemption.write_resume_marker(
+                            checkpoint.root, step=int(self.global_step),
+                            reason=preemption.stop_reason())
+                    raise PreemptedError(
+                        f"preempted ({preemption.stop_reason()}) before "
+                        f"resident pass dispatch at step "
+                        f"{self.global_step}",
+                        step=int(self.global_step), checkpoint_path=path)
+                pre.start_next()
+                results.append(
+                    self.train_pass_resident(rp, log_prefix=log_prefix))
+        finally:
+            pre.drain()
+        return results
+
     def eval_pass(self, dataset: Dataset,
                   log_prefix: str = "") -> Dict[str, float]:
         """Forward-only pass: AUC on frozen params/table, no updates, no

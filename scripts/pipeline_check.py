@@ -1,9 +1,10 @@
 #!/usr/bin/env python
-"""Deterministic async-epilogue gate (docs/PERFORMANCE.md).
+"""Deterministic pass-pipeline gates (docs/PERFORMANCE.md).
 
-Runs the SAME small 3-pass tiered job twice — once with the
-asynchronous end_pass epilogue (FLAGS.async_end_pass=True, the
-default) and once fully synchronous — and asserts:
+EPILOGUE gate (``run_check``): runs the SAME small 3-pass tiered job
+twice — once with the asynchronous end_pass epilogue
+(FLAGS.async_end_pass=True, the default) and once fully synchronous —
+and asserts:
 
 (a) the final host-tier state digests are IDENTICAL (the async
     epilogue's fence rules preserve the bit-for-bit delta==full
@@ -17,9 +18,23 @@ deterministic device mutation per pass (value = f(key, pass)) over
 sliding ~90%-overlap working sets, staging pass k+1 overlapped while
 pass k is open — the production pipeline shape (stage_pass /
 pre_build_thread) without a model in the loop, so the gate is fast and
-bit-exact by construction. ``python scripts/pipeline_check.py`` prints
-one JSON line; tests/test_pipeline_check.py runs a smaller variant in
-tier-1.
+bit-exact by construction.
+
+PROLOGUE gate (``run_prologue_check``, ISSUE 5): the depth-N preload
+pipeline's twin —
+
+(a) scheduling property: with deterministic sleep-timed builds
+    (bimodal, avg build < train — the BENCH_r05 shape), the depth-N
+    pipeline's steady-state per-pass wait drops vs depth-1 (the queue
+    absorbs the slow builds instead of joining on each), and
+(b) bit-identity: a REAL 4-pass single-chip resident training job run
+    at depth N produces the exact logical-state digest
+    (train/checkpoint.state_digest: table rows keyed+sorted by
+    feasign, dense params, optimizer, AUC) of the depth-1 run — the
+    deeper pipeline changes scheduling only, never results.
+
+``python scripts/pipeline_check.py`` prints one JSON line per gate;
+tests/test_pipeline_check.py runs smaller variants in tier-1.
 """
 
 from __future__ import annotations
@@ -160,6 +175,149 @@ def run_check(passes: int = 3, shards: int = 4, keys_per_pass: int = 512,
     }
 
 
+# ---- prologue gate: the depth-N preload pipeline (ISSUE 5) ----------
+
+
+class _TimedPass:
+    """Synthetic staged-pass token for the scheduling-property check:
+    the preloader only needs upload()/nbytes() from it."""
+
+    def upload(self, materialize: bool = False) -> None:
+        pass
+
+    def nbytes(self) -> int:
+        return 0
+
+
+def measure_preload_waits(depth: int, passes: int, train_sec: float,
+                          build_secs) -> List[float]:
+    """Per-pass consumer wait with sleep-timed builds: deterministic by
+    construction (the waits are structural — build/train overlap
+    arithmetic — not load-dependent)."""
+    from paddlebox_tpu.train.device_pass import PassPreloader
+
+    def build(d: float) -> _TimedPass:
+        time.sleep(d)
+        return _TimedPass()
+
+    durations = [build_secs[i % len(build_secs)] for i in range(passes)]
+    pre = PassPreloader(iter(durations), build_fn=build, depth=depth,
+                        hbm_budget_bytes=0)
+    pre.start_next()
+    waits: List[float] = []
+    while True:
+        t0 = time.perf_counter()
+        rp = pre.wait()
+        if rp is None:
+            break
+        waits.append(time.perf_counter() - t0)
+        pre.start_next()
+        time.sleep(train_sec)  # stand-in for device train time
+    pre.drain()
+    return waits
+
+
+def _make_pass_dataset(desc, num_records: int, seed: int):
+    """Tiny synthetic in-memory pass (criteo-shaped, 4 sparse slots)."""
+    import numpy as np
+
+    from paddlebox_tpu.data import InMemoryDataset
+    from paddlebox_tpu.data.record import SlotRecord
+    rng = np.random.default_rng(seed)
+    n_slots = len(desc.sparse_slots)
+    offsets = np.arange(n_slots + 1, dtype=np.int32)
+    ds = InMemoryDataset(desc)
+    for i in range(num_records):
+        label = float(rng.random() < 0.3)
+        ds.records.append(SlotRecord(
+            keys=(rng.integers(0, 500, size=n_slots)
+                  + np.arange(n_slots) * 500).astype(np.uint64),
+            slot_offsets=offsets,
+            dense=rng.normal(size=desc.dense_dim).astype(np.float32),
+            label=label, show=1.0, clk=label))
+    return ds
+
+
+def _resident_job_digest(depth: int, passes: int,
+                         num_records: int) -> str:
+    """One small single-chip resident training job driven through the
+    depth-``depth`` preload pipeline → logical-state digest."""
+    import optax
+
+    from paddlebox_tpu.data import DataFeedDesc, SlotDef
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.ps import EmbeddingTable, SparseSGDConfig
+    from paddlebox_tpu.train import Trainer
+    from paddlebox_tpu.train.checkpoint import state_digest
+    slots = [SlotDef("label", "float", 1), SlotDef("dense", "float", 4)]
+    slots += [SlotDef(f"C{i}", "uint64") for i in range(1, 5)]
+    desc = DataFeedDesc(slots=slots, batch_size=64, label_slot="label",
+                        key_bucket_min=256)
+    cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=0.0)
+    table = EmbeddingTable(mf_dim=4, capacity=1 << 12, cfg=cfg,
+                           unique_bucket_min=256)
+    tr = Trainer(DeepFM(hidden=(8,)), table, desc, tx=optax.adam(1e-2),
+                 seed=7)
+    datasets = [_make_pass_dataset(desc, num_records, seed=s % 2)
+                for s in range(passes)]
+    results = tr.train_passes_resident(datasets, depth=depth)
+    assert len(results) == passes
+    return state_digest(tr)
+
+
+def run_prologue_check(passes: int = 9, train_sec: float = 0.1,
+                       build_secs=(0.02, 0.16),
+                       real_passes: int = 4,
+                       real_records: int = 192,
+                       depth: int = 2) -> Dict:
+    """The depth-N preload gate. Raises AssertionError on any violated
+    invariant; returns the evidence record."""
+    assert passes >= 6, "steady-state needs a few passes past warmup"
+    # the wait arithmetic is deterministic for an ideal scheduler, but
+    # a loaded CI box can delay one worker wakeup by ~100 ms and eat
+    # the margin — measure up to 3 times and gate on the best attempt
+    # (a scheduling PROPERTY holds if any clean measurement shows it;
+    # noise only ever inflates waits)
+    steady1 = steadyn = 0.0
+    w1 = wn = []
+    for attempt in range(3):
+        w1 = measure_preload_waits(1, passes, train_sec, build_secs)
+        wn = measure_preload_waits(depth, passes, train_sec, build_secs)
+        assert len(w1) == len(wn) == passes
+        # steady state skips the first two passes (cold build + fill)
+        steady1 = sum(w1[2:])
+        steadyn = sum(wn[2:])
+        if steady1 > train_sec / 4 and steadyn <= 0.5 * steady1:
+            break
+    # with avg build < train, depth-1 still waits on every slow build;
+    # the depth-N queue buffers them — wait must at least halve (it
+    # lands near zero; 0.5 leaves room for scheduler wakeup noise)
+    assert steady1 > train_sec / 4, (
+        f"depth-1 baseline shows no prologue stall ({steady1:.3f}s) — "
+        "the gate's build/train timing no longer exercises the "
+        f"pipeline (waits: {w1})")
+    assert steadyn <= 0.5 * steady1, (
+        f"depth-{depth} steady-state preload wait {steadyn:.3f}s did "
+        f"not drop >=50% vs depth-1 {steady1:.3f}s "
+        f"(depth-1 {w1}, depth-{depth} {wn})")
+    d1 = _resident_job_digest(1, real_passes, real_records)
+    dn = _resident_job_digest(depth, real_passes, real_records)
+    assert dn == d1, (
+        f"depth-{depth} resident training produced a DIFFERENT "
+        f"logical state than depth-1: {dn[:16]}… != {d1[:16]}…")
+    return {
+        "check": "prologue_check",
+        "ok": True,
+        "depth": depth,
+        "passes": passes,
+        "steady_wait_sec_depth1": round(steady1, 4),
+        f"steady_wait_sec_depth{depth}": round(steadyn, 4),
+        "wait_drop_frac": round(1.0 - steadyn / max(steady1, 1e-9), 4),
+        "real_passes": real_passes,
+        "digest": dn,
+    }
+
+
 def main() -> None:
     shards = int(os.environ.get("PIPECHECK_SHARDS", "4"))
     passes = int(os.environ.get("PIPECHECK_PASSES", "3"))
@@ -167,6 +325,7 @@ def main() -> None:
     out = run_check(passes=passes, shards=shards, keys_per_pass=keys,
                     capacity_per_shard=max(1024, keys))
     print(json.dumps(out))
+    print(json.dumps(run_prologue_check()))
 
 
 if __name__ == "__main__":

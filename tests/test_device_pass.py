@@ -276,6 +276,188 @@ def test_pass_preloader(criteo_files):
     assert all(np.isfinite(r["auc"]) for r in results)
 
 
+def test_pass_preloader_depth2_bit_identical_to_depth1(criteo_files):
+    """Deep pipeline invariant (ISSUE 5): depth only changes
+    scheduling, never results — the depth-2 pipeline's 4 overlapped
+    passes produce the exact logical state (params + table rows by
+    key + AUC) of the depth-1 run."""
+    from paddlebox_tpu.train.checkpoint import state_digest
+
+    def run(depth):
+        tr, ds = _make(criteo_files)
+        res = tr.train_passes_resident([ds, ds, ds, ds], depth=depth)
+        assert len(res) == 4
+        return tr, state_digest(tr)
+
+    tr1, d1 = run(1)
+    tr2, d2 = run(2)
+    assert d1 == d2
+    for a, b in zip(jax.tree.leaves(tr1.state.params),
+                    jax.tree.leaves(tr2.state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_preloader_hbm_budget_clamps(criteo_files):
+    """An oversized pass degrades the pipeline to depth 1 — loudly,
+    never stacking staged passes until HBM OOMs."""
+    tr, ds = _make(criteo_files)
+    pre = PassPreloader(iter([ds, ds, ds]), tr.table, depth=3,
+                        hbm_budget_bytes=1)  # any real pass overflows
+    pre.start_next()
+    results = []
+    while True:
+        rp = pre.wait()
+        if rp is None:
+            break
+        results.append(tr.train_pass_resident(rp))
+    assert len(results) == 3           # degraded, but never starved
+    assert pre.depth_clamped
+    assert pre._effective_depth == 1
+    pre.drain()
+
+
+def test_bulk_assign_matches_serial(criteo_files):
+    """Whole-pass bulk key assignment (one host_lock round-trip)
+    produces the same per-batch index as the serial per-batch path:
+    key→row decode agrees with the index either way, and on the
+    native (first-occurrence) index the builds are row-for-row
+    identical."""
+    from paddlebox_tpu.config import flags_scope
+    from paddlebox_tpu.native import load_native
+    tr_a, ds = _make(criteo_files)
+    tr_b, _ = _make(criteo_files)
+    with flags_scope(bulk_pass_assign=True):
+        rp_a = ResidentPass.build(ds, tr_a.table)
+    with flags_scope(bulk_pass_assign=False):
+        rp_b = ResidentPass.build(ds, tr_b.table)
+    assert rp_a.num_batches == rp_b.num_batches
+    np.testing.assert_array_equal(rp_a.meta[:, (0, 1, 2)],
+                                  rp_b.meta[:, (0, 1, 2)])
+    # both builds registered the same key set, and each build's wire
+    # decodes every key to the row its own index assigned
+    keys_a, rows_a = tr_a.table.index.items()
+    keys_b, _ = tr_b.table.index.items()
+    np.testing.assert_array_equal(np.sort(keys_a), np.sort(keys_b))
+    for rp, tr in ((rp_a, tr_a), (rp_b, tr_b)):
+        batches = list(ds.batches())
+        for i, b in enumerate(batches):
+            nk = b.num_keys
+            rows_wire = rp.uniq[i][rp.gidx[i][:nk]]
+            rows_idx = tr.table.index.lookup(b.keys[:nk])
+            np.testing.assert_array_equal(rows_wire, rows_idx)
+    if load_native() is not None:
+        # native assign_unique is first-occurrence — bulk first-seen
+        # allocation reproduces the serial walk row for row
+        np.testing.assert_array_equal(rp_a.uniq, rp_b.uniq)
+        np.testing.assert_array_equal(rp_a.gidx, rp_b.gidx)
+        np.testing.assert_array_equal(rp_a.meta, rp_b.meta)
+
+
+def test_preloader_error_mid_queue(criteo_files):
+    """A mid-queue build failure surfaces on the wait() that would
+    have consumed the broken pass; passes built before it stay valid,
+    and waits after the raise return None."""
+    tr, ds = _make(criteo_files)
+    calls = {"n": 0}
+
+    def build(d):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("boom at build 2")
+        return ResidentPass.build_streamed(d, tr.table, block=False)
+
+    pre = PassPreloader(iter([ds, ds, ds]), build_fn=build, depth=2)
+    pre.start_next()
+    rp1 = pre.wait()
+    assert rp1 is not None             # build 1 is valid and served
+    with pytest.raises(RuntimeError, match="boom at build 2"):
+        pre.wait()
+    assert pre.wait() is None          # pipeline is dead after a raise
+    assert calls["n"] == 2             # build 3 never started
+
+
+def test_preloader_stops_on_request_stop(criteo_files):
+    """Graceful preemption: the pipeline stops building within one
+    stage poll of request_stop and drain() leaves no build running —
+    a long build can't eat the SIGTERM grace window."""
+    from paddlebox_tpu.resilience import preemption
+    tr, ds = _make(criteo_files)
+    pre = PassPreloader(iter([ds] * 6), tr.table, depth=1)
+    try:
+        pre.start_next()
+        rp = pre.wait()
+        assert rp is not None
+        preemption.request_stop("test")
+        served = 0
+        while pre.wait() is not None:  # staged passes stay consumable
+            served += 1
+        assert served <= 1             # depth 1 → at most one staged
+        pre.drain(timeout=30)
+        assert not pre._worker.is_alive()
+        assert pre.builds < 6
+    finally:
+        preemption.clear_stop()
+        pre.drain()
+
+
+def _q8_records_dataset(num_records=96, seed=3, bad_label=False):
+    """Small NON-columnar in-memory dataset (records path) for the q8
+    streaming front."""
+    from paddlebox_tpu.data import DataFeedDesc, InMemoryDataset, SlotDef
+    from paddlebox_tpu.data.record import SlotRecord
+    rng = np.random.default_rng(seed)
+    slots = [SlotDef("label", "float", 1), SlotDef("dense", "float", 5)]
+    slots += [SlotDef(f"C{i}", "uint64") for i in range(1, 5)]
+    desc = DataFeedDesc(slots=slots, batch_size=32, label_slot="label",
+                        key_bucket_min=128)
+    offs = np.arange(5, dtype=np.int32)
+    ds = InMemoryDataset(desc)
+    for i in range(num_records):
+        label = 0.5 if bad_label else float(rng.random() < 0.3)
+        ds.records.append(SlotRecord(
+            keys=(rng.integers(0, 64, size=4)
+                  + np.arange(4) * 64).astype(np.uint64),
+            slot_offsets=offs,
+            dense=(rng.normal(size=5) * np.array(
+                [1, 10, 0.1, 100, 1])).astype(np.float32),
+            label=label, show=1.0, clk=label))
+    return ds, desc
+
+
+def test_q8_streaming_front_matches_staged():
+    """The streaming (two-phase, min/max) q8 front reproduces the
+    staged whole-pass quantization bit for bit when the winsorize
+    branch is idle (< 1000 valid rows, the formulas coincide) — while
+    never holding a full-pass f32 float block."""
+    from paddlebox_tpu.train.device_pass import ResidentPass as RP
+    from paddlebox_tpu.train.step import pack_floats, quantize_floats
+    ds, _ = _q8_records_dataset()
+    assert ds.columnar is None and ds.supports_reiteration
+    per_batch, floats, qmeta, trivial, nrec, side = RP._front(ds, "q8")
+    assert floats.dtype == np.uint8
+    # reference: the staged path's whole-pass quantize
+    blocks = [pack_floats(b.dense, b.label, b.show, b.clk)
+              for b in ds.batches()]
+    ref = np.stack(blocks)
+    nb, bsz, d3 = ref.shape
+    flat = ref.reshape(nb * bsz, d3)
+    rblock, rqmeta = quantize_floats(flat[:, :-3], flat[:, -3],
+                                     flat[:, -2], flat[:, -1],
+                                     valid=flat[:, -2] > 0)
+    np.testing.assert_array_equal(qmeta, rqmeta)
+    np.testing.assert_array_equal(floats, rblock.reshape(nb, bsz, d3))
+
+
+def test_q8_streaming_front_bf16_fallback():
+    """Data outside the exact-u8 wire falls back to bf16, matching
+    _encode_floats' contract."""
+    from paddlebox_tpu.train.device_pass import ResidentPass as RP
+    ds, _ = _q8_records_dataset(bad_label=True)  # label 0.5 ≠ rint
+    per_batch, floats, qmeta, *_ = RP._front(ds, "q8")
+    assert qmeta is None
+    assert floats.dtype == jnp.bfloat16
+
+
 def test_quantize_floats_roundtrip():
     """q8 float wire: affine dequant error bounded by scale/2 per column;
     label/show/clk ride exactly; out-of-range data falls back (None)."""

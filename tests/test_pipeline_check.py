@@ -1,12 +1,16 @@
 """Tier-1 wiring of scripts/pipeline_check.py — the deterministic
-async-epilogue gate (ISSUE 4): async==sync host-tier digest over a
-3-pass tiered job with overlapped staging, and measured end_pass
-overlap > 0. The standalone script runs a bigger variant; this is the
-fast non-slow gate."""
+pass-pipeline gates: the async-epilogue gate (ISSUE 4: async==sync
+host-tier digest over a 3-pass tiered job with overlapped staging, and
+measured end_pass overlap > 0) and the depth-N preload prologue gate
+(ISSUE 5: steady-state preload wait drops >=50% vs depth-1 on the
+deterministic sleep-timed smoke, and a depth-N resident training run
+reproduces the depth-1 logical-state digest exactly). The standalone
+script runs bigger variants; these are the fast non-slow gates."""
 
 import numpy as np
 
-from scripts.pipeline_check import host_tier_digest, run_check
+from scripts.pipeline_check import (host_tier_digest, run_check,
+                                    run_prologue_check)
 
 
 def test_pipeline_check_gate():
@@ -18,6 +22,15 @@ def test_pipeline_check_gate():
     assert eps["jobs_run"] >= 3
     assert eps["overlap_sec"] > 0.0
     assert eps["pending"] == 0
+
+
+def test_prologue_gate():
+    out = run_prologue_check(passes=7, train_sec=0.08,
+                             build_secs=(0.02, 0.14),
+                             real_passes=3, real_records=128)
+    assert out["ok"]
+    assert out["wait_drop_frac"] >= 0.5
+    assert out["digest"]
 
 
 def test_host_tier_digest_is_order_insensitive():
