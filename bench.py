@@ -405,8 +405,8 @@ def main() -> None:
         if "BENCH_ARENA" in os.environ:
             print("warning: BENCH_ARENA is ignored in sharded mode",
                   file=sys.stderr)
-    elif mode == "tiered":
-        pass  # table/trainer built inside the tiered measurement branch
+    elif mode in ("tiered", "stream"):
+        pass  # table/trainer built inside the mode's measurement branch
     else:
         # slot-arena allocation → the resident path ships the COMPACT
         # wire (per-key ~17-bit slot-local rows, no dedup streams); set
@@ -425,6 +425,67 @@ def main() -> None:
     if mode == "tiered":
         print(json.dumps(measure_tiered(
             int(os.environ.get("BENCH_PASSES", 4)), shape=shape)))
+        return
+    elif mode == "stream":
+        # windowed streaming-ingest bench (docs/RESILIENCE.md
+        # §Streaming): criteo-format text files through the windowed
+        # QueueDataset + Trainer.train_stream — end-to-end ingest
+        # (parse, window dispatch, train, stream-boundary checkpoints),
+        # headline in windows/sec. The first window is the warmup
+        # (compile + first upload); the measured call CONTINUES the same
+        # stream in-process, which is exactly the resumable-window
+        # contract the mode exists to exercise.
+        import shutil
+        import tempfile
+        from paddlebox_tpu.data import DatasetFactory
+        from paddlebox_tpu.data.criteo import generate_criteo_files
+        from paddlebox_tpu.train.checkpoint import CheckpointManager
+        n_files = int(os.environ.get("BENCH_STREAM_FILES", "12"))
+        rows = int(os.environ.get("BENCH_STREAM_ROWS_PER_FILE", "2048"))
+        FLAGS.stream_window_files = int(
+            os.environ.get("BENCH_STREAM_WINDOW_FILES", "2"))
+        FLAGS.stream_ckpt_every_windows = int(
+            os.environ.get("BENCH_STREAM_CKPT_EVERY", "2"))
+        sdesc = DataFeedDesc.criteo(batch_size=bs)
+        sdesc.key_bucket_min = max(4096, bs * 26)
+        stream_tr = Trainer(
+            DeepFM(hidden=(512, 256, 128)),
+            EmbeddingTable(mf_dim=mf_dim, capacity=1 << 23, cfg=cfg,
+                           unique_bucket_min=1 << 12),
+            sdesc, tx=optax.adam(1e-3))
+        base = tempfile.mkdtemp(prefix="pbox_stream_bench_")
+        try:
+            files = generate_criteo_files(
+                os.path.join(base, "data"), num_files=n_files,
+                rows_per_file=rows, vocab_per_slot=100_000,
+                seed=FLAGS.seed)
+            ds = DatasetFactory().create_dataset("QueueDataset", sdesc)
+            ds.set_filelist(files)
+            cm = CheckpointManager(os.path.join(base, "ckpt"))
+            stream_tr.train_stream(ds, cm, max_windows=1)  # warmup
+            t0 = time.perf_counter()
+            out = stream_tr.train_stream(ds, cm)
+            wall = time.perf_counter() - t0
+        finally:
+            shutil.rmtree(base, ignore_errors=True)
+        meas_files = int(out["files"])
+        print(json.dumps({
+            "metric": "stream_windows_per_sec",
+            "value": round(out["windows"] / wall, 3),
+            "unit": "windows/sec",
+            "vs_baseline": None,
+            "mode": mode,
+            "window_files": FLAGS.stream_window_files,
+            "ckpt_every_windows": FLAGS.stream_ckpt_every_windows,
+            "windows": int(out["windows"]),
+            "files": meas_files,
+            "rows_per_file": rows,
+            "batches": int(out["batches"]),
+            "replayed_files": int(out["replayed_files"]),
+            "files_per_sec": round(meas_files / wall, 2),
+            "examples_per_sec": round(meas_files * rows / wall, 1),
+            "wall_sec": round(wall, 3),
+        }))
         return
     elif mode == "streaming":
         ds = make_ds(0)

@@ -191,6 +191,30 @@ class Flags:
     # how long a consensus gather waits for the full mesh to publish
     consensus_timeout_sec: float = 60.0
 
+    # --- streaming ingest (data/dataset.QueueDataset windowed mode +
+    # Trainer.train_stream; docs/RESILIENCE.md §Streaming) ---
+    # >0: QueueDataset consumes its filelist in bounded WINDOWS of N
+    # files — no record crosses a window boundary, completed windows are
+    # tracked per file, and the v2 stream cursor (cursor.json) records
+    # fully-consumed files + the open window so a preempted streaming
+    # job resumes by skipping completed files and replaying the open
+    # window AT-LEAST-ONCE. 0 = legacy unwindowed streaming (no cursor
+    # resume; start_batch != 0 keeps refusing).
+    stream_window_files: int = 0
+    # Trainer.train_stream publishes a stream-boundary checkpoint every
+    # N completed windows (bounds replay after a hard kill)
+    stream_ckpt_every_windows: int = 1
+
+    # --- pipeline hang deadline (ps/epilogue.PassEpilogue.fence,
+    # train/device_pass.PassPreloader.wait) ---
+    # >0: a pipeline wait that sees no job/build COMPLETE for this long
+    # raises PipelineHangError naming the stuck stage (with queue-depth
+    # telemetry) instead of blocking forever on a wedged worker — set
+    # above the worst-case single job duration (progress is observed at
+    # whole-job granularity); 0 = wait indefinitely (the pre-deadline
+    # behavior)
+    pipeline_wait_timeout_sec: float = 0.0
+
     # --- runtime ---
     profile: bool = False
     log_period_steps: int = 100

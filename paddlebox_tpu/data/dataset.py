@@ -131,6 +131,11 @@ class Dataset:
         # files isolated by the current/last load: [(path, error_repr)]
         self.quarantined_files: List[Tuple[str, str]] = []
         self._quarantine_lock = threading.Lock()
+        # entries in quarantined_files that were PRESEEDED (a resumed
+        # cursor's / the mesh consensus's prior decisions) rather than
+        # discovered by this process — they must not consume the
+        # FLAGS.poison_budget_files budget
+        self._quarantine_preseeded = 0
 
     # --- config surface (mirrors dataset.py setters) ---
     def set_feed_desc(self, desc: DataFeedDesc) -> None:
@@ -180,6 +185,7 @@ class Dataset:
     def _reset_quarantine(self) -> None:
         with self._quarantine_lock:
             self.quarantined_files = []
+            self._quarantine_preseeded = 0
 
     def _quarantine(self, path: str, exc: BaseException) -> bool:
         """Try to isolate a per-file failure instead of killing the load.
@@ -190,11 +196,12 @@ class Dataset:
             return False  # consumer-side close / interrupt: not the file
         budget = FLAGS.poison_budget_files
         with self._quarantine_lock:
-            if budget <= 0 or len(self.quarantined_files) >= budget:
+            mine = len(self.quarantined_files) - self._quarantine_preseeded
+            if budget <= 0 or mine >= budget:
                 return False
             self.quarantined_files.append((path, repr(exc)))
         log.warning("quarantined bad file %s: %r (budget %d/%d)", path,
-                    exc, len(self.quarantined_files), budget)
+                    exc, mine + 1, budget)
         stat_add("files_quarantined", 1)
         try:
             from paddlebox_tpu.obs.hub import get_hub
@@ -282,6 +289,11 @@ class Dataset:
                 try:
                     read_one(parser, path)
                 except BaseException as e:
+                    if isinstance(e, ChannelClosed):
+                        # the CONSUMER cancelled the output channel
+                        # (abandoned stream) — a clean shutdown, never a
+                        # reader error
+                        return
                     # isolate the failure to this file when the poison
                     # budget allows; surviving readers drain the rest of
                     # the file list
@@ -303,8 +315,9 @@ class Dataset:
                         group.errors.append(e)
                     return
 
-        group.threads = [threading.Thread(target=worker, daemon=True)
-                         for _ in range(max(1, n_threads))]
+        group.threads = [threading.Thread(target=worker, daemon=True,
+                                          name=f"pbox-reader-{i}")
+                         for i in range(max(1, n_threads))]
         for t in group.threads:
             t.start()
         return group
@@ -672,40 +685,292 @@ class InMemoryDataset(Dataset):
 
 class QueueDataset(Dataset):
     """Streaming dataset: batches come off the reader channel without
-    materializing the pass (reference dataset.py:1191)."""
+    materializing the pass (reference dataset.py:1191).
 
+    **Windowed streaming** (``FLAGS.stream_window_files > 0``,
+    docs/RESILIENCE.md §Streaming): the filelist is consumed in bounded
+    windows of N files. No record crosses a window boundary (the tail
+    batch of each window is flushed short), fully-consumed files are
+    tracked across ``batches()`` calls, and the trainer's v2 stream
+    cursor (``Trainer._pass_cursor`` → ``cursor.json``) records the
+    completed-file set plus the open window — a restarted process skips
+    completed files and REPLAYS the open window, so a preempted
+    unbounded stream loses no completed-window data and re-trains at
+    most one window (**at-least-once** for the open window,
+    exactly-once for completed windows; never exactly-once end to end).
+    ``supports_cursor_resume`` is therefore True in windowed mode only;
+    the legacy unwindowed stream keeps refusing ``start_batch != 0``.
+
+    Window completion is tied to CONSUMPTION, not read-ahead: the
+    generator records, per window, the yield count of its final batch
+    (``mark``), and :meth:`stream_cursor_state` only counts a window
+    completed once the trainer reports that many batches TRAINED — a
+    prefetch pipeline pulling batches ahead of training can never get a
+    half-trained window declared complete."""
+
+    def __init__(self, desc: Optional[DataFeedDesc] = None) -> None:
+        super().__init__(desc)
+        # --- windowed streaming state (survives across batches() calls;
+        # guarded by _stream_lock: the generator runs on a prefetch
+        # producer thread while the trainer snapshots cursors) ---
+        self._stream_lock = threading.Lock()
+        self._files_completed: List[str] = []  # fully-consumed files
+        self._windows: List[dict] = []   # open pass: {"files", "mark"}
+        self._skip_files: set = set()    # preseeded quarantine decisions
+        self._replay_files: List[str] = []  # adopted open window
+        self.windows_completed = 0
+        self.files_replayed = 0
+
+    # ---- windowed-mode surface (docs/RESILIENCE.md §Streaming) ----
+    @property
+    def windowed(self) -> bool:
+        return FLAGS.stream_window_files > 0
+
+    @property
+    def supports_cursor_resume(self) -> bool:
+        """True only in windowed mode — and then with the AT-LEAST-ONCE
+        caveat: resume replays the whole open window, it does not splice
+        back into a thread-interleaved batch stream (which is why the
+        unwindowed stream still refuses)."""
+        return self.windowed
+
+    @property
+    def files_completed(self) -> List[str]:
+        """Fully-consumed files, in consumption order. Folding is tied
+        to CONSUMPTION (``note_batches_consumed``, called by the
+        trainer per trained batch), never to generator read-ahead — an
+        abandoned/preempted stream leaves its unconsumed windows
+        unfolded, so they replay."""
+        with self._stream_lock:
+            return list(self._files_completed)
+
+    def note_batches_consumed(self, consumed: int) -> None:
+        """Trainer callback: ``consumed`` batches of the current
+        ``batches()`` call have been TRAINED — fold every window whose
+        final batch lies in that prefix into the completed set. Without
+        this call (a raw ``batches()`` drain with no trainer) nothing
+        folds and a later ``batches()`` call re-streams the filelist,
+        like the legacy unwindowed dataset."""
+        if not self.windowed:
+            return
+        with self._quarantine_lock:
+            quarantined = {p for p, _ in self.quarantined_files}
+        with self._stream_lock:
+            while self._windows:
+                w = self._windows[0]
+                if w["mark"] is None or w["mark"] > consumed:
+                    break
+                self._files_completed.extend(
+                    f for f in w["files"] if f not in quarantined)
+                self.windows_completed += 1
+                self._windows.pop(0)
+
+    def pending_files(self) -> List[str]:
+        """Files not yet consumed, not already dispatched into a window
+        of the open pass, and not excluded by a (preseeded or
+        discovered) quarantine decision — in filelist order."""
+        with self._stream_lock:
+            done = set(self._files_completed)
+            for w in self._windows:
+                done.update(w["files"])
+        with self._quarantine_lock:
+            skip = self._skip_files | {p for p, _ in self.quarantined_files}
+        return [f for f in self.filelist if f not in done
+                and f not in skip]
+
+    def stream_cursor_state(self, consumed_batches: Optional[int] = None
+                            ) -> Optional[dict]:
+        """The dataset half of the v2 stream cursor: the files fully
+        consumed once ``consumed_batches`` batches of the CURRENT
+        ``batches()`` call have been trained, plus the open window those
+        batches stop inside (empty at a stream boundary).
+        ``consumed_batches=None`` means "between passes" (boundary
+        cursor). Returns None when not in windowed mode."""
+        if not self.windowed:
+            return None
+        with self._quarantine_lock:
+            quarantined = {p for p, _ in self.quarantined_files}
+        with self._stream_lock:
+            completed = list(self._files_completed)
+            n_windows = int(self.windows_completed)
+            window: List[str] = []
+            for w in self._windows:
+                mark = w["mark"]
+                if (mark is not None and consumed_batches is not None
+                        and mark <= consumed_batches):
+                    completed.extend(f for f in w["files"]
+                                     if f not in quarantined)
+                    n_windows += 1
+                else:
+                    window = list(w["files"])
+                    break
+            return {"windowed": True,
+                    "files_completed": completed,
+                    "window_files": window,
+                    "windows_completed": n_windows}
+
+    def adopt_stream_cursor(self, stream: dict,
+                            quarantined: Sequence[str] = ()) -> None:
+        """Restore the stream position from a v2 cursor's ``stream``
+        block: completed files will be skipped, the open window replays
+        (at-least-once), and the cursor's quarantine decisions are
+        preseeded so the resumed run drops the SAME files the preempted
+        one did (restart/consensus parity)."""
+        completed = [str(f) for f in stream.get("files_completed", [])]
+        window = [str(f) for f in stream.get("window_files", [])]
+        with self._stream_lock:
+            self._files_completed = completed
+            self._windows = []
+            self._replay_files = window
+            self.windows_completed = int(
+                stream.get("windows_completed", 0))
+        self.preseed_quarantine(quarantined)
+
+    def preseed_quarantine(self, files: Sequence[str]) -> None:
+        """Adopt prior quarantine decisions (a resumed cursor's, or the
+        mesh consensus union) WITHOUT consuming the local poison budget:
+        the files are excluded from future windows and reported in
+        ``quarantined_files`` so later cursors carry them forward."""
+        with self._quarantine_lock:
+            have = {p for p, _ in self.quarantined_files}
+            for f in files:
+                f = str(f)
+                self._skip_files.add(f)
+                if f in have:
+                    continue
+                self.quarantined_files.append(
+                    (f, "preseeded quarantine (resume cursor / mesh "
+                        "consensus)"))
+                self._quarantine_preseeded += 1
+
+    # ---- batch streams -------------------------------------------------
     def batches(self, start_batch: int = 0) -> Iterator[SlotBatch]:
+        if self.windowed:
+            if start_batch:
+                raise ValueError(
+                    "windowed QueueDataset resumes by FILE WINDOW (the "
+                    "v2 stream cursor), not by batch index — resume via "
+                    "Trainer.run_pass/train_stream, which adopts the "
+                    "cursor and replays the open window at-least-once")
+            return self._windowed_batches()
         if start_batch:
             raise ValueError(
                 "QueueDataset streams through threaded readers — batch "
                 "order is not deterministic, so cursor resume "
-                "(start_batch) needs an in-memory dataset")
+                "(start_batch) needs an in-memory dataset, or windowed "
+                "streaming (FLAGS.stream_window_files > 0) with its "
+                "at-least-once window replay")
         if not self.filelist:
             raise ValueError("set_filelist first")
         self._reset_quarantine()
+        return self._stream_files(self.filelist)
+
+    def _stream_files(self, files: Sequence[str]) -> Iterator[SlotBatch]:
+        """Stream ``files`` through the reader group as batches, flushing
+        the short tail batch at the end. Reader errors surface within one
+        batch of the failure (the group is polled every loop, not only at
+        stream end), and an abandoned generator cancels the channel and
+        joins every reader thread before returning — no hot channel or
+        orphan reader outlives the consumer (the prefetch_iter contract,
+        docs/RESILIENCE.md)."""
+        bs = self.desc.batch_size
         ch: Channel[SlotRecord] = Channel(capacity=FLAGS.channel_capacity,
-                                          block_size=self.desc.batch_size,
+                                          block_size=bs,
                                           name="dataset.stream_records")
-        group = self._read_files_into(self.filelist, ch, self.thread_num)
+        group = self._read_files_into(files, ch, self.thread_num)
 
         def closer() -> None:
             for t in group.threads:
                 t.join()
             ch.close()
 
-        threading.Thread(target=closer, daemon=True).start()
-        pending: List[SlotRecord] = []
+        closer_th = threading.Thread(target=closer, daemon=True)
+        closer_th.start()
+        try:
+            pending: List[SlotRecord] = []
+            while True:
+                if group.errors:
+                    # a reader died (budget spent / fatal): raise within
+                    # one batch instead of silently draining the channel
+                    raise group.errors[0]
+                got = ch.get_batch(bs - len(pending))
+                if not got and ch.closed and len(ch) == 0:
+                    break
+                pending.extend(got)
+                if len(pending) >= bs:
+                    yield self.builder.build(pending[:bs])
+                    pending = pending[bs:]
+            if pending:
+                yield self.builder.build(pending)
+            group.join()  # surface reader errors at stream end
+        finally:
+            # consumer-abandon cleanup: without this, readers blocked on
+            # ch.put (and the closer waiting on them) outlive the
+            # abandoned generator and the channel stays hot
+            ch.cancel()
+            for t in group.threads:
+                t.join()
+            closer_th.join()
+
+    def _windowed_batches(self) -> Iterator[SlotBatch]:
+        if not self.filelist:
+            raise ValueError("set_filelist first")
+        wsize = FLAGS.stream_window_files
+        with self._quarantine_lock:
+            # per-LOAD budget semantics (FLAGS.poison_budget_files is
+            # "per load", config.py): fold prior loads' discovered
+            # quarantines into the preseeded count — still sticky
+            # (pending_files keeps excluding them) but no longer charged
+            # against this load's budget, so an always-on stream is not
+            # slowly exhausted by bad files weeks apart
+            self._quarantine_preseeded = len(self.quarantined_files)
+        with self._stream_lock:
+            self._windows = []  # fresh pass over the pending files
+            replay = set(self._replay_files)
+            self._replay_files = []
+        yielded = 0
         while True:
-            got = ch.get_batch(self.desc.batch_size - len(pending))
-            if not got and ch.closed and len(ch) == 0:
+            pending = self.pending_files()
+            if not pending:
                 break
-            pending.extend(got)
-            if len(pending) >= self.desc.batch_size:
-                yield self.builder.build(pending[:self.desc.batch_size])
-                pending = pending[self.desc.batch_size:]
-        if pending:
-            yield self.builder.build(pending)
-        group.join()  # surface reader errors at stream end
+            files = pending[:wsize]
+            win = {"files": list(files), "mark": None}
+            with self._stream_lock:
+                self._windows.append(win)
+                widx = self.windows_completed + len(self._windows) - 1
+            hit = [f for f in files if f in replay]
+            if hit:
+                replay -= set(hit)
+                self.files_replayed += len(hit)
+                self._note_replay(hit)
+            # chaos seam: a seeded fault here breaks the window dispatch
+            # deterministically (scripts/chaos_check.py recovery drill)
+            faults.inject("stream.window", path=files[0], window=widx,
+                          files=len(files))
+            for batch in self._stream_files(files):
+                yielded += 1
+                yield batch
+            # consumption-tied completion: the window only counts as
+            # complete once the trainer has TRAINED `yielded` batches
+            # (note_batches_consumed folds it; stream_cursor_state
+            # reads unfolded marks the same way)
+            with self._stream_lock:
+                win["mark"] = yielded
+
+    def _note_replay(self, files: Sequence[str]) -> None:
+        log.warning("stream resume: replaying open window "
+                    "(at-least-once): %s", list(files))
+        try:
+            from paddlebox_tpu.obs.hub import get_hub
+            hub = get_hub()
+            hub.counter("pbox_stream_replayed_files_total",
+                        "open-window files replayed after a stream "
+                        "resume (at-least-once)").inc(len(files))
+            if hub.active:
+                hub.emit("stream_replay", files=list(files))
+        except Exception:
+            log.debug("stream replay telemetry emit failed",
+                      exc_info=True)
 
 
 class PaddleBoxDataset(InMemoryDataset):

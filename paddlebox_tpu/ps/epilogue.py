@@ -55,6 +55,66 @@ class EndPassWritebackError(RuntimeError):
     restoring a checkpoint, never by continuing."""
 
 
+class PipelineHangError(RuntimeError):
+    """A pipeline wait (epilogue fence / preload wait) made NO progress
+    for ``FLAGS.pipeline_wait_timeout_sec`` — a worker is wedged (stuck
+    IO, a deadlocked device transfer). The message names the stuck
+    stage and dumps the queue-depth telemetry; raised INSTEAD of
+    blocking forever so the straggler watchdog's grace window is spent
+    on diagnosis, not on a silent hang. Progress is observed at
+    whole-job granularity (a job/build COMPLETING resets the deadline),
+    so a pipeline whose every job beats the deadline never trips it —
+    but a single job slower than the deadline does, even if its worker
+    is alive: set the timeout above the worst-case single job/build
+    duration."""
+
+
+def hang_timeout() -> float:
+    """Shared hang-deadline infrastructure for all pipeline waits —
+    public because train/device_pass.PassPreloader.wait consumes it
+    alongside the fence below."""
+    from paddlebox_tpu.config import FLAGS
+    return float(FLAGS.pipeline_wait_timeout_sec)
+
+
+def note_hang(stage: str) -> None:
+    try:
+        from paddlebox_tpu.obs.hub import get_hub
+        get_hub().counter(
+            "pbox_pipeline_hangs_total",
+            "pipeline waits aborted by the hang deadline").inc(
+                stage=stage)
+    except Exception:
+        log.debug("hang telemetry emit failed", exc_info=True)
+
+
+def wait_with_deadline(cv: threading.Condition, done: Callable[[], bool],
+                       progress: Callable[[], object], stage: str,
+                       message: Callable[[], str]) -> None:
+    """The ONE timed-condition-wait-with-hang-deadline loop, shared by
+    every pipeline wait (``PassEpilogue.fence``,
+    ``train/device_pass.PassPreloader.wait``). Call with ``cv`` HELD;
+    returns once ``done()`` is true. With
+    ``FLAGS.pipeline_wait_timeout_sec > 0``, an unchanged ``progress()``
+    value for that long bumps the hang counter for ``stage`` and raises
+    ``PipelineHangError`` with ``message()``."""
+    hang = hang_timeout()
+    deadline = (time.monotonic() + hang) if hang > 0 else None
+    last = progress()
+    while not done():
+        if deadline is None:
+            cv.wait()
+            continue
+        cv.wait(min(0.2, hang))
+        cur = progress()
+        if cur != last:  # progress resets the clock
+            last = cur
+            deadline = time.monotonic() + hang
+        elif time.monotonic() > deadline:
+            note_hang(stage)
+            raise PipelineHangError(message())
+
+
 class PassEpilogue:
     """Single-lane background worker serializing end-pass write-backs."""
 
@@ -131,14 +191,40 @@ class PassEpilogue:
     def fence(self) -> None:
         """Wait for every submitted write-back to land, then surface the
         first failure (once). Cheap when nothing is queued: one lock
-        round-trip."""
+        round-trip. With ``FLAGS.pipeline_wait_timeout_sec > 0`` a wait
+        that makes no progress for that long raises
+        ``PipelineHangError`` naming this stage instead of blocking
+        forever on a wedged worker."""
         t0 = time.perf_counter()
         critical = threading.current_thread() is threading.main_thread()
         with self._cv:
             if self._done >= self._submitted and self._error is None:
                 return
-            while self._done < self._submitted:
-                self._cv.wait()
+            try:
+                wait_with_deadline(
+                    self._cv,
+                    done=lambda: self._done >= self._submitted,
+                    progress=lambda: self._done,
+                    stage="endpass.writeback",
+                    message=lambda: (
+                        f"end-pass epilogue fence hung: stage "
+                        f"'endpass.writeback' ({self.name}) made no "
+                        f"progress for {hang_timeout():.1f}s — "
+                        f"{self._submitted - self._done} job(s) "
+                        f"outstanding (submitted={self._submitted}, "
+                        f"done={self._done}, queued={len(self._jobs)}, "
+                        f"worker_running={self._running}, "
+                        f"last_writeback_sec="
+                        f"{self.last_writeback_sec:.3f})"))
+            except PipelineHangError:
+                # the hang window still counts as fence wait — a
+                # postmortem reconciling fence-wait counters against
+                # wall time must see the stall, not a gap
+                waited = time.perf_counter() - t0
+                self.total_fence_wait_sec += waited
+                if critical:
+                    self.critical_fence_wait_sec += waited
+                raise
             waited = time.perf_counter() - t0
             self.total_fence_wait_sec += waited
             if critical:

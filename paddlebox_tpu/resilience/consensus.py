@@ -313,7 +313,12 @@ def sync_shared_quarantine(dataset, consensus: RestoreConsensus,
 
     Needs a dataset that can reload (``load_into_memory``) — i.e. the
     in-memory family that the SPMD identical-batches contract applies
-    to; streaming datasets are refused up front.
+    to — OR a WINDOWED streaming QueueDataset, whose quarantine union
+    is adopted as a preseeded skip set instead of a reload (records of
+    not-yet-consumed files simply never stream; files a rank partially
+    read before quarantining fall under the stream's documented
+    at-least-once accounting, not the byte-identical-batches contract).
+    Legacy unwindowed streams are still refused up front.
 
     TIMEOUT SIZING: a rank that adopts peer drops RELOADS the pass
     between rounds while its peers already wait in the next round's
@@ -321,10 +326,16 @@ def sync_shared_quarantine(dataset, consensus: RestoreConsensus,
     ``timeout=``) must therefore cover a full pass reload, not just
     filesystem latency."""
     if not hasattr(dataset, "load_into_memory"):
+        if getattr(dataset, "windowed", False) and \
+                hasattr(dataset, "preseed_quarantine"):
+            return _sync_stream_quarantine(dataset, consensus,
+                                           max_rounds)
         raise TypeError(
             "sync_shared_quarantine needs an in-memory dataset (it "
-            "reloads without the mesh-quarantined files); "
-            f"{type(dataset).__name__} cannot reload")
+            "reloads without the mesh-quarantined files) or a WINDOWED "
+            "streaming QueueDataset (FLAGS.stream_window_files, which "
+            "adopts the union as a skip set); "
+            f"{type(dataset).__name__} can do neither")
     applied = {p for p, _ in dataset.quarantined_files}
     for rnd in range(max_rounds):
         local = sorted({p for p, _ in dataset.quarantined_files}
@@ -357,3 +368,31 @@ def sync_shared_quarantine(dataset, consensus: RestoreConsensus,
             (p, have.get(p, "quarantined on a peer process"))
             for p in sorted(applied)]
     return sorted(applied)
+
+
+def _sync_stream_quarantine(dataset, consensus: RestoreConsensus,
+                            max_rounds: int = 4) -> List[str]:
+    """Quarantine-union agreement for a WINDOWED streaming dataset: the
+    mesh union is adopted as a PRESEEDED skip set
+    (``QueueDataset.preseed_quarantine`` — budget-free, carried forward
+    by every later stream cursor) so every rank's future windows drop
+    the same files. Same lockstep round contract as the in-memory path
+    (``agree_quarantine`` rounds must align across ranks)."""
+    union: List[str] = []
+    for rnd in range(max_rounds):
+        local = sorted({p for p, _ in dataset.quarantined_files})
+        union, converged = consensus._quarantine_round(local, rnd)
+        if converged:
+            break
+        extra = [p for p in union if p not in set(local)]
+        if extra:
+            log.warning("shared quarantine (stream): preseeding %d "
+                        "file(s) quarantined on peer process(es): %s",
+                        len(extra), extra)
+        dataset.preseed_quarantine(union)
+    else:
+        raise RuntimeError(
+            f"shared quarantine did not converge in {max_rounds} "
+            f"rounds — files keep failing; last union: {union}")
+    dataset.preseed_quarantine(union)
+    return sorted(union)

@@ -37,6 +37,14 @@ site                        seam
                             surfaces at the next epilogue fence as
                             ``EndPassWritebackError`` — never as silent
                             row loss
+``stream.window``           each streaming window dispatch (windowed
+                            ``QueueDataset``, data/dataset.py): fires as
+                            a window's readers are about to start, ctx
+                            carries the window index and its first file
+                            — a transient ``fail`` here exercises the
+                            stream recovery path (run_pass rolls back to
+                            the last stream checkpoint and REPLAYS the
+                            window, at-least-once)
 ==========================  =============================================
 
 Fault kinds: ``fail`` (raise — ``exc=transient|crash|os`` picks the

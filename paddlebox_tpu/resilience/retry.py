@@ -117,7 +117,12 @@ class RetryPolicy:
         exposed so tests can assert the exact seeded sequence."""
         rng = self._rng()
         for k in range(1, max(1, self.max_attempts)):
-            d = min(self.max_delay, self.base_delay * (2 ** (k - 1)))
+            # exponent clamp: past 2**64 the doubling is irrelevant
+            # (min() already plateaus at max_delay) but the raw int
+            # would overflow float() around k~1024 — a real hazard for
+            # long-lived schedules like the stream idle poll
+            d = min(self.max_delay,
+                    self.base_delay * (2.0 ** min(k - 1, 64)))
             if self.jitter > 0:
                 d *= 1.0 + self.jitter * (2.0 * rng.random() - 1.0)
             yield max(0.0, d)

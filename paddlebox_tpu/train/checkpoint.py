@@ -194,16 +194,26 @@ class CheckpointManager:
     # ---- save ----
     def save(self, trainer, step: Optional[int] = None,
              delta: bool = False, cursor: Optional[dict] = None,
-             metrics=None) -> str:
+             metrics=None, clear_touched: Optional[bool] = None) -> str:
         """Snapshot the trainer. ``delta=True`` = save_delta (rows touched
         since the previous save) referencing the most recent base.
 
         ``cursor`` marks a MID-PASS checkpoint: the dict (pass position —
-        ``Trainer._pass_cursor``) lands in ``cursor.json`` so a restart
-        resumes the pass from this batch instead of replaying it;
-        ``metrics`` (a MetricRegistry) snapshots the host-side metric
-        accumulators alongside (``metrics.pkl``). Checkpoints without a
-        cursor are pass-boundary checkpoints."""
+        ``Trainer._pass_cursor``, schema v2: batch position + optional
+        ``stream`` block for windowed streaming) lands in ``cursor.json``
+        so a restart resumes the pass from this position instead of
+        replaying it; ``metrics`` (a MetricRegistry) snapshots the
+        host-side metric accumulators alongside (``metrics.pkl``).
+        Checkpoints without a cursor are pass-boundary checkpoints — as
+        are STREAM-BOUNDARY checkpoints, whose cursor's ``stream`` block
+        has an empty open window (``latest_boundary_step`` treats both
+        as safe rollback targets).
+
+        ``clear_touched`` overrides the touched-row bookkeeping: the
+        default (None) clears on cursor-free saves and keeps on cursor
+        saves (mid-pass deltas must stay cumulative — see below); stream
+        BOUNDARY saves pass ``clear_touched=True`` explicitly, since
+        their cursor records stream position, not a mid-pass state."""
         step = trainer.global_step if step is None else step
         base_step = None
         # chain link: the state we descend from — the last step this
@@ -244,7 +254,10 @@ class CheckpointManager:
         # clear drops assigned-but-not-yet-pushed rows from every later
         # delta. A table type without the kwarg fails loudly here —
         # silently clearing would corrupt the chain.
-        kw = {} if cursor is None else {"clear_touched": False}
+        if clear_touched is None:
+            kw = {} if cursor is None else {"clear_touched": False}
+        else:
+            kw = {"clear_touched": clear_touched}
         if delta:
             n = trainer.table.save_delta(
                 os.path.join(tmp, "sparse_delta.npz"), **kw)
@@ -421,12 +434,25 @@ class CheckpointManager:
             return None
 
     def latest_boundary_step(self) -> Optional[int]:
-        """Newest checkpoint WITHOUT a cursor — the last pass-boundary
-        state, the safe rollback target when a mid-pass cursor can't be
-        applied (e.g. the dataset changed)."""
+        """Newest checkpoint at a BOUNDARY — the safe rollback target
+        when a mid-pass cursor can't be applied (e.g. the dataset
+        changed): either no cursor at all (a pass-boundary checkpoint),
+        or a v2 STREAM cursor whose open window is empty (a
+        stream-boundary checkpoint: every recorded file is fully
+        consumed, nothing needs replay). Read WITHOUT the
+        ``checkpoint.cursor`` fault seam: this is a scan, not a resume
+        — firing the seam here would shift seeded chaos-plan counters."""
         for s in reversed(self.steps()):
-            if not os.path.isfile(os.path.join(self._dir(s),
-                                               "cursor.json")):
+            path = os.path.join(self._dir(s), "cursor.json")
+            if not os.path.isfile(path):
+                return s
+            try:
+                with open(path) as fh:
+                    cur = json.load(fh)
+                stream = cur.get("stream")
+            except (OSError, ValueError, AttributeError):
+                continue  # unreadable cursor: not provably a boundary
+            if isinstance(stream, dict) and not stream.get("window_files"):
                 return s
         return None
 

@@ -1408,15 +1408,34 @@ class PassPreloader:
         pipeline's prologue stall — exported as
         ``pbox_preload_wait_seconds_total`` so a starved pipeline
         (build slower than train) is visible next to the epilogue's
-        fence-wait counter (docs/PERFORMANCE.md)."""
+        fence-wait counter (docs/PERFORMANCE.md).
+
+        With ``FLAGS.pipeline_wait_timeout_sec > 0`` a wait during
+        which no build completes for that long raises
+        ``PipelineHangError`` (ps/epilogue) naming the preload stage —
+        a wedged build worker becomes a loud failure instead of an
+        indefinite stall."""
+        from paddlebox_tpu.ps.epilogue import hang_timeout, \
+            wait_with_deadline
         if self._worker is None:
             return None
         t0 = time.perf_counter()
         err = None
         with self._cv:
-            while (not self._q and not self._exhausted
-                   and not self._stopped and self._err is None):
-                self._cv.wait()
+            wait_with_deadline(
+                self._cv,
+                done=lambda: bool(self._q) or self._exhausted
+                or self._stopped or self._err is not None,
+                progress=lambda: self.builds,
+                stage="preload.build",
+                message=lambda: (
+                    f"pass preload wait hung: stage 'preload.build' "
+                    f"made no progress for {hang_timeout():.1f}s — 0 "
+                    f"staged pass(es) queued (building="
+                    f"{self._building}, builds_done={self.builds}, "
+                    f"effective_depth={self._effective_depth}, "
+                    f"worker_alive="
+                    f"{self._worker.is_alive()})"))
             waited = time.perf_counter() - t0
             if self._q:
                 rp = self._q.popleft()

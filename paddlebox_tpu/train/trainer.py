@@ -17,7 +17,9 @@ from __future__ import annotations
 
 import math
 import threading
-from typing import Any, Dict, Iterable, Iterator, Optional, Tuple
+import time
+from typing import (Any, Callable, Dict, Iterable, Iterator, List,
+                    Optional, Sequence, Tuple)
 
 import jax
 import jax.numpy as jnp
@@ -109,6 +111,10 @@ class Trainer:
             from paddlebox_tpu.resilience import preemption
             preemption.install_signal_handlers()
         self._pass_seq = 0
+        # optional per-batch hook, called AFTER the step's state update
+        # with the host SlotBatch — streaming record accounting and the
+        # at-least-once gates (scripts/stream_check.py) key off it
+        self.on_batch_trained: Optional[Callable[[SlotBatch], None]] = None
 
     # ---- host-side prefetch: batch build + dedup + row assign + H2D ----
     def _prefetch_iter(
@@ -185,6 +191,11 @@ class Trainer:
         cursor_ok = (checkpoint is not None
                      and getattr(dataset, "supports_cursor_resume",
                                  False))
+        # consumption feedback for windowed streams: fold a window into
+        # the completed set only once its last batch has TRAINED (the
+        # reader group runs ahead of training; docs/RESILIENCE.md
+        # §Streaming)
+        note_consumed = getattr(dataset, "note_batches_consumed", None)
         every = FLAGS.ckpt_every_batches if cursor_ok else 0
         last_save = (-1, None)  # (batch_index, path) of the newest save
         for batch, dev in self._prefetch_iter(
@@ -200,6 +211,10 @@ class Trainer:
             with st.stage("step"):
                 self.state, stats = self.step_fn(self.state, dev, rng)
             nb += 1
+            if note_consumed is not None:
+                note_consumed(nb)
+            if self.on_batch_trained is not None:
+                self.on_batch_trained(batch)
             if len(self.metrics):
                 # AddAucMonitor hook: feed registered metric variants.
                 # Side channels stay HOST numpy — device metrics convert
@@ -278,24 +293,43 @@ class Trainer:
             dump_writer.close()
         timer.pause()
         self.sync_table()
-        if cursor_ok and (last_save[0] >= 0 or skip > 0):
+        if note_consumed is not None:
+            # the loop has fully drained the generator, so every window
+            # mark is set by now — fold the tail window the in-loop
+            # note may have raced (its mark lands when the producer
+            # thread resumes past the final yield)
+            note_consumed(nb)
+        streaming = (getattr(dataset, "stream_cursor_state", None)
+                     is not None and getattr(dataset, "windowed", False))
+        if cursor_ok and (last_save[0] >= 0 or skip > 0
+                          or (streaming and start_cursor is not None)):
             # the pass completed after writing (or resuming from) a
             # mid-pass cursor checkpoint: publish a pass-boundary
-            # checkpoint so the newest restorable state carries NO
-            # cursor (a later rollback must not resume into a pass that
-            # already finished)
+            # checkpoint so the newest restorable state does not resume
+            # into a pass that already finished. For a windowed stream
+            # the boundary checkpoint still carries the STREAM cursor
+            # (completed files, empty open window) — losing the
+            # completed-file set here would retrain the whole stream on
+            # the next restart.
+            kw = {}
+            if streaming:
+                kw = dict(cursor=self._boundary_cursor(dataset),
+                          clear_touched=True,
+                          metrics=(self.metrics if len(self.metrics)
+                                   else None))
             try:
-                checkpoint.save(self, delta=checkpoint.has_base())
+                checkpoint.save(self, delta=checkpoint.has_base(), **kw)
             except ValueError:
                 # the cadence hit the pass length exactly and the save
                 # at this step is the first BASE — a delta re-save over
                 # it is refused, so supersede it with a fresh base
-                checkpoint.save(self, delta=False)
+                checkpoint.save(self, delta=False, **kw)
         res = auc_compute(self.state.auc)
         out = res.as_dict()
         # ex/s counts THIS pass's instances (res.ins_num is cumulative
         # across passes until reset_metrics, like the reference registry)
-        out.update(batches=nb, elapsed_sec=timer.elapsed_sec(),
+        out.update(batches=nb, examples=n_ex,
+                   elapsed_sec=timer.elapsed_sec(),
                    examples_per_sec=n_ex / max(timer.elapsed_sec(), 1e-9),
                    last_loss=last_loss)
         log.info("%spass done: %d batches, %.0f ex/s, auc=%.4f",
@@ -312,8 +346,16 @@ class Trainer:
         + quarantine decisions pin the data, global_step pins both the
         trainer position and the per-step rng fold
         (``fold_in(rng, global_step)``), and the AUC/metric accumulators
-        ride the checkpoint itself (dense.pkl / metrics.pkl)."""
-        return {
+        ride the checkpoint itself (dense.pkl / metrics.pkl).
+
+        Schema v2 (backward-compatible: v1 cursors — no ``version`` —
+        keep their batch-index semantics): windowed streaming datasets
+        add a ``stream`` block (completed files + open window,
+        ``QueueDataset.stream_cursor_state``) — resume then skips
+        completed files and replays the open window at-least-once
+        instead of splicing by batch index."""
+        cur = {
+            "version": 2,
             "pass_seq": int(self._pass_seq) + 1,
             "fingerprint": dataset.filelist_fingerprint(),
             "files_consumed": len(getattr(dataset, "filelist", [])),
@@ -323,6 +365,22 @@ class Trainer:
             "quarantined_files": sorted(
                 p for p, _ in getattr(dataset, "quarantined_files", [])),
         }
+        state_fn = getattr(dataset, "stream_cursor_state", None)
+        if state_fn is not None:
+            s = state_fn(int(batch_index))
+            if s is not None:
+                cur["stream"] = s
+        return cur
+
+    def _boundary_cursor(self, dataset) -> Optional[dict]:
+        """The cursor a BETWEEN-PASS checkpoint of a windowed streaming
+        dataset must carry (completed files, empty open window) so a
+        restart skips every consumed file; None for non-streaming
+        datasets (their boundary checkpoints stay cursor-free)."""
+        state_fn = getattr(dataset, "stream_cursor_state", None)
+        if state_fn is None or not getattr(dataset, "windowed", False):
+            return None
+        return self._pass_cursor(dataset, 0)
 
     def _save_inpass(self, checkpoint, dataset, batch_index: int,
                      reason: str) -> str:
@@ -362,7 +420,30 @@ class Trainer:
         if int(cur.get("global_step", -1)) != int(self.global_step):
             return None  # cursor belongs to a different position
         reason = None
-        if not getattr(dataset, "supports_cursor_resume", False):
+        stream = cur.get("stream")
+        stream = stream if isinstance(stream, dict) else None
+        if stream is not None:
+            # v2 STREAM cursor: resume is by file window, not batch
+            # index — validate that the current filelist still extends
+            # the cursor's consumption order (completed files then the
+            # open window, quarantined files excluded on both sides)
+            if (getattr(dataset, "adopt_stream_cursor", None) is None
+                    or not getattr(dataset, "windowed", False)):
+                reason = ("cursor belongs to a windowed stream but this "
+                          "dataset is not a windowed QueueDataset "
+                          "(FLAGS.stream_window_files)")
+            else:
+                quar = set(cur.get("quarantined_files", []))
+                expect = [str(f) for f in
+                          list(stream.get("files_completed", []))
+                          + list(stream.get("window_files", []))
+                          if str(f) not in quar]
+                avail = [f for f in dataset.filelist if f not in quar]
+                if avail[:len(expect)] != expect:
+                    reason = ("stream file prefix changed — the "
+                              "filelist no longer extends the cursor's "
+                              "consumption order")
+        elif not getattr(dataset, "supports_cursor_resume", False):
             reason = ("dataset batch order is not deterministic "
                       "(supports_cursor_resume is False)")
         else:
@@ -388,6 +469,29 @@ class Trainer:
                 self.global_step, reason, boundary)
             checkpoint.restore(self, step=boundary)
             return None
+        if stream is not None:
+            completed = [str(f) for f in stream.get("files_completed",
+                                                    [])]
+            if (not stream.get("window_files")
+                    and getattr(dataset, "files_completed", None)
+                    == completed):
+                # in-process continuation at a stream BOUNDARY: the
+                # dataset already sits exactly where the cursor points
+                # (the previous window's boundary save) — nothing to
+                # adopt, and counting it as a "resume" would bury the
+                # real replay events in per-window noise. Still consume
+                # a leftover resume marker (a restart whose kill landed
+                # before anything trained matches this branch too).
+                from paddlebox_tpu.resilience import preemption
+                preemption.clear_resume_marker(checkpoint.root)
+                return None
+            # skip completed files, replay the open window from its
+            # start (at-least-once), and carry the quarantine decisions
+            # forward; batch_index is forced to 0 — there is no batch
+            # splice in a thread-interleaved stream
+            dataset.adopt_stream_cursor(
+                stream, quarantined=cur.get("quarantined_files", []))
+            cur = dict(cur, batch_index=0)
         mr = checkpoint.load_metrics(step)
         if mr is not None:
             self.metrics = mr
@@ -398,10 +502,17 @@ class Trainer:
         hub.counter("pbox_cursor_resumes_total",
                     "passes resumed mid-pass from a cursor").inc()
         if hub.active:
+            fields = {}
+            if stream is not None:
+                fields = dict(
+                    stream=True,
+                    files_completed=len(stream.get("files_completed",
+                                                   [])),
+                    replay_files=len(stream.get("window_files", [])))
             hub.emit("cursor_resume",
                      global_step=int(self.global_step),
                      batch_index=int(cur.get("batch_index", 0)),
-                     pass_seq=cur.get("pass_seq"))
+                     pass_seq=cur.get("pass_seq"), **fields)
         return cur
 
     def _reject_cursor_state(self, checkpoint) -> None:
@@ -478,8 +589,12 @@ class Trainer:
                     path = None
                     if checkpoint is not None:
                         if start_cursor is None:
-                            path = checkpoint.save(
-                                self, delta=checkpoint.has_base())
+                            # publish the boundary state (windowed
+                            # streams carry their boundary cursor so
+                            # the restart skips every consumed file;
+                            # a step already on disk is reused)
+                            path = self._stream_boundary_save(
+                                dataset, checkpoint)
                         preemption.write_resume_marker(
                             checkpoint.root, step=int(self.global_step),
                             reason=preemption.stop_reason())
@@ -523,10 +638,16 @@ class Trainer:
                 if checkpoint is not None:
                     if isinstance(e, NanInfError):
                         # mid-pass snapshots are suspect (see above):
-                        # roll all the way back to the clean boundary
+                        # roll all the way back to the clean boundary.
+                        # A STREAM boundary still carries its stream
+                        # cursor — adopt it so the dataset's
+                        # completed-file view matches the restored
+                        # state (for batch cursors this is a no-op:
+                        # boundary checkpoints have no cursor)
                         restored = checkpoint.restore(
                             self, step=checkpoint.latest_boundary_step())
-                        start_cursor = None
+                        start_cursor = self._adopt_cursor(
+                            checkpoint, dataset, restored)
                     elif resident:
                         restored = checkpoint.restore(self)
                         self._reject_cursor_state(checkpoint)
@@ -548,6 +669,247 @@ class Trainer:
                         "%spass failed (%r) — no checkpoint manager, "
                         "retrying from current state (%d/%d)",
                         log_prefix, e, attempt, limit)
+
+    # ---- continuous streaming ingest (docs/RESILIENCE.md §Streaming) ----
+    def train_stream(self, dataset, checkpoint=None, *,
+                     filelist_fn: Optional[Callable[[], Sequence]] = None,
+                     max_windows: Optional[int] = None,
+                     max_idle_polls: Optional[int] = None,
+                     log_prefix: str = "") -> Dict[str, float]:
+        """Always-on streaming loop: train arriving files through a
+        windowed ``QueueDataset`` (``FLAGS.stream_window_files``), one
+        window per pass, forever (or until the source dries up / a
+        bound is hit).
+
+        - **Arrivals**: ``filelist_fn()`` is polled for the current file
+          list each iteration (new files append in poll order); with no
+          ``filelist_fn`` the dataset's static filelist is drained and
+          the loop ends. Empty polls emit ``stream_idle`` events and
+          back off on the seeded ``RetryPolicy`` schedule
+          (site ``stream.poll`` — deterministic per FLAGS.seed);
+          arrivals reset the backoff. ``max_idle_polls`` bounds
+          consecutive empty polls (None = poll forever).
+        - **Checkpoints**: a stream-boundary checkpoint (v2 cursor:
+          completed files, empty open window) publishes every
+          ``FLAGS.stream_ckpt_every_windows`` completed windows, so a
+          hard kill replays at most that many windows.
+        - **Preemption** honors the full run_pass contract: SIGTERM
+          mid-window raises ``PreemptedError`` after an emergency
+          checkpoint whose stream cursor marks the open window; a
+          restarted process (``CheckpointManager.restore`` then
+          ``train_stream`` again) skips completed files and replays the
+          open window AT-LEAST-ONCE — byte-identical to the
+          uninterrupted run at the last common window boundary, modulo
+          the documented replay window. Stops during the idle loop
+          snapshot a boundary cursor the same way.
+        - **Telemetry**: ``pbox_stream_{windows,files,replayed_files,
+          idle_polls}_total`` counters, the ``pbox_stream_lag_files``
+          backlog gauge (pending files not yet dispatched — the
+          straggler watchdog's stalled-stream escalation signal), and
+          ``stream_window``/``stream_idle`` events.
+        """
+        from paddlebox_tpu.obs.hub import get_hub
+        if not getattr(dataset, "windowed", False):
+            raise ValueError(
+                "train_stream needs a windowed QueueDataset — set "
+                "FLAGS.stream_window_files > 0 (the unbounded "
+                "unwindowed stream cannot checkpoint/resume)")
+        known: List[str] = [str(f) for f in dataset.filelist]
+        # resume: seed the dataset's stream position and the known-file
+        # order from the newest stream cursor, so the first window pass
+        # reconstructs the cursor's consumption order exactly
+        if checkpoint is not None and not dataset.files_completed:
+            cur = checkpoint.load_cursor()
+            stream = (cur or {}).get("stream")
+            if isinstance(stream, dict):
+                if int(cur.get("global_step", -1)) \
+                        != int(self.global_step):
+                    raise RuntimeError(
+                        f"stream cursor at step "
+                        f"{cur.get('global_step')} does not match "
+                        f"trainer step {self.global_step} — restore "
+                        "the checkpoint first "
+                        "(CheckpointManager.restore) or point at a "
+                        "fresh checkpoint root")
+                dataset.adopt_stream_cursor(
+                    stream,
+                    quarantined=cur.get("quarantined_files", []))
+                prefix = ([str(f) for f in
+                           stream.get("files_completed", [])]
+                          + [str(f) for f in
+                             stream.get("window_files", [])])
+                seen = set(prefix)
+                known = prefix + [f for f in known if f not in seen]
+        hub = get_hub()
+        totals = {"windows": 0, "files": 0, "batches": 0,
+                  "examples": 0, "replayed_files": 0, "idle_polls": 0}
+        try:
+            self._stream_loop(dataset, checkpoint, filelist_fn,
+                              max_windows, max_idle_polls, log_prefix,
+                              known, totals, hub)
+        finally:
+            # each window pass narrowed the filelist to its consumption
+            # order — restore the full known list on EVERY exit
+            # (preemption included) so a later train_stream call or
+            # pending_files() probe still sees the whole stream
+            dataset.set_filelist(known)
+        log.info("%sstream done: %d windows, %d files (%d replayed), "
+                 "%d batches", log_prefix, totals["windows"],
+                 totals["files"], totals["replayed_files"],
+                 totals["batches"])
+        return totals
+
+    def _stream_loop(self, dataset, checkpoint, filelist_fn,
+                     max_windows, max_idle_polls, log_prefix,
+                     known: List[str], totals: Dict[str, float],
+                     hub) -> None:
+        from paddlebox_tpu.resilience import preemption
+        from paddlebox_tpu.resilience.retry import RetryPolicy
+        wsize = FLAGS.stream_window_files
+        since_ckpt = 0
+        idle_run = 0
+        backoff = iter(())  # armed lazily; reset on every arrival
+        while True:
+            if max_windows is not None \
+                    and totals["windows"] >= max_windows:
+                break
+            if preemption.stop_pending():
+                # idle/between-window stop: run_pass would catch it too,
+                # but the poll loop must honor it without pending work —
+                # and the snapshot must carry the stream boundary cursor
+                self._stream_stop(dataset, checkpoint)
+            if filelist_fn is not None:
+                have = set(known)
+                known.extend(str(f) for f in filelist_fn()
+                             if str(f) not in have)
+            dataset.set_filelist(known)
+            pending = dataset.pending_files()
+            hub.gauge("pbox_stream_lag_files",
+                      "stream backlog: pending files not yet "
+                      "dispatched into a window").set(
+                          max(0, len(pending) - wsize))
+            if not pending:
+                if filelist_fn is None:
+                    break
+                idle_run += 1
+                totals["idle_polls"] += 1
+                if max_idle_polls is not None \
+                        and idle_run > max_idle_polls:
+                    break
+                delay = next(backoff, None)
+                if delay is None:
+                    # (re)arm the seeded schedule; cap attempts high —
+                    # the schedule plateaus at retry_max_delay_sec
+                    backoff = RetryPolicy.from_flags(
+                        site="stream.poll",
+                        max_attempts=1 << 20).delays()
+                    delay = next(backoff)
+                hub.counter("pbox_stream_idle_polls_total",
+                            "filelist polls that found no new files"
+                            ).inc()
+                if hub.active:
+                    hub.emit("stream_idle", idle_polls=idle_run,
+                             backoff_sec=round(delay, 4),
+                             known_files=len(known))
+                self._stream_sleep(delay)
+                continue
+            idle_run = 0
+            backoff = iter(())
+            window = pending[:wsize]
+            # the pass's filelist is exactly the consumption order the
+            # cursor records: completed files then this window (files
+            # quarantined earlier are excluded from both)
+            dataset.set_filelist(dataset.files_completed + window)
+            widx = totals["windows"]
+            rep0 = int(getattr(dataset, "files_replayed", 0))
+            out = self.run_pass(dataset, checkpoint=checkpoint,
+                                log_prefix=f"{log_prefix}stream "
+                                           f"w{widx}: ")
+            # files_replayed is cumulative on the dataset — book the
+            # per-window delta so a resumed dataset's history doesn't
+            # bleed into this call's totals/events
+            replayed = int(getattr(dataset, "files_replayed", 0)) - rep0
+            # files CONSUMED, not dispatched: a window file quarantined
+            # during this pass never trained, so it must not inflate
+            # the throughput totals (bench stream mode divides by them)
+            # or desync pbox_stream_files_total from files_completed
+            quarantined = {p for p, _ in
+                           getattr(dataset, "quarantined_files", [])}
+            consumed = [f for f in window if f not in quarantined]
+            totals["windows"] += 1
+            totals["files"] += len(consumed)
+            totals["batches"] += int(out.get("batches", 0))
+            totals["examples"] += int(out.get("examples", 0))
+            totals["replayed_files"] += replayed
+            totals.update({k: out[k] for k in ("auc", "last_loss")
+                           if k in out})
+            since_ckpt += 1
+            hub.counter("pbox_stream_windows_total",
+                        "stream windows fully trained").inc()
+            hub.counter("pbox_stream_files_total",
+                        "files consumed by stream windows").inc(
+                            len(consumed))
+            if hub.active:
+                hub.emit("stream_window", window=widx,
+                         files=len(consumed),
+                         batches=int(out.get("batches", 0)),
+                         lag_files=max(0, len(pending) - len(window)),
+                         replayed_files=replayed,
+                         global_step=int(self.global_step))
+            if checkpoint is not None and since_ckpt >= max(
+                    1, FLAGS.stream_ckpt_every_windows):
+                self._stream_boundary_save(dataset, checkpoint)
+                since_ckpt = 0
+
+    def _stream_boundary_save(self, dataset, checkpoint) -> str:
+        """Publish a boundary checkpoint: for a windowed stream it
+        carries the stream cursor (completed files, empty open window);
+        for any other dataset ``_boundary_cursor`` is None and this is
+        a plain cursor-free boundary save. A no-op when this step is
+        already on disk (e.g. the window pass published a boundary
+        after a mid-pass save or a cursor resume — a re-save would
+        refuse as a delta over a base)."""
+        if checkpoint.latest_step() == int(self.global_step):
+            return checkpoint._dir(int(self.global_step))
+        cursor = self._boundary_cursor(dataset)
+        # clear_touched=True only with a stream cursor: a cursor-free
+        # boundary save must stay kwarg-free so duck-typed tables whose
+        # save surface predates the kwarg (sharded/tiered/multi_mf)
+        # keep working on the generic graceful-stop path
+        return checkpoint.save(
+            self, delta=checkpoint.has_base(), cursor=cursor,
+            clear_touched=True if cursor is not None else None,
+            metrics=self.metrics if len(self.metrics) else None)
+
+    def _stream_stop(self, dataset, checkpoint) -> None:
+        """Graceful stop from the stream loop (idle poll / between
+        windows): snapshot a stream-boundary checkpoint, write the
+        resume marker, raise — the run_pass preemption contract."""
+        from paddlebox_tpu.resilience import preemption
+        path = None
+        if checkpoint is not None:
+            path = self._stream_boundary_save(dataset, checkpoint)
+            preemption.write_resume_marker(
+                checkpoint.root, step=int(self.global_step),
+                reason=preemption.stop_reason())
+        raise preemption.PreemptedError(
+            f"preempted ({preemption.stop_reason()}) in the stream "
+            f"loop at step {self.global_step}",
+            step=int(self.global_step), checkpoint_path=path)
+
+    @staticmethod
+    def _stream_sleep(sec: float) -> None:
+        """Stop-aware sleep: wakes early when a graceful stop arrives so
+        the grace window is not burned idling."""
+        from paddlebox_tpu.resilience import preemption
+        deadline = time.monotonic() + sec
+        while True:
+            if preemption.stop_pending():
+                return
+            left = deadline - time.monotonic()
+            if left <= 0:
+                return
+            time.sleep(min(0.05, left))
 
     def _emit_pass(self, kind: str, out: Dict[str, float], examples: int,
                    stage_timers: bool = False) -> None:

@@ -8,13 +8,17 @@ injects, in ONE run:
 2. a corrupt record file (every line of one input file is mangled at
    the ``parser.record`` seam), and
 3. a mid-save checkpoint crash (the second ``save`` dies just before
-   its atomic publish),
+   its atomic publish), and
+4. a transient ``stream.window`` dispatch failure on a WINDOWED
+   streaming job (docs/RESILIENCE.md §Streaming),
 
 then asserts full recovery:
 
 - the pass completes and the quarantine list names EXACTLY the corrupt
   file,
 - ``restore()`` into a fresh trainer returns the last consistent step,
+- the windowed stream retries the broken window from its boundary
+  checkpoint and still consumes every file,
 - the telemetry JSONL records nonzero ``retry_attempts`` /
   ``files_quarantined`` counters,
 
@@ -69,7 +73,8 @@ def run_scenario(workdir: str, seed: int) -> dict:
         "file_mgr.command:fail:nth=1; "
         f"parser.record:corrupt:match=*{os.path.basename(corrupt_file)}*,"
         "times=0; "
-        "checkpoint.save_commit:fail:nth=2,exc=crash", seed=seed)
+        "checkpoint.save_commit:fail:nth=2,exc=crash; "
+        "stream.window:fail:nth=2", seed=seed)
     outcome: dict = {}
     with flags_scope(seed=seed, native_parse=False,
                      poison_budget_files=1, poison_budget_records=0,
@@ -124,6 +129,23 @@ def run_scenario(workdir: str, seed: int) -> dict:
         assert restored == consistent_step, (
             f"restore() returned {restored}, want {consistent_step}")
 
+        # (4) stream.window seam: window 2's dispatch dies once; the
+        # stream retries it from the window-1 boundary checkpoint and
+        # still drains every (healthy) file
+        healthy = [files[0], files[2]]
+        with flags_scope(stream_window_files=1, read_thread_num=1,
+                         stream_ckpt_every_windows=1,
+                         pass_retry_limit=1):
+            sds = DatasetFactory().create_dataset("QueueDataset", desc)
+            sds.set_filelist(healthy)
+            streamer = mk()
+            sout = streamer.train_stream(
+                sds, CheckpointManager(os.path.join(workdir,
+                                                    "ckpt_stream")))
+        assert sout["windows"] == 2, sout
+        assert sds.files_completed == healthy
+        assert plan.stats()["stream.window:fail"]["fired"] == 1
+
     # telemetry JSONL: final pass event carries nonzero counters
     with open(jsonl) as fh:
         events = [json.loads(line) for line in fh]
@@ -144,6 +166,7 @@ def run_scenario(workdir: str, seed: int) -> dict:
                                         "records_poisoned",
                                         "faults_injected")},
         surviving_records=len(ds),
+        stream_windows=int(sout["windows"]),
     )
     return outcome
 

@@ -1,0 +1,22 @@
+"""Tier-1 wiring of scripts/stream_check.py — the deterministic
+streaming-ingest gate (ISSUE 6): SIGTERM mid-stream + resume loses zero
+completed-window records, replays exactly the open window
+(at-least-once), and the killed run's checkpoint at the last common
+window boundary matches the no-kill oracle's ``state_digest``. The
+standalone script additionally runs the scenario twice and asserts the
+outcome is byte-identical across identically-seeded runs."""
+
+from scripts.stream_check import FILES, WINDOW, run_scenario
+
+
+def test_stream_check_gate(tmp_path):
+    out = run_scenario(str(tmp_path), seed=7, preempt_at=8)
+    assert out["ok"]
+    assert out["oracle_windows"] == FILES // WINDOW
+    # the kill landed mid-window-2: one window completed, one open
+    assert len(out["completed_at_kill"]) == WINDOW
+    assert len(out["open_window"]) == WINDOW
+    assert out["replayed_files"] == WINDOW
+    assert out["resumed_windows"] == FILES // WINDOW - 1
+    assert out["events"]["stream_replay"] >= 1
+    assert out["fault_stats"]["preempt.signal:fail"]["fired"] == 1
