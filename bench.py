@@ -15,7 +15,9 @@ the prefetch thread, one fused jit step per batch.
 
 import json
 import os
+import shutil
 import sys
+import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -131,9 +133,14 @@ def measure_tiered(num_passes: int = 4, shape: str = "uniform") -> dict:
         return d
 
     mesh = make_mesh(chips)
+    # SSD third tier attached (ps/ssd.py): idle during the headline
+    # passes (occupancy 0 below the demote watermark), then exercised
+    # by the promote-attribution section below
+    ssd_root = tempfile.mkdtemp(prefix="pbox_bench_ssd_")
     table = TieredShardedEmbeddingTable(
         chips, mf_dim=mf_dim, capacity_per_shard=(1 << 22) // chips,
-        cfg=cfg, req_bucket_min=1 << 12, serve_bucket_min=1 << 12)
+        cfg=cfg, req_bucket_min=1 << 12, serve_bucket_min=1 << 12,
+        ssd_dir=ssd_root)
     tr = ShardedTrainer(DeepFM(hidden=(512, 256, 128)), table,
                         desc, mesh, tx=optax.adam(1e-3))
     helper = BoxPSHelper(table, trainer=tr)
@@ -169,7 +176,7 @@ def measure_tiered(num_passes: int = 4, shape: str = "uniform") -> dict:
     # overlap fraction)
     table.fence()
     eps0 = table.endpass_stats()
-    begin_l, train_l, end_l, staged_l = [], [], [], []
+    begin_l, train_l, end_l, staged_l, stall_l = [], [], [], [], []
     for i in range(num_passes):
         ds = pool[(i + 1) % 2]
         nxt = pool[i % 2]
@@ -178,6 +185,15 @@ def measure_tiered(num_passes: int = 4, shape: str = "uniform") -> dict:
         train_l.append(t)
         end_l.append(e)
         staged_l.append(st["staged"])
+        # per-pass begin_stall attribution (ps/tiered.begin_pass):
+        # stage wait on the critical path, evict+scatter, and the SSD
+        # promote seconds the staging incurred (wait = main-thread
+        # share — ~0 when the promote rode the overlapped stage)
+        stall_l.append({k: st.get(k, 0.0)
+                        for k in ("stage_wait_sec", "evict_scatter_sec",
+                                  "ssd_promote_sec",
+                                  "ssd_promote_wait_sec",
+                                  "ssd_promoted_rows")})
     # drain the measured passes' epilogue, then diff the cumulative
     # accounting against the cold-pass snapshot — end_pass_overlap_sec
     # is the measured write-back time that never blocked the main
@@ -216,6 +232,40 @@ def measure_tiered(num_passes: int = 4, shape: str = "uniform") -> dict:
     begin_full = time.perf_counter() - t0
     staged_full = table.last_pass_stats["staged"]
     helper.end_pass(None)
+    # --- SSD third-tier attribution (ISSUE 7; docs/STORAGE.md) ---
+    # Demote the WHOLE model to segments, then stage pass B's working
+    # set back twice: once synchronously (begin_pass pays the segment
+    # reads inline — the LoadSSD2Mem cost on the critical path) and
+    # once ridden on the overlapped stage during pass A's training
+    # (the production pre_build_thread shape). The acceptance claim is
+    # overlap_promote_wait_sec << sync_promote_wait_sec for the same
+    # working set (scripts/ssd_check.run_overlap_check gates it; the
+    # bench reports the measured numbers).
+    table.fence()
+    table.drop_window()
+    t0 = time.perf_counter()
+    ssd_demoted = sum(h.demote_cold() for h in table.hosts)
+    ssd_demote_sec = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    helper.begin_pass(pool[1])            # sync: promote paid inline
+    begin_ssd_sync = time.perf_counter() - t0
+    sync_st = dict(table.last_pass_stats)
+    helper.end_pass(None)
+    table.fence()
+    table.drop_window()
+    sum(h.demote_cold() for h in table.hosts)
+    helper.begin_pass(pool[0])            # A staged inline (unmeasured)
+    helper.stage_pass(pool[1])            # B's promote rides A's train
+    tr.train_pass_resident(pool[0])
+    helper.end_pass(pool[0])
+    t0 = time.perf_counter()
+    helper.begin_pass(pool[1])
+    begin_ssd_overlap = time.perf_counter() - t0
+    ov_st = dict(table.last_pass_stats)
+    helper.end_pass(None)
+    table.fence()
+    ssd = table.ssd_stats()
+    shutil.rmtree(ssd_root, ignore_errors=True)
     walls = [b + t + e for b, t, e in zip(begin_l, train_l, end_l)]
     value = num_records * len(walls) / sum(walls) / chips
     dev_time_total = num_records * len(walls) / max(dev_only, 1e-9)
@@ -266,6 +316,42 @@ def measure_tiered(num_passes: int = 4, shape: str = "uniform") -> dict:
         # staging vs full re-staging of the same working set
         "begin_stall_shrink": round(
             begin_full / max(begin_steady, 1e-9), 1),
+        # per-pass begin_stall attribution (stage wait / evict+scatter /
+        # SSD promote seconds) — the tiered-mode gap finally has
+        # per-stage numbers (ISSUE 7)
+        "begin_stall_breakdown": [
+            {k: (round(float(v), 4) if isinstance(v, float) else v)
+             for k, v in st.items()} for st in stall_l],
+        # SSD third tier (ps/ssd.py): cumulative tier accounting plus
+        # the sync-vs-overlapped promote comparison for pass B's
+        # working set — overlap wait must sit far below the sync
+        # control where begin_pass pays the segment reads inline
+        "ssd": {
+            "demoted_rows": int(ssd.get("demoted_rows", 0)),
+            "promoted_rows": int(ssd.get("promoted_rows", 0)),
+            "compacted_rows": int(ssd.get("compacted_rows", 0)),
+            "demote_sec_total": round(ssd.get("demote_sec", 0.0), 4),
+            "promote_sec_total": round(ssd.get("promote_sec", 0.0), 4),
+            "promote_wait_sec_total": round(
+                ssd.get("promote_wait_sec", 0.0), 4),
+            "live_rows": int(ssd.get("live_rows", 0)),
+            "segments": int(ssd.get("segments", 0)),
+            "bytes": int(ssd.get("bytes", 0)),
+            "demote_all_rows": int(ssd_demoted),
+            "demote_all_sec": round(ssd_demote_sec, 4),
+            "begin_sync_sec": round(begin_ssd_sync, 4),
+            "begin_overlap_sec": round(begin_ssd_overlap, 4),
+            "sync_promote_wait_sec": round(
+                sync_st.get("ssd_promote_wait_sec", 0.0), 4),
+            "sync_promoted_rows": int(
+                sync_st.get("ssd_promoted_rows", 0)),
+            "overlap_promote_sec": round(
+                ov_st.get("ssd_promote_sec", 0.0), 4),
+            "overlap_promote_wait_sec": round(
+                ov_st.get("ssd_promote_wait_sec", 0.0), 4),
+            "overlap_promoted_rows": int(
+                ov_st.get("ssd_promoted_rows", 0)),
+        },
     }
 
 

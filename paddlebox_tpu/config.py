@@ -58,6 +58,24 @@ class Flags:
     table_capacity_per_shard: int = 1 << 20
     # host-RAM backing store capacity (Phase 5; rows beyond HBM)
     host_store_capacity: int = 1 << 24
+    # --- SSD third tier (ps/ssd.SsdTier; docs/STORAGE.md) ---
+    # directory for disk-tier segment files; non-empty auto-attaches a
+    # tier (unique subdir per HostStore). "" = no tier unless a table
+    # passes ssd_dir explicitly or spill_cold lazily creates one.
+    ssd_dir: str = ""
+    # rows per log-structured segment before it seals (append-only;
+    # sealed segments are immutable — the manifest/compaction unit)
+    ssd_segment_rows: int = 1 << 15
+    # background compaction rewrites a sealed segment when its live-row
+    # fraction falls below this (<= 0 disables compaction)
+    ssd_compact_live_frac: float = 0.5
+    # host-RAM occupancy fraction that triggers background demotion of
+    # the coldest rows to the SSD tier (runs on the async-epilogue
+    # worker after each end_pass write-back; <= 0 disables — rows then
+    # demote only under hard capacity pressure or manual spill_cold)
+    host_demote_watermark: float = 0.92
+    # demotion drains RAM occupancy down to this fraction
+    host_demote_target: float = 0.8
     # embedx (mf) lazy-creation threshold semantics (optimizer.cuh.h:105)
     mf_create_threshold: float = 0.0
     # feature shrink: drop rows whose decayed show falls below this

@@ -18,6 +18,7 @@ transport (single-host default is an in-proc identity).
 from __future__ import annotations
 
 import glob as globlib
+import hashlib
 import random
 import threading
 from typing import Callable, Iterator, List, Optional, Sequence, Tuple
@@ -35,6 +36,19 @@ from paddlebox_tpu.utils import Channel, ChannelClosed, stat_add
 from paddlebox_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
+
+
+def chain_digest(digest: str, files: Sequence[str]) -> str:
+    """Left-fold a chained sha256 over ``files`` starting from
+    ``digest`` (``""`` for an empty chain). Incremental by construction:
+    ``chain_digest(chain_digest("", a), b) == chain_digest("", a + b)``
+    — the stream cursor's folded-history fingerprint (a resumed run
+    re-derives the whole chain from the filelist prefix and compares;
+    trainer._adopt_cursor / QueueDataset.adopt_stream_cursor)."""
+    for f in files:
+        digest = hashlib.sha256(
+            (digest + "\n" + str(f)).encode()).hexdigest()
+    return digest
 
 
 class PoisonedFileError(RuntimeError):
@@ -715,6 +729,12 @@ class QueueDataset(Dataset):
         # producer thread while the trainer snapshots cursors) ---
         self._stream_lock = threading.Lock()
         self._files_completed: List[str] = []  # fully-consumed files
+        # cursor compaction (fold_completed_history): the first
+        # _folded_count entries of _files_completed are ALSO summarized
+        # by the chained fingerprint — serialized cursors carry only
+        # {count, sha256} for them, not the names
+        self._folded_count = 0
+        self._folded_digest = ""
         self._windows: List[dict] = []   # open pass: {"files", "mark"}
         self._skip_files: set = set()    # preseeded quarantine decisions
         self._replay_files: List[str] = []  # adopted open window
@@ -743,6 +763,27 @@ class QueueDataset(Dataset):
         unfolded, so they replay."""
         with self._stream_lock:
             return list(self._files_completed)
+
+    def fold_completed_history(self) -> int:
+        """Compact the cursor's completed-file history: fold every file
+        completed so far into a count + chained ``chain_digest``
+        fingerprint, so serialized cursors stop growing O(files
+        consumed) on an always-on stream (ROADMAP item 5; the PR 6
+        known limit). The trainer calls this right AFTER a
+        stream-boundary checkpoint publishes — every file folded here
+        is recorded BY NAME in that durable boundary cursor, and
+        rollback never reaches past the latest boundary, so the names
+        are never needed explicitly again. The in-memory list keeps the
+        full history (``files_completed`` / per-window filelist
+        narrowing are unchanged); only ``stream_cursor_state``'s
+        serialized view shrinks. Returns the total folded count."""
+        with self._stream_lock:
+            new = self._files_completed[self._folded_count:]
+            if new:
+                self._folded_digest = chain_digest(self._folded_digest,
+                                                   new)
+                self._folded_count = len(self._files_completed)
+            return self._folded_count
 
     def note_batches_consumed(self, consumed: int) -> None:
         """Trainer callback: ``consumed`` batches of the current
@@ -804,10 +845,19 @@ class QueueDataset(Dataset):
                 else:
                     window = list(w["files"])
                     break
-            return {"windowed": True,
-                    "files_completed": completed,
-                    "window_files": window,
-                    "windows_completed": n_windows}
+            state = {"windowed": True,
+                     # folded history is carried as count+fingerprint,
+                     # not names — the cursor stays O(files since the
+                     # last boundary checkpoint)
+                     "files_completed": completed[self._folded_count:],
+                     "window_files": window,
+                     "windows_completed": n_windows}
+            if self._folded_count:
+                state["files_folded"] = {
+                    "count": int(self._folded_count),
+                    "sha256": self._folded_digest,
+                }
+            return state
 
     def adopt_stream_cursor(self, stream: dict,
                             quarantined: Sequence[str] = ()) -> None:
@@ -815,11 +865,36 @@ class QueueDataset(Dataset):
         block: completed files will be skipped, the open window replays
         (at-least-once), and the cursor's quarantine decisions are
         preseeded so the resumed run drops the SAME files the preempted
-        one did (restart/consensus parity)."""
+        one did (restart/consensus parity).
+
+        A ``files_folded`` block (compacted history) is expanded from
+        THIS dataset's filelist: the first ``count`` non-quarantined
+        files must reproduce the chained fingerprint — a mismatch
+        raises ``ValueError`` (the filelist no longer extends the
+        folded consumption order), never a silent skip of the wrong
+        files."""
         completed = [str(f) for f in stream.get("files_completed", [])]
         window = [str(f) for f in stream.get("window_files", [])]
+        fold = stream.get("files_folded")
+        count, digest, prefix = 0, "", []
+        if isinstance(fold, dict) and int(fold.get("count", 0)) > 0:
+            count = int(fold["count"])
+            digest = str(fold.get("sha256", ""))
+            skip = {str(f) for f in quarantined}
+            eligible = [f for f in self.filelist if f not in skip]
+            prefix = eligible[:count]
+            if len(prefix) < count \
+                    or chain_digest("", prefix) != digest:
+                raise ValueError(
+                    f"stream cursor folded history ({count} files) does "
+                    "not match this filelist — its leading files no "
+                    "longer reproduce the folded fingerprint; resume "
+                    "with the original filelist order or roll back to a "
+                    "pass boundary")
         with self._stream_lock:
-            self._files_completed = completed
+            self._files_completed = prefix + completed
+            self._folded_count = count
+            self._folded_digest = digest
             self._windows = []
             self._replay_files = window
             self.windows_completed = int(

@@ -14,10 +14,26 @@ up to a hard capacity, fronted by the native C++ key→row index (ps/kv.py).
 Fetch/update are fully vectorized (no per-key python). The pass working
 set is fetched here and scattered into the statically-shaped device
 TableState by PassScopedTable; spill granularity is the pass, not the key.
+
+THIRD TIER (ps/ssd.py, docs/STORAGE.md): rows beyond host-RAM capacity
+live in an attached ``SsdTier`` — log-structured segment files with an
+in-memory key→(segment, offset) index. ``fetch`` promotes spilled keys
+transparently (``LoadSSD2Mem``: on the tiered pipeline this runs on the
+stage thread, overlapped with training); crossing the
+``FLAGS.host_demote_watermark`` capacity fraction demotes the coldest
+rows (two-phase, so segment IO never holds the store lock against a
+concurrent stage fetch — the background path the tiered tables drive
+from the async-epilogue worker). A demoted row's un-exported update
+travels as a ``touched`` bit through the tier, so ``save_delta`` stays
+complete; ``save_base``/``export_rows`` merge the tier, so exports stay
+complete. ``spill_cold``/``load_from_disk`` remain as thin compat shims
+over the tier (one sealed segment per manual spill file).
 """
 
 from __future__ import annotations
 
+import itertools
+import os
 import threading
 from typing import Callable, Dict, Optional, Tuple
 
@@ -25,7 +41,9 @@ import numpy as np
 
 from paddlebox_tpu.config import FLAGS
 from paddlebox_tpu.ps.kv import make_kv
-from paddlebox_tpu.ps.table import (TWO_D_FIELDS, FIELDS,
+from paddlebox_tpu.ps.ssd import SsdTier, read_segment_file
+from paddlebox_tpu.ps.table import (NUM_FIXED, TWO_D_FIELDS, FIELDS,
+                                    rows_from_store_fields,
                                     store_fields_from_rows)
 from paddlebox_tpu.utils.logging import get_logger
 
@@ -35,15 +53,23 @@ log = get_logger(__name__)
 # (FeatureValue layout, heter_ps/feature_value.h:570)
 _2D_FIELDS = TWO_D_FIELDS
 
+#: distinct auto-created tier directories under FLAGS.ssd_dir
+_TIER_SEQ = itertools.count()
+
 
 class HostStore:
     """All-features host table; thread-safe for one writer at a time."""
 
     def __init__(self, mf_dim: int, capacity: Optional[int] = None,
-                 init_rows: int = 1 << 16, opt_ext: int = 0) -> None:
+                 init_rows: int = 1 << 16, opt_ext: int = 0,
+                 ssd_dir: Optional[str] = None) -> None:
         """``opt_ext`` — width of the per-row optimizer extension block
         (ps/sgd.opt_ext_width) persisted alongside the base fields, so
-        pass-scoped tables keep SparseAdam state across pass windows."""
+        pass-scoped tables keep SparseAdam state across pass windows.
+        ``ssd_dir`` attaches the disk tier explicitly; with
+        ``FLAGS.ssd_dir`` set, every store auto-attaches one under a
+        unique subdirectory; otherwise the tier materializes lazily on
+        the first ``spill_cold``."""
         self.mf_dim = mf_dim
         self.opt_ext = opt_ext
         self.fields = tuple(FIELDS) + (("opt_ext",) if opt_ext else ())
@@ -55,9 +81,18 @@ class HostStore:
             for f in self.fields
         }
         self._touched = np.zeros(self._alloc, dtype=bool)
+        # rows selected by an in-flight two-phase demote: a concurrent
+        # write clears the mark, telling the demote's confirm phase the
+        # row is fresher than the copy it just wrote to disk
+        self._demote_mark = np.zeros(self._alloc, dtype=bool)
         self._lock = threading.Lock()
-        self._spill_files: list = []  # active disk-tier files (spill_cold)
-        self._spill_keys: Dict[str, np.ndarray] = {}  # path → spilled keys
+        # disk tier (ps/ssd.SsdTier); None = two-tier store (seed shape)
+        self.ssd: Optional[SsdTier] = None
+        if ssd_dir is None and FLAGS.ssd_dir:
+            ssd_dir = os.path.join(FLAGS.ssd_dir,
+                                   f"hs{next(_TIER_SEQ):04d}")
+        if ssd_dir:
+            self.ssd = SsdTier(ssd_dir, self._row_width)
         # async-epilogue fence (ps/epilogue.PassEpilogue.fence, installed
         # by the pass-window tables): EVERY read/wholesale-mutate entry
         # point drains in-flight end_pass write-backs first, so no
@@ -65,6 +100,18 @@ class HostStore:
         # partially written-back pass. ``update`` deliberately does NOT
         # barrier: the epilogue worker itself lands rows through it.
         self.read_barrier: Optional[Callable[[], None]] = None
+
+    @property
+    def _row_width(self) -> int:
+        """Logical row width (rows_from_store_fields layout) — the SSD
+        tier's fixed record stride."""
+        return NUM_FIXED + self.mf_dim + self.opt_ext
+
+    @property
+    def _spill_files(self) -> list:
+        """Compat view of the disk tier: segment paths still holding
+        live (disk-only) rows, oldest first."""
+        return self.ssd.segment_paths() if self.ssd is not None else []
 
     def _barrier(self) -> None:
         b = self.read_barrier
@@ -87,34 +134,231 @@ class HostStore:
             a = np.zeros(self._shape(f, new), np.float32)
             a[:self._alloc] = self._arr[f]
             self._arr[f] = a
-        t = np.zeros(new, dtype=bool)
-        t[:self._alloc] = self._touched
-        self._touched = t
+        for name in ("_touched", "_demote_mark"):
+            t = np.zeros(new, dtype=bool)
+            t[:self._alloc] = getattr(self, name)
+            setattr(self, name, t)
         self._alloc = new
 
     def __len__(self) -> int:
         self._barrier()
         return len(self.index)
 
+    def total_rows(self) -> int:
+        """Logical model size: RAM rows + disk-tier-only rows."""
+        self._barrier()
+        with self._lock:
+            n = len(self.index)
+        return n + (len(self.ssd) if self.ssd is not None else 0)
+
+    # ---- disk tier plumbing (ps/ssd.py) --------------------------------
+    def attach_ssd(self, tier: SsdTier) -> None:
+        if tier.width != self._row_width:
+            raise ValueError(
+                f"SSD tier row width {tier.width} != store row width "
+                f"{self._row_width} (mf_dim/opt_ext mismatch)")
+        self.ssd = tier
+
+    def _ensure_tier(self, root_hint: str) -> SsdTier:
+        """Lazily attach a tier for the spill_cold compat shim (manual
+        spills get a tier rooted next to their first spill file)."""
+        if self.ssd is None:
+            self.ssd = SsdTier(
+                os.path.join(root_hint or ".", ".pbox_ssd"),
+                self._row_width)
+        return self.ssd
+
+    def _pack_rows(self, rows: np.ndarray) -> np.ndarray:
+        """Host rows (SoA field arrays at ``rows``) → logical [k, width]
+        block — the demote wire format (bit-exact round trip with
+        store_fields_from_rows on promote)."""
+        return rows_from_store_fields(
+            {f: self._arr[f][rows] for f in self.fields},
+            self.mf_dim, self.opt_ext)
+
+    def _select_cold(self, count: int,
+                     exclude: Optional[np.ndarray] = None,
+                     include_touched: bool = True,
+                     nonclk_coeff: float = 0.1, clk_coeff: float = 1.0
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+        """Deterministic demote victim selection (caller holds _lock):
+        coldest first by (untouched-first, score asc, key asc) — the
+        ctr_accessor shrink rule's heat over show/clk. Touched rows are
+        LAST resorts (their delta rides the tier's touched bit)."""
+        keys, rows = self.index.items()
+        if len(keys) == 0 or count <= 0:
+            return np.empty(0, np.uint64), np.empty(0, np.int32)
+        keep = np.ones(len(keys), bool)
+        if exclude is not None and len(exclude):
+            keep &= ~np.isin(keys, exclude)
+        if not include_touched:
+            keep &= ~self._touched[rows]
+        keys, rows = keys[keep], rows[keep]
+        if len(keys) == 0:
+            return np.empty(0, np.uint64), np.empty(0, np.int32)
+        score = self._score(rows, nonclk_coeff, clk_coeff)
+        order = np.lexsort((keys, score,
+                            self._touched[rows].astype(np.int8)))
+        sel = order[:min(count, len(order))]
+        return keys[sel], rows[sel]
+
+    def _headroom_locked(self, need: int,
+                         exclude: Optional[np.ndarray] = None) -> None:
+        """Free index capacity for ``need`` new rows by demoting cold
+        rows synchronously (caller holds _lock; tier IO under the lock
+        — the EMERGENCY path; the watermark keeps it rare). Without a
+        tier this is a no-op and the index raises TableFullError as
+        before."""
+        if self.ssd is None:
+            return
+        free = self.capacity - len(self.index)
+        if free >= need:
+            return
+        ck, cr = self._select_cold(need - free, exclude=exclude)
+        if len(ck) == 0:
+            return
+        self.ssd.append(ck, self._pack_rows(cr),
+                        touched=self._touched[cr].copy())
+        self._free(ck)
+        log.info("host headroom: demoted %d cold rows to the SSD tier",
+                 len(ck))
+
+    def demote_cold(self, count: Optional[int] = None,
+                    include_touched: bool = True,
+                    barrier: bool = True,
+                    nonclk_coeff: float = 0.1,
+                    clk_coeff: float = 1.0) -> int:
+        """Demote the ``count`` coldest rows (None = every eligible row)
+        to the SSD tier — TWO-PHASE so the segment write never holds the
+        store lock against a concurrent stage fetch: select+copy under
+        the lock, write outside it, then confirm-free only rows no
+        writer touched meanwhile (a raced row keeps its fresher RAM
+        state and its just-written disk copy is discarded).
+
+        ``barrier=False`` is for callers already ordered BEHIND the
+        async epilogue (the tiered end_pass write-back job runs this on
+        the epilogue lane itself — fencing there would deadlock the
+        single-lane worker)."""
+        if self.ssd is None:
+            return 0
+        if barrier:
+            self._barrier()
+        with self._lock:
+            if count is None:
+                count = len(self.index)
+            ck, cr = self._select_cold(count,
+                                       include_touched=include_touched,
+                                       nonclk_coeff=nonclk_coeff,
+                                       clk_coeff=clk_coeff)
+            if len(ck) == 0:
+                return 0
+            sub = self._pack_rows(cr)
+            tch = self._touched[cr].copy()
+            self._demote_mark[cr] = True
+        # phase 2: segment IO with the store lock RELEASED
+        self.ssd.append(ck, sub, touched=tch)
+        # phase 3: free only rows whose mark survived (no writer raced)
+        with self._lock:
+            cur = self.index.lookup(ck)
+            same = cur == cr          # still the same key→row binding
+            ok = same.copy()
+            ok[same] = self._demote_mark[cr[same]]
+            self._demote_mark[cr] = False
+            freed_keys = ck[ok]
+            self._free(freed_keys)
+            # a concurrent write superseded the copy we just demoted —
+            # RAM stays authoritative, so the disk copy must not shadow
+            # it. INSIDE the lock, and only while the key is still
+            # RAM-live: a raced key someone ELSE demoted-and-freed
+            # meanwhile has its (fresher) tier copy as the only copy
+            # left — discarding that would lose the row.
+            stale = ck[~ok & (cur >= 0)]
+            if len(stale):
+                self.ssd.discard(stale)
+        if len(freed_keys):
+            log.info("demote_cold: %d rows -> SSD tier (%d raced and "
+                     "stayed in RAM)", len(freed_keys), len(stale))
+        return int(len(freed_keys))
+
+    def demote_to_watermark(self, barrier: bool = True) -> int:
+        """Background demotion policy: above
+        ``FLAGS.host_demote_watermark × capacity`` RAM rows, demote the
+        coldest down to ``FLAGS.host_demote_target × capacity``. The
+        tiered tables run this on the async-epilogue worker right after
+        each end_pass write-back lands (ordered, off the critical
+        path). No-op without a tier or below the watermark."""
+        if self.ssd is None:
+            return 0
+        wm = FLAGS.host_demote_watermark
+        if wm <= 0:
+            return 0
+        with self._lock:
+            n = len(self.index)
+        if n <= int(wm * self.capacity):
+            return 0
+        target = int(max(0.0, min(FLAGS.host_demote_target, wm))
+                     * self.capacity)
+        return self.demote_cold(count=n - target, barrier=barrier)
+
+    def _promote(self, keys: np.ndarray) -> int:
+        """LoadSSD2Mem: move ``keys``' rows (the subset found in the
+        tier) back into host RAM. Promoted keys leave the tier index
+        atomically with the read — no stale copy can resurrect — and a
+        key that became RAM-resident meanwhile keeps its fresher RAM
+        state (the promoted copy is dropped)."""
+        if self.ssd is None or len(keys) == 0:
+            return 0
+        fkeys, sub, tch = self.ssd.take(keys)
+        if len(fkeys) == 0:
+            return 0
+        try:
+            fields = store_fields_from_rows(sub, self.mf_dim,
+                                            self.opt_ext)
+            with self._lock:
+                live = self.index.lookup(fkeys) >= 0
+                ins = ~live                    # RAM wins over the tier
+                ik = fkeys[ins]
+                if len(ik):
+                    self._headroom_locked(len(ik), exclude=ik)
+                    rows = self.index.assign(ik)
+                    self._ensure(int(rows.max()))
+                    for f in self.fields:
+                        self._arr[f][rows] = fields[f][ins]
+                    self._touched[rows] = tch[ins]
+                    self._demote_mark[rows] = False
+            return int(len(ik))
+        except BaseException:
+            # the rows left the tier but never landed in RAM — put them
+            # back rather than lose them
+            self.ssd.append(fkeys, sub, touched=tch)
+            raise
+
+    def spill_manifest(self) -> Optional[dict]:
+        """The tier's checkpoint manifest (segment paths + sha256), or
+        None without a tier / with an empty tier. Sealing side effect:
+        see SsdTier.manifest."""
+        self._barrier()
+        return self.ssd.manifest() if self.ssd is not None else None
+
+    def ssd_stats(self) -> Dict[str, float]:
+        return self.ssd.stats() if self.ssd is not None else {}
+
     # ---- pass staging ----
     def fetch(self, keys: np.ndarray) -> Dict[str, np.ndarray]:
         """Values for ``keys``; unknown keys read as zero-initialized rows
         (they materialize on update — lazy feature creation). Keys that
-        live only in a disk-tier spill file are promoted transparently
-        first (the LoadSSD2Mem step of the pass lifecycle), so
-        PassScopedTable.stage never trains a spilled feature from zero."""
+        live only in the disk tier are promoted transparently first (the
+        LoadSSD2Mem step of the pass lifecycle), so PassScopedTable.stage
+        never trains a spilled feature from zero — and on the tiered
+        pipeline this fetch runs on the STAGE thread, so the promotion
+        IO overlaps the open pass's training."""
         self._barrier()  # in-flight end_pass write-backs land first
         keys_u64 = np.ascontiguousarray(keys, np.uint64)
-        if self._spill_files:
+        if self.ssd is not None and len(self.ssd):
             with self._lock:
                 missing = self.index.lookup(keys_u64) < 0
-                want = keys_u64[missing]
-                candidates = [
-                    p for p in self._spill_files
-                    if np.isin(want, self._spill_keys[p]).any()
-                ] if missing.any() else []
-            for p in candidates:
-                self.load_from_disk(p, keys=want)
+            if missing.any():
+                self._promote(keys_u64[missing])
         with self._lock:
             rows = self.index.lookup(keys_u64)
             known = rows >= 0
@@ -127,13 +371,27 @@ class HostStore:
 
     def update(self, keys: np.ndarray, data: Dict[str, np.ndarray]) -> None:
         """Write back a pass's updated rows (EndPass dump)."""
+        keys_u64 = np.ascontiguousarray(keys, np.uint64)
         with self._lock:
-            rows = self.index.assign(np.ascontiguousarray(keys, np.uint64))
+            if self.ssd is not None:
+                new = int((self.index.lookup(keys_u64) < 0).sum())
+                if new:
+                    self._headroom_locked(new, exclude=keys_u64)
+            rows = self.index.assign(keys_u64)
             if len(rows):
                 self._ensure(int(rows.max()))
             for f in self.fields:
                 self._arr[f][rows] = data[f]
             self._touched[rows] = True
+            self._demote_mark[rows] = False
+            if self.ssd is not None and len(self.ssd):
+                # tier copies of freshly written keys are stale now (a
+                # key demoted earlier and re-created by this write) —
+                # drop them so no export or later promote can see the
+                # old values. INSIDE the store lock: released, a racing
+                # demote could re-spill one of these keys and this
+                # discard would then delete the only remaining copy.
+                self.ssd.discard(keys_u64)
 
     def update_rows(self, keys: np.ndarray, sub: np.ndarray,
                     slot_override: Optional[np.ndarray] = None) -> None:
@@ -158,12 +416,12 @@ class HostStore:
         for f in self.fields:
             self._arr[f][freed] = 0
         self._touched[freed] = False
+        self._demote_mark[freed] = False
         return freed
 
     # ---- checkpoint (SaveBase/SaveDelta, box_wrapper.cc:1383-1415) ----
     def _dump(self, path: str, keys: np.ndarray, rows: np.ndarray,
-              extra: Optional[Dict[str, Dict[str, np.ndarray]]] = None
-              ) -> int:
+              extra: Optional[Dict[str, np.ndarray]] = None) -> int:
         """npz dump of rows; ``extra`` appends out-of-RAM rows (spilled
         tiers) as {field: values} with their own key array."""
         blobs = {f: self._arr[f][rows] for f in self.fields}
@@ -175,53 +433,34 @@ class HostStore:
                             **blobs)
         return len(keys)
 
-    def _purge_spilled(self, keys: np.ndarray) -> None:
-        """Drop keys from every spill file's in-memory REGISTRY (the files
-        themselves are immutable snapshots; _spill_keys is the only
-        authority on which rows are still disk-resident) — called with
-        shrink-deleted keys so an aged-out feature's stale spilled copy
-        can never resurrect into a base export. Caller holds _lock."""
-        if not self._spill_files or len(keys) == 0:
-            return
-        for p in list(self._spill_files):
-            reg = self._spill_keys[p]
-            keep = ~np.isin(reg, keys)
-            if keep.all():
-                continue
-            if keep.any():
-                self._spill_keys[p] = reg[keep]
-            else:
-                self._spill_files.remove(p)
-                self._spill_keys.pop(p, None)
-
-    def _spilled_not_in_ram(self) -> Optional[Dict[str, np.ndarray]]:
-        """Rows living only in spill files (for complete base exports)."""
-        if not self._spill_files:
+    def _ssd_extra(self, delta: bool = False,
+                   clear_touched: bool = True
+                   ) -> Optional[Dict[str, np.ndarray]]:
+        """Tier rows for a save/export merge: {field: values, "keys"}.
+        ``delta`` restricts to tier rows carrying the touched bit (their
+        update never reached a save yet). RAM-live keys are filtered
+        defensively — RAM is always the fresher copy."""
+        if self.ssd is None or len(self.ssd) == 0:
             return None
-        out = {f: [] for f in self.fields}
-        out_keys = []
-        for p in list(self._spill_files):
-            blob = np.load(p)
-            dkeys = blob["keys"]
-            reg = self._spill_keys[p]
-            dead = self.index.lookup(
-                np.ascontiguousarray(dkeys, np.uint64)) < 0
-            sel = dead & np.isin(dkeys, reg)
-            out_keys.append(dkeys[sel])
-            for f in self.fields:
-                out[f].append(blob[f][sel])
-        res = {f: np.concatenate(v) for f, v in out.items()}
-        res["keys"] = np.concatenate(out_keys)
-        return res if len(res["keys"]) else None
+        tk, trows, _tch = self.ssd.export_rows(delta=delta,
+                                               clear_touched=clear_touched)
+        if len(tk) == 0:
+            return None
+        dead = self.index.lookup(tk) < 0
+        tk, trows = tk[dead], trows[dead]
+        if len(tk) == 0:
+            return None
+        out = store_fields_from_rows(trows, self.mf_dim, self.opt_ext)
+        out["keys"] = tk
+        return out
 
     def save_base(self, path: str) -> int:
-        """Full model dump — includes rows currently spilled to disk
-        tiers, so the exported base is always the COMPLETE model."""
+        """Full model dump — includes rows currently spilled to the disk
+        tier, so the exported base is always the COMPLETE model."""
         self._barrier()
         with self._lock:
             keys, rows = self.index.items()
-            n = self._dump(path, keys, rows,
-                           extra=self._spilled_not_in_ram())
+            n = self._dump(path, keys, rows, extra=self._ssd_extra())
             self._touched[:] = False
         log.info("save_base: %d rows -> %s", n, path)
         return n
@@ -229,9 +468,10 @@ class HostStore:
     # ---- in-memory export/import (sharded single-file save format) ----
     def export_rows(self, delta: bool = False
                     ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
-        """(keys, {field: values}) snapshot — base includes disk-spilled
+        """(keys, {field: values}) snapshot — base includes disk-tier
         rows so the export is the COMPLETE model; ``delta`` restricts to
-        rows touched since the last export/save and clears their flags."""
+        rows touched since the last export/save (including tier rows
+        demoted with un-exported updates) and clears their flags."""
         self._barrier()
         with self._lock:
             keys, rows = self.index.items()
@@ -239,12 +479,12 @@ class HostStore:
                 m = self._touched[rows]
                 keys, rows = keys[m], rows[m]
             out = {f: self._arr[f][rows].copy() for f in self.fields}
+            extra = self._ssd_extra(delta=delta)
+            if extra is not None:
+                keys = np.concatenate([keys, extra["keys"]])
+                for f in self.fields:
+                    out[f] = np.concatenate([out[f], extra[f]])
             if not delta:
-                extra = self._spilled_not_in_ram()
-                if extra is not None:
-                    keys = np.concatenate([keys, extra["keys"]])
-                    for f in self.fields:
-                        out[f] = np.concatenate([out[f], extra[f]])
                 self._touched[:] = False
             else:
                 self._touched[rows] = False
@@ -253,32 +493,95 @@ class HostStore:
     def import_rows(self, keys: np.ndarray, fields: Dict[str, np.ndarray],
                     merge: bool = False) -> int:
         """Write rows wholesale (load semantics); merge=False resets the
-        store first. Missing/mismatched opt_ext starts fresh."""
+        store first (the old model's disk tier does not carry over).
+        Missing/mismatched opt_ext starts fresh. With a tier attached,
+        an import larger than the RAM watermark routes the COLDEST rows
+        straight to the tier — the restore path for models bigger than
+        host RAM."""
         self._barrier()  # an in-flight write-back must not land AFTER
+        keys_u64 = np.ascontiguousarray(keys, np.uint64)
         with self._lock:  # a reset/load overwrote the store
             if not merge:
                 self.index = make_kv(self.capacity)
                 for f in self.fields:
                     self._arr[f][:] = 0
                 self._touched[:] = False
-                self._spill_files = []
-                self._spill_keys = {}
-            rows = self.index.assign(np.ascontiguousarray(keys, np.uint64))
+                self._demote_mark[:] = False
+                if self.ssd is not None:
+                    self.ssd.clear()  # old model's tiers don't carry over
+            ram_sel, cold_sel = self._split_import(keys_u64, fields)
+            rows = self.index.assign(keys_u64[ram_sel])
             if len(rows):
                 self._ensure(int(rows.max()))
             for f in self.fields:
-                self._write_field(f, rows, fields, "import_rows")
+                self._write_field(f, rows, fields, "import_rows",
+                                  sel=ram_sel)
+            self._demote_mark[rows] = False
+            if merge and self.ssd is not None and len(self.ssd):
+                # imported keys that also had a tier copy: the import
+                # wins. Inside the store lock — released, a racing
+                # demote could re-spill one of these keys first and
+                # this discard would delete the only remaining copy.
+                self.ssd.discard(keys_u64[ram_sel])
+        if cold_sel is not None and cold_sel.any():
+            sub = rows_from_store_fields(
+                {f: (fields[f][cold_sel] if f in fields
+                     else np.zeros(self._shape(f, int(cold_sel.sum())),
+                                   np.float32))
+                 for f in self.fields}, self.mf_dim, self.opt_ext)
+            self.ssd.append(keys_u64[cold_sel], sub)
+            log.info("import_rows: %d rows routed to the SSD tier "
+                     "(host RAM watermark)", int(cold_sel.sum()))
         return len(keys)
+
+    def _split_import(self, keys: np.ndarray,
+                      fields: Dict[str, np.ndarray]
+                      ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """(ram_mask, cold_mask) for an import: without a tier all rows
+        go to RAM (TableFullError stays the relief valve); with one,
+        rows beyond the watermark budget spill coldest-first (score over
+        the incoming show/clk, key-tiebroken — deterministic)."""
+        n = len(keys)
+        all_ram = np.ones(n, bool)
+        if self.ssd is None:
+            return all_ram, None
+        wm = FLAGS.host_demote_watermark
+        budget = int((wm if wm > 0 else 1.0) * self.capacity) \
+            - len(self.index)
+        # re-imported keys reuse their existing rows — only truly new
+        # keys consume budget
+        existing = self.index.lookup(keys) >= 0
+        new_n = int((~existing).sum())
+        if new_n <= max(0, budget):
+            return all_ram, None
+        show = np.asarray(fields.get("show", np.zeros(n)), np.float32)
+        clk = np.asarray(fields.get("clk", np.zeros(n)), np.float32)
+        score = 0.1 * (show - clk) + 1.0 * clk
+        order = np.lexsort((keys, -score))   # hottest first, key tiebreak
+        keep_new = max(0, budget)
+        ram = existing.copy()
+        picked = 0
+        for i in order.tolist():
+            if ram[i]:
+                continue
+            if picked < keep_new:
+                ram[i] = True
+                picked += 1
+        return ram, ~ram
 
     def merge_model_rows(self, keys: np.ndarray,
                          fields: Dict[str, np.ndarray]) -> int:
         """MergeModel semantics (box_wrapper.h:801-803) on the host tier:
         keys present in both ACCUMULATE show/clk/delta_score and keep the
-        live weights/optimizer state; unseen keys insert wholesale."""
+        live weights/optimizer state; unseen keys insert wholesale.
+        Tier-resident keys count as present: they promote first so the
+        accumulate lands on their real values."""
         if len(keys) == 0:
             return 0
         self._barrier()
         keys = np.ascontiguousarray(keys, np.uint64)
+        if self.ssd is not None and len(self.ssd):
+            self._promote(keys)   # accumulate needs the real rows in RAM
         with self._lock:
             existing = self.index.lookup(keys) >= 0
         new_keys = keys[~existing]
@@ -290,8 +593,10 @@ class HostStore:
             for f in ("show", "clk", "delta_score"):
                 self._arr[f][rows_old] += fields[f][existing]
             self._touched[rows_old] = True
-            rows_new = self.index.lookup(new_keys)
-            self._touched[rows_new] = True
+            self._demote_mark[rows_old] = False
+            lk = self.index.lookup(new_keys)
+            rows_new = lk[lk >= 0]   # watermark may have routed some
+            self._touched[rows_new] = True   # new rows to the tier
         return len(keys)
 
     def save_delta(self, path: str) -> int:
@@ -299,7 +604,8 @@ class HostStore:
         with self._lock:
             keys, rows = self.index.items()
             m = self._touched[rows]
-            n = self._dump(path, keys[m], rows[m])
+            n = self._dump(path, keys[m], rows[m],
+                           extra=self._ssd_extra(delta=True))
             self._touched[:] = False
         log.info("save_delta: %d rows -> %s", n, path)
         return n
@@ -320,41 +626,30 @@ class HostStore:
         self._arr[f][rows] = blob[f][sel]
 
     def load(self, path: str, merge: bool = False) -> int:
-        self._barrier()  # same reset-vs-in-flight hazard as import_rows
         blob = np.load(path)
         keys = blob["keys"]
-        with self._lock:
-            if not merge:
-                self.index = make_kv(self.capacity)
-                for f in self.fields:
-                    self._arr[f][:] = 0
-                self._touched[:] = False
-                self._spill_files = []  # old model's tiers don't carry over
-                self._spill_keys = {}
-            rows = self.index.assign(keys)
-            if len(rows):
-                self._ensure(int(rows.max()))
-            for f in self.fields:
-                self._write_field(f, rows, blob, "load")
-        return len(keys)
+        fields = {f: blob[f] for f in self.fields if f in blob}
+        return self.import_rows(keys, fields, merge=merge)
 
-    # ---- disk tier (SSD role: LoadSSD2Mem, box_wrapper.cc:1415) ----
+    # ---- disk tier compat shims (SSD role: LoadSSD2Mem,
+    # box_wrapper.cc:1415 — thin wrappers over ps/ssd.SsdTier) ----
     def spill_cold(self, path: str, threshold: float,
                    nonclk_coeff: float = 0.1, clk_coeff: float = 1.0) -> int:
-        """Move COLD rows (score < threshold) to a disk file and free
-        their host rows — the host-RAM ↔ SSD boundary of the reference's
-        tiered store (hot rows stay in mem, cold spill to SSD until a
-        later ``load_from_disk`` promotes them back for a pass).
+        """Move COLD rows (score < threshold) into ONE sealed tier
+        segment at ``path`` and free their host rows — the manual
+        host-RAM ↔ SSD boundary (hot rows stay in mem, cold spill to SSD
+        until a later ``load_from_disk``/``fetch`` promotes them back).
 
-        Only rows whose updates are already exported spill (touched rows
-        stay in RAM): a spilled row is on disk in BOTH the spill file and
-        the last base, so no save_delta update can be lost, and
-        ``save_base`` merges spill files in so exports stay complete."""
+        Only rows whose updates are already exported spill here (touched
+        rows stay in RAM — the conservative legacy contract; the
+        watermark demoter is the path that may spill touched rows, with
+        the touched bit carried through the tier)."""
         if not path.endswith(".npz"):
-            path += ".npz"  # savez appends it; the registry must match
+            path += ".npz"  # legacy savez convention; registry must match
         self._barrier()
         with self._lock:
-            if path in self._spill_files:
+            tier = self._ensure_tier(os.path.dirname(path))
+            if tier.has_live_path(path):
                 raise ValueError(
                     f"{path} already holds an active spill — overwriting "
                     "would lose its still-spilled rows; use a fresh path "
@@ -367,12 +662,8 @@ class HostStore:
             ck, cr = keys[cold], rows[cold]
             if len(ck) == 0:
                 return 0
-            self._dump(path, ck, cr)
+            tier.append_sealed_file(path, ck, self._pack_rows(cr))
             self._free(ck)
-            # the file is IMMUTABLE from here on; _spill_keys[path] is the
-            # live accounting of which of its rows are still disk-only
-            self._spill_files.append(path)
-            self._spill_keys[path] = ck
         log.info("spill_cold: %d/%d rows -> %s", len(ck), len(keys), path)
         return int(len(ck))
 
@@ -382,44 +673,39 @@ class HostStore:
         ``keys``, only the requested subset (a pass working set) loads;
         rows already live in RAM keep their fresher in-memory state.
 
-        Promoted (or RAM-superseded) keys leave the spill ACCOUNTING
-        (_spill_keys — the file itself is immutable): a later shrink of a
-        promoted key can never resurrect its stale spilled copy into a
-        base export, and no call ever rewrites a spill file."""
+        Promoted (or RAM-superseded) keys leave the tier index — a later
+        shrink of a promoted key can never resurrect its stale spilled
+        copy into a base export. A path unknown to this store's tier
+        (another process's spill file) is scanned directly and adopted
+        row-by-row — the fresh-restore path."""
+        if not path.endswith(".npz"):
+            path += ".npz"
         self._barrier()  # "RAM wins" needs in-flight rows IN RAM first
-        blob = np.load(path)  # immutable file: safe to read unlocked
-        dkeys = blob["keys"]
-        if len(dkeys) == 0:
-            return 0
+        if self.ssd is not None and self.ssd.has_live_path(path):
+            want = self.ssd.keys_in_path(path)
+            if keys is not None:
+                want = want[np.isin(want,
+                                    np.ascontiguousarray(keys, np.uint64))]
+            n = self._promote(want)
+            log.info("load_from_disk: %d rows <- %s (tier)", n, path)
+            return n
+        dkeys, sub, tch = read_segment_file(path, self._row_width)
         sel = np.ones(len(dkeys), bool)
         if keys is not None:
             sel = np.isin(dkeys, np.ascontiguousarray(keys, np.uint64))
+        fields = store_fields_from_rows(sub, self.mf_dim, self.opt_ext)
         with self._lock:
-            reg0 = self._spill_keys.get(path)
-            if reg0 is not None:
-                # the file is a snapshot; only its REGISTERED keys are
-                # still disk-authoritative — a promoted-then-updated key's
-                # stale copy must never load back over fresher state
-                sel &= np.isin(dkeys, reg0)
-            live = self.index.lookup(
-                np.ascontiguousarray(dkeys, np.uint64)) >= 0
+            live = self.index.lookup(dkeys) >= 0
             sel &= ~live  # RAM state wins over the spilled copy
             lk = dkeys[sel]
-            rows = self.index.assign(lk)
-            if len(rows):
+            if len(lk):
+                self._headroom_locked(len(lk), exclude=lk)
+                rows = self.index.assign(lk)
                 self._ensure(int(rows.max()))
-            for f in self.fields:
-                self._write_field(f, rows, blob, "load_from_disk",
-                                  sel=sel)
-            reg = self._spill_keys.get(path)
-            if reg is not None:
-                gone = dkeys[sel | live]
-                remaining = reg[~np.isin(reg, gone)]
-                if len(remaining):
-                    self._spill_keys[path] = remaining
-                else:
-                    self._spill_files.remove(path)
-                    self._spill_keys.pop(path, None)
+                for f in self.fields:
+                    self._arr[f][rows] = fields[f][sel]
+                self._touched[rows] = tch[sel]
+                self._demote_mark[rows] = False
         log.info("load_from_disk: %d rows <- %s", len(lk), path)
         return int(len(lk))
 
@@ -440,6 +726,8 @@ class HostStore:
             self._arr["delta_score"] *= dk
             drop = self._score(rows, nonclk_coeff, clk_coeff) < thr
             freed = self._free(keys[drop])
-            self._purge_spilled(keys[drop])
+            if self.ssd is not None and len(self.ssd):
+                # an aged-out feature's disk copy must never resurrect
+                self.ssd.discard(keys[drop])
         log.info("host shrink: freed %d/%d rows", len(freed), len(keys))
         return int(len(freed))

@@ -120,6 +120,20 @@ class PassScopedTable(EmbeddingTable):
         """Cumulative epilogue accounting (obs/hub pass events, bench)."""
         return self._epilogue.stats()
 
+    def spill_manifest(self) -> Optional[dict]:
+        """Checkpoint spill manifest of the backing store's SSD tier
+        (train/checkpoint.py), single-shard shape — None without a
+        tier."""
+        self.fence()
+        m = self.host.spill_manifest()
+        if m is None:
+            return None
+        return {"version": 1, "shards": {"0": m},
+                "live_rows": m["live_rows"]}
+
+    def ssd_stats(self) -> Dict[str, float]:
+        return self.host.ssd_stats()
+
     # ---- host field <-> logical row conversion --------------------------
     def _logical_rows(self, vals: Dict[str, np.ndarray]) -> np.ndarray:
         return rows_from_store_fields(vals, self.mf_dim, self.opt_ext)
@@ -260,6 +274,13 @@ class PassScopedTable(EmbeddingTable):
                                   rows=len(keys))
                     sub = np.asarray(jax.device_get(sub_dev))[:k]
                     self.host.update_rows(keys, sub, slot_override=slots)
+                    if self.host.ssd is not None:
+                        # watermark demotion on the epilogue lane,
+                        # strictly after the write-back (ps/tiered.py's
+                        # identical discipline; barrier=False — fencing
+                        # from the worker would deadlock the lane)
+                        self.host.demote_to_watermark(barrier=False)
+                        self.host.ssd.maybe_compact()
         self.in_pass = False
         self.last_pass_stats["written_back"] = len(keys)
         if job is not None:

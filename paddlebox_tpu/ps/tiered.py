@@ -136,15 +136,21 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
                  host_capacity: Optional[int] = None,
                  host_init_rows: int = 1 << 14,
                  req_bucket_min: int = 512,
-                 serve_bucket_min: int = 1024) -> None:
+                 serve_bucket_min: int = 1024,
+                 ssd_dir: Optional[str] = None) -> None:
         super().__init__(num_shards, mf_dim=mf_dim,
                          capacity_per_shard=capacity_per_shard, cfg=cfg,
                          req_bucket_min=req_bucket_min,
                          serve_bucket_min=serve_bucket_min)
+        # SSD third tier (ps/ssd.py): an explicit ssd_dir pins each
+        # shard's tier under <dir>/s<K>; otherwise HostStore follows
+        # FLAGS.ssd_dir (auto subdirs) or stays two-tier
         self.hosts = [HostStore(mf_dim, capacity=host_capacity,
                                 init_rows=host_init_rows,
-                                opt_ext=self.opt_ext)
-                      for _ in range(self.n)]
+                                opt_ext=self.opt_ext,
+                                ssd_dir=(f"{ssd_dir}/s{s}" if ssd_dir
+                                         else None))
+                      for s in range(self.n)]
         self.in_pass = False
         self._stage: Optional[_ShardStage] = None
         self._stage_thread: Optional[threading.Thread] = None
@@ -194,6 +200,81 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
     def endpass_stats(self) -> Dict[str, float]:
         """Cumulative epilogue accounting (obs/hub pass events, bench)."""
         return self._epilogue.stats()
+
+    # ---- SSD third tier (ps/ssd.py; docs/STORAGE.md) -----------------
+    def ssd_stats(self) -> Dict[str, float]:
+        """Summed disk-tier accounting across shards (bench / obs);
+        empty when no shard has a tier."""
+        out: Dict[str, float] = {}
+        for h in self.hosts:
+            if h is None or h.ssd is None:
+                continue
+            for k, v in h.ssd.stats().items():
+                out[k] = out.get(k, 0.0) + v
+        return out
+
+    def spill_manifest(self) -> Optional[dict]:
+        """Merged spill manifest over every shard's tier (checkpoint
+        integration — train/checkpoint.py records it in the ckpt dir
+        and verifies segment digests on restore); None when no tier
+        holds rows. Fences first: an in-flight end_pass write-back may
+        still trigger a demotion that belongs in this manifest."""
+        self.fence()
+        shards = {}
+        for s, h in enumerate(self.hosts):
+            if h is None:
+                continue
+            m = h.spill_manifest()
+            if m is not None:
+                shards[str(s)] = m
+        if not shards:
+            return None
+        return {"version": 1, "shards": shards,
+                "live_rows": sum(m["live_rows"] for m in shards.values())}
+
+    def has_spilled_rows(self) -> bool:
+        """Cheap guard for the preloader's promote prefetch: True when
+        any shard's tier holds live rows."""
+        return any(h is not None and h.ssd is not None and len(h.ssd)
+                   for h in self.hosts)
+
+    def prefetch_promote(self, pass_keys: np.ndarray) -> int:
+        """LoadSSD2Mem prefetch for a FUTURE pass, run from the depth-N
+        ``PassPreloader`` build stage (train/sharded.build_resident_pass):
+        promote the pass keys' spilled rows SSD→host-RAM on the
+        preloader worker, overlapping the open pass's training — the
+        later ``stage`` fetch then hits RAM instead of stalling
+        ``begin_pass`` on segment reads (the measured 26 s
+        ``begin_stall_shrink`` path). Rows land in the HOST tier only;
+        window promotion stays with begin_pass's reconcile."""
+        total = 0
+        for s, ks in enumerate(self._split_by_owner(pass_keys)):
+            h = self.hosts[s]
+            if h is None or h.ssd is None or not len(h.ssd) \
+                    or not len(ks):
+                continue
+            h._barrier()  # order behind in-flight write-backs
+            with h._lock:
+                missing = h.index.lookup(ks) < 0
+            if missing.any():
+                total += h._promote(ks[missing])
+        if total:
+            log.info("prefetch_promote: %d spilled rows -> host RAM "
+                     "(overlapped)", total)
+        return total
+
+    def _demote_after_writeback(self) -> None:
+        """Watermark demotion + compaction, run ON the epilogue lane
+        right after an end_pass write-back lands (so demote IO never
+        blocks host_lock and is strictly ordered AFTER the write-back —
+        rows the pass just touched are marked and never selected).
+        barrier=False: fencing from the single-lane worker itself would
+        deadlock."""
+        for h in self.hosts:
+            if h is None or h.ssd is None:
+                continue
+            h.demote_to_watermark(barrier=False)
+            h.ssd.maybe_compact()
 
     # ---- overlapped plan builds (preload_into_memory) ----------------
     @contextlib.contextmanager
@@ -389,6 +470,7 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         synchronously."""
         if self.in_pass:
             raise RuntimeError("begin_pass while a pass is open")
+        t0 = time.perf_counter()
         if pass_keys is not None:
             if self._stage_thread is not None or self._stage is not None:
                 self.wait_stage_done()
@@ -401,6 +483,11 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
             else:
                 self.stage(pass_keys, background=False)
         self.wait_stage_done()
+        # critical-path stall spent WAITING on the stage (host fetch +
+        # any SSD promote it triggered) — near zero when the stage
+        # overlapped the previous pass's training (bench begin_stall
+        # breakdown; docs/STORAGE.md)
+        self._last_stage_wait_sec = time.perf_counter() - t0
         st = self._stage
         if st is None:
             raise RuntimeError("begin_pass with nothing staged")
@@ -412,6 +499,9 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         reconcile the stage against the live window, evict only what
         capacity demands, scatter only the genuinely new rows. Returns
         the number of working-set rows across shards."""
+        # promote attribution spans since the PREVIOUS begin_pass (the
+        # overlapped stage promotes during the previous pass's train)
+        ssd0 = getattr(self, "_ssd_mark", {})
         st = self._resolve_stage(pass_keys)
 
         stats = dict(resident=0, staged=0, evicted=0, evicted_writeback=0,
@@ -420,6 +510,7 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         row_l: List[np.ndarray] = []
         val_l: List[np.ndarray] = []
         total = 0
+        t_evict0 = time.perf_counter()
         with self.host_lock:
             if any(len(self.indexes[s]) + len(st.new_keys[s])
                    > self.capacity for s in range(self.n)):
@@ -456,6 +547,22 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
                     self.state, np.concatenate(sh_l), rows,
                     np.concatenate(val_l))
         self.in_pass = True
+        # begin_stall breakdown (bench tiered mode): stage wait on the
+        # critical path, evict+scatter time, and the SSD promote
+        # seconds this pass's staging incurred (with its critical-path
+        # share — overlapped promotes show promote_sec > 0 with
+        # promote_wait_sec ~ 0)
+        stats["stage_wait_sec"] = round(
+            getattr(self, "_last_stage_wait_sec", 0.0), 6)
+        stats["evict_scatter_sec"] = round(
+            time.perf_counter() - t_evict0, 6)
+        ssd1 = self.ssd_stats()
+        self._ssd_mark = ssd1
+        for k, ok in (("promote_sec", "ssd_promote_sec"),
+                      ("promote_wait_sec", "ssd_promote_wait_sec"),
+                      ("promoted_rows", "ssd_promoted_rows")):
+            if ssd1:
+                stats[ok] = round(ssd1.get(k, 0.0) - ssd0.get(k, 0.0), 6)
         self.last_pass_stats = stats
         log.info("begin_pass: %d working-set rows (%d resident, %d staged, "
                  "%d evicted) across %d HBM shards", total,
@@ -503,7 +610,9 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         self.in_pass = False
         self.last_pass_stats["written_back"] = total
 
-        if jobs:
+        tiered_ssd = any(h is not None and h.ssd is not None
+                         for h in self.hosts)
+        if jobs or tiered_ssd:
             def run(jobs=jobs) -> None:
                 for s, keys, (sub_dev, k) in jobs:
                     # chaos seam: a mid-write-back failure must surface
@@ -512,6 +621,13 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
                                   shard=s, rows=len(keys))
                     sub = np.asarray(jax.device_get(sub_dev))[:k]
                     self.hosts[s].update_rows(keys, sub)
+                # watermark demotion rides the SAME job: strictly after
+                # this pass's rows landed and are marked touched —
+                # selection is untouched-first, so a row whose write-back
+                # just landed spills only when nothing colder exists
+                # (and then its touched bit rides the tier). Off the
+                # critical path; disk IO outside host_lock.
+                self._demote_after_writeback()
 
             if FLAGS.async_end_pass:
                 self._epilogue.submit(run, label="end_pass")

@@ -202,6 +202,11 @@ class MultihostTieredShardedTable(TieredShardedEmbeddingTable):
                 total += len(rows)
         self.in_pass = False
         self.last_pass_stats["written_back"] = total
+        # per-node SSD tier: watermark demotion after the (synchronous)
+        # write-back — owned shards only; host-local bookkeeping, so no
+        # collective coordination is needed (each AIBox node manages its
+        # own SSD, box_wrapper.h:446-450)
+        self._demote_after_writeback()
         return total
 
     def drop_window(self) -> None:

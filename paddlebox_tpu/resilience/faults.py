@@ -37,6 +37,13 @@ site                        seam
                             surfaces at the next epilogue fence as
                             ``EndPassWritebackError`` — never as silent
                             row loss
+``ssd.io``                  every SSD-tier segment file operation
+                            (append / read / unlink — ps/ssd.py): a
+                            transient ``fail`` retries on the seeded
+                            RetryPolicy (site ``ssd.io``); repeated
+                            failures surface through the demote/promote
+                            caller (the epilogue fence for background
+                            demotes — never silent zeros)
 ``stream.window``           each streaming window dispatch (windowed
                             ``QueueDataset``, data/dataset.py): fires as
                             a window's readers are about to start, ctx

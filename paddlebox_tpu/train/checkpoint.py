@@ -41,7 +41,7 @@ log = get_logger(__name__)
 #: files whose content digests are recorded in meta.json and verified
 #: on restore (meta.json itself is covered by the meta.sha256 sidecar)
 _CHECKSUMMED = ("sparse.npz", "sparse_delta.npz", "dense.pkl",
-                "cursor.json", "metrics.pkl")
+                "cursor.json", "metrics.pkl", "spill_manifest.json")
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -288,6 +288,23 @@ class CheckpointManager:
             if metrics is not None and len(metrics):
                 with open(os.path.join(tmp, "metrics.pkl"), "wb") as fh:
                     pickle.dump(metrics, fh)
+        # SSD spill manifest (ps/ssd.py; docs/STORAGE.md): segment paths
+        # + sha256 of the table's disk tier AT THIS CHECKPOINT (the
+        # manifest call seals the active segment, so every recorded
+        # file is immutable from here). The checkpoint itself stays
+        # self-contained — save_base/save_delta merged the tier rows —
+        # but restore() verifies the recorded segments so a corrupt
+        # tier surfaces loudly instead of promoting garbage later.
+        manifest_fn = getattr(trainer.table, "spill_manifest", None)
+        if manifest_fn is not None:
+            manifest = manifest_fn()
+            if manifest:
+                def write_manifest() -> None:
+                    path = os.path.join(tmp, "spill_manifest.json")
+                    faults.inject("checkpoint.io", path=path)
+                    with open(path, "w") as fh:
+                        json.dump(manifest, fh)
+                _io_retry().call(write_manifest)
         # content digests: restore refuses a bit-rotted chain link
         # instead of silently loading garbage rows
         checksums: Dict[str, str] = {
@@ -500,6 +517,7 @@ class CheckpointManager:
         chain = self._chain(target)
         for s in chain:  # verify the WHOLE chain before touching state
             self.verify(s)
+        self._verify_spill_manifest(target)
         first = True
         for s in chain:
             d = self._dir(s)
@@ -528,6 +546,42 @@ class CheckpointManager:
         self._lineage_tip = target
         log.info("restored step %d (chain: %s)", target, chain)
         return target
+
+    def _verify_spill_manifest(self, step: int) -> None:
+        """Verify the SSD-tier segments recorded with ``ckpt-<step>``
+        against their manifest sha256 — the spill-tier link of the
+        checksum chain (docs/STORAGE.md). A MISSING segment is fine
+        (compaction unlinks dead segments and restore re-imports every
+        row from the checkpoint itself); a PRESENT-but-different one is
+        real corruption and raising here stops the restore before any
+        later promote could read garbage rows."""
+        path = os.path.join(self._dir(step), "spill_manifest.json")
+        if not os.path.isfile(path):
+            return
+        try:
+            with open(path) as fh:
+                manifest = json.load(fh)
+        except (OSError, ValueError) as e:
+            # the file itself is covered by meta.json checksums — an
+            # unreadable manifest that PASSED verify() means a pre-
+            # checksum writer; treat as absent
+            log.warning("unreadable spill_manifest.json at step %d "
+                        "(%r) — skipping tier verification", step, e)
+            return
+        from paddlebox_tpu.ps.ssd import (SegmentCorruptError,
+                                          verify_manifest)
+        missing: List[str] = []
+        for shard, m in manifest.get("shards", {}).items():
+            try:
+                missing += verify_manifest(m)
+            except SegmentCorruptError as e:
+                raise CheckpointCorruptError(
+                    f"checkpoint {step} spill manifest (shard {shard}): "
+                    f"{e} ") from e
+        if missing:
+            log.info("spill manifest at step %d: %d segment(s) no "
+                     "longer on disk (compacted/reset) — checkpoint is "
+                     "self-contained, continuing", step, len(missing))
 
     def _chain(self, target: int) -> List[int]:
         """base → …deltas… → target, walking each delta's prev_step link

@@ -962,9 +962,23 @@ class ShardedTrainer:
         (ps/tiered.py module docstring)."""
         scope = getattr(self.table, "plan_scope", None)
         if scope is None:
-            return ShardedResidentPass.build(dataset, self)
-        with scope():
-            return ShardedResidentPass.build(dataset, self)
+            rp = ShardedResidentPass.build(dataset, self)
+        else:
+            with scope():
+                rp = ShardedResidentPass.build(dataset, self)
+        # SSD promote prefetch (ps/ssd.py): with a disk tier holding
+        # rows, promote this pass's spilled working set host-ward NOW —
+        # on a preloader worker this overlaps the open pass's training,
+        # so the later stage fetch hits RAM and begin_pass never stalls
+        # on segment reads (LoadSSD2Mem inside the build stage)
+        pf = getattr(self.table, "prefetch_promote", None)
+        if (pf is not None and hasattr(dataset, "pass_keys")
+                and getattr(self.table, "has_spilled_rows",
+                            lambda: False)()):
+            from paddlebox_tpu.train.device_pass import poll_preload_abort
+            poll_preload_abort()
+            pf(dataset.pass_keys())
+        return rp
 
     def _feed_registry_resident(self, rp, preds) -> None:
         """Post-pass metric registry replay (the per-batch AddAucMonitor

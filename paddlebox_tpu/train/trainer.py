@@ -433,13 +433,22 @@ class Trainer:
                           "dataset is not a windowed QueueDataset "
                           "(FLAGS.stream_window_files)")
             else:
+                from paddlebox_tpu.data.dataset import chain_digest
                 quar = set(cur.get("quarantined_files", []))
+                fold = stream.get("files_folded") or {}
+                nfold = int(fold.get("count", 0) or 0)
                 expect = [str(f) for f in
                           list(stream.get("files_completed", []))
                           + list(stream.get("window_files", []))
                           if str(f) not in quar]
                 avail = [f for f in dataset.filelist if f not in quar]
-                if avail[:len(expect)] != expect:
+                if nfold and (len(avail) < nfold or chain_digest(
+                        "", avail[:nfold]) != fold.get("sha256")):
+                    reason = ("stream folded-history fingerprint "
+                              "mismatch — the filelist's leading files "
+                              "no longer reproduce the cursor's "
+                              "compacted consumption prefix")
+                elif avail[nfold:nfold + len(expect)] != expect:
                     reason = ("stream file prefix changed — the "
                               "filelist no longer extends the cursor's "
                               "consumption order")
@@ -470,11 +479,18 @@ class Trainer:
             checkpoint.restore(self, step=boundary)
             return None
         if stream is not None:
+            fold = stream.get("files_folded") or {}
+            nfold = int(fold.get("count", 0) or 0)
             completed = [str(f) for f in stream.get("files_completed",
                                                     [])]
-            if (not stream.get("window_files")
-                    and getattr(dataset, "files_completed", None)
-                    == completed):
+            dsc = getattr(dataset, "files_completed", None)
+            # with a folded history the cursor names only the tail —
+            # the folded prefix was fingerprint-checked above, so the
+            # dataset sits at the cursor iff lengths line up and the
+            # named tail matches
+            if (not stream.get("window_files") and dsc is not None
+                    and len(dsc) == nfold + len(completed)
+                    and dsc[nfold:] == completed):
                 # in-process continuation at a stream BOUNDARY: the
                 # dataset already sits exactly where the cursor points
                 # (the previous window's boundary save) — nothing to
@@ -506,8 +522,9 @@ class Trainer:
             if stream is not None:
                 fields = dict(
                     stream=True,
-                    files_completed=len(stream.get("files_completed",
-                                                   [])),
+                    files_completed=nfold + len(
+                        stream.get("files_completed", [])),
+                    folded_files=nfold,
                     replay_files=len(stream.get("window_files", [])))
             hub.emit("cursor_resume",
                      global_step=int(self.global_step),
@@ -734,8 +751,10 @@ class Trainer:
                 dataset.adopt_stream_cursor(
                     stream,
                     quarantined=cur.get("quarantined_files", []))
-                prefix = ([str(f) for f in
-                           stream.get("files_completed", [])]
+                # the dataset expanded any folded (compacted) history
+                # back to names from its filelist — read the prefix
+                # from it, not from the cursor's (tail-only) block
+                prefix = (list(dataset.files_completed)
                           + [str(f) for f in
                              stream.get("window_files", [])])
                 seen = set(prefix)
@@ -876,10 +895,18 @@ class Trainer:
         # boundary save must stay kwarg-free so duck-typed tables whose
         # save surface predates the kwarg (sharded/tiered/multi_mf)
         # keep working on the generic graceful-stop path
-        return checkpoint.save(
+        path = checkpoint.save(
             self, delta=checkpoint.has_base(), cursor=cursor,
             clear_touched=True if cursor is not None else None,
             metrics=self.metrics if len(self.metrics) else None)
+        if cursor is not None:
+            # this boundary checkpoint now records every completed file
+            # BY NAME — fold them into the compact count+fingerprint
+            # form so later cursors stay O(files since this boundary)
+            fold = getattr(dataset, "fold_completed_history", None)
+            if fold is not None:
+                fold()
+        return path
 
     def _stream_stop(self, dataset, checkpoint) -> None:
         """Graceful stop from the stream loop (idle poll / between

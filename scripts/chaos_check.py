@@ -8,9 +8,14 @@ injects, in ONE run:
 2. a corrupt record file (every line of one input file is mangled at
    the ``parser.record`` seam), and
 3. a mid-save checkpoint crash (the second ``save`` dies just before
-   its atomic publish), and
+   its atomic publish),
 4. a transient ``stream.window`` dispatch failure on a WINDOWED
-   streaming job (docs/RESILIENCE.md §Streaming),
+   streaming job (docs/RESILIENCE.md §Streaming), and
+5. ``ssd.io`` faults on a THREE-TIER (HBM+mem+SSD) table
+   (docs/STORAGE.md): a transient segment write during demotion and a
+   transient segment read during promotion (both retried on the seeded
+   RetryPolicy), a hard CRASH mid-demotion, and a flipped byte in a
+   manifested segment file,
 
 then asserts full recovery:
 
@@ -19,6 +24,10 @@ then asserts full recovery:
 - ``restore()`` into a fresh trainer returns the last consistent step,
 - the windowed stream retries the broken window from its boundary
   checkpoint and still consumes every file,
+- the tiered trainer restores THROUGH spill-manifest verification after
+  the mid-demotion crash with no lost rows (bit-identical full-model
+  digest), while the corrupt segment makes the same restore refuse
+  LOUDLY (``CheckpointCorruptError``) — never silent zeros,
 - the telemetry JSONL records nonzero ``retry_attempts`` /
   ``files_quarantined`` counters,
 
@@ -45,6 +54,144 @@ import tempfile
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+
+def _run_ssd_chaos(workdir: str, seed: int) -> dict:
+    """Fault (5): the SSD third tier under chaos (ps/ssd.py). Drives a
+    1-mesh tiered trainer whose host stores hold more rows than the
+    demote watermark allows, so segments exist and the checkpoint
+    records a spill manifest — then injects the ``ssd.io`` seam."""
+    import hashlib
+
+    import jax
+    import numpy as np
+    import optax
+
+    from paddlebox_tpu.data import DataFeedDesc
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.parallel import make_mesh
+    from paddlebox_tpu.ps import SparseSGDConfig
+    from paddlebox_tpu.ps.table import FIELDS, TWO_D_FIELDS
+    from paddlebox_tpu.ps.tiered import TieredShardedEmbeddingTable
+    from paddlebox_tpu.resilience.faults import (FaultPlan, InjectedCrash,
+                                                 installed)
+    from paddlebox_tpu.train.checkpoint import (CheckpointCorruptError,
+                                                CheckpointManager)
+    from paddlebox_tpu.train.sharded import ShardedTrainer
+
+    chips = len(jax.devices())
+    mesh = make_mesh(chips)
+    desc = DataFeedDesc.criteo(batch_size=32)
+    cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=0.0)
+    ckpt_root = os.path.join(workdir, "ckpt_ssd")
+
+    def mk(tier: str):
+        table = TieredShardedEmbeddingTable(
+            chips, mf_dim=4, capacity_per_shard=2048, cfg=cfg,
+            host_capacity=512, req_bucket_min=256, serve_bucket_min=256,
+            ssd_dir=os.path.join(workdir, tier))
+        tr = ShardedTrainer(DeepFM(hidden=(8,)), table, desc, mesh,
+                            tx=optax.adam(1e-2))
+        return table, tr
+
+    def mk_fields(ks: np.ndarray):
+        base = ks.astype(np.float32)
+        return {f: (np.tile(base[:, None], (1, 4)) * 0.01
+                    if f in TWO_D_FIELDS else base * 0.001)
+                for f in FIELDS}
+
+    def digest(table) -> str:
+        h = hashlib.sha256()
+        for hs in table.hosts:
+            keys, fields = hs.export_rows()
+            order = np.argsort(keys)
+            h.update(np.ascontiguousarray(keys[order]).tobytes())
+            for f in sorted(fields):
+                h.update(np.ascontiguousarray(
+                    fields[f][order], np.float32).tobytes())
+        return h.hexdigest()
+
+    table, tr = mk("tier1")
+    keys = np.arange(1, 801, dtype=np.uint64)
+    for s, ks in enumerate(table._split_by_owner(keys)):
+        # chunked: the store holds 512 rows, the model 800 — inserting
+        # past capacity drives the emergency headroom demoter
+        for i in range(0, len(ks), 256):
+            chunk = ks[i:i + 256]
+            table.hosts[s].update(chunk, mk_fields(chunk))
+
+    # (5a) transient segment WRITE during demotion — retried to success
+    with installed(FaultPlan.parse("ssd.io:fail:nth=1", seed=seed)) as pw:
+        demoted = sum(h.demote_cold(count=200) for h in table.hosts)
+    assert demoted > 0, "ssd chaos: nothing demoted"
+    assert pw.stats()["ssd.io:fail"]["fired"] == 1, pw.stats()
+
+    # (5b) transient segment READ during promotion (the LoadSSD2Mem
+    # path inside fetch) — retried the same way, values intact
+    probe = np.sort(table.hosts[0].ssd.keys())[:5]
+    with installed(FaultPlan.parse("ssd.io:fail:nth=1", seed=seed)) as pr:
+        got = table.hosts[0].fetch(probe)
+    assert pr.stats()["ssd.io:fail"]["fired"] == 1, pr.stats()
+    assert np.allclose(got["embed_w"], probe.astype(np.float32) * 0.001)
+
+    digest0 = digest(table)
+    total0 = sum(h.total_rows() for h in table.hosts)
+    cm = CheckpointManager(ckpt_root)
+    cm.save(tr)
+    step = int(tr.global_step)
+    mpath = os.path.join(ckpt_root, f"ckpt-{step:012d}",
+                         "spill_manifest.json")
+    assert os.path.isfile(mpath), \
+        "tiered checkpoint recorded no spill manifest"
+
+    # (5c) hard crash MID-DEMOTION (process dies inside the segment
+    # append) — the checkpoint published above must stay restorable
+    crashed = False
+    with installed(FaultPlan.parse("ssd.io:fail:nth=1,exc=crash",
+                                   seed=seed)):
+        try:
+            for h in table.hosts:
+                h.demote_cold(count=100)
+        except InjectedCrash:
+            crashed = True
+    assert crashed, "mid-demotion crash fault never fired"
+
+    # (5d) corrupt ONE manifested segment: the restart must refuse
+    # LOUDLY before any promote could read garbage
+    with open(mpath) as fh:
+        seg0 = json.load(fh)["shards"]["0"]["segments"][0]["path"]
+    with open(seg0, "rb") as fh:
+        blob = fh.read()
+    with open(seg0, "wb") as fh:
+        fh.write(blob[:8] + bytes([blob[8] ^ 0xFF]) + blob[9:])
+    _, tr_c = mk("tier_corrupt")
+    loud = False
+    try:
+        CheckpointManager(ckpt_root).restore(tr_c)
+    except CheckpointCorruptError:
+        loud = True
+    assert loud, "corrupt segment restored silently"
+
+    # repair the segment: the SAME restart now recovers every row
+    # through manifest verification — nothing lost to the crash
+    with open(seg0, "wb") as fh:
+        fh.write(blob)
+    table_r, tr_r = mk("tier_restore")
+    restored = CheckpointManager(ckpt_root).restore(tr_r)
+    assert restored == step, (restored, step)
+    assert sum(h.total_rows() for h in table_r.hosts) == total0
+    assert digest(table_r) == digest0, (
+        "restore after mid-demotion crash lost or mutated rows")
+    return {
+        "ssd_demoted": int(demoted),
+        "ssd_write_fault_fired": pw.stats()["ssd.io:fail"]["fired"],
+        "ssd_read_fault_fired": pr.stats()["ssd.io:fail"]["fired"],
+        "ssd_crash_mid_demotion": crashed,
+        "ssd_corrupt_segment_loud": loud,
+        "ssd_restored_step": int(restored),
+        "ssd_rows": int(total0),
+        "ssd_digest": digest0,
+    }
 
 
 def run_scenario(workdir: str, seed: int) -> dict:
@@ -146,6 +293,10 @@ def run_scenario(workdir: str, seed: int) -> dict:
         assert sds.files_completed == healthy
         assert plan.stats()["stream.window:fail"]["fired"] == 1
 
+        # (5) ssd.io seam on a three-tier table (sub-plans installed
+        # around each injection so the op counting stays trivial)
+        ssd_outcome = _run_ssd_chaos(workdir, seed)
+
     # telemetry JSONL: final pass event carries nonzero counters
     with open(jsonl) as fh:
         events = [json.loads(line) for line in fh]
@@ -167,6 +318,7 @@ def run_scenario(workdir: str, seed: int) -> dict:
                                         "faults_injected")},
         surviving_records=len(ds),
         stream_windows=int(sout["windows"]),
+        **ssd_outcome,
     )
     return outcome
 

@@ -185,7 +185,19 @@ def run_scenario(workdir: str, seed: int, preempt_at: int) -> dict:
         cursor = cm.load_cursor()
         assert cursor is not None and "stream" in cursor, cursor
         stream = cursor["stream"]
-        completed_at_kill = list(stream["files_completed"])
+        # completed-file history older than the last boundary ckpt is
+        # FOLDED to a count+fingerprint (cursor compaction, ISSUE 7) —
+        # expand it from the known consumption order, checking the
+        # chained digest on the way
+        fold = stream.get("files_folded") or {}
+        nfold = int(fold.get("count", 0) or 0)
+        if nfold:
+            from paddlebox_tpu.data.dataset import chain_digest
+            assert chain_digest("", files[:nfold]) == fold["sha256"], (
+                "folded cursor fingerprint does not match the stream's "
+                "consumption order")
+        completed_at_kill = files[:nfold] + list(
+            stream["files_completed"])
         open_window = list(stream["window_files"])
         assert open_window, "kill was meant to land MID-window"
         marker = preemption.read_resume_marker(root)

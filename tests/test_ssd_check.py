@@ -1,0 +1,29 @@
+"""Tier-1 wiring of scripts/ssd_check.py — the SSD third-tier gates
+(ISSUE 7): a capped-host tiered job whose working set exceeds
+``host_store_capacity`` demotes and promotes rows through the SSD
+segment tier and still reproduces the uncapped oracle's full-model
+digest bit-for-bit (deterministic across two runs), and the overlapped
+stage keeps the LoadSSD2Mem promote wait off the begin_pass critical
+path. The standalone script runs bigger variants; these are the fast
+non-slow gates."""
+
+from scripts.ssd_check import run_overlap_check, run_ssd_check
+
+
+def test_ssd_check_gate():
+    out = run_ssd_check(passes=5, shards=2, keys_per_set=384,
+                        host_capacity=260, window_cap=224)
+    assert out["ok"]
+    assert out["ssd"]["demoted_rows"] > 0
+    assert out["ssd"]["promoted_rows"] > 0
+    assert out["ssd"]["live_rows"] > 0   # the model genuinely exceeds RAM
+    assert out["digest"]
+
+
+def test_ssd_overlap_gate():
+    out = run_overlap_check(passes=4, keys_per_set=1536,
+                            host_capacity=1000, window_cap=850,
+                            train_sleep=0.12)
+    assert out["ok"]
+    assert out["wait_overlap_sec"] <= 0.5 * out["wait_sync_sec"]
+    assert out["promoted_rows"] > 0

@@ -353,11 +353,14 @@ def test_train_stream_arrivals_idle_and_boundary_ckpt(tmp_path,
         assert out["idle_polls"] >= 1
         assert ds.files_completed == files
         # the newest checkpoint is a STREAM BOUNDARY: completed files
-        # recorded, open window empty — and it is a rollback target
+        # recorded (older history compacted to count+fingerprint after
+        # each boundary publish), open window empty — a rollback target
         cur = cm.load_cursor()
         assert cur["version"] == 2
-        assert cur["stream"]["files_completed"] == files
-        assert cur["stream"]["window_files"] == []
+        st = cur["stream"]
+        assert st["files_completed"] == files[2:]
+        assert st["files_folded"]["count"] == 2
+        assert st["window_files"] == []
         assert cm.latest_boundary_step() == cm.latest_step()
         names = [e["event"] for e in sink.events]
         assert "stream_window" in names and "stream_idle" in names
@@ -383,6 +386,83 @@ def test_train_stream_continues_across_calls(tmp_path):
         out2 = tr.train_stream(ds, cm)
         assert out2["windows"] == 1
         assert ds.files_completed == files
+
+
+def test_stream_cursor_history_compaction_bounded(tmp_path):
+    """ISSUE 7 satellite (ROADMAP item 5): the boundary-checkpoint
+    cadence folds completed-file history into a count + chained
+    fingerprint, so cursor.json stops growing O(files consumed) — the
+    serialized tail stays bounded by the checkpoint interval while the
+    in-memory view keeps every name."""
+    from paddlebox_tpu.data.dataset import chain_digest
+    files = _files(tmp_path, n=8, rows=32)
+    with flags_scope(stream_window_files=2, read_thread_num=1,
+                     stream_ckpt_every_windows=1):
+        desc = DataFeedDesc.criteo(batch_size=16)
+        desc.key_bucket_min = 2048
+        tr = _mk_trainer(desc)
+        ds = _qds(files)
+        cm = CheckpointManager(str(tmp_path / "ckpt"))
+        out = tr.train_stream(ds, cm)
+        assert out["windows"] == 4
+        # in-memory history is complete; the SERIALIZED cursor carries
+        # only the files since the previous boundary + the fingerprint
+        assert ds.files_completed == files
+        st = cm.load_cursor()["stream"]
+        assert st["files_completed"] == files[6:]
+        assert st["files_folded"]["count"] == 6
+        assert st["files_folded"]["sha256"] == chain_digest("", files[:6])
+        # every on-disk cursor of the run is bounded the same way
+        for step in cm.steps():
+            cur = cm.load_cursor(step)
+            if cur is None or "stream" not in cur:
+                continue
+            assert len(cur["stream"]["files_completed"]) <= 2, cur
+
+
+def test_folded_cursor_resume_skips_completed(tmp_path):
+    """A restart from a cursor whose history is folded re-derives the
+    folded prefix from the filelist (fingerprint-checked), skips it,
+    and consumes only the remaining stream."""
+    files = _files(tmp_path, n=8, rows=32)
+    with flags_scope(stream_window_files=2, read_thread_num=1,
+                     stream_ckpt_every_windows=1):
+        desc = DataFeedDesc.criteo(batch_size=16)
+        desc.key_bucket_min = 2048
+        root = str(tmp_path / "ckpt")
+        tr = _mk_trainer(desc)
+        out1 = tr.train_stream(_qds(files), CheckpointManager(root),
+                               max_windows=2)
+        assert out1["windows"] == 2
+        st = CheckpointManager(root).load_cursor()["stream"]
+        assert st["files_folded"]["count"] == 2   # folded history
+        # fresh process: restore, then stream the SAME filelist
+        tr2 = _mk_trainer(desc)
+        cm2 = CheckpointManager(root)
+        assert cm2.restore(tr2) == tr.global_step
+        ds2 = _qds(files)
+        out2 = tr2.train_stream(ds2, cm2)
+        assert out2["windows"] == 2          # only the remaining half
+        assert out2["files"] == 4
+        assert out2["replayed_files"] == 0   # boundary cursor: no window
+        assert ds2.files_completed == files
+
+
+def test_folded_cursor_filelist_mismatch_is_loud(tmp_path):
+    """A filelist that no longer reproduces the folded fingerprint must
+    refuse adoption with a clear error — never silently skip the wrong
+    files."""
+    from paddlebox_tpu.data.dataset import chain_digest
+    files = _files(tmp_path, n=4, rows=32)
+    with flags_scope(stream_window_files=2, read_thread_num=1):
+        ds = _qds([files[1], files[0]] + files[2:])  # reordered prefix
+        with pytest.raises(ValueError, match="folded"):
+            ds.adopt_stream_cursor(
+                {"windowed": True, "files_completed": [],
+                 "window_files": files[2:4], "windows_completed": 1,
+                 "files_folded": {
+                     "count": 2,
+                     "sha256": chain_digest("", files[:2])}})
 
 
 @pytest.mark.chaos

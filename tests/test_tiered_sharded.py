@@ -763,3 +763,196 @@ def test_tiered_preloader_overlapped_plan_build(mesh, tmp_path):
         assert np.abs(fa["embed_w"][oa]).sum() > 0  # actually trained
         np.testing.assert_allclose(fa["embed_w"][oa], fb["embed_w"][ob],
                                    rtol=2e-2, atol=2e-3)
+
+
+# ---- SSD third tier (ps/ssd.py, ISSUE 7): spill × async-epilogue ----
+
+
+def test_ssd_demote_fences_inflight_endpass(tmp_path):
+    """Demotion racing an in-flight end_pass write-back must FENCE
+    first: the write-back lands (marking its rows touched) before the
+    demote selects victims, so a pass's freshly written rows never
+    spill while colder candidates exist."""
+    from paddlebox_tpu.ps.host_store import HostStore
+    from paddlebox_tpu.ps.table import FIELDS
+
+    def mk_fields(n, v):
+        return {f: (np.full((n, 2), v, np.float32) if f == "embedx_w"
+                    else np.full(n, v, np.float32)) for f in FIELDS}
+
+    hs = HostStore(mf_dim=2, capacity=64,
+                   ssd_dir=str(tmp_path / "tier"))
+    cold = np.arange(1, 41, dtype=np.uint64)
+    hs.update(cold, mk_fields(40, 1.0))
+    hs.export_rows()            # clear touched: cold rows are spillable
+    hot = np.arange(101, 111, dtype=np.uint64)
+
+    barrier_calls = []
+
+    def inflight_writeback():
+        # stands in for PassEpilogue.fence draining an end_pass job:
+        # the job lands the hot rows (update marks them touched)
+        if not barrier_calls:
+            hs.update(hot, mk_fields(10, 9.0))
+        barrier_calls.append(1)
+
+    hs.read_barrier = inflight_writeback
+    with flags_scope(host_demote_watermark=0.5, host_demote_target=0.25):
+        n = hs.demote_to_watermark(barrier=True)
+    assert barrier_calls, "demote never fenced the epilogue"
+    assert n > 0
+    # every hot (just-written-back, touched) key stayed in RAM …
+    assert (hs.index.lookup(hot) >= 0).all()
+    assert not hs.ssd.contains(hot).any()
+    # … and the spilled set is cold keys only
+    assert hs.ssd.contains(cold).sum() == n
+
+
+def test_ssd_promote_under_plan_rollback_releases_rows(tmp_path):
+    """A promote landing under a plan_scope that ROLLS BACK releases
+    its plan-assigned window rows (no leaked pending pins), while the
+    promoted host rows keep their trained values — the next real pass
+    stages them normally."""
+    import sys
+    sys.path.insert(0, "scripts")
+    from pipeline_check import _train_mutate
+
+    with flags_scope(warmup_pass_scatter=False):
+        table = TieredShardedEmbeddingTable(
+            2, mf_dim=2, capacity_per_shard=256, cfg=_cfg(),
+            host_capacity=1 << 12, ssd_dir=str(tmp_path / "tier"))
+        keys = np.arange(1, 65, dtype=np.uint64)
+        table.stage(keys, background=False)
+        table.begin_pass(keys)
+        _train_mutate(table, 0)           # embed_w = key*0.001 + 1
+        table.end_pass()
+        table.fence()
+        table.drop_window()
+        # force the whole trained set to the SSD tier
+        for h in table.hosts:
+            h.demote_cold()
+        assert table.has_spilled_rows()
+        assert sum(len(h) for h in table.hosts) == 0
+
+        with pytest.raises(RuntimeError, match="boom"):
+            with table.plan_scope():
+                # a preloader build: plan-assign the keys as pending …
+                for s, ks in enumerate(table._split_by_owner(keys)):
+                    with table.host_lock:
+                        table.indexes[s].assign(ks)
+                        table._note_plan_assigned(s, ks)
+                # … promote their spilled values host-ward …
+                assert table.prefetch_promote(keys) == len(keys)
+                raise RuntimeError("boom")   # … and the build dies
+
+        # rollback released the plan's window rows and pending pins
+        assert table.obs_stats()["pending"] == 0
+        for s, ks in enumerate(table._split_by_owner(keys)):
+            assert (table.indexes[s].lookup(ks) == -1).all()
+        # the promote itself is NOT rolled back: rows live in host RAM
+        # with their trained values (RAM is authoritative; the tier
+        # copy was consumed exactly once)
+        assert not table.has_spilled_rows()
+        for s, ks in enumerate(table._split_by_owner(keys)):
+            got = table.hosts[s].fetch(ks)["embed_w"]
+            np.testing.assert_allclose(
+                got, ks.astype(np.float64) * 0.001 + 1, rtol=1e-6)
+        # and a real pass over the same keys stages cleanly
+        table.stage(keys, background=False)
+        assert table.begin_pass(keys) == len(keys)
+        table.end_pass()
+        table.fence()
+
+
+def test_ssd_segment_compaction(tmp_path):
+    """Compaction rewrites a sealed segment whose live fraction fell
+    below the threshold: live rows re-append bit-identically, the dead
+    file unlinks, and ONLY the compaction accounting books the rewrite
+    — the real demote/promote counters (and the promote-wait
+    critical-path attribution) stay untouched."""
+    import os
+
+    from paddlebox_tpu.ps.ssd import SsdTier
+    tier = SsdTier(str(tmp_path / "t"), width=4, segment_rows=8,
+                   compact_live_frac=0.9)
+    keys = np.arange(1, 9, dtype=np.uint64)
+    rows = np.arange(32, dtype=np.float32).reshape(8, 4)
+    tier.append(keys, rows)                    # fills + seals segment 0
+    path0 = tier.segment_paths()[0]
+    assert tier.discard(keys[:6]) == 6         # live 2/8 < 0.9
+    moved = tier.maybe_compact()
+    assert moved == 2
+    st = tier.stats()
+    assert st["compacted_rows"] == 2
+    assert st["demoted_rows"] == 8 and st["promoted_rows"] == 0, st
+    assert st["promote_sec"] == 0.0 and st["promote_wait_sec"] == 0.0
+    assert not os.path.exists(path0)           # dead segment unlinked
+    fk, frows, _ = tier.take(keys[6:])
+    np.testing.assert_array_equal(np.sort(fk), keys[6:])
+    order = np.argsort(fk)
+    np.testing.assert_array_equal(frows[order], rows[6:])
+    assert len(tier) == 0
+
+
+def test_ssd_tier_sweeps_leftover_segments(tmp_path):
+    """A restarted process reusing the same tier directory must NOT
+    append into the dead process's segment files (offsets would address
+    the old content — silent wrong rows); leftovers are swept at init
+    (the tier is a capacity cache; checkpoints are self-contained)."""
+    import os
+
+    from paddlebox_tpu.ps.ssd import SsdTier
+    root = str(tmp_path / "t")
+    t1 = SsdTier(root, width=4, segment_rows=8)
+    keys = np.arange(1, 5, dtype=np.uint64)
+    t1.append(keys, np.full((4, 4), 7.0, np.float32))
+    old = t1.segment_paths()
+    assert old and all(os.path.exists(p) for p in old)
+    t2 = SsdTier(root, width=4, segment_rows=8)   # "restart"
+    assert len(t2) == 0
+    assert not any(os.path.exists(p) for p in old)  # swept
+    t2.append(keys, np.full((4, 4), 42.0, np.float32))
+    fk, rows, _ = t2.take(keys)
+    assert len(fk) == 4
+    np.testing.assert_array_equal(rows, np.full((4, 4), 42.0, np.float32))
+
+
+def test_ssd_take_deduplicates_keys(tmp_path):
+    """A key duplicated in one take() promotes (and leaves the index)
+    exactly once — no KeyError, no double-counted row."""
+    from paddlebox_tpu.ps.ssd import SsdTier
+    tier = SsdTier(str(tmp_path / "t"), width=4)
+    keys = np.arange(1, 4, dtype=np.uint64)
+    tier.append(keys, np.tile(keys.astype(np.float32)[:, None], (1, 4)))
+    dup = np.array([2, 2, 1, 2], np.uint64)
+    fk, rows, _ = tier.take(dup)
+    np.testing.assert_array_equal(np.sort(fk), [1, 2])
+    assert len(tier) == 1
+    assert tier.stats()["promoted_rows"] == 2
+
+
+def test_ssd_touched_bit_preserves_delta(tmp_path):
+    """A row demoted with an un-exported update carries its touched bit
+    through the tier: save_delta/export_rows(delta=True) still emit it
+    exactly once — demotion never loses a pending delta row."""
+    from paddlebox_tpu.ps.host_store import HostStore
+    from paddlebox_tpu.ps.table import FIELDS
+
+    hs = HostStore(mf_dim=2, capacity=1 << 10,
+                   ssd_dir=str(tmp_path / "tier"))
+    keys = np.arange(1, 11, dtype=np.uint64)
+    data = {f: (np.full((10, 2), 5.0, np.float32) if f == "embedx_w"
+                else np.arange(10, dtype=np.float32)) for f in FIELDS}
+    hs.update(keys, data)                      # touched
+    assert hs.demote_cold(include_touched=True) == 10
+    assert len(hs) == 0 and len(hs.ssd) == 10
+    dk, dfields = hs.export_rows(delta=True)   # tier-touched rows merge
+    order = np.argsort(dk)
+    np.testing.assert_array_equal(dk[order], keys)
+    np.testing.assert_allclose(dfields["embed_w"][order],
+                               data["embed_w"])
+    dk2, _ = hs.export_rows(delta=True)        # … exactly once
+    assert len(dk2) == 0
+    # the full export still carries the (now clean) tier rows
+    fk, _ = hs.export_rows()
+    assert len(fk) == 10
