@@ -27,21 +27,37 @@ import numpy as np
 
 def build_records(num_records: int, num_slots: int = 26,
                   vocab_per_slot: int = 100_000, seed: int = 0,
-                  avg_keys_per_slot: float = 1.0):
+                  avg_keys_per_slot: float = 1.0,
+                  key_dist: str = "uniform"):
     """Synthetic criteo-shaped records, built columnar-fast.
 
     ``avg_keys_per_slot > 1`` produces RAGGED slots: per-(record, slot)
     key counts ~ 1 + Poisson(avg-1) — variable-length multi-key slots,
     the real PaddleBox feed-log shape (data_feed.h:2066-2287) that
-    stresses the segment stream and the non-trivial seqpool path."""
+    stresses the segment stream and the non-trivial seqpool path.
+
+    ``key_dist="zipf"`` draws per-slot key ids from a bounded Zipf
+    (s=1.2) instead of uniform — the hot-key CTR shape
+    (docs/BENCH_SHAPES.md): a few ids dominate every batch, so dedup,
+    the persistent HBM window and the host/SSD tiers stop being
+    flattered by uniform draws (ROADMAP item 5)."""
     from paddlebox_tpu.data.record import SlotRecord
     rng = np.random.default_rng(seed)
+
+    def draw_keys(size):
+        if key_dist == "zipf":
+            # bounded Zipf over [0, vocab): P(r) ∝ 1/(r+1)^1.2 — one
+            # vectorized choice() call per pass build
+            w = 1.0 / np.arange(1, vocab_per_slot + 1,
+                                dtype=np.float64) ** 1.2
+            return rng.choice(vocab_per_slot, size=size, p=w / w.sum())
+        return rng.integers(0, vocab_per_slot, size=size)
+
     dense_all = rng.normal(size=(num_records, 13)).astype(np.float32)
     labels = (rng.random(num_records) < 0.25).astype(np.float32)
     slot_base = (np.arange(num_slots) * vocab_per_slot).astype(np.uint64)
     if avg_keys_per_slot <= 1.0:
-        keys_all = rng.integers(0, vocab_per_slot,
-                                size=(num_records, num_slots))
+        keys_all = draw_keys((num_records, num_slots))
         keys_all = (keys_all + slot_base).astype(np.uint64)
         offsets = np.arange(num_slots + 1, dtype=np.int32)
         return [
@@ -55,7 +71,7 @@ def build_records(num_records: int, num_slots: int = 26,
     offs = np.zeros((num_records, num_slots + 1), np.int32)
     np.cumsum(counts, axis=1, out=offs[:, 1:])
     total = offs[:, -1]
-    flat = rng.integers(0, vocab_per_slot, size=int(total.sum()))
+    flat = draw_keys(int(total.sum()))
     flat_base = np.repeat(
         np.tile(slot_base, num_records),
         counts.reshape(-1))
@@ -85,23 +101,33 @@ def dense_flops_per_example(params) -> float:
 
 SHAPES = {
     # BENCH_SHAPE → (num_slots, avg_keys_per_slot, default_bs,
-    #                default_records, default_vocab_per_slot)
-    "uniform": (26, 1.0, 8192, 262_144, 100_000),
-    "ragged": (26, 5.0, 4096, 131_072, 100_000),
-    "thousand": (1000, 1.0, 512, 32_768, 4_000),
+    #                default_records, default_vocab_per_slot, key_dist)
+    "uniform": (26, 1.0, 8192, 262_144, 100_000, "uniform"),
+    "ragged": (26, 5.0, 4096, 131_072, 100_000, "uniform"),
+    "thousand": (1000, 1.0, 512, 32_768, 4_000, "uniform"),
+    # hot-key CTR shape (ROADMAP item 5; docs/BENCH_SHAPES.md): bounded
+    # Zipf key draws — same geometry as "uniform" so the two rows
+    # isolate the skew effect on dedup / window / tier hit rates
+    "zipf": (26, 1.0, 8192, 262_144, 100_000, "zipf"),
 }
 
 
 def measure_tiered(num_passes: int = 4, shape: str = "uniform") -> dict:
     """Pass-window benchmark: the tiered sharded PS with PERSISTENT HBM
-    windows (ps/tiered.py). Consecutive passes draw from the same key
-    space (the CTR workload), so delta staging should shrink the
-    begin_pass boundary stall to ~the working-set delta; a drop_window
-    control pass measures what full re-staging would cost on the same
-    box state. Returns the JSON record (caller prints)."""
+    windows (ps/tiered.py), driven through the UNIFIED pass pipeline
+    (train/device_pass.PassPipeline — ISSUE 9): plan build, dedup/pack,
+    the H2D wire and the host-tier feed-pass fetch all ride the depth-N
+    preloader worker, begin_pass is reconcile-only, end_pass submits to
+    the epilogue lane (which also evicts ahead for the next queued
+    stage). Consecutive passes draw from the same key space (the CTR
+    workload), so delta staging shrinks the begin boundary to ~the
+    working-set delta; a drop_window control pass measures what full
+    re-staging would cost on the same box state. Returns the JSON
+    record (caller prints)."""
     import jax
     import optax
 
+    from paddlebox_tpu.config import FLAGS
     from paddlebox_tpu.data import DataFeedDesc, InMemoryDataset, SlotDef
     from paddlebox_tpu.models import DeepFM
     from paddlebox_tpu.parallel import make_mesh
@@ -109,7 +135,7 @@ def measure_tiered(num_passes: int = 4, shape: str = "uniform") -> dict:
     from paddlebox_tpu.ps.tiered import TieredShardedEmbeddingTable
     from paddlebox_tpu.train.sharded import ShardedTrainer
 
-    n_slots, avg_keys, bs_default, _, _ = SHAPES[shape]
+    n_slots, avg_keys, bs_default, _, _, key_dist = SHAPES[shape]
     bs = int(os.environ.get("BENCH_BATCH_SIZE", bs_default))
     # smaller working set than the resident headline: the cold stage
     # ships the full working set over the tunnel once
@@ -128,7 +154,8 @@ def measure_tiered(num_passes: int = 4, shape: str = "uniform") -> dict:
         d = InMemoryDataset(desc)
         d.records = build_records(num_records, num_slots=n_slots,
                                   vocab_per_slot=vocab, seed=seed,
-                                  avg_keys_per_slot=avg_keys)
+                                  avg_keys_per_slot=avg_keys,
+                                  key_dist=key_dist)
         d.columnarize()
         return d
 
@@ -146,51 +173,76 @@ def measure_tiered(num_passes: int = 4, shape: str = "uniform") -> dict:
     helper = BoxPSHelper(table, trainer=tr)
     pool = [make_ds(s) for s in range(2)]
 
-    def one_pass(ds, stage_overlap=None):
+    # the pipeline: cold pass + measured passes, alternating datasets
+    # (~96% key overlap). BENCH_NO_OVERLAP=1 = the sequential
+    # kick-per-pass control (depth 0); BENCH_PRELOAD_DEPTH overrides.
+    no_overlap = os.environ.get("BENCH_NO_OVERLAP", "0") == "1"
+    depth = (0 if no_overlap else
+             int(os.environ.get("BENCH_PRELOAD_DEPTH",
+                                str(FLAGS.preload_depth))))
+    seq = [pool[i % 2] for i in range(num_passes + 2)]
+    pipe = tr.tiered_pass_pipeline(iter(seq), depth=depth)
+    pipe.start_next()
+
+    def one_pass():
         t0 = time.perf_counter()
-        helper.begin_pass(ds)
-        t_begin = time.perf_counter() - t0
-        if stage_overlap is not None:
-            helper.stage_pass(stage_overlap)  # overlapped pre-build
+        rp = pipe.wait()
+        t_wait = time.perf_counter() - t0     # prologue stall
         t1 = time.perf_counter()
-        tr.train_pass_resident(ds)
-        t_train = time.perf_counter() - t1
+        pipe.begin_pass()                     # reconcile-only boundary
+        t_begin = time.perf_counter() - t1
+        if not no_overlap:
+            pipe.start_next()
         t2 = time.perf_counter()
-        helper.end_pass(ds)
+        tr.train_pass_resident(rp)
+        t_train = time.perf_counter() - t2
+        if no_overlap:
+            pipe.start_next()
+        t3 = time.perf_counter()
+        pipe.end_pass()
         # with the async epilogue (FLAGS.async_end_pass, the default)
         # this is SUBMIT time — the HBM→host write-back drains in the
         # background; its true cost/overlap comes from endpass_stats()
-        t_end = time.perf_counter() - t2
-        return t_begin, t_train, t_end, dict(table.last_pass_stats)
+        t_end = time.perf_counter() - t3
+        return t_wait, t_begin, t_train, t_end, \
+            dict(table.last_pass_stats)
 
-    # cold pass: full stage + compile (not measured in the headline);
-    # the FIRST measured pass's delta stages overlapped with cold
-    # training, like every later pass (pre_build_thread is always on,
-    # ps_gpu_wrapper.cc:913) — without this the first begin_delta
-    # reads the synchronous host fetch, not the boundary
-    b0, _, e0, st0 = one_pass(pool[0], stage_overlap=pool[1])
+    # warmup, the resident headline's discipline (its pass 0 pays
+    # compile+upload and is excluded): TWO unmeasured passes — the cold
+    # pass stages the full working set + compiles dataset A's shapes,
+    # the warm pass stages the A→B key delta + compiles B's shapes (the
+    # two datasets' routing buckets can differ, each costing a one-off
+    # jit). Pass 1's build+stage already ride the worker during cold
+    # training (the pre_build_thread shape, ps_gpu_wrapper.cc:913);
+    # measured passes then show the steady-state boundary.
+    w0, b0, _, e0, st0 = one_pass()
+    w1, b1, _, _, st1 = one_pass()
     # scope the epilogue accounting to the MEASURED passes: drain the
-    # cold pass's write-back and snapshot the cumulative stats; the
-    # post-loop snapshot diffs against this (the cold pass and the
+    # warmup passes' write-backs and snapshot the cumulative stats; the
+    # post-loop snapshot diffs against this (the warmups and the
     # device-only rerun below would otherwise pollute the headline
     # overlap fraction)
     table.fence()
     eps0 = table.endpass_stats()
-    begin_l, train_l, end_l, staged_l, stall_l = [], [], [], [], []
+    wait_l, begin_l, train_l, end_l = [], [], [], []
+    staged_l, stall_l, ep_dispatch_l = [], [], []
     for i in range(num_passes):
-        ds = pool[(i + 1) % 2]
-        nxt = pool[i % 2]
-        b, t, e, st = one_pass(ds, stage_overlap=nxt)
-        begin_l.append(b)
-        train_l.append(t)
+        w, b, t, e, st = one_pass()
+        wait_l.append(w)
+        begin_l.append(w + b)   # critical-path boundary stall: preload
+        train_l.append(t)       # wait + the reconcile-only begin
         end_l.append(e)
         staged_l.append(st["staged"])
+        ep_dispatch_l.append(st.get("end_pass_dispatch_sec", 0.0))
         # per-pass begin_stall attribution (ps/tiered.begin_pass):
-        # stage wait on the critical path, evict+scatter, and the SSD
-        # promote seconds the staging incurred (wait = main-thread
-        # share — ~0 when the promote rode the overlapped stage)
+        # stage wait on the critical path, evict+scatter, the async-
+        # lane vs emergency-inline eviction split, and the SSD promote
+        # seconds the staging incurred (wait = main-thread share — ~0
+        # when the promote rode the overlapped stage)
         stall_l.append({k: st.get(k, 0.0)
                         for k in ("stage_wait_sec", "evict_scatter_sec",
+                                  "evict_async_sec", "evict_async_rows",
+                                  "evict_emergency_sec",
                                   "ssd_promote_sec",
                                   "ssd_promote_wait_sec",
                                   "ssd_promoted_rows")})
@@ -208,14 +260,25 @@ def measure_tiered(num_passes: int = 4, shape: str = "uniform") -> dict:
             "critical_fence_wait_sec")}
     eps["overlap_sec"] = max(
         0.0, eps["writeback_sec"] - eps["critical_fence_wait_sec"])
-    # device-only rerun (duty-cycle attribution): consume the loop's
-    # pending stage, build the pass once, and re-train the staged
-    # batches — nothing rides the tunnel, so this is the device's real
-    # compute time per pass (same two-rerun discipline as the resident
+    pipe_stats = dict(
+        preload_depth=depth,
+        preload_builds=pipe.builds,
+        preload_build_sec_total=round(pipe.build_sec_total, 4),
+        preload_build_stage_sec={
+            k: round(v, 4)
+            for k, v in sorted(pipe.build_stage_sec.items())})
+    # quiesce the pipeline before the reruns/controls: stop the worker
+    # and discard queued stages that will never begin (their plan pins
+    # release — ps/tiered.discard_queued_stages)
+    pipe.drain()
+    # device-only rerun (duty-cycle attribution): re-stage the last
+    # pass classically, build once, and re-train the staged batches —
+    # nothing rides the tunnel, so this is the device's real compute
+    # time per pass (same two-rerun discipline as the resident
     # headline; these extra passes perturb only model state, which the
     # tiered bench does not report, and run AFTER the epilogue
     # accounting snapshot so they cannot skew it)
-    ds_dev = pool[(num_passes - 1) % 2]
+    ds_dev = pool[(num_passes + 1) % 2]
     helper.begin_pass(ds_dev)
     rp_dev = tr.build_resident_pass(ds_dev)
     tr.train_pass_resident(rp_dev)          # warm rerun
@@ -228,7 +291,7 @@ def measure_tiered(num_passes: int = 4, shape: str = "uniform") -> dict:
     # the last pass overlapped)
     table.drop_window()
     t0 = time.perf_counter()
-    helper.begin_pass(pool[num_passes % 2])
+    helper.begin_pass(pool[(num_passes + 1) % 2])
     begin_full = time.perf_counter() - t0
     staged_full = table.last_pass_stats["staged"]
     helper.end_pass(None)
@@ -284,14 +347,24 @@ def measure_tiered(num_passes: int = 4, shape: str = "uniform") -> dict:
         "num_slots": n_slots, "avg_keys_per_slot": avg_keys,
         "records_per_pass": num_records,
         "passes": num_passes,
-        "stage_cold_sec": round(b0, 3),
+        "stage_cold_sec": round(w0 + b0, 3),
         "staged_rows_cold": st0["staged"],
+        # begin_delta = the critical-path pass boundary: preload wait
+        # (build+stage pipeline starvation) + the reconcile-only begin
         "begin_delta_sec": [round(b, 3) for b in begin_l],
+        "preload_wait_sec": [round(w, 3) for w in wait_l],
         "staged_rows_delta": staged_l,
         "train_sec": [round(t, 3) for t in train_l],
-        # async epilogue: end_pass_sec is now SUBMIT time (critical-path
-        # cost of the boundary); the write-back itself runs overlapped
+        # unified pass pipeline (train/device_pass.PassPipeline):
+        # depth + worker build accounting, the resident bench's fields
+        **pipe_stats,
+        # async epilogue: end_pass_sec is SUBMIT time (critical-path
+        # cost of the boundary); the write-back itself runs overlapped.
+        # dispatch = the bucketed D2H gather dispatch inside submit
+        # (the rest is the touched-row snapshot) — the submit-parity
+        # audit's split (ISSUE 9)
         "end_pass_sec": [round(e, 3) for e in end_l],
+        "end_pass_dispatch_sec": [round(d, 4) for d in ep_dispatch_l],
         "end_pass_writeback_sec_total": round(eps["writeback_sec"], 4),
         "end_pass_fence_wait_sec_total": round(
             eps["critical_fence_wait_sec"], 4),
@@ -309,7 +382,10 @@ def measure_tiered(num_passes: int = 4, shape: str = "uniform") -> dict:
             min(dev_time_total / max(sum(walls), 1e-9), 1.0), 4),
         "device_only_ex_per_sec": round(dev_only / chips, 1),
         "begin_delta_steady_sec": round(begin_steady, 4),
-        "begin_first_delta_sec": round(begin_l[0], 3) if begin_l else None,
+        # the first DELTA boundary is the warm (2nd unmeasured) pass:
+        # it stages the A→B working-set delta + pays B's one-off compile
+        "begin_first_delta_sec": round(w1 + b1, 3),
+        "staged_rows_first_delta": st1["staged"],
         "begin_full_control_sec": round(begin_full, 3),
         "staged_rows_full_control": staged_full,
         # the headline ratio: steady-state boundary stall with delta
@@ -428,7 +504,7 @@ def main() -> None:
     # per-slot vocab: thousand-slot workloads share the key budget (1000
     # slots x 100k would overflow the 2^23-row table)
     (shape_slots, shape_avg, bs_default, rec_default,
-     shape_vocab) = SHAPES[shape]
+     shape_vocab, shape_dist) = SHAPES[shape]
     bs = int(os.environ.get("BENCH_BATCH_SIZE", bs_default))
     num_records = int(os.environ.get("BENCH_RECORDS", rec_default))
     mf_dim = int(os.environ.get("BENCH_MF_DIM", 8))
@@ -452,7 +528,8 @@ def main() -> None:
         d = InMemoryDataset(desc)
         d.records = build_records(num_records, num_slots=shape_slots,
                                   vocab_per_slot=shape_vocab, seed=seed,
-                                  avg_keys_per_slot=shape_avg)
+                                  avg_keys_per_slot=shape_avg,
+                                  key_dist=shape_dist)
         d.columnarize()
         return d
 
@@ -578,7 +655,8 @@ def main() -> None:
         warm = InMemoryDataset(desc)
         warm.records = build_records(bs * 3, num_slots=shape_slots,
                                      vocab_per_slot=shape_vocab, seed=99,
-                                     avg_keys_per_slot=shape_avg)
+                                     avg_keys_per_slot=shape_avg,
+                                     key_dist=shape_dist)
         warm.columnarize()
         tr.train_pass(warm)
         res = tr.train_pass(ds)

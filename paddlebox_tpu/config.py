@@ -103,6 +103,17 @@ class Flags:
     # scripts/pipeline_check.py is the gate). False = write back inline
     # before end_pass returns (the pre-overlap behavior).
     async_end_pass: bool = True
+    # --- async capacity eviction (ps/tiered._evict_ahead; ISSUE 9) ---
+    # with queued feed-pass stages (the tiered pass pipeline,
+    # train/device_pass.PassPipeline), capacity-pressure eviction for
+    # the NEXT pass runs on the end_pass epilogue lane right after each
+    # write-back lands (clean rows only — release + accounting, no D2H)
+    # so steady-state begin_pass pays only for genuinely-new rows; the
+    # inline eviction in begin_pass remains as the emergency path
+    # (reported as evict_emergency_sec vs evict_async_sec in the bench's
+    # begin_stall_breakdown). False = eviction stays fully inline at
+    # begin_pass (the pre-pipeline behavior).
+    async_capacity_evict: bool = True
 
     # --- pass-boundary scatter (ps/table.scatter_logical_rows) ---
     # fixed chunk size for the begin_pass delta scatter: one compiled

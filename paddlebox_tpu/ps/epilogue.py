@@ -115,6 +115,34 @@ def wait_with_deadline(cv: threading.Condition, done: Callable[[], bool],
             raise PipelineHangError(message())
 
 
+def fence_under_pressure(lock: threading.Lock, fence: Callable[[], None],
+                         pressure: Callable[[], bool]) -> float:
+    """THE fence-outside-the-lock discipline for begin-boundary
+    eviction, shared by the pass-window tables (ps/tiered.py,
+    ps/pass_table.py). Call with ``lock`` HELD. While ``pressure()``
+    holds and the epilogue hasn't been fenced yet: release the lock,
+    ``fence()``, reacquire, re-check — the fence must never run under
+    a lock the epilogue lane itself takes (``_evict_ahead`` takes
+    ``host_lock``; fencing under it would deadlock the pipeline), and
+    re-checking under the SAME lock hold as the following promote
+    means pressure appearing between check and promote (a concurrent
+    plan-assign) re-triggers the fence instead of evicting unfenced.
+    Returns the fence-wait seconds; on return the lock is held again
+    and either pressure() is False or the fence ran."""
+    fence_sec = 0.0
+    fenced = False
+    while not fenced and pressure():
+        lock.release()
+        try:
+            t0 = time.perf_counter()
+            fence()
+            fence_sec += time.perf_counter() - t0
+            fenced = True
+        finally:
+            lock.acquire()
+    return fence_sec
+
+
 class PassEpilogue:
     """Single-lane background worker serializing end-pass write-backs."""
 

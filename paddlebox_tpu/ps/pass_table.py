@@ -37,7 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddlebox_tpu.config import FLAGS
-from paddlebox_tpu.ps.epilogue import PassEpilogue
+from paddlebox_tpu.ps.epilogue import PassEpilogue, fence_under_pressure
 from paddlebox_tpu.ps.host_store import HostStore
 from paddlebox_tpu.ps.kv import make_kv
 from paddlebox_tpu.ps.sgd import SparseSGDConfig
@@ -211,13 +211,19 @@ class PassScopedTable(EmbeddingTable):
             raise RuntimeError("begin_pass with nothing staged")
         self._stage = None
 
-        with self.host_lock:
-            if len(self.index) + len(st.new_keys) > self.capacity:
-                # promote may EVICT under capacity pressure: order the
-                # dirty-evictee write-backs (and released rows' later
-                # re-fetches) after the in-flight epilogue (see the
-                # tiered table's identical fence)
-                self._epilogue.fence()
+        self.host_lock.acquire()
+        try:
+            # promote may EVICT under capacity pressure: order the
+            # dirty-evictee write-backs (and released rows' later
+            # re-fetches) after the in-flight epilogue. The shared
+            # fence-outside-the-lock loop (ps/epilogue.
+            # fence_under_pressure) re-checks under this same lock
+            # hold — a concurrent preload build's bulk assign cannot
+            # create unfenced pressure between check and evict.
+            fence_sec = fence_under_pressure(
+                self.host_lock, self._epilogue.fence,
+                lambda: (len(self.index) + len(st.new_keys)
+                         > self.capacity))
             rows_new, still, stats = promote_window_delta(
                 self.index, self._touched, self.capacity,
                 st.keys, st.new_keys,
@@ -233,7 +239,14 @@ class PassScopedTable(EmbeddingTable):
                 self.state = scatter_logical_rows(
                     self.state, None, rows_new,
                     self._logical_rows(ins_vals))
+        finally:
+            self.host_lock.release()
         stats["written_back"] = 0
+        # begin-boundary eviction attribution (the tiered table's
+        # begin_stall_breakdown keys, single-chip): all inline here —
+        # the emergency path — as this table has no stage queue yet
+        stats["evict_emergency_sec"] = round(
+            fence_sec + stats.pop("evict_sec", 0.0), 6)
         self.in_pass = True
         self.last_pass_stats = stats
         log.info("begin_pass: %d working-set rows (%d resident, %d "

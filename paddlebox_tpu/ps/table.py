@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from typing import Dict, NamedTuple, Optional, Tuple
 
 import jax
@@ -326,7 +327,8 @@ def rows_from_store_fields(vals: Dict[str, np.ndarray], mf_dim: int,
 def promote_window_delta(index, touched: np.ndarray, capacity: int,
                          want_keys: np.ndarray, new_keys: np.ndarray,
                          gather_rows, writeback, on_freed=None,
-                         pending: Optional[np.ndarray] = None):
+                         pending: Optional[np.ndarray] = None,
+                         protect: Optional[np.ndarray] = None):
     """THE shared per-window delta-promotion core (tiered shards and the
     single-chip PassScopedTable — box_wrapper.cc:129-186's incremental
     window, one place): reconcile the staged delta against the live
@@ -342,20 +344,32 @@ def promote_window_delta(index, touched: np.ndarray, capacity: int,
     must NOT apply — their staged values win, and their (plan-baked)
     rows are pinned against eviction.
 
+    ``protect`` lists additional keys PINNED against eviction: with the
+    depth-N pass pipeline (ps/tiered stage queue) several FUTURE passes'
+    working sets may be staged ahead of this begin — evicting a queued
+    pass's resident row would invalidate the missing-split its stage
+    already computed (the capacity contract is the union over open +
+    queued passes; ps/tiered.py module docstring).
+
     Caller holds the host lock and scatters the staged values for the
     returned ``rows_new``. Returns (rows_new, still_missing_mask,
-    stats). ``on_freed(rows)`` hooks per-row host metadata cleanup."""
+    stats) — ``stats["evict_sec"]`` is the wall spent in the eviction
+    block (the begin-boundary's inline/emergency eviction cost; the
+    async lane's eviction is accounted by the table).
+    ``on_freed(rows)`` hooks per-row host metadata cleanup."""
     miss = index.lookup(new_keys) < 0
     still = miss
     if pending is not None and len(pending):
         still = miss | np.isin(new_keys, pending, assume_unique=False)
     ins_keys = new_keys[still]
     stats = dict(resident=len(want_keys) - len(ins_keys),
-                 staged=len(ins_keys), evicted=0, evicted_writeback=0)
+                 staged=len(ins_keys), evicted=0, evicted_writeback=0,
+                 evict_sec=0.0)
     # capacity pressure counts only truly-missing keys: pending keys
     # already own rows, re-assigning them allocates nothing
     overflow = len(index) + int(miss.sum()) - capacity
     if overflow > 0:
+        t0 = time.perf_counter()
         live_keys, live_rows = index.items()
         cand = ~np.isin(live_keys, want_keys)
         if pending is not None and len(pending):
@@ -363,6 +377,8 @@ def promote_window_delta(index, touched: np.ndarray, capacity: int,
             # already encoded in that pass's staged wire — evicting
             # them would hand the rows to other keys
             cand &= ~np.isin(live_keys, pending)
+        if protect is not None and len(protect):
+            cand &= ~np.isin(live_keys, protect)
         ck, cr = live_keys[cand], live_rows[cand]
         t = touched[cr]
         order = np.argsort(t, kind="stable")[:overflow]
@@ -375,6 +391,7 @@ def promote_window_delta(index, touched: np.ndarray, capacity: int,
         if on_freed is not None:
             on_freed(freed)
         stats["evicted"] = len(ck)
+        stats["evict_sec"] = time.perf_counter() - t0
     rows_new = index.assign(ins_keys)
     touched[rows_new] = False  # freshly loaded = clean
     from paddlebox_tpu.obs.hub import get_hub

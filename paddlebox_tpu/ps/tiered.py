@@ -82,10 +82,33 @@ once — plan builds stay serialized in pass order on the preloader
 worker, each bracketed in its own ``plan_scope``, and keys recorded by
 a later pass's plan stay pinned until THAT pass's begin_pass; the
 capacity union extends over every queued pass accordingly.
+
+QUEUED STAGES + ASYNC CAPACITY EVICTION (the tiered pass pipeline,
+ISSUE 9 — train/device_pass.PassPipeline): ``stage(..., queue=True)``
+runs the host fetch on the CALLING thread (the preloader worker) and
+appends the result to a stage QUEUE consumed in pass order by
+``begin_pass`` — with depth N several future passes' stages sit queued
+at once, so the whole begin boundary (plan build, dedup/pack, H2D
+wire, host fetch, SSD promote) rides the persistent worker and the
+boundary itself is reconcile-only. Eviction moves off that boundary
+too: right after each end_pass write-back lands on the epilogue lane
+(the same slot as watermark demotion), ``_evict_ahead`` frees the rows
+the NEXT queued stage will need — candidates are CLEAN by construction
+(the write-back that just landed cleared their touched bits, so the
+host tier already holds their values and eviction is index release +
+accounting, no D2H). Never evicted: the open pass's working set, any
+queued stage's working set, and plan-pending rows (the capacity-union
+contract above). Rows dirtied after the end_pass snapshot are skipped
+and fall to the EMERGENCY inline path in begin_pass (the pre-pipeline
+eviction, with its fence + dirty write-back), reported separately as
+``evict_emergency_sec`` vs ``evict_async_sec`` in the bench's
+``begin_stall_breakdown``. ``FLAGS.async_capacity_evict=False``
+restores fully-inline eviction.
 """
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import threading
 import time
@@ -96,7 +119,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddlebox_tpu.config import FLAGS
-from paddlebox_tpu.ps.epilogue import PassEpilogue
+from paddlebox_tpu.ps.epilogue import PassEpilogue, fence_under_pressure
 from paddlebox_tpu.ps.host_store import HostStore
 from paddlebox_tpu.ps.sharded import ShardedEmbeddingTable
 from paddlebox_tpu.ps.sgd import SparseSGDConfig
@@ -155,6 +178,33 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         self._stage: Optional[_ShardStage] = None
         self._stage_thread: Optional[threading.Thread] = None
         self._stage_exc: Optional[BaseException] = None
+        # QUEUED feed-pass stages (the depth-N pass pipeline,
+        # train/device_pass.PassPipeline): stage(queue=True) appends,
+        # begin_pass consumes in pass order. Guarded by host_lock.
+        self._stage_q: "collections.deque[_ShardStage]" = \
+            collections.deque()
+        # generation counter: discard_queued_stages / drop_window bump
+        # it, so an in-flight queued fetch that straddled the discard
+        # cannot append a zombie stage afterwards (its raise rolls the
+        # build's plan pins back through the PassPipeline bracket)
+        self._stage_gen = 0
+        # the IN-FLIGHT queued stage's per-shard keys: its missing
+        # split is computed before the (lock-free) host fetch, so the
+        # whole working set must be pinned against eviction from that
+        # moment — a key it classified as resident and then lost to
+        # _evict_ahead (or an emergency promote) would never be
+        # re-inserted at its begin_pass. Set/cleared under host_lock.
+        self._staging_keys: Optional[List[np.ndarray]] = None
+        # the last consumed (≈ open) pass's per-shard working set —
+        # pinned against the lane's _evict_ahead; set at stage-queue
+        # pop / begin_pass, cleared at end_pass (all under host_lock)
+        self._open_keys: List[np.ndarray] = [np.empty(0, np.uint64)
+                                             for _ in range(self.n)]
+        # async capacity-eviction accounting (cumulative; the lane
+        # updates under host_lock, begin_pass diffs per pass)
+        self._evict_async_sec = 0.0
+        self._evict_async_rows = 0
+        self._evict_async_mark = (0.0, 0)
         # async pass epilogue (ps/epilogue): end_pass hands the D2H pull
         # + host write-back to this worker; every HostStore read entry
         # point drains it first (read_barrier), so no consumer observes
@@ -262,6 +312,147 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
             log.info("prefetch_promote: %d spilled rows -> host RAM "
                      "(overlapped)", total)
         return total
+
+    # ---- async capacity eviction (ISSUE 9; epilogue-lane slot) -------
+    def pin_working_set(self, pass_keys: np.ndarray) -> None:
+        """Pin a FUTURE pass's working set against eviction BEFORE its
+        plan build starts (PassPipeline does this around build+stage):
+        the build bakes row ids for RESIDENT keys too — not just the
+        plan-pending new ones — so an eviction between the plan's row
+        lookup and the stage() pin would leave the staged wire
+        addressing a stale (possibly reassigned) row. The pin is the
+        same ``_staging_keys`` slot the queued stage fetch uses;
+        ``stage(queue=True)`` for the same keys keeps it, and its
+        completion (or ``unpin_working_set`` on a failed build)
+        releases it — from then on the queued stage itself carries the
+        pin."""
+        per_shard = self._split_by_owner(pass_keys)
+        with self.host_lock:
+            if self._staging_keys is not None:
+                raise RuntimeError(
+                    "a working set is already pinned — pipeline builds "
+                    "serialize on one worker")
+            self._staging_keys = per_shard
+
+    def unpin_working_set(self) -> None:
+        """Release a ``pin_working_set`` pin (idempotent) — the failed-
+        build path; a completed ``stage(queue=True)`` releases it
+        itself."""
+        with self.host_lock:
+            self._staging_keys = None
+
+    def _queued_protect(self, s: int) -> Optional[np.ndarray]:
+        """Shard s's eviction-pinned keys beyond the current want set
+        (caller holds host_lock): the union of every QUEUED stage's
+        working set plus the IN-FLIGHT stage's (_staging_keys) —
+        evicting one would invalidate the missing-split its stage
+        already computed (the capacity contract is the union over
+        open + queued passes). THE single source of the queued-pin
+        rule — _evict_ahead and the inline promote both use it."""
+        arrs = [q.keys[s] for q in self._stage_q if len(q.keys[s])]
+        if self._staging_keys is not None \
+                and len(self._staging_keys[s]):
+            arrs.append(self._staging_keys[s])
+        if not arrs:
+            return None
+        return arrs[0] if len(arrs) == 1 else \
+            np.unique(np.concatenate(arrs))
+
+    def _evict_ahead(self) -> int:
+        """Capacity-pressure eviction for the NEXT queued pass, run ON
+        the epilogue lane right after an end_pass write-back lands (the
+        watermark-demotion slot — strictly ordered after the
+        write-back). Every candidate's latest value is already in the
+        host tier (the write-back that just landed cleared its touched
+        bit), so eviction here is index release + accounting — no D2H
+        gather, no host write rides the lane. Clean rows only; anything
+        dirtied since the snapshot keeps its row and falls to the
+        emergency inline path. Pinned (never evicted): the open pass's
+        working set (``_open_keys``), every queued stage's working set,
+        and plan-pending rows. No-op without queued stages or with
+        ``FLAGS.async_capacity_evict=False``."""
+        if not FLAGS.async_capacity_evict:
+            return 0
+        freed_total = 0
+        with self.host_lock:
+            # timer starts INSIDE the lock: lane lock-wait behind a
+            # main-thread promote is not eviction work
+            t0 = time.perf_counter()
+            if not self._stage_q:
+                return 0
+            head = self._stage_q[0]
+            for s in range(self.n):
+                # rows the head stage will allocate at its begin_pass:
+                # its still-missing keys (pending keys own rows already)
+                need = int((self.indexes[s].lookup(head.new_keys[s])
+                            < 0).sum())
+                overflow = len(self.indexes[s]) + need - self.capacity
+                if overflow <= 0:
+                    continue
+                live_keys, live_rows = self.indexes[s].items()
+                cand = ~self._touched[s][live_rows]   # clean rows only
+                # pins: every queued + in-flight stage's working set
+                # (_queued_protect — the shared rule, head included),
+                # the open pass, and plan-pending rows
+                pin = [self._open_keys[s]]
+                qp = self._queued_protect(s)
+                if qp is not None:
+                    pin.append(qp)
+                pend = self._pending_of(s)
+                if len(pend):
+                    pin.append(pend)
+                pin = [p for p in pin if len(p)]
+                if pin:
+                    cand &= ~np.isin(live_keys, np.concatenate(pin))
+                ck = live_keys[cand][:overflow]
+                if not len(ck):
+                    continue
+                freed = self.indexes[s].release(ck)
+                self._touched[s][freed] = False
+                freed_total += len(ck)
+            self._evict_async_rows += freed_total
+            self._evict_async_sec += time.perf_counter() - t0
+        if freed_total:
+            from paddlebox_tpu.obs.hub import get_hub
+            hub = get_hub()
+            if hub.active:
+                hub.counter(
+                    "pbox_table_evict_async_rows_total",
+                    "rows evicted on the epilogue lane ahead of the "
+                    "next queued pass").inc(freed_total)
+            log.info("evict_ahead: %d clean rows released on the "
+                     "epilogue lane for the next queued pass",
+                     freed_total)
+        return freed_total
+
+    def discard_queued_stages(self) -> int:
+        """Drop every queued feed-pass stage (pipeline shutdown — e.g.
+        PassPipeline.drain when queued passes will never begin).
+        Releases the plan-pending rows those stages' builds assigned
+        (the _rollback_plan rule: untrained rows only — a row whose
+        updates await write-back follows the normal resident rules) so
+        abandoned stages never pin window capacity. Returns the number
+        of stages discarded."""
+        with self.host_lock:
+            n = len(self._stage_q)
+            for q in self._stage_q:
+                for s in range(self.n):
+                    pend = self._pending_of(s)
+                    if not len(pend):
+                        continue
+                    ks = q.keys[s][np.isin(q.keys[s], pend)]
+                    if not len(ks):
+                        continue
+                    rows = self.indexes[s].lookup(ks)
+                    ok = rows >= 0
+                    ks_ok, rows_ok = ks[ok], rows[ok]
+                    untouched = ~self._touched[s][rows_ok]
+                    if untouched.any():
+                        self.indexes[s].release(ks_ok[untouched])
+                    self._unpin_pending(s, ks)
+            self._stage_q.clear()
+            self._stage_gen += 1   # reject straddling in-flight fetches
+        return n
 
     def _demote_after_writeback(self) -> None:
         """Watermark demotion + compaction, run ON the epilogue lane
@@ -410,15 +601,36 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         multihost table returns None for shards it does not own."""
         return self.hosts[s].fetch(new_keys)
 
-    def stage(self, pass_keys: np.ndarray, background: bool = True) -> None:
+    def stage(self, pass_keys: np.ndarray, background: bool = True,
+              queue: bool = False) -> None:
         """Fetch host values for the pass keys NOT already resident in
         the HBM window. Legal while a pass is open (the overlapped
         pre_build_thread, ps_gpu_wrapper.cc:913): missing keys are
         outside the open window, so the open pass's end_pass write-back
         cannot touch them; any key that becomes resident between stage
-        and begin_pass has its fetched value dropped by the reconcile."""
+        and begin_pass has its fetched value dropped by the reconcile.
+
+        ``queue=True`` (the depth-N pass pipeline): the fetch runs on
+        the CALLING thread (the preloader worker — already background
+        to training) and the completed stage is APPENDED to a queue
+        that ``begin_pass`` consumes in pass order, so several future
+        passes can sit staged at once. The capacity contract extends
+        to the union over open + queued passes; queued working sets
+        are pinned against eviction until their own begin_pass. A
+        fetch failure queues nothing (the caller — the preload worker
+        — holds and re-raises it at the consuming ``wait()``)."""
+        if queue and background:
+            raise ValueError("queued stages fetch on the calling thread "
+                             "(background staging is the single-slot "
+                             "protocol)")
         if self._stage_thread is not None or self._stage is not None:
             raise RuntimeError("a feed pass is already staging")
+        if self._stage_q and not queue:
+            raise RuntimeError(
+                "queued feed-pass stages are pending — single-slot "
+                "stage() cannot interleave with the stage queue "
+                "(consume the queue via begin_pass, or "
+                "discard_queued_stages())")
         per_shard = self._split_by_owner(pass_keys)
         for s, ks in enumerate(per_shard):
             if len(ks) > self.capacity:
@@ -426,6 +638,16 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
                     f"shard {s} working set ({len(ks)}) exceeds "
                     f"capacity_per_shard ({self.capacity})")
         with self.host_lock:
+            if queue and self._staging_keys is not None \
+                    and not all(np.array_equal(a, b) for a, b in
+                                zip(self._staging_keys, per_shard)):
+                # a pre-build pin_working_set for THIS pass is fine
+                # (PassPipeline pins before the plan build); a
+                # different in-flight stage is a protocol violation
+                raise RuntimeError(
+                    "a different queued feed-pass stage is already "
+                    "pinned/fetching — queued stages serialize on one "
+                    "worker")
             # "missing" includes PENDING plan rows: they sit in the
             # index but hold zero values, so their host values must
             # still fetch (begin_pass scatters them at the reconcile)
@@ -437,6 +659,28 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
                 if len(pend):
                     miss |= np.isin(ks, pend)
                 new.append(ks[miss])
+            if queue:
+                # pin the working set for the whole fetch: the missing
+                # split above is only valid while no eviction touches
+                # these keys (see _staging_keys)
+                self._staging_keys = per_shard
+                gen = self._stage_gen
+        if queue:
+            try:
+                vals = [self._fetch_stage_values(s, new[s])
+                        for s in range(self.n)]
+                with self.host_lock:
+                    if self._stage_gen != gen:
+                        raise RuntimeError(
+                            "the stage queue was discarded while this "
+                            "feed-pass fetch was in flight — the pass "
+                            "will never begin")
+                    self._stage_q.append(
+                        _ShardStage(per_shard, new, vals))
+            finally:
+                with self.host_lock:
+                    self._staging_keys = None
+            return
         self._stage_exc = None
 
         def run() -> None:
@@ -465,12 +709,32 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
 
     # ---- pass window (BuildGPUTask/EndPass, ps_gpu_wrapper.cc:684,983) --
     def _resolve_stage(self, pass_keys: Optional[np.ndarray]) -> _ShardStage:
-        """Shared begin_pass prologue: consume the pending stage (after
+        """Shared begin_pass prologue: consume the HEAD of the stage
+        queue (pipeline mode), the pending single-slot stage (after
         validating its keys against ``pass_keys``), or stage
         synchronously."""
         if self.in_pass:
             raise RuntimeError("begin_pass while a pass is open")
         t0 = time.perf_counter()
+        with self.host_lock:
+            if self._stage_q:
+                st = self._stage_q.popleft()
+                if pass_keys is not None:
+                    want = self._split_by_owner(pass_keys)
+                    if not all(np.array_equal(a, b) for a, b in
+                               zip(st.keys, want)):
+                        self._stage_q.appendleft(st)
+                        raise RuntimeError(
+                            "begin_pass keys differ from the HEAD "
+                            "queued stage — the pipeline consumes "
+                            "stages strictly in pass order")
+                # the consumed pass's working set is pinned against the
+                # lane's _evict_ahead from this moment (atomically with
+                # the pop, so the lane can never see it unprotected)
+                self._open_keys = st.keys
+                st.from_queue = True  # begin_pass restores it on failure
+                self._last_stage_wait_sec = time.perf_counter() - t0
+                return st
         if pass_keys is not None:
             if self._stage_thread is not None or self._stage is not None:
                 self.wait_stage_done()
@@ -510,17 +774,25 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         row_l: List[np.ndarray] = []
         val_l: List[np.ndarray] = []
         total = 0
+        fence_sec = 0.0
         t_evict0 = time.perf_counter()
-        with self.host_lock:
-            if any(len(self.indexes[s]) + len(st.new_keys[s])
-                   > self.capacity for s in range(self.n)):
-                # capacity pressure → promote may EVICT: a dirty
-                # evictee's write-back and pass N's in-flight epilogue
-                # write-back could reorder on the host store, and a
-                # released row's stale host value must be fully landed
-                # before a later stage re-fetches it — fence first
-                # (the common non-evicting boundary stays fence-free)
-                self._epilogue.fence()
+        self.host_lock.acquire()
+        try:
+            # capacity pressure → promote may EVICT: a dirty evictee's
+            # write-back and pass N's in-flight epilogue write-back
+            # could reorder on the host store, and a released row's
+            # stale host value must be fully landed before a later
+            # stage re-fetches it — fence first (the common
+            # non-evicting boundary stays fence-free). The shared
+            # fence-outside-the-lock loop (ps/epilogue.
+            # fence_under_pressure) re-checks under this same lock
+            # hold. With the async lane eviction this is the EMERGENCY
+            # path — the lane usually freed the rows already.
+            fence_sec = fence_under_pressure(
+                self.host_lock, self._epilogue.fence,
+                lambda: any(len(self.indexes[s]) + len(st.new_keys[s])
+                            > self.capacity for s in range(self.n)))
+            self._open_keys = st.keys
             for s in range(self.n):
                 rows_new, still, st_s = promote_window_delta(
                     self.indexes[s], self._touched[s], self.capacity,
@@ -529,7 +801,8 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
                         s, rs),
                     writeback=lambda ks, rs, sub, s=s:
                         self.hosts[s].update_rows(ks, sub),
-                    pending=self._pending_of(s))
+                    pending=self._pending_of(s),
+                    protect=self._queued_protect(s))
                 # pending keys promoted by THIS pass leave the pending
                 # set; keys a concurrent plan build (the pass after
                 # next) recorded stay pinned until their own begin
@@ -539,23 +812,49 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
                 row_l.append(rows_new)
                 val_l.append(self._logical_rows(ins_vals))
                 for k in st_s:
-                    stats[k] += st_s[k]
+                    stats[k] = stats.get(k, 0) + st_s[k]
                 total += len(st.keys[s])
             rows = np.concatenate(row_l) if row_l else np.empty(0, np.int32)
             if len(rows):
                 self.state = scatter_logical_rows(
                     self.state, np.concatenate(sh_l), rows,
                     np.concatenate(val_l))
+            ev_sec, ev_rows = self._evict_async_sec, self._evict_async_rows
+        except BaseException:
+            # a begin that fails AFTER consuming a queued stage must
+            # not strand the pipeline's bookkeeping: restore the stage
+            # to the queue head (its pins release via drain/
+            # discard_queued_stages, and the driver's key queue stays
+            # aligned) and drop the open-pass pin. NOTE: promote may
+            # have partially applied before the raise — the restored
+            # stage exists for clean shutdown/diagnosis, not blind
+            # retry.
+            if getattr(st, "from_queue", False):
+                self._stage_q.appendleft(st)
+            self._open_keys = [np.empty(0, np.uint64)
+                               for _ in range(self.n)]
+            raise
+        finally:
+            self.host_lock.release()
         self.in_pass = True
         # begin_stall breakdown (bench tiered mode): stage wait on the
         # critical path, evict+scatter time, and the SSD promote
         # seconds this pass's staging incurred (with its critical-path
         # share — overlapped promotes show promote_sec > 0 with
-        # promote_wait_sec ~ 0)
+        # promote_wait_sec ~ 0). Eviction attribution splits into the
+        # lane's overlapped work since the previous begin
+        # (evict_async_*) and the inline emergency remainder
+        # (evict_emergency_sec = fence wait + promote eviction wall).
         stats["stage_wait_sec"] = round(
             getattr(self, "_last_stage_wait_sec", 0.0), 6)
         stats["evict_scatter_sec"] = round(
             time.perf_counter() - t_evict0, 6)
+        stats["evict_emergency_sec"] = round(
+            fence_sec + stats.pop("evict_sec", 0.0), 6)
+        mark_sec, mark_rows = self._evict_async_mark
+        self._evict_async_mark = (ev_sec, ev_rows)
+        stats["evict_async_sec"] = round(ev_sec - mark_sec, 6)
+        stats["evict_async_rows"] = int(ev_rows - mark_rows)
         ssd1 = self.ssd_stats()
         self._ssd_mark = ssd1
         for k, ok in (("promote_sec", "ssd_promote_sec"),
@@ -587,6 +886,7 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
             raise RuntimeError("end_pass without begin_pass")
         total = 0
         t0 = time.perf_counter()
+        t_dispatch = 0.0
         jobs: List[tuple] = []
         with self.host_lock:
             for s in range(self.n):
@@ -598,8 +898,10 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
                     # buffers are immutable and the dispatch pins them,
                     # so a later jit step donating the (possibly same)
                     # live table buffer cannot invalidate this read
-                    jobs.append((s, keys, dispatch_packed_row_gather(
-                        self.state, s, rows)))
+                    t_d = time.perf_counter()
+                    dev = dispatch_packed_row_gather(self.state, s, rows)
+                    t_dispatch += time.perf_counter() - t_d
+                    jobs.append((s, keys, dev))
                     self._touched[s][rows] = False
                     # a PENDING key that trained anyway (a key outside
                     # its pass's staged set) is being written back — the
@@ -607,12 +909,17 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
                     # resident-is-fresher reconcile may resume for it
                     self._unpin_pending(s, keys)
                 total += len(rows)
+            # nothing is open between passes: the closed pass's set no
+            # longer pins the lane's _evict_ahead (its un-shared rows
+            # are exactly the right victims for the next queued pass)
+            self._open_keys = [np.empty(0, np.uint64)
+                               for _ in range(self.n)]
         self.in_pass = False
         self.last_pass_stats["written_back"] = total
 
         tiered_ssd = any(h is not None and h.ssd is not None
                          for h in self.hosts)
-        if jobs or tiered_ssd:
+        if jobs or tiered_ssd or self._stage_q:
             def run(jobs=jobs) -> None:
                 for s, keys, (sub_dev, k) in jobs:
                     # chaos seam: a mid-write-back failure must surface
@@ -621,6 +928,12 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
                                   shard=s, rows=len(keys))
                     sub = np.asarray(jax.device_get(sub_dev))[:k]
                     self.hosts[s].update_rows(keys, sub)
+                # async capacity eviction rides the SAME job, strictly
+                # AFTER this pass's rows landed (their touched bits just
+                # cleared, so candidates are clean and eviction is pure
+                # index release): free the rows the next queued pass
+                # will need so its begin_pass pays no inline eviction
+                self._evict_ahead()
                 # watermark demotion rides the SAME job: strictly after
                 # this pass's rows landed and are marked touched —
                 # selection is untouched-first, so a row whose write-back
@@ -633,8 +946,13 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
                 self._epilogue.submit(run, label="end_pass")
             else:
                 run()
+        # submit-time parity audit (ISSUE 9): the ONLY synchronous
+        # portion is touched-row snapshot + bucketed D2H dispatch —
+        # split out so a regressed boundary names which half grew
         self.last_pass_stats["end_pass_submit_sec"] = round(
             time.perf_counter() - t0, 6)
+        self.last_pass_stats["end_pass_dispatch_sec"] = round(
+            t_dispatch, 6)
         log.info("end_pass: %d touched rows -> %d host stores (%s)",
                  total, self.n,
                  "async" if FLAGS.async_end_pass else "sync")
@@ -664,6 +982,13 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
             # pre-mutation rows resident, shadowing the host tier
             self._stage = None
             with self.host_lock:
+                # queued stages predate the mutation too — their
+                # fetched values and missing-splits are stale (the gen
+                # bump also rejects any fetch still in flight)
+                self._stage_q.clear()
+                self._stage_gen += 1
+                self._open_keys = [np.empty(0, np.uint64)
+                                   for _ in range(self.n)]
                 self.indexes = [HostKV(self.capacity)
                                 for _ in range(self.n)]
                 self._touched[:] = False

@@ -133,44 +133,76 @@ class MultihostTieredShardedTable(TieredShardedEmbeddingTable):
         new_shards: Dict[int, jax.Array] = {}
         rpl, fp, nl = pack_geometry(self.capacity, self.state._feat)
         feat = self.state._feat
-        with self.host_lock:
-            addr = self._addressable()
-            for s in range(self.n):
-                owned = s in self.owned
+        try:
+            with self.host_lock:
+                self._open_keys = st.keys
+                addr = self._addressable()
+                for s in range(self.n):
+                    owned = s in self.owned
 
-                def gather(rows, s=s, owned=owned):
-                    return (self._gather_local_rows(s, rows)
-                            if owned else None)
+                    def gather(rows, s=s, owned=owned):
+                        return (self._gather_local_rows(s, rows)
+                                if owned else None)
 
-                def writeback(ks, rs, sub, s=s, owned=owned):
-                    if owned:
-                        self.hosts[s].update(ks, self._store_fields(sub))
+                    def writeback(ks, rs, sub, s=s, owned=owned):
+                        if owned:
+                            self.hosts[s].update(
+                                ks, self._store_fields(sub))
 
-                rows_new, still, st_s = promote_window_delta(
-                    self.indexes[s], self._touched[s], self.capacity,
-                    st.keys[s], st.new_keys[s],
-                    gather_rows=gather, writeback=writeback,
-                    pending=self._pending_of(s))
-                # pending keys promoted by THIS pass leave the pending
-                # set (same bookkeeping as the single-controller table;
-                # identical on every process per the SPMD host contract)
-                self._unpin_pending(s, st.keys[s])
-                for k in st_s:
-                    stats[k] += st_s[k]
-                total += len(st.keys[s])
-                if owned and len(rows_new):
-                    vals = self._logical_rows(
-                        {f: v[still] for f, v in st.values[s].items()})
-                    data = addr[s].data           # [1, L, 128] on-device
-                    flat = data.reshape(nl * rpl, fp)
-                    flat = flat.at[
-                        jnp.asarray(np.ascontiguousarray(rows_new,
-                                                         np.int32)),
-                        :feat].set(jnp.asarray(vals))
-                    new_shards[s] = flat.reshape(data.shape)
-            if new_shards:
-                self._reassemble(new_shards)
+                    rows_new, still, st_s = promote_window_delta(
+                        self.indexes[s], self._touched[s], self.capacity,
+                        st.keys[s], st.new_keys[s],
+                        gather_rows=gather, writeback=writeback,
+                        pending=self._pending_of(s),
+                        protect=self._queued_protect(s))
+                    # pending keys promoted by THIS pass leave the
+                    # pending set (same bookkeeping as the single-
+                    # controller table; identical on every process per
+                    # the SPMD host contract)
+                    self._unpin_pending(s, st.keys[s])
+                    for k in st_s:
+                        stats[k] = stats.get(k, 0) + st_s[k]
+                    total += len(st.keys[s])
+                    if owned and len(rows_new):
+                        vals = self._logical_rows(
+                            {f: v[still]
+                             for f, v in st.values[s].items()})
+                        data = addr[s].data       # [1, L, 128] on-device
+                        flat = data.reshape(nl * rpl, fp)
+                        flat = flat.at[
+                            jnp.asarray(np.ascontiguousarray(rows_new,
+                                                             np.int32)),
+                            :feat].set(jnp.asarray(vals))
+                        new_shards[s] = flat.reshape(data.shape)
+                if new_shards:
+                    self._reassemble(new_shards)
+                ev_sec, ev_rows = (self._evict_async_sec,
+                                   self._evict_async_rows)
+        except BaseException:
+            # the base class's restore contract (PassPipeline relies on
+            # it): a begin that fails after consuming a queued stage
+            # puts the stage back at the head and drops the open pin —
+            # drain/discard can still release every plan-pending pin
+            with self.host_lock:
+                if getattr(st, "from_queue", False):
+                    self._stage_q.appendleft(st)
+                self._open_keys = [np.empty(0, np.uint64)
+                                   for _ in range(self.n)]
+            raise
         self.in_pass = True
+        # the single-controller table's eviction attribution keys, so
+        # telemetry consumers (BEGIN_STALL_COLS) see one schema: inline
+        # promote eviction is the emergency path here too, and the
+        # ahead-of-time eviction (inline in this class's end_pass, but
+        # the same accounting) diffs off the cumulative marks
+        stats["stage_wait_sec"] = round(
+            getattr(self, "_last_stage_wait_sec", 0.0), 6)
+        stats["evict_emergency_sec"] = round(
+            stats.pop("evict_sec", 0.0), 6)
+        mark_sec, mark_rows = self._evict_async_mark
+        self._evict_async_mark = (ev_sec, ev_rows)
+        stats["evict_async_sec"] = round(ev_sec - mark_sec, 6)
+        stats["evict_async_rows"] = int(ev_rows - mark_rows)
         self.last_pass_stats = stats
         log.info("begin_pass (mh, %d owned shards): %d rows (%d resident "
                  "%d staged %d evicted)", len(self.owned), total,
@@ -200,8 +232,15 @@ class MultihostTieredShardedTable(TieredShardedEmbeddingTable):
                 # again (see TieredShardedEmbeddingTable.end_pass)
                 self._unpin_pending(s, keys)
                 total += len(rows)
+            self._open_keys = [np.empty(0, np.uint64)
+                               for _ in range(self.n)]
         self.in_pass = False
         self.last_pass_stats["written_back"] = total
+        # async capacity eviction, INLINE here (end_pass is collective
+        # and synchronous on the pod): the index/_touched bookkeeping
+        # is replicated and the selection deterministic, so every
+        # process frees the identical rows for the next queued pass
+        self._evict_ahead()
         # per-node SSD tier: watermark demotion after the (synchronous)
         # write-back — owned shards only; host-local bookkeeping, so no
         # collective coordination is needed (each AIBox node manages its
@@ -217,6 +256,10 @@ class MultihostTieredShardedTable(TieredShardedEmbeddingTable):
         finally:
             self._stage = None
             with self.host_lock:
+                self._stage_q.clear()
+                self._stage_gen += 1
+                self._open_keys = [np.empty(0, np.uint64)
+                                   for _ in range(self.n)]
                 self.indexes = [HostKV(self.capacity)
                                 for _ in range(self.n)]
                 self._touched[:] = False

@@ -3,7 +3,8 @@ from paddlebox_tpu.train.trainer import Trainer
 from paddlebox_tpu.train.dense_modes import (AsyncDenseTable, KStepParamSync,
                                              build_lr_scales,
                                              lr_map_transform)
-from paddlebox_tpu.train.device_pass import (PassPreloader,
+from paddlebox_tpu.train.device_pass import (PassPipeline,
+                                             PassPreloader,
                                              PreloadBuildAborted,
                                              ResidentPass,
                                              ResidentPassRunner)
@@ -16,7 +17,8 @@ from paddlebox_tpu.train.multi_mf_sharded import MultiMfShardedTrainer
 __all__ = ["TrainStep", "DeviceBatch", "make_device_batch", "Trainer",
            "AsyncDenseTable", "KStepParamSync", "build_lr_scales",
            "lr_map_transform",
-           "PassPreloader", "PreloadBuildAborted", "ResidentPass",
+           "PassPipeline", "PassPreloader", "PreloadBuildAborted",
+           "ResidentPass",
            "ResidentPassRunner",
            "CheckpointManager", "MultiMfTrainStep", "MultiMfTrainer",
            "ShardedTrainer", "MultiMfShardedTrainer"]

@@ -1510,3 +1510,208 @@ class PassPreloader:
         from paddlebox_tpu.obs.hub import get_hub
         hub = get_hub()
         return hub if hub.active else None
+
+
+class PassPipeline:
+    """ONE pass-pipeline abstraction — build → stage → consume →
+    epilogue — shared by resident and tiered modes (ISSUE 9; ROADMAP's
+    cross-cutting unification).
+
+    Every pass mode decomposes into the same four phases:
+
+      build    host pack of the pass (routing plans / dedup / wire
+               encode) — ``build_fn`` (e.g. ``ResidentPass.build_streamed``
+               or ``ShardedTrainer.build_resident_pass``)
+      stage    moving the pass's bytes to where training reads them:
+               the chunked H2D wire upload, plus — for pass-WINDOW
+               tables — the host-tier feed-pass fetch (``table.stage``)
+      consume  ``begin_pass`` reconcile (window tables) + the resident
+               train loop over the staged pass
+      epilogue ``end_pass`` write-back on the PassEpilogue lane, which
+               also carries async capacity eviction and SSD watermark
+               demotion (ps/tiered.py, ps/epilogue.py)
+
+    For a plain resident table (``window_table=None``) this is exactly
+    the depth-N ``PassPreloader``: build+stage ride the persistent
+    worker, consume is the training loop, the epilogue is empty. For a
+    pass-window table (``TieredShardedEmbeddingTable`` /
+    ``MultihostTieredShardedTable``) each build is followed ON THE
+    WORKER by the host-tier stage fetch, QUEUED in pass order
+    (``table.stage(queue=True)``) — so by the time ``wait()`` hands a
+    pass out, its plan is baked (plan_scope pending rows), its wire is
+    in HBM, its host values are fetched, and its spilled rows are
+    promoted (``prefetch_promote`` inside the build): ``begin_pass()``
+    is reconcile-only, and ``end_pass()`` submits a write-back whose
+    lane slot also evicts ahead for the NEXT queued stage
+    (``_evict_ahead``). Plan builds stay serialized per ``plan_scope``
+    on the single worker; the window capacity contract is the union
+    over the open pass and every queued pass (ps/tiered.py module
+    docstring).
+
+    Driver shape (the bench / trainers):
+
+        pipe = PassPipeline(datasets, build_fn=tr.build_resident_pass,
+                            window_table=table, trainer=tr)
+        pipe.start_next()
+        while (rp := pipe.wait()) is not None:
+            pipe.begin_pass()                  # reconcile-only
+            pipe.start_next()
+            tr.train_pass_resident(rp)
+            pipe.end_pass()                    # submit; lane drains
+        pipe.drain()
+
+    ``depth=0`` gives the manual kick-per-pass sequential control (the
+    no-overlap oracle for the pipeline gates)."""
+
+    def __init__(self, datasets: Iterator, build_fn,
+                 window_table=None, trainer=None,
+                 depth: Optional[int] = None,
+                 keys_of=None) -> None:
+        import contextlib
+        self.table = window_table
+        self.trainer = trainer
+        self._keys_of = keys_of or (lambda ds: ds.pass_keys())
+        # key sets of built-and-staged passes, in build order — consumed
+        # by begin_pass() to validate the head queued stage
+        self._key_q: collections.deque = collections.deque()
+        self._lock = threading.Lock()
+        if window_table is None:
+            build = build_fn
+        else:
+            def build(ds):
+                keys = self._keys_of(ds)
+                scope = getattr(window_table, "plan_scope", None)
+                cm = (scope() if scope is not None
+                      else contextlib.nullcontext())
+                pin = getattr(window_table, "pin_working_set", None)
+                # the OUTER plan_scope brackets build AND stage: an
+                # abort (preemption/stop) or fetch failure between them
+                # rolls the pass's pending plan rows back — a dead
+                # build must not pin window capacity (the
+                # rollback-under-abort contract,
+                # tests/test_tiered_sharded.py)
+                with cm:
+                    # pin the working set for the WHOLE build+stage
+                    # span: the plan bakes row ids for resident keys
+                    # too, so eviction must not touch them from the
+                    # first row lookup on (the pin hands over to the
+                    # queued stage when stage() completes)
+                    if pin is not None:
+                        pin(keys)
+                    try:
+                        t0 = time.perf_counter()
+                        rp = build_fn(ds)
+                        t_build = time.perf_counter() - t0
+                        poll_preload_abort()
+                        # host fetch ON this worker, queued in pass
+                        # order — by the time wait() hands the pass out
+                        # its stage is complete and begin_pass is
+                        # reconcile-only
+                        t0 = time.perf_counter()
+                        window_table.stage(keys, background=False,
+                                           queue=True)
+                        t_stage = time.perf_counter() - t0
+                    except BaseException:
+                        if pin is not None:
+                            window_table.unpin_working_set()
+                        raise
+                # per-stage worker seconds for the preloader's
+                # build_stage_sec mirror (builders that already report
+                # stages — build_streamed — keep their finer split)
+                stats = dict(getattr(rp, "build_stats", None) or {})
+                stats.setdefault("build", t_build)
+                stats["stage_fetch"] = t_stage
+                try:
+                    rp.build_stats = stats
+                except AttributeError:
+                    pass  # slotted pass objects skip the attribution
+                with self._lock:
+                    self._key_q.append(keys)
+                return rp
+        self.pre = PassPreloader(iter(datasets), build_fn=build,
+                                 depth=depth)
+
+    # ---- prologue (build + stage on the worker) ----------------------
+    def start_next(self) -> bool:
+        return self.pre.start_next()
+
+    def wait(self):
+        """Next staged pass (build + H2D wire + host fetch complete),
+        or None at end-of-stream; the blocked seconds are the
+        pipeline's prologue stall (PassPreloader.wait)."""
+        return self.pre.wait()
+
+    # ---- consume / epilogue (pass-window tables) ---------------------
+    def begin_pass(self) -> int:
+        """Consume the head queued stage: reconcile the staged working
+        set into the HBM window (steady state: no fetch wait, no inline
+        eviction — both already rode background lanes) and point the
+        trainer's jit state at it."""
+        if self.table is None:
+            return 0
+        with self._lock:
+            if not self._key_q:
+                raise RuntimeError("begin_pass with no staged pass — "
+                                   "call wait() first")
+            keys = self._key_q[0]
+        # pop only AFTER the table accepted the pass: a raising
+        # begin_pass leaves both queues ALIGNED — the table restores a
+        # consumed stage to its queue head on failure (ps/tiered), so
+        # drain() still releases every pin and the error surfaces
+        # consistently (a partially-promoted pass must not be blindly
+        # retried; see the table-side note)
+        n = self.table.begin_pass(keys)
+        with self._lock:
+            if self._key_q and self._key_q[0] is keys:
+                self._key_q.popleft()
+        if self.trainer is not None:
+            self.trainer.adopt_table()
+        return n
+
+    def end_pass(self) -> int:
+        """Close the open pass: write-back submits to the epilogue lane
+        (async), which also runs the next queued stage's capacity
+        eviction and any SSD watermark demotion."""
+        if self.table is None:
+            return 0
+        if self.trainer is not None:
+            self.trainer.sync_table()
+        return self.table.end_pass()
+
+    # ---- shutdown ----------------------------------------------------
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Stop building, join the worker, settle in-flight transfers,
+        and DISCARD queued stages that will never begin (releasing
+        their plan-pending pins — ps/tiered.discard_queued_stages)."""
+        self.pre.drain(timeout)
+        if self.table is not None:
+            discard = getattr(self.table, "discard_queued_stages", None)
+            if discard is not None:
+                discard()
+        with self._lock:
+            self._key_q.clear()
+
+    # ---- accounting pass-throughs (bench / telemetry) ----------------
+    @property
+    def depth(self) -> int:
+        return self.pre.depth
+
+    @property
+    def builds(self) -> int:
+        return self.pre.builds
+
+    @property
+    def build_sec_total(self) -> float:
+        return self.pre.build_sec_total
+
+    @property
+    def wait_sec_total(self) -> float:
+        return self.pre.wait_sec_total
+
+    @property
+    def build_stage_sec(self) -> Dict[str, float]:
+        return self.pre.build_stage_sec
+
+    @property
+    def depth_clamped(self) -> bool:
+        return self.pre.depth_clamped

@@ -980,6 +980,49 @@ class ShardedTrainer:
             pf(dataset.pass_keys())
         return rp
 
+    def tiered_pass_pipeline(self, datasets,
+                             depth: "Optional[int]" = None):
+        """The tiered pass pipeline (ISSUE 9): a
+        ``train/device_pass.PassPipeline`` wired for this trainer's
+        pass-window table — builds (plan_scope + prefetch_promote), the
+        H2D wire and the host-tier feed-pass fetch all ride the
+        depth-N preloader worker, begin_pass is reconcile-only, and
+        end_pass's epilogue lane carries async capacity eviction.
+        ``depth=0`` = the sequential kick-per-pass control."""
+        from paddlebox_tpu.train.device_pass import PassPipeline
+        return PassPipeline(iter(datasets),
+                            build_fn=self.build_resident_pass,
+                            window_table=self.table, trainer=self,
+                            depth=depth)
+
+    def train_passes_tiered(self, datasets, depth: "Optional[int]" = None,
+                            log_prefix: str = "") -> list:
+        """Drive tiered resident passes end to end through the unified
+        pipeline: one call per dataset list, returns the per-pass
+        result dicts (the tiered twin of
+        Trainer.train_passes_resident)."""
+        pipe = self.tiered_pass_pipeline(datasets, depth=depth)
+        pipe.start_next()
+        sequential = depth == 0   # the no-overlap kick-per-pass control
+        results = []
+        try:
+            while True:
+                rp = pipe.wait()
+                if rp is None:
+                    break
+                pipe.begin_pass()
+                if not sequential:
+                    pipe.start_next()
+                results.append(self.train_pass_resident(
+                    rp, log_prefix=log_prefix))
+                pipe.end_pass()
+                if sequential:
+                    # the next build+stage only AFTER this pass closed
+                    pipe.start_next()
+        finally:
+            pipe.drain()
+        return results
+
     def _feed_registry_resident(self, rp, preds) -> None:
         """Post-pass metric registry replay (the per-batch AddAucMonitor
         hook, boxps_worker.cc:1267,1337) from predictions collected

@@ -53,6 +53,32 @@ def _chan_blocked(ch: Dict[str, dict]) -> Dict[str, float]:
             for name, st in ch.items()}
 
 
+#: tiered per-pass begin_stall attribution (ps/tiered.begin_pass →
+#: last_pass_stats, riding every pass event as table.last_pass):
+#: column label → stats key. Seconds render only when non-zero so
+#: resident rows stay compact.
+BEGIN_STALL_COLS = (
+    ("stage", "stage_wait_sec"),
+    ("evS", "evict_scatter_sec"),
+    ("evA", "evict_async_sec"),
+    ("evE", "evict_emergency_sec"),
+    ("ssdW", "ssd_promote_wait_sec"),
+)
+
+
+def _begin_stall_cell(lp: Dict) -> str:
+    """Render a pass event's begin_stall breakdown (tiered runs) —
+    the per-stage boundary attribution without jq archaeology."""
+    if not lp or "stage_wait_sec" not in lp:
+        return ""
+    bits = [f"{label}={lp[key]:.3f}s" for label, key in BEGIN_STALL_COLS
+            if float(lp.get(key, 0.0) or 0.0) > 5e-4]
+    rows = int(lp.get("evict_async_rows", 0) or 0)
+    if rows:
+        bits.append(f"evA_rows={rows}")
+    return " ".join(bits) or "~0"
+
+
 def build_rows(events: List[dict]) -> List[Dict[str, str]]:
     """Pass events → printable row dicts (the unit tests call this)."""
     rows = []
@@ -71,6 +97,7 @@ def build_rows(events: List[dict]) -> List[Dict[str, str]]:
             stall = f"{max(delta, 0.0):.3f}s (depth {int(depth)})"
             prev_blocked[proc] = cur
         tbl = ""
+        begin_stall = ""
         if "table" in ev:
             t = ev["table"]
             if "used" in t and "capacity" in t:
@@ -79,6 +106,9 @@ def build_rows(events: List[dict]) -> List[Dict[str, str]]:
             if lp:
                 tbl += (f" (+{lp.get('staged', 0)} staged,"
                         f" -{lp.get('evicted', 0)} evicted)")
+                # tiered begin_stall attribution (ISSUE 9): the
+                # boundary's per-stage seconds as their own column
+                begin_stall = _begin_stall_cell(lp)
             eps = t.get("endpass")
             if eps and eps.get("jobs_run"):
                 # async epilogue (docs/PERFORMANCE.md): cumulative
@@ -100,6 +130,7 @@ def build_rows(events: List[dict]) -> List[Dict[str, str]]:
             "stages": _stage_cell(ev.get("stage_sec", {})),
             "queue stall": stall or "-",
             "table": tbl or "-",
+            "begin stall": begin_stall or "-",
             "hbm peak": _fmt_bytes(hbm.get("peak_bytes_in_use", 0)),
         })
     return rows

@@ -10,7 +10,8 @@ script runs bigger variants; these are the fast non-slow gates."""
 import numpy as np
 
 from scripts.pipeline_check import (host_tier_digest, run_check,
-                                    run_prologue_check)
+                                    run_prologue_check,
+                                    run_tiered_prologue_check)
 
 
 def test_pipeline_check_gate():
@@ -30,6 +31,21 @@ def test_prologue_gate():
                              real_passes=3, real_records=128)
     assert out["ok"]
     assert out["wait_drop_frac"] >= 0.5
+    assert out["digest"]
+
+
+def test_tiered_prologue_gate():
+    """ISSUE 9: the depth-2 tiered pass pipeline (queued stages on the
+    preloader worker + async capacity eviction) reproduces the
+    sequential oracle's host-tier digest bit-for-bit across 2 seeded
+    runs, and the steady-state begin_delta boundary stall drops ≥50%
+    vs the no-overlap control."""
+    out = run_tiered_prologue_check(passes=4, keys_per_pass=256,
+                                    capacity_per_shard=512,
+                                    build_delay=0.04, train_sec=0.08)
+    assert out["ok"]
+    assert out["stall_drop_frac"] >= 0.5
+    assert out["runs"] >= 4          # ≥2 seeded pipeline runs agreed
     assert out["digest"]
 
 

@@ -3,6 +3,8 @@ HBM shard on the 8-device CPU mesh — capacity beyond HBM composed with
 the mesh trainer (BuildPull/BuildGPUTask/EndPass, ps_gpu_wrapper.cc:337,
 684,983; LoadSSD2Mem, box_wrapper.cc:1415)."""
 
+import time
+
 import numpy as np
 import jax
 import optax
@@ -956,3 +958,460 @@ def test_ssd_touched_bit_preserves_delta(tmp_path):
     # the full export still carries the (now clean) tier rows
     fk, _ = hs.export_rows()
     assert len(fk) == 10
+
+
+# ---- unified pass pipeline (ISSUE 9): queued stages × async eviction ----
+
+
+def _plant_window_values(table, value: float) -> None:
+    """Write ``value`` into every resident row's embed_w and mark the
+    rows touched (a deterministic stand-in for a trained pass)."""
+    from paddlebox_tpu.ps.table import FIELD_COL
+    data = np.asarray(jax.device_get(table.state.data)).copy()
+    with table.host_lock:
+        for s in range(table.n):
+            _, rows = table.indexes[s].items()
+            if not len(rows):
+                continue
+            data[s][rows, FIELD_COL["embed_w"]] = value
+            table._touched[s][rows] = True
+        data[:, table.capacity, :] = 0.0
+        table.state = type(table.state).from_logical(
+            data, table.capacity, ext=table.opt_ext)
+
+
+def test_async_evict_orders_behind_writeback():
+    """Async capacity eviction vs the in-flight end_pass write-back:
+    the lane's _evict_ahead runs in the SAME epilogue job strictly
+    after the write-back lands, so a freshly-written row is never
+    evicted ahead of its write-back — after the fence, every evicted
+    key's host value carries the pass's update, and the next begin_pass
+    finds its eviction already done (no inline emergency)."""
+    from paddlebox_tpu.config import flags_scope
+    cap = 16
+    with flags_scope(warmup_pass_scatter=False):
+        table = TieredShardedEmbeddingTable(
+            2, mf_dim=2, capacity_per_shard=cap, cfg=_cfg())
+        k1 = np.arange(0, 2 * cap, dtype=np.uint64)   # fills both shards
+        table.stage(k1, background=False)
+        table.begin_pass(k1)
+        _plant_window_values(table, 5.0)
+        # the NEXT pass's stage is queued (disjoint keys → full
+        # pressure) BEFORE end_pass, the pipeline shape
+        k2 = np.arange(2 * cap, 4 * cap, dtype=np.uint64)
+        table.stage(k2, background=False, queue=True)
+        table.end_pass()      # lane: write-back k1 → evict ahead for k2
+        table.fence()
+        # every k1 value landed in the host tier BEFORE its eviction
+        for s, ks in enumerate(table._split_by_owner(k1)):
+            got = table.hosts[s].fetch(ks)["embed_w"]
+            np.testing.assert_allclose(got, 5.0)
+        # the lane actually freed the window for k2
+        with table.host_lock:
+            for s in range(2):
+                assert len(table.indexes[s]) == 0
+        table.begin_pass(k2)
+        st = table.last_pass_stats
+        assert st["evict_async_rows"] == 2 * cap
+        assert st["evicted"] == 0, (
+            f"begin_pass still evicted inline: {st}")
+        assert st["staged"] == 2 * cap
+        table.end_pass()
+        table.fence()
+
+
+def test_async_evict_skips_dirty_rows():
+    """The clean-only rule: a row dirtied AFTER the end_pass snapshot
+    (its write-back hasn't landed) is never evicted by the lane — it
+    survives _evict_ahead and falls to the emergency inline path at
+    begin_pass, which writes it back before release."""
+    from paddlebox_tpu.config import flags_scope
+    from paddlebox_tpu.ps.table import FIELD_COL
+    cap = 16
+    with flags_scope(warmup_pass_scatter=False):
+        table = TieredShardedEmbeddingTable(
+            2, mf_dim=2, capacity_per_shard=cap, cfg=_cfg())
+        k1 = np.arange(0, 2 * cap, dtype=np.uint64)
+        table.stage(k1, background=False)
+        table.begin_pass(k1)
+        _plant_window_values(table, 5.0)
+        table.end_pass()
+        table.fence()          # k1 clean, host has 5.0
+        k2 = np.arange(2 * cap, 4 * cap, dtype=np.uint64)
+        table.stage(k2, background=False, queue=True)
+        # dirty ONE row after the snapshot: its newest value (9.0) is
+        # only on device — the lane must not evict it
+        s0 = 0
+        keys0, rows0 = table.indexes[s0].items()
+        probe_key, probe_row = keys0[0], rows0[0]
+        data = np.asarray(jax.device_get(table.state.data)).copy()
+        data[s0][probe_row, FIELD_COL["embed_w"]] = 9.0
+        table.state = type(table.state).from_logical(
+            data, table.capacity, ext=table.opt_ext)
+        table._touched[s0][probe_row] = True
+        freed = table._evict_ahead()   # what the lane would run
+        assert freed == 2 * cap - 1, freed
+        with table.host_lock:          # the dirty row survived the lane
+            assert int(table.indexes[s0].lookup(
+                np.array([probe_key]))[0]) == probe_row
+        # host still has the OLD value — the lane wrote nothing
+        assert table.hosts[s0].fetch(
+            np.array([probe_key]))["embed_w"][0] == 5.0
+        # begin_pass: the emergency inline path evicts it WITH its
+        # write-back (the fence + dirty-evictee discipline)
+        table.begin_pass(k2)
+        st = table.last_pass_stats
+        assert st["evicted"] == 1 and st["evicted_writeback"] == 1, st
+        assert st["evict_emergency_sec"] > 0.0
+        assert table.hosts[s0].fetch(
+            np.array([probe_key]))["embed_w"][0] == 9.0, (
+            "dirty evictee lost its update")
+        table.end_pass()
+        table.fence()
+
+
+def test_async_evict_never_unpins_queued_promote(tmp_path):
+    """Eviction vs prefetch_promote: a row plan-assigned (pending) for
+    a QUEUED pass — its value just promoted SSD→host by the preloader —
+    cannot be evicted out from under its pin, even when the overflow
+    wants more rows than the unpinned candidates can supply; its
+    promoted value survives to its own begin_pass."""
+    from paddlebox_tpu.config import flags_scope
+    with flags_scope(warmup_pass_scatter=False):
+        cap = 12
+        table = TieredShardedEmbeddingTable(
+            2, mf_dim=2, capacity_per_shard=cap, cfg=_cfg(),
+            ssd_dir=str(tmp_path / "tier"))
+        # pass 1: 8 rows/shard, trained to 5.0, written back, clean
+        k1 = np.arange(0, 16, dtype=np.uint64)
+        table.stage(k1, background=False)
+        table.begin_pass(k1)
+        _plant_window_values(table, 5.0)
+        table.end_pass()
+        table.fence()
+        # pass 2's keys: 4/shard whose values live ONLY on SSD + 8/shard
+        # genuinely new
+        pend = np.arange(100, 108, dtype=np.uint64)
+        new = np.arange(200, 216, dtype=np.uint64)
+        k2 = np.concatenate([pend, new])
+        from paddlebox_tpu.ps.table import FIELDS
+        for s, ks in enumerate(table._split_by_owner(pend)):
+            f = {f_: (np.full((len(ks), 2), 7.0, np.float32)
+                      if f_ == "embedx_w"
+                      else np.full(len(ks), 7.0, np.float32))
+                 for f_ in FIELDS}
+            table.hosts[s].update(ks, f)
+        table.fence()
+        for h in table.hosts:
+            h.demote_cold()
+        assert table.has_spilled_rows()
+        # the preloader build: plan-assign k2's pending subset + promote
+        # their spilled values, then queue the stage (PassPipeline shape)
+        with table.plan_scope():
+            for s, ks in enumerate(table._split_by_owner(pend)):
+                with table.host_lock:
+                    pre = table.indexes[s].lookup(ks)
+                    table.indexes[s].assign(ks)
+                    table._note_plan_assigned(s, ks[pre < 0])
+            assert table.prefetch_promote(pend) == len(pend)
+            table.stage(k2, background=False, queue=True)
+        # pressure: index 12/shard (8 k1 + 4 pending) + 8 new > cap 12;
+        # overflow (8) equals the ONLY unpinned candidates (k1) — the
+        # pinned pending rows must all survive
+        freed = table._evict_ahead()
+        assert freed == 16, freed       # all of k1, both shards
+        with table.host_lock:
+            for s, ks in enumerate(table._split_by_owner(pend)):
+                assert (table.indexes[s].lookup(ks) >= 0).all(), (
+                    "a pinned pending row was evicted from under its "
+                    "promote")
+        table.begin_pass(k2)
+        st = table.last_pass_stats
+        assert st["evicted"] == 0, st
+        # the promoted values reached the window through the reconcile
+        for s, ks in enumerate(table._split_by_owner(pend)):
+            rows = table.indexes[s].lookup(ks)
+            from paddlebox_tpu.ps.table import FIELD_COL
+            w = np.asarray(jax.device_get(
+                table.state.data))[s][rows, FIELD_COL["embed_w"]]
+            np.testing.assert_allclose(w, 7.0)
+        table.end_pass()
+        table.fence()
+
+
+def test_pipeline_plan_rollback_on_abort():
+    """Preloader-staged tiered pass rollback under plan_scope abort: a
+    build that dies AFTER plan-assigning its keys (the abort-between-
+    stages poll) rolls its pending rows back — nothing stays pinned, no
+    stage is queued, and the table runs a normal pass afterwards."""
+    from paddlebox_tpu.config import flags_scope
+    from paddlebox_tpu.train.device_pass import (PassPipeline,
+                                                 PreloadBuildAborted)
+    with flags_scope(warmup_pass_scatter=False):
+        table = TieredShardedEmbeddingTable(
+            2, mf_dim=2, capacity_per_shard=256, cfg=_cfg())
+        k1 = np.arange(0, 32, dtype=np.uint64)
+        k2 = np.arange(100, 132, dtype=np.uint64)
+        built = []
+
+        class _Tok:
+            def upload(self, materialize=False):
+                pass
+
+            def nbytes(self):
+                return 0
+
+        def build(ks):
+            for s, sub in enumerate(table._split_by_owner(ks)):
+                with table.host_lock:
+                    pre = table.indexes[s].lookup(sub)
+                    table.indexes[s].assign(sub)
+                    table._note_plan_assigned(s, sub[pre < 0])
+            built.append(ks[0])
+            if len(built) == 2:
+                # the second build observes a stop between stages
+                raise PreloadBuildAborted("stop between build stages")
+            return _Tok()
+
+        pipe = PassPipeline(iter([k1, k2]), build_fn=build,
+                            window_table=table, keys_of=lambda k: k)
+        pipe.start_next()
+        rp = pipe.wait()
+        assert rp is not None
+        pipe.begin_pass()
+        pipe.end_pass()
+        assert pipe.wait() is None       # the aborted build never lands
+        pipe.drain()
+        table.fence()
+        # k2's plan rows rolled back: no pins, no rows, no queued stage
+        assert table.obs_stats()["pending"] == 0
+        for s, sub in enumerate(table._split_by_owner(k2)):
+            assert (table.indexes[s].lookup(sub) == -1).all()
+        assert len(table._stage_q) == 0
+        # and the table still runs a normal pass over those keys
+        table.stage(k2, background=False)
+        assert table.begin_pass(k2) == len(k2)
+        table.end_pass()
+        table.fence()
+
+
+def test_pipeline_drain_discards_queued_stages():
+    """PassPipeline.drain() with built-but-never-begun passes: queued
+    stages are discarded and their plan-pending pins released
+    (discard_queued_stages) — abandoned stages never pin window
+    capacity; keys shared with the open window stay resident."""
+    from paddlebox_tpu.config import flags_scope
+    from paddlebox_tpu.train.device_pass import PassPipeline
+    with flags_scope(warmup_pass_scatter=False):
+        table = TieredShardedEmbeddingTable(
+            2, mf_dim=2, capacity_per_shard=256, cfg=_cfg())
+        k1 = np.arange(0, 32, dtype=np.uint64)
+        k2 = np.arange(100, 132, dtype=np.uint64)    # disjoint from k1
+        k3 = np.arange(116, 148, dtype=np.uint64)    # overlaps k2
+
+        class _Tok:
+            def upload(self, materialize=False):
+                pass
+
+            def nbytes(self):
+                return 0
+
+        def build(ks):
+            for s, sub in enumerate(table._split_by_owner(ks)):
+                with table.host_lock:
+                    pre = table.indexes[s].lookup(sub)
+                    table.indexes[s].assign(sub)
+                    table._note_plan_assigned(s, sub[pre < 0])
+            return _Tok()
+
+        pipe = PassPipeline(iter([k1, k2, k3]), build_fn=build,
+                            window_table=table, depth=3,
+                            keys_of=lambda k: k)
+        pipe.start_next()
+        rp = pipe.wait()
+        pipe.begin_pass()                 # consume k1 only
+        # let the worker finish building+staging k2 and k3
+        for _ in range(200):
+            with table.host_lock:
+                q = len(table._stage_q)
+            if q == 2:
+                break
+            time.sleep(0.01)
+        assert q == 2
+        pipe.end_pass()
+        pipe.drain()                      # k2/k3 will never begin
+        table.fence()
+        assert table.obs_stats()["pending"] == 0
+        assert len(table._stage_q) == 0
+        with table.host_lock:
+            for s, sub in enumerate(table._split_by_owner(
+                    np.setdiff1d(np.concatenate([k2, k3]), k1))):
+                assert (table.indexes[s].lookup(sub) == -1).all(), (
+                    "an abandoned stage left plan rows pinning the "
+                    "window")
+            # the open pass's rows are untouched by the discard
+            for s, sub in enumerate(table._split_by_owner(k1)):
+                assert (table.indexes[s].lookup(sub) >= 0).all()
+
+
+def test_async_evict_pins_inflight_stage():
+    """The in-flight stage pin (review finding): a queued stage's
+    missing-split is computed BEFORE its lock-free host fetch, so the
+    whole working set must be pinned from that moment — _evict_ahead
+    firing mid-fetch must not evict a key the stage classified as
+    resident (it would never be re-inserted at that pass's begin)."""
+    from paddlebox_tpu.config import flags_scope
+    cap = 16
+    with flags_scope(warmup_pass_scatter=False):
+        table = TieredShardedEmbeddingTable(
+            2, mf_dim=2, capacity_per_shard=cap, cfg=_cfg())
+        k1 = np.arange(0, 2 * cap, dtype=np.uint64)
+        table.stage(k1, background=False)
+        table.begin_pass(k1)
+        _plant_window_values(table, 5.0)
+        table.end_pass()
+        table.fence()                      # k1 resident, clean
+        # head queued stage: disjoint keys → full capacity pressure
+        kb = np.arange(100, 100 + 2 * cap, dtype=np.uint64)
+        table.stage(kb, background=False, queue=True)
+        # next stage re-uses k1 (classified resident at split time);
+        # the lane fires _evict_ahead DURING its host fetch
+        fired = []
+        orig = table._fetch_stage_values
+
+        def hook(s, new_keys, table=table):
+            if not fired:
+                fired.append(table._evict_ahead())
+            return orig(s, new_keys)
+
+        table._fetch_stage_values = hook
+        try:
+            table.stage(k1, background=False, queue=True)
+        finally:
+            table._fetch_stage_values = orig
+        assert fired, "the mid-fetch eviction never ran"
+        # the in-flight stage's resident keys survived the lane
+        assert fired[0] == 0, (
+            f"_evict_ahead evicted {fired[0]} rows out from under the "
+            "in-flight stage's missing-split")
+        with table.host_lock:
+            for s, ks in enumerate(table._split_by_owner(k1)):
+                assert (table.indexes[s].lookup(ks) >= 0).all(), (
+                    "an in-flight stage's resident key was evicted "
+                    "mid-fetch")
+            assert table._staging_keys is None   # pin released
+        table.discard_queued_stages()
+        table.fence()
+
+
+def test_begin_failure_restores_queued_stage():
+    """A begin_pass that fails AFTER consuming a queued stage (e.g.
+    window overflow with every candidate pinned) restores the stage to
+    the queue head and drops the open-pass pin — the pipeline's queues
+    stay aligned and drain/discard still release every pin."""
+    from paddlebox_tpu.config import flags_scope
+    cap = 8
+    with flags_scope(warmup_pass_scatter=False):
+        table = TieredShardedEmbeddingTable(
+            2, mf_dim=2, capacity_per_shard=cap, cfg=_cfg())
+        k1 = np.arange(0, 2 * cap, dtype=np.uint64)
+        table.stage(k1, background=False)
+        table.begin_pass(k1)
+        _plant_window_values(table, 5.0)
+        table.end_pass()
+        table.fence()                       # window full of clean k1
+        kb = np.arange(100, 100 + 2 * cap, dtype=np.uint64)
+        table.stage(kb, background=False, queue=True)
+        # the NEXT queued stage re-stages k1 — pinning it, so kb's
+        # begin has zero evictable candidates and must overflow
+        table.stage(k1, background=False, queue=True)
+        with pytest.raises(Exception):
+            table.begin_pass(kb)
+        assert not table.in_pass
+        with table.host_lock:
+            # the failed pass's stage is back at the queue head …
+            assert len(table._stage_q) == 2
+            assert np.array_equal(
+                np.concatenate(table._stage_q[0].keys),
+                np.concatenate(table._split_by_owner(kb)))
+            # … and nothing stays pinned as "open"
+            assert all(len(a) == 0 for a in table._open_keys)
+        assert table.discard_queued_stages() == 2
+        table.fence()
+        # the table still runs a normal (evicting) pass afterwards
+        table.stage(kb, background=False)
+        assert table.begin_pass(kb) == len(kb)
+        table.end_pass()
+        table.fence()
+
+
+def test_pin_working_set_covers_plan_build():
+    """The pre-build pin (review finding): a plan build bakes row ids
+    for RESIDENT keys too, so the pass's working set must be pinned
+    from the first row lookup — _evict_ahead firing between plan build
+    and stage() must not evict a resident key the plan already
+    addresses."""
+    from paddlebox_tpu.config import flags_scope
+    cap = 16
+    with flags_scope(warmup_pass_scatter=False):
+        table = TieredShardedEmbeddingTable(
+            2, mf_dim=2, capacity_per_shard=cap, cfg=_cfg())
+        k1 = np.arange(0, 2 * cap, dtype=np.uint64)
+        table.stage(k1, background=False)
+        table.begin_pass(k1)
+        _plant_window_values(table, 5.0)
+        table.end_pass()
+        table.fence()                      # k1 resident, clean
+        kb = np.arange(100, 100 + 2 * cap, dtype=np.uint64)
+        table.stage(kb, background=False, queue=True)   # pressure head
+        # the PassPipeline order: pin → plan build (bakes k1's rows) →
+        # lane eviction fires → stage. The pin must hold throughout.
+        table.pin_working_set(k1)
+        rows_baked = [table.indexes[s].lookup(ks) for s, ks in
+                      enumerate(table._split_by_owner(k1))]
+        freed = table._evict_ahead()       # the lane firing mid-build
+        assert freed == 0, (
+            f"_evict_ahead evicted {freed} rows the in-build plan "
+            "already baked")
+        table.stage(k1, background=False, queue=True)   # same-keys pin ok
+        with table.host_lock:
+            assert table._staging_keys is None          # handed over
+            for s, ks in enumerate(table._split_by_owner(k1)):
+                np.testing.assert_array_equal(
+                    table.indexes[s].lookup(ks), rows_baked[s])
+        table.discard_queued_stages()
+        table.fence()
+
+
+def test_discard_rejects_straddling_fetch():
+    """discard_queued_stages racing an in-flight queued fetch: the
+    fetch that straddled the discard must NOT append a zombie stage
+    afterwards (its plan pins would leak forever) — it raises, and the
+    queue stays empty."""
+    from paddlebox_tpu.config import flags_scope
+    with flags_scope(warmup_pass_scatter=False):
+        table = TieredShardedEmbeddingTable(
+            2, mf_dim=2, capacity_per_shard=64, cfg=_cfg())
+        k1 = np.arange(0, 32, dtype=np.uint64)
+        orig = table._fetch_stage_values
+        fired = []
+
+        def hook(s, new_keys):
+            if not fired:     # the discard lands mid-fetch
+                fired.append(table.discard_queued_stages())
+            return orig(s, new_keys)
+
+        table._fetch_stage_values = hook
+        try:
+            with pytest.raises(RuntimeError, match="discarded"):
+                table.stage(k1, background=False, queue=True)
+        finally:
+            table._fetch_stage_values = orig
+        with table.host_lock:
+            assert len(table._stage_q) == 0
+            assert table._staging_keys is None
+        # the table still stages and begins normally afterwards
+        table.stage(k1, background=False, queue=True)
+        assert table.begin_pass(k1) == len(k1)
+        table.end_pass()
+        table.fence()
