@@ -469,21 +469,71 @@ def xplane_device_busy_sec(trace_dir: str) -> float:
     return busy / 1e9
 
 
+_PERF_GATE_MOD = None
+
+
+def _perf_gate():
+    """scripts/perf_gate loaded by path once (scripts/ is not a
+    package; a bench run emits several rows)."""
+    global _PERF_GATE_MOD
+    if _PERF_GATE_MOD is None:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "perf_gate", os.path.join(os.path.dirname(os.path.abspath(
+                __file__)), "scripts", "perf_gate.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _PERF_GATE_MOD = mod
+    return _PERF_GATE_MOD
+
+
+def emit_result(row: dict) -> None:
+    """Print one bench JSON line AND record it on the perf-regression
+    trajectory (scripts/perf_gate.py): the recorded best per metric is
+    what `perf_gate.py --check` gates future runs against, and a live
+    row landing below the gate prints a loud REGRESSION banner here.
+    BENCH_TRAJECTORY=0 disables recording; =path overrides."""
+    print(json.dumps(row))
+    if os.environ.get("BENCH_TRAJECTORY", "") == "0":
+        return
+    try:
+        _perf_gate().record_result(row)
+    except Exception as e:  # recording must never eat the bench output
+        print(f"perf_gate record failed: {e}", file=sys.stderr)
+
+
 def setup_telemetry() -> None:
     """Write the run's telemetry JSONL next to the BENCH_*.json artifacts
     (repo root — same dir as this script), so every bench round carries
     per-pass stage/queue/HBM attribution for free
     (scripts/telemetry_report.py renders it). BENCH_TELEMETRY_JSONL
-    overrides the path; =0 disables."""
+    overrides the path; =0 disables.
+
+    BENCH_TRACE=1 (or =path) additionally records the causal pass
+    trace (obs/trace): per-lane Chrome rows — main / preload.worker /
+    epilogue.lane / ssd.compact — with build→consume flow arrows,
+    saved at exit as BENCH_trace.json. Default OFF: the headline runs
+    with tracing inert (the hub.active contract)."""
+    import atexit
+
     from paddlebox_tpu.obs.hub import get_hub
     from paddlebox_tpu.obs.sinks import JsonlSink
     dest = os.environ.get("BENCH_TELEMETRY_JSONL", "")
-    if dest == "0":
-        return
-    path = dest or os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                "BENCH_telemetry.jsonl")
-    get_hub().add_sink(JsonlSink(path, truncate=True))
-    print(f"telemetry jsonl: {path}", file=sys.stderr)
+    here = os.path.dirname(os.path.abspath(__file__))
+    if dest != "0":
+        path = dest or os.path.join(here, "BENCH_telemetry.jsonl")
+        get_hub().add_sink(JsonlSink(path, truncate=True))
+        print(f"telemetry jsonl: {path}", file=sys.stderr)
+    tdest = os.environ.get("BENCH_TRACE", "")
+    if tdest and tdest != "0":
+        from paddlebox_tpu.obs.trace import ChromeLaneTraceSink
+        from paddlebox_tpu.utils.profiler import ChromeTraceWriter
+        tpath = (tdest if tdest != "1"
+                 else os.path.join(here, "BENCH_trace.json"))
+        writer = ChromeTraceWriter()
+        get_hub().add_sink(ChromeLaneTraceSink(writer))
+        atexit.register(writer.save, tpath)
+        print(f"pass trace: {tpath}", file=sys.stderr)
 
 
 def main() -> None:
@@ -586,8 +636,8 @@ def main() -> None:
               "records_per_pass": num_records, "num_slots": shape_slots,
               "avg_keys_per_slot": shape_avg}
     if mode == "tiered":
-        print(json.dumps(measure_tiered(
-            int(os.environ.get("BENCH_PASSES", 4)), shape=shape)))
+        emit_result(measure_tiered(
+            int(os.environ.get("BENCH_PASSES", 4)), shape=shape))
         return
     elif mode == "stream":
         # windowed streaming-ingest bench (docs/RESILIENCE.md
@@ -632,7 +682,7 @@ def main() -> None:
         finally:
             shutil.rmtree(base, ignore_errors=True)
         meas_files = int(out["files"])
-        print(json.dumps({
+        emit_result({
             "metric": "stream_windows_per_sec",
             "value": round(out["windows"] / wall, 3),
             "unit": "windows/sec",
@@ -648,9 +698,15 @@ def main() -> None:
             "files_per_sec": round(meas_files / wall, 2),
             "examples_per_sec": round(meas_files * rows / wall, 1),
             "wall_sec": round(wall, 3),
-        }))
+        })
         return
     elif mode == "streaming":
+        # distinct gate key: the per-batch streaming pass measures a
+        # different pipeline than the resident headline, and the perf
+        # trajectory (scripts/perf_gate.py) keys on the metric name —
+        # sharing the resident name would gate streaming runs against
+        # the resident recorded best
+        metric += "_streaming"
         ds = make_ds(0)
         warm = InMemoryDataset(desc)
         warm.records = build_records(bs * 3, num_slots=shape_slots,
@@ -890,7 +946,7 @@ def main() -> None:
         # unconditionally, box_wrapper.cc:1182). Headline line stays
         # LAST for parsers that take the final line.
         try:
-            print(json.dumps(measure_tiered(num_passes=3)))
+            emit_result(measure_tiered(num_passes=3))
         except Exception as e:  # the headline must survive a tiered trip
             print(f"tiered row failed: {e}", file=sys.stderr)
     if mode == "resident" and "ex_per_sec_per_wire_mb_per_sec" in extras:
@@ -901,7 +957,7 @@ def main() -> None:
         # round-over-round comparisons stop riding tunnel weather
         r04_ref = {"uniform": 14032.1, "ragged": 2257.2,
                    "thousand": 495.8}.get(shape)
-        print(json.dumps({
+        emit_result({
             "metric": metric + "_per_wire_mb_per_sec",
             "value": extras["ex_per_sec_per_wire_mb_per_sec"],
             "unit": "examples/sec per wire-MB/s",
@@ -909,14 +965,14 @@ def main() -> None:
                 extras["ex_per_sec_per_wire_mb_per_sec"] / r04_ref, 4)
                 if r04_ref else None),
             "baseline_ref": "round-4 recorded value (BENCH_SHAPES.md)",
-        }))
-    print(json.dumps({
+        })
+    emit_result({
         "metric": metric,
         "value": round(value, 1),
         "unit": "examples/sec/chip",
         "vs_baseline": round(value / baseline_per_chip, 4),
         **extras,
-    }))
+    })
 
 
 if __name__ == "__main__":

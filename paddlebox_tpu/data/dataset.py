@@ -298,10 +298,13 @@ class Dataset:
                     "records dropped as unparseable").inc(n_bad)
 
         def worker() -> None:
+            from paddlebox_tpu.obs import trace
+            trace.set_lane(trace.LANE_READER)
             parser = parser_factory()
             for path in file_ch:
                 try:
-                    read_one(parser, path)
+                    with trace.span("read.file", file=path):
+                        read_one(parser, path)
                 except BaseException as e:
                     if isinstance(e, ChannelClosed):
                         # the CONSUMER cancelled the output channel

@@ -48,6 +48,11 @@ class TelemetryHub:
         self._prom_server = None
         self._proc: Optional[int] = None
         self._seq = 0
+        # liveness surface (/healthz on the prom endpoint): run start +
+        # the last pass event's wall clock / count
+        self.started_at = time.time()
+        self._last_pass_ts: Optional[float] = None
+        self._pass_count = 0
         # fast-path flag: any sink attached / endpoint running. Hot call
         # sites read this one attribute and skip all payload assembly.
         self.active = False
@@ -79,13 +84,34 @@ class TelemetryHub:
         self.active = bool(self._event_sinks or self._span_sinks
                            or self._prom_server is not None)
 
-    def add_sink(self, sink) -> None:
-        """Attach an event sink (has ``emit(dict)``) or a span sink
-        (has ``span(name, start, dur, attrs)``)."""
+    def add_sink(self, sink, kind: Optional[str] = None) -> None:
+        """Attach an event sink (has ``emit(dict)``), a span sink (has
+        ``span(name, start, dur, attrs)`` or the rich
+        ``span_full(rec)``), or BOTH — a dual-capability sink registers
+        in both lists (the pre-fix behavior silently filed it as
+        span-only, dropping its events). ``kind`` overrides the
+        auto-classification: "event", "span", or "both"."""
+        if kind not in (None, "event", "span", "both"):
+            raise ValueError(f"unknown sink kind: {kind!r}")
+        as_span = (hasattr(sink, "span") or hasattr(sink, "span_full")
+                   if kind is None else kind in ("span", "both"))
+        as_event = (hasattr(sink, "emit") if kind is None
+                    else kind in ("event", "both"))
+        if kind is not None:
+            # an explicit kind must be honorable: registering a sink
+            # for a capability it lacks would fail at first emit
+            if kind in ("event", "both") and not hasattr(sink, "emit"):
+                raise TypeError(f"sink {sink!r} has no emit()")
+            if kind in ("span", "both") and not (
+                    hasattr(sink, "span") or hasattr(sink, "span_full")):
+                raise TypeError(f"sink {sink!r} has no span()/span_full()")
+        if not (as_span or as_event):
+            raise TypeError(
+                f"sink {sink!r} exposes neither emit() nor span()")
         with self._lock:
-            if hasattr(sink, "span"):
+            if as_span:
                 self._span_sinks.append(sink)
-            else:
+            if as_event:
                 self._event_sinks.append(sink)
             self._refresh_active()
 
@@ -98,7 +124,9 @@ class TelemetryHub:
 
     def close_sinks(self) -> None:
         with self._lock:
-            sinks = self._event_sinks + self._span_sinks
+            # dual-capability sinks sit in both lists — close once
+            sinks = list({id(s): s for s in
+                          self._event_sinks + self._span_sinks}.values())
             self._event_sinks = []
             self._span_sinks = []
             self._refresh_active()
@@ -110,6 +138,9 @@ class TelemetryHub:
 
     def event_sinks(self) -> List:
         return list(self._event_sinks)
+
+    def span_sinks(self) -> List:
+        return list(self._span_sinks)
 
     # ---- events --------------------------------------------------------
     def _process_index(self) -> int:
@@ -192,23 +223,55 @@ class TelemetryHub:
                 lines.append(f'pbox_stat{{name="{name}"}} {val}')
         return "\n".join(lines) + "\n"
 
+    def note_pass(self) -> None:
+        """Stamp a completed pass for the /healthz liveness surface
+        (emit_pass_event calls this on the active path)."""
+        with self._lock:
+            self._last_pass_ts = time.time()
+            self._pass_count += 1
+
+    def health(self) -> Dict:
+        """The /healthz payload: run identity, uptime, and how stale
+        the latest pass is — the liveness probe the serving/streaming
+        loops poll (a wedged always-on trainer shows a growing
+        ``last_pass_age_sec`` while the process still answers)."""
+        now = time.time()
+        with self._lock:
+            last = self._last_pass_ts
+            count = self._pass_count
+        return {
+            "status": "ok",
+            "run_id": self.run_id,
+            "uptime_sec": round(now - self.started_at, 3),
+            "passes_total": count,
+            "last_pass_ts": last,
+            "last_pass_age_sec": (None if last is None
+                                  else round(now - last, 3)),
+        }
+
     # ---- Prometheus HTTP endpoint --------------------------------------
     def start_prom_http(self, port: int = 0):
-        """Serve ``snapshot_prom()`` from a daemon thread; returns the
-        server (``server.server_address[1]`` is the bound port — pass
-        port=0 for an ephemeral one). Idempotent."""
+        """Serve ``snapshot_prom()`` from a daemon thread — plus
+        ``/healthz`` (JSON liveness: run_id, uptime, last-pass age);
+        returns the server (``server.server_address[1]`` is the bound
+        port — pass port=0 for an ephemeral one). Idempotent."""
         if self._prom_server is not None:
             return self._prom_server
         import http.server
+        import json as _json
 
         hub = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server API)
-                body = hub.snapshot_prom().encode()
+                if self.path.split("?", 1)[0] == "/healthz":
+                    body = _json.dumps(hub.health()).encode()
+                    ctype = "application/json"
+                else:
+                    body = hub.snapshot_prom().encode()
+                    ctype = "text/plain; version=0.0.4"
                 self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -358,6 +421,22 @@ def emit_pass_event(kind: str, metrics: Dict, stage_timers=None,
         ev["resilience"] = retry_counters()
     except Exception:
         pass
+    # critical-path attribution (obs/trace; docs/OBSERVABILITY.md
+    # §Tracing): the pass drivers reported each boundary stall
+    # (preload wait, stage wait, emergency eviction, the previous
+    # pass's end-submit + fence wait) into the trace accumulator —
+    # consume them here so every TRAIN pass event carries the wall
+    # attribution + bottleneck verdict telemetry_report renders
+    if "elapsed_sec" in ev and kind.startswith(("train_pass",
+                                                "stream")):
+        from paddlebox_tpu.obs import trace
+        cp = trace.critical_path_block(ev["elapsed_sec"],
+                                       trace.consume_pass_parts())
+        ev["critical_path"] = cp
+        hub.counter("pbox_pass_bottleneck_total",
+                    "passes by critical-path bottleneck verdict"
+                    ).inc(stage=cp["bottleneck"])
+    hub.note_pass()
     hub.gauge("pbox_hbm_bytes_in_use",
               "device bytes in use").set(hbm["bytes_in_use"])
     hub.gauge("pbox_hbm_peak_bytes",

@@ -149,7 +149,7 @@ class PassEpilogue:
     def __init__(self, name: str = "endpass") -> None:
         self.name = name
         self._cv = threading.Condition(threading.Lock())
-        self._jobs: Deque[Tuple[Callable[[], None], str]] = \
+        self._jobs: Deque[Tuple[Callable[[], None], str, int]] = \
             collections.deque()
         self._submitted = 0
         self._done = 0
@@ -168,13 +168,17 @@ class PassEpilogue:
         self.last_writeback_sec = 0.0
 
     # ---- submission ----------------------------------------------------
-    def submit(self, fn: Callable[[], None], label: str = "") -> None:
+    def submit(self, fn: Callable[[], None], label: str = "",
+               link_from: int = 0) -> None:
         """Enqueue a write-back job; returns immediately. Raises the
         previous job failure first (continuing to train atop a lost
-        write-back would compound the damage silently)."""
+        write-back would compound the damage silently). ``link_from``
+        names the submitter's trace span (obs/trace) — the job's
+        ``endpass.writeback`` span on the epilogue lane links back to
+        it, so the Chrome trace draws the submit→drain hand-off."""
         with self._cv:
             self._raise_pending_locked()
-            self._jobs.append((fn, label))
+            self._jobs.append((fn, label, link_from))
             self._submitted += 1
             depth = len(self._jobs)
             if not self._running:
@@ -184,16 +188,20 @@ class PassEpilogue:
         self._mirror_depth(depth)
 
     def _drain(self) -> None:
+        from paddlebox_tpu.obs import trace
+        trace.set_lane(trace.LANE_EPILOGUE)
         while True:
             with self._cv:
                 if not self._jobs:
                     self._running = False
                     self._cv.notify_all()
                     return
-                fn, label = self._jobs.popleft()
+                fn, label, link = self._jobs.popleft()
             t0 = time.perf_counter()
             try:
-                fn()
+                with trace.span("endpass.writeback", link_from=link,
+                                job=label or self.name):
+                    fn()
             except BaseException as e:  # held for the next fence
                 log.error("async end_pass write-back failed (%s): %r",
                           label or self.name, e)

@@ -129,6 +129,7 @@ from paddlebox_tpu.ps.table import (HostKV, dispatch_packed_row_gather,
                                     scatter_logical_rows,
                                     start_scatter_warmup,
                                     store_fields_from_rows)
+from paddlebox_tpu.obs import trace
 from paddlebox_tpu.resilience import faults
 from paddlebox_tpu.utils.logging import get_logger
 
@@ -460,12 +461,18 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         blocks host_lock and is strictly ordered AFTER the write-back —
         rows the pass just touched are marked and never selected).
         barrier=False: fencing from the single-lane worker itself would
-        deadlock."""
-        for h in self.hosts:
-            if h is None or h.ssd is None:
-                continue
-            h.demote_to_watermark(barrier=False)
-            h.ssd.maybe_compact()
+        deadlock. Renders on the ``ssd.compact`` trace lane: the work
+        rides the epilogue worker but is logically the SSD maintenance
+        service, so it gets its own row in the pass trace."""
+        tiers = [h for h in self.hosts
+                 if h is not None and h.ssd is not None]
+        if not tiers:
+            return
+        with trace.lane_scope(trace.LANE_SSD), \
+                trace.span("ssd.maintain"):
+            for h in tiers:
+                h.demote_to_watermark(barrier=False)
+                h.ssd.maybe_compact()
 
     # ---- overlapped plan builds (preload_into_memory) ----------------
     @contextlib.contextmanager
@@ -667,8 +674,13 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
                 gen = self._stage_gen
         if queue:
             try:
-                vals = [self._fetch_stage_values(s, new[s])
-                        for s in range(self.n)]
+                # queued feed-pass fetch: runs on the preloader worker
+                # — the pass trace's "pass.stage" span on that lane,
+                # child of the enclosing build span
+                with trace.span("pass.stage",
+                                new_rows=int(sum(len(a) for a in new))):
+                    vals = [self._fetch_stage_values(s, new[s])
+                            for s in range(self.n)]
                 with self.host_lock:
                     if self._stage_gen != gen:
                         raise RuntimeError(
@@ -766,6 +778,10 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         # promote attribution spans since the PREVIOUS begin_pass (the
         # overlapped stage promotes during the previous pass's train)
         ssd0 = getattr(self, "_ssd_mark", {})
+        with trace.span("pass.begin"):
+            return self._begin_pass_traced(pass_keys, ssd0)
+
+    def _begin_pass_traced(self, pass_keys, ssd0) -> int:
         st = self._resolve_stage(pass_keys)
 
         stats = dict(resident=0, staged=0, evicted=0, evicted_writeback=0,
@@ -884,6 +900,10 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         reuse."""
         if not self.in_pass:
             raise RuntimeError("end_pass without begin_pass")
+        with trace.span("pass.end_submit") as _sp:
+            return self._end_pass_traced(_sp.span_id)
+
+    def _end_pass_traced(self, submit_span: int) -> int:
         total = 0
         t0 = time.perf_counter()
         t_dispatch = 0.0
@@ -933,7 +953,8 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
                 # cleared, so candidates are clean and eviction is pure
                 # index release): free the rows the next queued pass
                 # will need so its begin_pass pays no inline eviction
-                self._evict_ahead()
+                with trace.span("evict.ahead"):
+                    self._evict_ahead()
                 # watermark demotion rides the SAME job: strictly after
                 # this pass's rows landed and are marked touched —
                 # selection is untouched-first, so a row whose write-back
@@ -943,7 +964,10 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
                 self._demote_after_writeback()
 
             if FLAGS.async_end_pass:
-                self._epilogue.submit(run, label="end_pass")
+                # link: the writeback job's span on the epilogue lane
+                # points back at this end_submit span (flow arrow)
+                self._epilogue.submit(run, label="end_pass",
+                                      link_from=submit_span)
             else:
                 run()
         # submit-time parity audit (ISSUE 9): the ONLY synchronous

@@ -1277,10 +1277,12 @@ class PassPreloader:
             block=self._block)
 
     def _run(self) -> None:
+        from paddlebox_tpu.obs import trace
         from paddlebox_tpu.resilience import preemption
         # lets the builders' stage polls see THIS preloader's stop()
         # (poll_preload_abort) so an in-flight build aborts promptly
         _PRELOAD_TLS.abort = lambda: self._stopped
+        trace.set_lane(trace.LANE_PRELOAD)
         while True:
             with self._cv:
                 while not self._stopped and (
@@ -1306,7 +1308,18 @@ class PassPreloader:
                         self._cv.notify_all()
                     return
                 t0 = time.perf_counter()
-                rp = self._build(ds)
+                # the pass trace's build span on the preload.worker
+                # lane; its id rides the pass so the main-thread
+                # consume span can link back (the build→consume flow
+                # arrow — obs/trace, docs/OBSERVABILITY.md §Tracing)
+                with trace.span("pass.build",
+                                pass_seq=self.builds + 1) as _sp:
+                    rp = self._build(ds)
+                if _sp.span_id:
+                    try:
+                        rp._trace_span_id = _sp.span_id
+                    except AttributeError:
+                        pass  # slotted pass objects skip the link
                 self._note_built(rp, time.perf_counter() - t0)
             except PreloadBuildAborted as e:
                 log.warning("pass preload pipeline stopped: %s", e)
@@ -1455,6 +1468,11 @@ class PassPreloader:
                 hub.counter("pbox_preload_wait_seconds_total",
                             "seconds the trainer blocked on pass preload"
                             ).inc(waited)
+                # critical-path attribution: the blocked wait is the
+                # consuming pass's build-starvation stall (obs/trace —
+                # rides the next pass event's critical_path block)
+                from paddlebox_tpu.obs import trace
+                trace.note_pass_part("build_wait", waited)
             hub.gauge("pbox_preload_queue_depth",
                       "staged passes queued ahead of training"
                       ).set(depth)
@@ -1571,6 +1589,16 @@ class PassPipeline:
         self.table = window_table
         self.trainer = trainer
         self._keys_of = keys_of or (lambda ds: ds.pass_keys())
+        # fence-wait attribution baseline: the table's counters are
+        # CUMULATIVE over its lifetime, and a fresh pipeline over a
+        # long-lived table must not book historical fence waits into
+        # its first pass's critical_path block
+        self._fence_wait_mark = 0.0
+        if window_table is not None:
+            eps = getattr(window_table, "endpass_stats", None)
+            if eps is not None:
+                self._fence_wait_mark = float(
+                    eps().get("critical_fence_wait_sec", 0.0))
         # key sets of built-and-staged passes, in build order — consumed
         # by begin_pass() to validate the head queued stage
         self._key_q: collections.deque = collections.deque()
@@ -1664,6 +1692,16 @@ class PassPipeline:
         with self._lock:
             if self._key_q and self._key_q[0] is keys:
                 self._key_q.popleft()
+        # boundary attribution for the upcoming pass event
+        # (obs/trace critical_path): the begin-stall pieces the table
+        # just measured (~0 in steady state — the point of the pipeline)
+        from paddlebox_tpu.obs import trace
+        lp = getattr(self.table, "last_pass_stats", None) or {}
+        for stage, key in (("stage_wait", "stage_wait_sec"),
+                           ("evict_scatter", "evict_scatter_sec"),
+                           ("evict_emergency", "evict_emergency_sec"),
+                           ("ssd_promote", "ssd_promote_wait_sec")):
+            trace.note_pass_part(stage, float(lp.get(key, 0.0) or 0.0))
         if self.trainer is not None:
             self.trainer.adopt_table()
         return n
@@ -1671,12 +1709,24 @@ class PassPipeline:
     def end_pass(self) -> int:
         """Close the open pass: write-back submits to the epilogue lane
         (async), which also runs the next queued stage's capacity
-        eviction and any SSD watermark demotion."""
+        eviction and any SSD watermark demotion. The submit cost and
+        the main-thread fence wait it exposed are reported into the
+        NEXT pass event's critical_path block (they stall the next
+        boundary, not the pass that already emitted its event)."""
         if self.table is None:
             return 0
         if self.trainer is not None:
             self.trainer.sync_table()
-        return self.table.end_pass()
+        t0 = time.perf_counter()
+        n = self.table.end_pass()
+        from paddlebox_tpu.obs import trace
+        trace.note_pass_part("end_submit", time.perf_counter() - t0)
+        eps = getattr(self.table, "endpass_stats", None)
+        if eps is not None:
+            cur = float(eps().get("critical_fence_wait_sec", 0.0))
+            mark, self._fence_wait_mark = self._fence_wait_mark, cur
+            trace.note_pass_part("fence_wait", cur - mark)
+        return n
 
     # ---- shutdown ----------------------------------------------------
     def drain(self, timeout: Optional[float] = None) -> None:

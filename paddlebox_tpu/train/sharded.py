@@ -1072,9 +1072,14 @@ class ShardedTrainer:
                 "with build_resident_pass, or use train_pass")
             want_metrics = False
         rp.upload()
-        self.state, preds = self.step_fn.run_resident(
-            self.state, rp, self._rng, collect_preds=want_metrics)
-        jax.block_until_ready(self.state.step)
+        # consume span: links back to this pass's build span on the
+        # preloader lane (obs/trace — the build→consume flow arrow)
+        from paddlebox_tpu.obs import trace
+        with trace.span("pass.consume",
+                        link_from=getattr(rp, "_trace_span_id", 0)):
+            self.state, preds = self.step_fn.run_resident(
+                self.state, rp, self._rng, collect_preds=want_metrics)
+            jax.block_until_ready(self.state.step)
         rp.mark_trained_rows(self.table)
         if want_metrics:
             self._feed_registry_resident(rp, preds)

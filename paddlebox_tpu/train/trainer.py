@@ -1015,8 +1015,13 @@ class Trainer:
                 chunk_bits=getattr(rp, "chunk_bits", None))
             self._resident_runners[key] = runner
         # "step" covers dispatch + device completion here (the resident
-        # loop is one XLA program; the block is the honest device time)
-        with st.stage("step"):
+        # loop is one XLA program; the block is the honest device time).
+        # The consume span links back to the pass's build span on the
+        # preloader lane (obs/trace — the cross-thread flow arrow)
+        from paddlebox_tpu.obs import trace
+        with trace.span("pass.consume",
+                        link_from=getattr(rp, "_trace_span_id", 0)), \
+                st.stage("step"):
             self.state, preds = runner.run_pass(
                 self.state, rp, self._rng,
                 collect_preds=want_metrics and rp.side is not None)

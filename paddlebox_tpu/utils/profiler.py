@@ -71,16 +71,48 @@ class ChromeTraceWriter:
             })
 
     def complete(self, name: str, start_s: float, dur_s: float,
-                 **args) -> None:
+                 tid: Optional[int] = None, **args) -> None:
         """Record an already-timed span (the obs hub's span-sink entry:
-        ``start_s`` is a perf_counter reading from this process)."""
+        ``start_s`` is a perf_counter reading from this process).
+        ``tid`` overrides the row the span renders on — the lane-trace
+        sink (obs/trace.ChromeLaneTraceSink) assigns one stable tid per
+        pipeline lane instead of the raw OS thread id."""
         self._append({
             "name": name, "ph": "X", "pid": 0,
-            "tid": threading.get_ident() & 0xFFFF,
+            "tid": (threading.get_ident() & 0xFFFF
+                    if tid is None else tid),
             "ts": (start_s - self._t0) * 1e6,
             "dur": dur_s * 1e6,
             **({"args": args} if args else {}),
         })
+
+    def thread_meta(self, tid: int, name: str,
+                    sort_index: Optional[int] = None) -> None:
+        """Label (and optionally order) a tid row — Chrome's
+        ``thread_name`` / ``thread_sort_index`` metadata events, so
+        lane rows render with their lane names instead of bare ids.
+        Metadata bypasses the event cap (a dropped label would mislabel
+        every span on the row)."""
+        with self._lock:
+            self._events.append({
+                "name": "thread_name", "ph": "M", "pid": 0, "tid": tid,
+                "args": {"name": name}})
+            if sort_index is not None:
+                self._events.append({
+                    "name": "thread_sort_index", "ph": "M", "pid": 0,
+                    "tid": tid, "args": {"sort_index": sort_index}})
+
+    def flow(self, flow_id: int, phase: str, ts_s: float, tid: int,
+             name: str = "flow", cat: str = "flow") -> None:
+        """Flow event: ``phase`` "s" starts an arrow, "f" binds its end
+        ("bp":"e" = bind to the ENCLOSING slice's start) — the
+        cross-lane causality arrows of the pass trace (a build span on
+        ``preload.worker`` flowing into its consume span on ``main``)."""
+        ev = {"name": name, "cat": cat, "ph": phase, "id": flow_id,
+              "pid": 0, "tid": tid, "ts": (ts_s - self._t0) * 1e6}
+        if phase == "f":
+            ev["bp"] = "e"
+        self._append(ev)
 
     def instant(self, name: str, **args) -> None:
         self._append({

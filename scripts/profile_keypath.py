@@ -1,15 +1,33 @@
 #!/usr/bin/env python
-"""Decompose the ragged-shape device step cost, component by component.
+"""Device key-path cost decomposition — ALL the round-5 probe sets in
+one harness (the former profile_keypath{,2,3}.py trio, consolidated).
 
-Round-5 measurement harness for the device key-path attack (VERDICT item
-1). Loop-shaped probes per DESIGN_NOTES §4h: every probe threads state
+Loop-shaped probes per DESIGN_NOTES §4h: every probe threads state
 through a fori_loop with VARYING indices per iteration — single-shot
-probes with repeated identical indices read 100x too fast.
+probes with repeated identical indices read 100x too fast. Prints one
+JSON line per probe: {"probe": ..., "ms_per_iter": ...}. Run on the
+real chip (no conftest).
 
-Prints one JSON line per probe: {"probe": ..., "ms_per_iter": ...}.
-Run on the real chip (no conftest): python scripts/profile_keypath.py
+Usage:
+    python scripts/profile_keypath.py [--set 1|2|3|all]
+                                      [--shape ragged|uniform|thousand]
+                                      [--iters N]
+
+Probe sets:
+    1  step components: table gather/push, dedup, expand, seqpool
+       fwd/bwd, slot-wire decode, dense fwd+bwd, hot-tier gathers
+       (the original harness — VERDICT item 1)
+    2  grad-merge ordering, gather extract form, push variants (the
+       levers left after the slot-wire decode fix)
+    3  merge form/dtype, packed-line expand, dedup sort form (the
+       levers left after the decode + gather-extract fixes)
+
+``PROF_ITERS`` / ``PROF_SHAPE`` env vars keep working (CLI wins).
+Sets 2 and 3 probe the ragged shape regardless of --shape (their
+question is merge/extract form at the ragged working point).
 """
 
+import argparse
 import json
 import os
 import sys
@@ -21,331 +39,740 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from paddlebox_tpu.ps.table import (TableState, apply_push,
-                                    gather_full_rows, init_table_state)
-from paddlebox_tpu.ps.sgd import SparseSGDConfig, opt_ext_width
-from paddlebox_tpu.ops.device_unique import dedup_rows
-from paddlebox_tpu.ops.pallas_kernels import segment_sum
-
-N_ITER = int(os.environ.get("PROF_ITERS", 16))
-SHAPE = os.environ.get("PROF_SHAPE", "ragged")
-
-# ragged bench shape: bs 4096, 26 slots, ~5 keys/slot, vocab 100k/slot
-if SHAPE == "ragged":
-    B, S, AVG, VOCAB = 4096, 26, 5.0, 100_000
-elif SHAPE == "thousand":
-    B, S, AVG, VOCAB = 512, 1000, 1.0, 4_000
-else:  # uniform
-    B, S, AVG, VOCAB = 8192, 26, 1.0, 100_000
 MF = 8
 CAP = 1 << 23
-cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=1e-3)
-EXT = opt_ext_width(cfg, MF)
-FEAT = 8 + MF + EXT
-
-rng = np.random.default_rng(0)
-if AVG > 1.0:
-    counts = 1 + rng.poisson(AVG - 1.0, size=(B, S))
-else:
-    counts = np.ones((B, S), np.int64)
-K = int(counts.sum())
-from paddlebox_tpu.ps.table import next_bucket_fine
-K_pad = next_bucket_fine(4096, K)
-
-# per-iteration index stacks (varying indices per §4h)
-def draw_rows(n):
-    """Per-key table rows for n iterations: keys are slot-partitioned
-    draws (like the bench), mapped to rows within slot arenas."""
-    out = np.empty((n, K_pad), np.int32)
-    slot_of_key = np.repeat(np.tile(np.arange(S), B), counts.reshape(-1))
-    for i in range(n):
-        k_ids = rng.integers(0, VOCAB, size=K)
-        out[i, :K] = (slot_of_key * VOCAB + k_ids).astype(np.int32) % CAP
-        out[i, K:] = CAP  # pads → sentinel
-    return out
-
-rows_stack = jnp.asarray(draw_rows(N_ITER))
-# segments per key: record*S + slot
-rec_of_key = np.repeat(np.arange(B, dtype=np.int32), counts.sum(axis=1))
-slot_flat = np.repeat(np.tile(np.arange(S, dtype=np.int32), B),
-                      counts.reshape(-1))
-segs_np = np.full(K_pad, B * S, np.int32)
-segs_np[:K] = rec_of_key * S + slot_flat
-segs = jnp.asarray(segs_np)
-key_valid = jnp.asarray((np.arange(K_pad) < K).astype(np.float32))
-
-# unique-rows stacks: dedup each iteration's rows on host
-uniqs, u_max = [], 0
-for i in range(N_ITER):
-    u = np.unique(np.asarray(rows_stack[i][:K]))
-    uniqs.append(u)
-    u_max = max(u_max, len(u))
-U_pad = next_bucket_fine(4096, u_max + 1)
-uniq_np = np.empty((N_ITER, U_pad), np.int32)
-for i, u in enumerate(uniqs):
-    uniq_np[i, :len(u)] = u
-    uniq_np[i, len(u):] = CAP + 1 + np.arange(U_pad - len(u))
-uniq_stack = jnp.asarray(uniq_np)
-U_real = u_max
-
-state = init_table_state(CAP, MF, ext=EXT)
-grads = jnp.asarray(rng.normal(size=(U_pad, 3 + MF)).astype(np.float32))
-vals_k = jnp.asarray(rng.normal(size=(K_pad, 3 + MF)).astype(np.float32))
-prng = jax.random.PRNGKey(0)
-
-print(json.dumps({"probe": "shape", "B": B, "S": S, "K": K,
-                  "K_pad": K_pad, "U": U_real, "U_pad": U_pad}),
-      flush=True)
 
 
-def timeit(name, fn, *args, **extra):
-    """fn: jitted callable taking iteration index array slot; runs a
-    warmup call then wall-times N_ITER iterations via fori_loop
-    INSIDE one jit (no per-iter dispatch)."""
-    r = fn(*args)
-    jax.block_until_ready(r)
-    t0 = time.perf_counter()
-    r = fn(*args)
-    jax.block_until_ready(r)
-    dt = (time.perf_counter() - t0) / N_ITER * 1000
-    print(json.dumps({"probe": name, "ms_per_iter": round(dt, 3),
-                      **extra}), flush=True)
-    return dt
+def shape_dims(shape: str):
+    """(B, S, AVG, VOCAB) for a bench shape name."""
+    if shape == "ragged":
+        return 4096, 26, 5.0, 100_000
+    if shape == "thousand":
+        return 512, 1000, 1.0, 4_000
+    return 8192, 26, 1.0, 100_000
 
 
-# ---- probe: gather U rows from the big table ----
-@jax.jit
-def p_gather(state, uniq_stack):
-    def body(i, acc):
-        rows = gather_full_rows(state, uniq_stack[i])
-        return acc + rows[0, 0] + rows[-1, -1]
-    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+def make_timeit(n_iter: int, fetch_val: bool = False):
+    """Warmup call + wall-timed second call / n_iter. ``fetch_val``
+    device_gets the result (sets 2/3's anti-DCE discipline) instead of
+    block_until_ready."""
 
-timeit("gather_U_big", p_gather, state, uniq_stack,
-       U_pad=U_pad)
+    def timeit(name, fn, *args, **extra):
+        r = fn(*args)
+        if fetch_val:
+            v = np.asarray(jax.device_get(r)).ravel()
+        else:
+            jax.block_until_ready(r)
+        t0 = time.perf_counter()
+        r = fn(*args)
+        if fetch_val:
+            v = np.asarray(jax.device_get(r)).ravel()
+            extra["val"] = float(v[0])
+        else:
+            jax.block_until_ready(r)
+        dt = (time.perf_counter() - t0) / n_iter * 1000
+        print(json.dumps({"probe": name, "ms_per_iter": round(dt, 3),
+                          **extra}), flush=True)
+        return dt
 
-# ---- probe: apply_push U rows ----
-@jax.jit
-def p_push(state, uniq_stack, grads, prng):
-    def body(i, st):
-        return apply_push(st, uniq_stack[i], grads, cfg, prng)
-    return jax.lax.fori_loop(0, N_ITER, body, state).packed[0, 0]
+    return timeit
 
-timeit("push_U", p_push, state, uniq_stack, grads, prng, U_pad=U_pad)
 
-# ---- probe: dedup_rows at K ----
-@jax.jit
-def p_dedup(rows_stack):
-    def body(i, acc):
-        u, g = dedup_rows(rows_stack[i], CAP)
-        return acc + u[0] + g[-1]
-    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros((), jnp.int32))
+def _ragged_rows(rng, n_iter, counts, k, k_pad, s, vocab):
+    """Per-iteration key rows: slot-partitioned draws mapped into slot
+    arenas; pads → the CAP sentinel."""
+    slot_of_key = np.repeat(np.tile(np.arange(s), counts.shape[0]),
+                            counts.reshape(-1))
+    out = np.empty((n_iter, k_pad), np.int32)
+    for i in range(n_iter):
+        k_ids = rng.integers(0, vocab, size=k)
+        out[i, :k] = (slot_of_key * vocab + k_ids).astype(np.int32) % CAP
+        out[i, k:] = CAP
+    return out, slot_of_key
 
-timeit("dedup_rows_K", p_dedup, rows_stack, K_pad=K_pad)
 
-# ---- probe: expand gather K from [U, 11] ----
-gidx_np = rng.integers(0, U_real, size=(N_ITER, K_pad)).astype(np.int32)
-gidx_stack = jnp.asarray(gidx_np)
-vals_u = jnp.asarray(rng.normal(size=(U_pad, 3 + MF)).astype(np.float32))
+def run_set1(shape: str, n_iter: int) -> None:
+    from paddlebox_tpu.ops.device_unique import dedup_rows
+    from paddlebox_tpu.ops.pallas_kernels import segment_sum
+    from paddlebox_tpu.ps.sgd import SparseSGDConfig, opt_ext_width
+    from paddlebox_tpu.ps.table import (TableState, apply_push,
+                                        gather_full_rows,
+                                        init_table_state,
+                                        next_bucket_fine)
 
-@jax.jit
-def p_expand(vals_u, gidx_stack):
-    def body(i, acc):
-        v = vals_u[gidx_stack[i]]
-        return acc + v[0, 0] + v[-1, -1]
-    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+    timeit = make_timeit(n_iter)
+    b, s, avg, vocab = shape_dims(shape)
+    cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=1e-3)
+    ext = opt_ext_width(cfg, MF)
+    feat = 8 + MF + ext
 
-timeit("expand_K_from_U", p_expand, vals_u, gidx_stack)
+    rng = np.random.default_rng(0)
+    if avg > 1.0:
+        counts = 1 + rng.poisson(avg - 1.0, size=(b, s))
+    else:
+        counts = np.ones((b, s), np.int64)
+    k = int(counts.sum())
+    k_pad = next_bucket_fine(4096, k)
+    rows_np, _ = _ragged_rows(rng, n_iter, counts, k, k_pad, s, vocab)
+    rows_stack = jnp.asarray(rows_np)
+    # segments per key: record*S + slot
+    rec_of_key = np.repeat(np.arange(b, dtype=np.int32),
+                           counts.sum(axis=1))
+    slot_flat = np.repeat(np.tile(np.arange(s, dtype=np.int32), b),
+                          counts.reshape(-1))
+    segs_np = np.full(k_pad, b * s, np.int32)
+    segs_np[:k] = rec_of_key * s + slot_flat
+    segs = jnp.asarray(segs_np)
 
-# ---- probe: seqpool segment_sum fwd (K→B*S) ----
-@jax.jit
-def p_segsum(vals_k, segs):
-    def body(i, acc):
-        pooled = segment_sum(vals_k * (1.0 + acc), segs,
-                             num_segments=B * S + 1)
-        return acc + pooled[0, 0] + pooled[-1, -1]
-    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+    # unique-rows stacks: dedup each iteration's rows on host
+    uniqs, u_max = [], 0
+    for i in range(n_iter):
+        u = np.unique(rows_np[i][:k])
+        uniqs.append(u)
+        u_max = max(u_max, len(u))
+    u_pad = next_bucket_fine(4096, u_max + 1)
+    uniq_np = np.empty((n_iter, u_pad), np.int32)
+    for i, u in enumerate(uniqs):
+        uniq_np[i, :len(u)] = u
+        uniq_np[i, len(u):] = CAP + 1 + np.arange(u_pad - len(u))
+    uniq_stack = jnp.asarray(uniq_np)
 
-timeit("segsum_K", p_segsum, vals_k, segs)
+    state = init_table_state(CAP, MF, ext=ext)
+    grads = jnp.asarray(
+        rng.normal(size=(u_pad, 3 + MF)).astype(np.float32))
+    vals_k = jnp.asarray(
+        rng.normal(size=(k_pad, 3 + MF)).astype(np.float32))
+    prng = jax.random.PRNGKey(0)
 
-# ---- probe: seqpool bwd (gather K from B*S) ----
-pooled_g = jnp.asarray(
-    rng.normal(size=(B * S + 1, 3 + MF)).astype(np.float32))
-
-@jax.jit
-def p_seg_bwd(pooled_g, segs):
-    def body(i, acc):
-        v = pooled_g[segs] * (1.0 + acc)
-        return acc + v[0, 0] + v[-1, -1]
-    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
-
-timeit("seg_bwd_gather_K", p_seg_bwd, pooled_g, segs)
-
-# ---- probe: slot-wire decode (cumsum + searchsorted at K) ----
-counts_u16 = jnp.asarray(counts.sum(axis=1).astype(np.int32))
-
-@jax.jit
-def p_slotwire(counts_u16):
-    def body(i, acc):
-        cum = jnp.cumsum(counts_u16 + acc.astype(jnp.int32))
-        rec = jnp.searchsorted(cum, jnp.arange(K_pad, dtype=jnp.int32),
-                               side="right").astype(jnp.int32)
-        return acc + rec[-1]
-    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros((), jnp.int32))
-
-timeit("slotwire_decode_K", p_slotwire, counts_u16)
-
-# ---- probe: slot-wire decode via scatter+cumsum (candidate fix) ----
-@jax.jit
-def p_slotwire2(counts_u16):
-    def body(i, acc):
-        cum = jnp.cumsum(counts_u16 + acc.astype(jnp.int32))
-        marks = jnp.zeros(K_pad, jnp.int32).at[cum].add(
-            1, mode="drop")
-        rec = jnp.cumsum(marks)
-        return acc + rec[-1]
-    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros((), jnp.int32))
-
-timeit("slotwire_scatter_cumsum_K", p_slotwire2, counts_u16)
-
-# ---- probe: expand backward (segment_sum K→U, the grad merge) ----
-@jax.jit
-def p_expand_bwd(vals_k, gidx_stack):
-    def body(i, acc):
-        g = jax.ops.segment_sum(vals_k * (1.0 + acc), gidx_stack[i],
-                                num_segments=U_pad)
-        return acc + g[0, 0] + g[-1, -1]
-    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
-
-timeit("expand_bwd_segsum_K_to_U", p_expand_bwd, vals_k, gidx_stack)
-
-# ---- probe: gather linearity (half U) ----
-half_stack = uniq_stack[:, :U_pad // 2]
-
-@jax.jit
-def p_gather_half(state, half_stack):
-    def body(i, acc):
-        rows = gather_full_rows(state, half_stack[i])
-        return acc + rows[0, 0] + rows[-1, -1]
-    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
-
-timeit("gather_halfU_big", p_gather_half, state, half_stack,
-       U=U_pad // 2)
-
-# ---- probe: per-key direct gather from big table (K-sized) ----
-@jax.jit
-def p_gather_K_direct(state, rows_stack):
-    def body(i, acc):
-        rows = gather_full_rows(state, rows_stack[i])
-        return acc + rows[0, 0] + rows[-1, -1]
-    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
-
-timeit("gather_K_direct_big", p_gather_K_direct, state, rows_stack,
-       K_pad=K_pad)
-
-# ---- probe: dense DeepFM fwd+bwd at this B ----
-from paddlebox_tpu.models import DeepFM
-import optax
-model = DeepFM(hidden=(512, 256, 128))
-pooled0 = jnp.zeros((B, S, 3 + MF))
-dense0 = jnp.zeros((B, 13))
-params = model.init(jax.random.PRNGKey(0), pooled0, dense0)
-pooled_in = jnp.asarray(rng.normal(size=(B, S, 3 + MF)).astype(np.float32))
-dense_in = jnp.asarray(rng.normal(size=(B, 13)).astype(np.float32))
-label = jnp.asarray((rng.random(B) < 0.25).astype(np.float32))
-
-@jax.jit
-def p_dense(params, pooled_in, dense_in, label):
-    def body(i, carry):
-        acc, params = carry
-        def loss_fn(p):
-            lg = model.apply(p, pooled_in * (1 + acc), dense_in)
-            return optax.sigmoid_binary_cross_entropy(lg, label).mean()
-        l, g = jax.value_and_grad(loss_fn)(params)
-        params = jax.tree.map(lambda a, b: a - 1e-9 * b, params, g)
-        return acc + l * 1e-9, params
-    acc, params = jax.lax.fori_loop(
-        0, N_ITER, body, (jnp.zeros(()), params))
-    return acc
-
-timeit("dense_fwd_bwd", p_dense, params, pooled_in, dense_in, label)
-
-# ---- hot-tier probes ----
-H = int(os.environ.get("PROF_HOT_ROWS", 8192))
-hot_packed = jnp.asarray(
-    rng.normal(size=(H // 8, 128)).astype(np.float32))
-hot_idx = jnp.asarray(
-    rng.integers(0, H, size=(N_ITER, K_pad)).astype(np.int32))
-
-@jax.jit
-def p_hot_gather(hot_packed, hot_idx):
-    """Same packed-line gather, small table: is per-index cost lower
-    when the source fits VMEM?"""
-    def body(i, acc):
-        rows = hot_idx[i]
-        lines = hot_packed[rows // 8]
-        sub = (rows % 8).astype(jnp.int32)
-        grouped = lines.reshape(K_pad, 8, 16)
-        v = jnp.take_along_axis(grouped, sub[:, None, None], axis=1)[:, 0]
-        return acc + v[0, 0] + v[-1, -1]
-    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
-
-timeit("hot_gather_smalltable_K", p_hot_gather, hot_packed, hot_idx, H=H)
-
-# one-hot MXU matmul gather: [K, H] @ [H, 16] for a few H
-for Hm in (512, 2048, 8192):
-    hot_tab = jnp.asarray(rng.normal(size=(Hm, 16)).astype(np.float32))
-    hidx = jnp.asarray(
-        rng.integers(0, Hm, size=(N_ITER, K_pad)).astype(np.int32))
+    print(json.dumps({"probe": "shape", "B": b, "S": s, "K": k,
+                      "K_pad": k_pad, "U": u_max, "U_pad": u_pad}),
+          flush=True)
 
     @jax.jit
-    def p_onehot(hot_tab, hidx):
+    def p_gather(state, uniq_stack):
         def body(i, acc):
-            oh = jax.nn.one_hot(hidx[i], Hm, dtype=jnp.bfloat16)
-            v = oh @ hot_tab.astype(jnp.bfloat16)
-            return acc + v[0, 0].astype(jnp.float32) \
-                + v[-1, -1].astype(jnp.float32)
-        return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+            rows = gather_full_rows(state, uniq_stack[i])
+            return acc + rows[0, 0] + rows[-1, -1]
+        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
 
-    timeit(f"onehot_matmul_gather_H{Hm}", p_onehot, hot_tab, hidx, H=Hm)
+    timeit("gather_U_big", p_gather, state, uniq_stack, U_pad=u_pad)
 
     @jax.jit
-    def p_onehot_push(hot_tab, hidx, grads16):
-        """Push via transposed one-hot: [H, K] @ [K, 16] scatter-add."""
-        def body(i, tab):
-            oh = jax.nn.one_hot(hidx[i], Hm, dtype=jnp.bfloat16,
-                                axis=0)  # [H, K]
-            return tab + (oh @ grads16).astype(jnp.float32)
-        return jax.lax.fori_loop(0, N_ITER, body, hot_tab)[0, 0]
+    def p_push(state, uniq_stack, grads, prng):
+        def body(i, st):
+            return apply_push(st, uniq_stack[i], grads, cfg, prng)
+        return jax.lax.fori_loop(0, n_iter, body, state).packed[0, 0]
 
-    grads16 = jnp.asarray(
-        rng.normal(size=(K_pad, 16)).astype(np.float32)).astype(
-            jnp.bfloat16)
-    timeit(f"onehot_matmul_push_H{Hm}", p_onehot_push, hot_tab, hidx,
-           grads16, H=Hm)
+    timeit("push_U", p_push, state, uniq_stack, grads, prng, U_pad=u_pad)
 
-# sorted vs unsorted gather from the big table
-sorted_stack = jnp.asarray(np.sort(uniq_np, axis=1))
+    @jax.jit
+    def p_dedup(rows_stack):
+        def body(i, acc):
+            u, g = dedup_rows(rows_stack[i], CAP)
+            return acc + u[0] + g[-1]
+        return jax.lax.fori_loop(0, n_iter, body,
+                                 jnp.zeros((), jnp.int32))
 
-@jax.jit
-def p_gather_sorted(state, sorted_stack):
-    def body(i, acc):
-        rows = gather_full_rows(state, sorted_stack[i])
-        return acc + rows[0, 0] + rows[-1, -1]
-    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+    timeit("dedup_rows_K", p_dedup, rows_stack, K_pad=k_pad)
 
-timeit("gather_U_big_sorted", p_gather_sorted, state, sorted_stack)
+    gidx_np = rng.integers(0, u_max, size=(n_iter, k_pad)) \
+        .astype(np.int32)
+    gidx_stack = jnp.asarray(gidx_np)
+    vals_u = jnp.asarray(
+        rng.normal(size=(u_pad, 3 + MF)).astype(np.float32))
 
-# bf16 pull lines: gather from a bf16 copy of the packed table
-state_bf = TableState(state.packed.astype(jnp.bfloat16), CAP, FEAT, EXT)
+    @jax.jit
+    def p_expand(vals_u, gidx_stack):
+        def body(i, acc):
+            v = vals_u[gidx_stack[i]]
+            return acc + v[0, 0] + v[-1, -1]
+        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
 
-@jax.jit
-def p_gather_bf16(state_bf, uniq_stack):
-    def body(i, acc):
-        rows = gather_full_rows(state_bf, uniq_stack[i])
-        return acc + rows[0, 0].astype(jnp.float32)
-    return jax.lax.fori_loop(0, N_ITER, body, jnp.zeros(()))
+    timeit("expand_K_from_U", p_expand, vals_u, gidx_stack)
 
-timeit("gather_U_big_bf16", p_gather_bf16, state_bf, uniq_stack)
+    @jax.jit
+    def p_segsum(vals_k, segs):
+        def body(i, acc):
+            pooled = segment_sum(vals_k * (1.0 + acc), segs,
+                                 num_segments=b * s + 1)
+            return acc + pooled[0, 0] + pooled[-1, -1]
+        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
 
-print(json.dumps({"probe": "done"}), flush=True)
+    timeit("segsum_K", p_segsum, vals_k, segs)
+
+    pooled_g = jnp.asarray(
+        rng.normal(size=(b * s + 1, 3 + MF)).astype(np.float32))
+
+    @jax.jit
+    def p_seg_bwd(pooled_g, segs):
+        def body(i, acc):
+            v = pooled_g[segs] * (1.0 + acc)
+            return acc + v[0, 0] + v[-1, -1]
+        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+    timeit("seg_bwd_gather_K", p_seg_bwd, pooled_g, segs)
+
+    counts_u16 = jnp.asarray(counts.sum(axis=1).astype(np.int32))
+
+    @jax.jit
+    def p_slotwire(counts_u16):
+        def body(i, acc):
+            cum = jnp.cumsum(counts_u16 + acc.astype(jnp.int32))
+            rec = jnp.searchsorted(cum,
+                                   jnp.arange(k_pad, dtype=jnp.int32),
+                                   side="right").astype(jnp.int32)
+            return acc + rec[-1]
+        return jax.lax.fori_loop(0, n_iter, body,
+                                 jnp.zeros((), jnp.int32))
+
+    timeit("slotwire_decode_K", p_slotwire, counts_u16)
+
+    @jax.jit
+    def p_slotwire2(counts_u16):
+        def body(i, acc):
+            cum = jnp.cumsum(counts_u16 + acc.astype(jnp.int32))
+            marks = jnp.zeros(k_pad, jnp.int32).at[cum].add(
+                1, mode="drop")
+            rec = jnp.cumsum(marks)
+            return acc + rec[-1]
+        return jax.lax.fori_loop(0, n_iter, body,
+                                 jnp.zeros((), jnp.int32))
+
+    timeit("slotwire_scatter_cumsum_K", p_slotwire2, counts_u16)
+
+    @jax.jit
+    def p_expand_bwd(vals_k, gidx_stack):
+        def body(i, acc):
+            g = jax.ops.segment_sum(vals_k * (1.0 + acc),
+                                    gidx_stack[i], num_segments=u_pad)
+            return acc + g[0, 0] + g[-1, -1]
+        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+    timeit("expand_bwd_segsum_K_to_U", p_expand_bwd, vals_k, gidx_stack)
+
+    half_stack = uniq_stack[:, :u_pad // 2]
+
+    @jax.jit
+    def p_gather_half(state, half_stack):
+        def body(i, acc):
+            rows = gather_full_rows(state, half_stack[i])
+            return acc + rows[0, 0] + rows[-1, -1]
+        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+    timeit("gather_halfU_big", p_gather_half, state, half_stack,
+           U=u_pad // 2)
+
+    @jax.jit
+    def p_gather_K_direct(state, rows_stack):
+        def body(i, acc):
+            rows = gather_full_rows(state, rows_stack[i])
+            return acc + rows[0, 0] + rows[-1, -1]
+        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+    timeit("gather_K_direct_big", p_gather_K_direct, state, rows_stack,
+           K_pad=k_pad)
+
+    # ---- dense DeepFM fwd+bwd at this B ----
+    import optax
+
+    from paddlebox_tpu.models import DeepFM
+    model = DeepFM(hidden=(512, 256, 128))
+    pooled0 = jnp.zeros((b, s, 3 + MF))
+    dense0 = jnp.zeros((b, 13))
+    params = model.init(jax.random.PRNGKey(0), pooled0, dense0)
+    pooled_in = jnp.asarray(
+        rng.normal(size=(b, s, 3 + MF)).astype(np.float32))
+    dense_in = jnp.asarray(rng.normal(size=(b, 13)).astype(np.float32))
+    label = jnp.asarray((rng.random(b) < 0.25).astype(np.float32))
+
+    @jax.jit
+    def p_dense(params, pooled_in, dense_in, label):
+        def body(i, carry):
+            acc, params = carry
+
+            def loss_fn(p):
+                lg = model.apply(p, pooled_in * (1 + acc), dense_in)
+                return optax.sigmoid_binary_cross_entropy(
+                    lg, label).mean()
+
+            l, g = jax.value_and_grad(loss_fn)(params)
+            params = jax.tree.map(lambda a, b: a - 1e-9 * b, params, g)
+            return acc + l * 1e-9, params
+
+        acc, params = jax.lax.fori_loop(
+            0, n_iter, body, (jnp.zeros(()), params))
+        return acc
+
+    timeit("dense_fwd_bwd", p_dense, params, pooled_in, dense_in, label)
+
+    # ---- hot-tier probes ----
+    h = int(os.environ.get("PROF_HOT_ROWS", 8192))
+    hot_packed = jnp.asarray(
+        rng.normal(size=(h // 8, 128)).astype(np.float32))
+    hot_idx = jnp.asarray(
+        rng.integers(0, h, size=(n_iter, k_pad)).astype(np.int32))
+
+    @jax.jit
+    def p_hot_gather(hot_packed, hot_idx):
+        def body(i, acc):
+            rows = hot_idx[i]
+            lines = hot_packed[rows // 8]
+            sub = (rows % 8).astype(jnp.int32)
+            grouped = lines.reshape(k_pad, 8, 16)
+            v = jnp.take_along_axis(grouped, sub[:, None, None],
+                                    axis=1)[:, 0]
+            return acc + v[0, 0] + v[-1, -1]
+        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+    timeit("hot_gather_smalltable_K", p_hot_gather, hot_packed, hot_idx,
+           H=h)
+
+    for hm in (512, 2048, 8192):
+        hot_tab = jnp.asarray(
+            rng.normal(size=(hm, 16)).astype(np.float32))
+        hidx = jnp.asarray(
+            rng.integers(0, hm, size=(n_iter, k_pad)).astype(np.int32))
+
+        @jax.jit
+        def p_onehot(hot_tab, hidx, hm=hm):
+            def body(i, acc):
+                oh = jax.nn.one_hot(hidx[i], hm, dtype=jnp.bfloat16)
+                v = oh @ hot_tab.astype(jnp.bfloat16)
+                return acc + v[0, 0].astype(jnp.float32) \
+                    + v[-1, -1].astype(jnp.float32)
+            return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+        timeit(f"onehot_matmul_gather_H{hm}", p_onehot, hot_tab, hidx,
+               H=hm)
+
+        @jax.jit
+        def p_onehot_push(hot_tab, hidx, grads16, hm=hm):
+            def body(i, tab):
+                oh = jax.nn.one_hot(hidx[i], hm, dtype=jnp.bfloat16,
+                                    axis=0)  # [H, K]
+                return tab + (oh @ grads16).astype(jnp.float32)
+            return jax.lax.fori_loop(0, n_iter, body, hot_tab)[0, 0]
+
+        grads16 = jnp.asarray(
+            rng.normal(size=(k_pad, 16)).astype(np.float32)).astype(
+                jnp.bfloat16)
+        timeit(f"onehot_matmul_push_H{hm}", p_onehot_push, hot_tab,
+               hidx, grads16, H=hm)
+
+    sorted_stack = jnp.asarray(np.sort(uniq_np, axis=1))
+
+    @jax.jit
+    def p_gather_sorted(state, sorted_stack):
+        def body(i, acc):
+            rows = gather_full_rows(state, sorted_stack[i])
+            return acc + rows[0, 0] + rows[-1, -1]
+        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+    timeit("gather_U_big_sorted", p_gather_sorted, state, sorted_stack)
+
+    state_bf = TableState(state.packed.astype(jnp.bfloat16), CAP, feat,
+                          ext)
+
+    @jax.jit
+    def p_gather_bf16(state_bf, uniq_stack):
+        def body(i, acc):
+            rows = gather_full_rows(state_bf, uniq_stack[i])
+            return acc + rows[0, 0].astype(jnp.float32)
+        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+    timeit("gather_U_big_bf16", p_gather_bf16, state_bf, uniq_stack)
+
+
+def run_set2(n_iter: int) -> None:
+    """Grad-merge ordering, gather extract form, push variants (the
+    levers left after the slot-wire decode fix). Ragged shape."""
+    from paddlebox_tpu.ps.table import (gather_full_rows,
+                                        init_table_state,
+                                        next_bucket_fine)
+    from paddlebox_tpu.ps.sgd import SparseSGDConfig, opt_ext_width
+
+    timeit = make_timeit(n_iter, fetch_val=True)
+    b, s, avg, vocab = shape_dims("ragged")
+    cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=1e-3)
+    ext = opt_ext_width(cfg, MF)
+
+    rng = np.random.default_rng(0)
+    counts = 1 + rng.poisson(avg - 1.0, size=(b, s))
+    k = int(counts.sum())
+    k_pad = next_bucket_fine(4096, k)
+    rows_np, _ = _ragged_rows(rng, n_iter, counts, k, k_pad, s, vocab)
+
+    # host-computed dedup per iteration (uniq sorted / gidx / perm /
+    # uid_sorted)
+    uniqs = [np.unique(rows_np[i][:k], return_inverse=True)
+             for i in range(n_iter)]
+    u_max = max(len(u) for u, _ in uniqs)
+    u_pad = next_bucket_fine(4096, u_max + 1)
+    gidx_np = np.zeros((n_iter, k_pad), np.int32)
+    for i, (u, inv) in enumerate(uniqs):
+        gidx_np[i, :k] = inv
+        gidx_np[i, k:] = len(u)  # pad position
+    gidx_stack = jnp.asarray(gidx_np)
+    # sorted-by-row order: perm sorts keys by row id; uid_sorted
+    # nondecreasing
+    perm_np = np.empty((n_iter, k_pad), np.int32)
+    uid_sorted_np = np.empty((n_iter, k_pad), np.int32)
+    for i in range(n_iter):
+        p = np.argsort(rows_np[i], kind="stable")
+        perm_np[i] = p
+        uid_sorted_np[i] = gidx_np[i][p]
+    perm_stack = jnp.asarray(perm_np)
+    uid_sorted_stack = jnp.asarray(uid_sorted_np)
+
+    g_k = jnp.asarray(rng.normal(size=(k_pad, 3 + MF)).astype(np.float32))
+    state = init_table_state(CAP, MF, ext=ext)
+    uniq_pad_np = np.empty((n_iter, u_pad), np.int32)
+    for i, (u, _) in enumerate(uniqs):
+        uniq_pad_np[i, :len(u)] = u
+        uniq_pad_np[i, len(u):] = CAP + 1 + np.arange(u_pad - len(u))
+    uniq_stack = jnp.asarray(uniq_pad_np)
+
+    print(json.dumps({"probe": "shape", "K": k, "K_pad": k_pad,
+                      "U_pad": u_pad}), flush=True)
+
+    @jax.jit
+    def p_merge_unsorted(g_k, gidx_stack):
+        def body(i, acc):
+            g = jax.ops.segment_sum(g_k + acc * 1e-9, gidx_stack[i],
+                                    num_segments=u_pad)
+            return acc + g.sum()
+        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+    timeit("merge_unsorted", p_merge_unsorted, g_k, gidx_stack)
+
+    @jax.jit
+    def p_merge_sorted_hint(g_k, perm_stack, uid_sorted_stack):
+        def body(i, acc):
+            gs = g_k[perm_stack[i]] + acc * 1e-9
+            g = jax.ops.segment_sum(gs, uid_sorted_stack[i],
+                                    num_segments=u_pad,
+                                    indices_are_sorted=True)
+            return acc + g.sum()
+        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+    timeit("merge_perm_plus_sorted_hint", p_merge_sorted_hint, g_k,
+           perm_stack, uid_sorted_stack)
+
+    @jax.jit
+    def p_merge_sorted_nohint(g_k, perm_stack, uid_sorted_stack):
+        def body(i, acc):
+            gs = g_k[perm_stack[i]] + acc * 1e-9
+            g = jax.ops.segment_sum(gs, uid_sorted_stack[i],
+                                    num_segments=u_pad)
+            return acc + g.sum()
+        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+    timeit("merge_perm_plus_sorted_nohint", p_merge_sorted_nohint, g_k,
+           perm_stack, uid_sorted_stack)
+
+    @jax.jit
+    def p_merge_sorted_only(g_k, uid_sorted_stack):
+        def body(i, acc):
+            g = jax.ops.segment_sum(g_k + acc * 1e-9,
+                                    uid_sorted_stack[i],
+                                    num_segments=u_pad,
+                                    indices_are_sorted=True)
+            return acc + g.sum()
+        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+    timeit("merge_sorted_ids_only_hint", p_merge_sorted_only, g_k,
+           uid_sorted_stack)
+
+    rand_small = jnp.asarray(
+        rng.integers(0, b * s, size=(n_iter, k_pad)).astype(np.int32))
+
+    @jax.jit
+    def p_segsum_small_random(g_k, rand_small):
+        def body(i, acc):
+            g = jax.ops.segment_sum(g_k + acc * 1e-9, rand_small[i],
+                                    num_segments=b * s + 1)
+            return acc + g.sum()
+        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+    timeit("segsum_small_random_ids", p_segsum_small_random, g_k,
+           rand_small)
+
+    @jax.jit
+    def p_gather_take(state, uniq_stack):
+        def body(i, acc):
+            rows = gather_full_rows(state, uniq_stack[i])
+            return acc + rows.sum()
+        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+    timeit("gather_take_along_axis", p_gather_take, state, uniq_stack)
+
+    @jax.jit
+    def p_gather_maskex(state, uniq_stack):
+        rpl, fp, _ = state.geometry
+
+        def body(i, acc):
+            rows = jnp.minimum(uniq_stack[i], CAP)
+            lines = state.packed[rows // rpl]              # [U, 128]
+            sub = (rows % rpl).astype(jnp.int32)
+            grouped = lines.reshape(-1, rpl, fp)
+            oh = (jnp.arange(rpl, dtype=jnp.int32)[None, :]
+                  == sub[:, None]).astype(lines.dtype)     # [U, rpl]
+            vals = jnp.einsum("urf,ur->uf", grouped, oh)
+            return acc + vals.sum()
+        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+    timeit("gather_maskextract", p_gather_maskex, state, uniq_stack)
+
+    @jax.jit
+    def p_gather_lines_only(state, uniq_stack):
+        rpl, fp, _ = state.geometry
+
+        def body(i, acc):
+            rows = jnp.minimum(uniq_stack[i], CAP)
+            lines = state.packed[rows // rpl]
+            return acc + lines.sum()
+        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+    timeit("gather_lines_only", p_gather_lines_only, state, uniq_stack)
+
+    d_lines = jnp.asarray(
+        rng.normal(size=(u_pad, 128)).astype(np.float32))
+
+    @jax.jit
+    def p_scatter_lines(state, uniq_stack, d_lines):
+        rpl, fp, _ = state.geometry
+
+        def body(i, packed):
+            return packed.at[uniq_stack[i] // rpl].add(d_lines,
+                                                       mode="drop")
+        return jax.lax.fori_loop(0, n_iter, body, state.packed)[0, 0]
+
+    timeit("scatter_add_lines_U", p_scatter_lines, state, uniq_stack,
+           d_lines)
+
+    # line-dedup'd scatter: merge co-resident rows' deltas first (uniq
+    # is sorted, so line ids are nondecreasing → sorted segment_sum),
+    # then scatter unique lines
+    line_uid_np = np.empty((n_iter, u_pad), np.int32)
+    n_ulines = 0
+    for i in range(n_iter):
+        lines_i = uniq_pad_np[i] // 8
+        uid = np.zeros(u_pad, np.int32)
+        uid[1:] = np.cumsum(lines_i[1:] != lines_i[:-1])
+        line_uid_np[i] = uid
+        n_ulines = max(n_ulines, uid[-1] + 1)
+    from paddlebox_tpu.ps.table import next_bucket_fine as _nbf
+    ul_pad = _nbf(4096, int(n_ulines) + 1)
+    line_uid_stack = jnp.asarray(line_uid_np)
+
+    @jax.jit
+    def p_scatter_linededup(state, uniq_stack, line_uid_stack, d_lines):
+        rpl, fp, _ = state.geometry
+
+        def body(i, packed):
+            uid = line_uid_stack[i]
+            merged = jax.ops.segment_sum(d_lines, uid,
+                                         num_segments=ul_pad,
+                                         indices_are_sorted=True)
+            first_pos = jnp.full(ul_pad, u_pad - 1, jnp.int32).at[
+                uid].min(jnp.arange(u_pad, dtype=jnp.int32),
+                         mode="drop")
+            tgt_lines = (uniq_stack[i] // rpl)[first_pos]
+            return packed.at[tgt_lines].add(merged, mode="drop")
+        return jax.lax.fori_loop(0, n_iter, body, state.packed)[0, 0]
+
+    timeit("scatter_add_linededup", p_scatter_linededup, state,
+           uniq_stack, line_uid_stack, d_lines, UL_pad=ul_pad)
+
+
+def run_set3(n_iter: int) -> None:
+    """Merge form/dtype, packed-line expand, dedup sort form (the
+    levers left after the decode + gather-extract fixes). Ragged."""
+    from paddlebox_tpu.ops.device_unique import dedup_rows
+    from paddlebox_tpu.ps.table import next_bucket_fine
+
+    timeit = make_timeit(n_iter, fetch_val=True)
+    b, s, avg, vocab = shape_dims("ragged")
+    rng = np.random.default_rng(0)
+    counts = 1 + rng.poisson(avg - 1.0, size=(b, s))
+    k = int(counts.sum())
+    k_pad = next_bucket_fine(4096, k)
+    u_pad = 491520
+    u_real = 481763
+
+    gidx_stack = jnp.asarray(
+        rng.integers(0, u_real, size=(n_iter, k_pad)).astype(np.int32))
+    g_k = jnp.asarray(rng.normal(size=(k_pad, 11)).astype(np.float32))
+    rows_np, _ = _ragged_rows(rng, n_iter, counts, k, k_pad, s, vocab)
+    rows_stack = jnp.asarray(rows_np)
+
+    print(json.dumps({"probe": "shape", "K_pad": k_pad,
+                      "U_pad": u_pad}), flush=True)
+
+    @jax.jit
+    def p_merge_f32(g_k, gidx_stack):
+        def body(i, acc):
+            g = jax.ops.segment_sum(g_k + acc * 1e-9, gidx_stack[i],
+                                    num_segments=u_pad)
+            return acc + g.sum()
+        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+    timeit("merge_f32", p_merge_f32, g_k, gidx_stack)
+
+    @jax.jit
+    def p_merge_bf16(g_k, gidx_stack):
+        def body(i, acc):
+            g = jax.ops.segment_sum(
+                (g_k + acc * 1e-9).astype(jnp.bfloat16), gidx_stack[i],
+                num_segments=u_pad)
+            return acc + g.astype(jnp.float32).sum()
+        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+    timeit("merge_bf16", p_merge_bf16, g_k, gidx_stack)
+
+    @jax.jit
+    def p_merge_at_add(g_k, gidx_stack):
+        def body(i, acc):
+            g = jnp.zeros((u_pad, 11), jnp.float32).at[
+                gidx_stack[i]].add(g_k + acc * 1e-9)
+            return acc + g.sum()
+        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+    timeit("merge_at_add", p_merge_at_add, g_k, gidx_stack)
+
+    g_k16 = jnp.asarray(rng.normal(size=(k_pad, 16)).astype(np.float32))
+
+    @jax.jit
+    def p_merge_w16(g_k16, gidx_stack):
+        def body(i, acc):
+            g = jax.ops.segment_sum(g_k16 + acc * 1e-9, gidx_stack[i],
+                                    num_segments=u_pad)
+            return acc + g.sum()
+        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+    timeit("merge_w16", p_merge_w16, g_k16, gidx_stack)
+
+    vals_u = jnp.asarray(rng.normal(size=(u_pad, 11)).astype(np.float32))
+
+    @jax.jit
+    def p_expand_plain(vals_u, gidx_stack):
+        def body(i, acc):
+            v = vals_u[gidx_stack[i]] + acc * 1e-9
+            return acc + v.sum()
+        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+    timeit("expand_plain", p_expand_plain, vals_u, gidx_stack)
+
+    vals_packed = jnp.asarray(
+        rng.normal(size=(u_pad // 8, 128)).astype(np.float32))
+
+    @jax.jit
+    def p_expand_packedlines(vals_packed, gidx_stack):
+        def body(i, acc):
+            g = gidx_stack[i]
+            lines = vals_packed[g // 8]                    # [K, 128]
+            sub = (g % 8).astype(jnp.int32)
+            grouped = lines.reshape(-1, 8, 16)
+            oh = (jnp.arange(8, dtype=jnp.int32)[None, :]
+                  == sub[:, None]).astype(lines.dtype)
+            v = jnp.einsum("krf,kr->kf", grouped, oh) + acc * 1e-9
+            return acc + v.sum()
+        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+    timeit("expand_packedlines_maskex", p_expand_packedlines,
+           vals_packed, gidx_stack)
+
+    @jax.jit
+    def p_dedup_current(rows_stack):
+        def body(i, acc):
+            u, g = dedup_rows(rows_stack[i], CAP)
+            return acc + (u.sum() + g.sum())
+        return jax.lax.fori_loop(0, n_iter, body,
+                                 jnp.zeros((), jnp.int32))
+
+    timeit("dedup_current", p_dedup_current, rows_stack)
+
+    @jax.jit
+    def p_dedup_i64pack(rows_stack):
+        def body(i, acc):
+            rows = rows_stack[i]
+            kk = rows.shape[0]
+            pos = jnp.arange(kk, dtype=jnp.int64)
+            packed = (rows.astype(jnp.int64) << 20) | pos
+            sp = jax.lax.sort(packed)
+            sr = (sp >> 20).astype(jnp.int32)
+            perm = (sp & ((1 << 20) - 1)).astype(jnp.int32)
+            is_first = jnp.concatenate([jnp.ones(1, bool),
+                                        sr[1:] != sr[:-1]])
+            uid_sorted = jnp.cumsum(is_first.astype(jnp.int32)) - 1
+            gidx = jnp.zeros(kk, jnp.int32).at[perm].set(
+                uid_sorted, unique_indices=True)
+            oob = CAP + 1 + jnp.arange(kk, dtype=jnp.int32)
+            uniq = oob.at[uid_sorted].set(sr)
+            return acc + (uniq.sum() + gidx.sum())
+        return jax.lax.fori_loop(0, n_iter, body,
+                                 jnp.zeros((), jnp.int32))
+
+    timeit("dedup_i64pack", p_dedup_i64pack, rows_stack)
+
+    @jax.jit
+    def p_merge_lines(g_k16, gidx_stack):
+        def body(i, acc):
+            g = gidx_stack[i]
+            sub = (g % 8).astype(jnp.int32)
+            oh = (jnp.arange(8, dtype=jnp.int32)[None, :]
+                  == sub[:, None]).astype(jnp.float32)     # [K, 8]
+            d = (oh[:, :, None] * (g_k16 + acc * 1e-9)[:, None, :]
+                 ).reshape(-1, 128)                        # [K, 128]
+            out = jnp.zeros((u_pad // 8, 128), jnp.float32).at[
+                g // 8].add(d)
+            return acc + out.sum()
+        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+    timeit("merge_lines_f32", p_merge_lines, g_k16, gidx_stack)
+
+    @jax.jit
+    def p_merge_bucketed64(g_k, gidx_stack):
+        def body(i, acc):
+            g = gidx_stack[i]
+            col = (g % 64).astype(jnp.int32)
+            oh_cols = (col[:, None] * 11
+                       + jnp.arange(11, dtype=jnp.int32)[None, :])
+            out = jnp.zeros((u_pad // 64, 64 * 11), jnp.float32).at[
+                (g // 64)[:, None], oh_cols].add(g_k + acc * 1e-9)
+            return acc + out.sum()
+        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+    timeit("merge_bucketed64", p_merge_bucketed64, g_k, gidx_stack)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="device key-path cost probes")
+    ap.add_argument("--set", dest="probe_set", default="1",
+                    choices=("1", "2", "3", "all"),
+                    help="probe set to run (default 1)")
+    ap.add_argument("--shape",
+                    default=os.environ.get("PROF_SHAPE", "ragged"),
+                    choices=("ragged", "uniform", "thousand"),
+                    help="workload shape for set 1")
+    ap.add_argument("--iters", type=int,
+                    default=int(os.environ.get("PROF_ITERS", 16)),
+                    help="fori_loop iterations per probe")
+    args = ap.parse_args(argv)
+    sets = ("1", "2", "3") if args.probe_set == "all" \
+        else (args.probe_set,)
+    for ps in sets:
+        print(json.dumps({"probe": "set", "set": int(ps)}), flush=True)
+        if ps == "1":
+            run_set1(args.shape, args.iters)
+        elif ps == "2":
+            run_set2(args.iters)
+        else:
+            run_set3(args.iters)
+    print(json.dumps({"probe": "done"}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -66,6 +66,19 @@ BEGIN_STALL_COLS = (
 )
 
 
+def _bottleneck_cell(cp: Dict) -> str:
+    """Render a pass event's critical_path block (obs/trace): the
+    bottleneck verdict plus the stall it names — 'device (+0.012s
+    stalls)' or 'build_wait +0.740s'."""
+    if not cp or "bottleneck" not in cp:
+        return ""
+    b = cp["bottleneck"]
+    stall = float(cp.get("stall_sec", 0.0) or 0.0)
+    if b == "device":
+        return f"device (+{stall:.3f}s stalls)"
+    return f"{b} +{stall:.3f}s"
+
+
 def _begin_stall_cell(lp: Dict) -> str:
     """Render a pass event's begin_stall breakdown (tiered runs) —
     the per-stage boundary attribution without jq archaeology."""
@@ -131,6 +144,8 @@ def build_rows(events: List[dict]) -> List[Dict[str, str]]:
             "queue stall": stall or "-",
             "table": tbl or "-",
             "begin stall": begin_stall or "-",
+            "bottleneck": _bottleneck_cell(ev.get("critical_path", {}))
+            or "-",
             "hbm peak": _fmt_bytes(hbm.get("peak_bytes_in_use", 0)),
         })
     return rows
@@ -165,6 +180,36 @@ def _fmt_recovery(ev: dict) -> str:
     return f"{name}({', '.join(bits)})" if bits else name
 
 
+def critical_path_summary(events: List[dict]) -> str:
+    """Whole-run critical-path verdict from the passes' critical_path
+    blocks (obs/trace): the majority verdict plus each minority pass
+    called out with its stall — '7/8 passes device-bound, pass 2
+    build_wait-bound: +0.740s'. Empty when no pass carried a block."""
+    cps = []
+    for ev in events:
+        if ev.get("event") != "pass":
+            continue
+        cp = ev.get("critical_path")
+        if cp and "bottleneck" in cp:
+            cps.append((str(ev.get("pass_seq", len(cps) + 1)), cp))
+    if not cps:
+        return ""
+    counts: Dict[str, int] = {}
+    for _, cp in cps:
+        counts[cp["bottleneck"]] = counts.get(cp["bottleneck"], 0) + 1
+    major = max(counts, key=counts.get)
+    bits = [f"{counts[major]}/{len(cps)} passes {major}-bound"]
+    for seq, cp in cps:
+        if cp["bottleneck"] != major:
+            bits.append(f"pass {seq} {cp['bottleneck']}-bound: "
+                        f"+{float(cp.get('stall_sec', 0.0)):.3f}s")
+    stall_tot = sum(float(cp.get("stall_sec", 0.0) or 0.0)
+                    for _, cp in cps if cp["bottleneck"] != "device")
+    if stall_tot > 5e-4:
+        bits.append(f"non-device stalls total +{stall_tot:.3f}s")
+    return "critical path: " + ", ".join(bits)
+
+
 def render_report(events: List[dict], show_events: bool = False) -> str:
     rows = build_rows(events)
     out = [render_table(rows)]
@@ -177,6 +222,9 @@ def render_report(events: List[dict], show_events: bool = False) -> str:
                    f"{tot_wall:.3f}s inside passes"
                    + (f", {tot_ex / tot_wall:.0f} ex/s overall"
                       if tot_wall > 0 else ""))
+    cp_line = critical_path_summary(events)
+    if cp_line:
+        out.append(cp_line)
     recovery = [e for e in events if e.get("event") in RECOVERY_EVENTS]
     if recovery:
         out.append("recovery: " + " -> ".join(_fmt_recovery(e)

@@ -146,6 +146,86 @@ def test_prom_http_endpoint(fresh_hub):
         fresh_hub.stop_prom_http()
 
 
+def test_healthz_route(fresh_hub):
+    """/healthz on the prom endpoint (ISSUE 10 satellite): run_id,
+    uptime, and last-pass age — the serving/streaming liveness probe."""
+    srv = fresh_hub.start_prom_http(0)
+    try:
+        port = srv.server_address[1]
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5)
+        assert resp.headers["Content-Type"] == "application/json"
+        h = json.loads(resp.read().decode())
+        assert h["status"] == "ok"
+        assert h["run_id"] == fresh_hub.run_id
+        assert h["uptime_sec"] >= 0
+        # no pass yet: age is null, count 0
+        assert h["passes_total"] == 0
+        assert h["last_pass_age_sec"] is None
+        emit_pass_event("train_pass", {"batches": 1, "elapsed_sec": 0.1})
+        h = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5
+            ).read().decode())
+        assert h["passes_total"] == 1
+        assert h["last_pass_age_sec"] is not None
+        assert 0 <= h["last_pass_age_sec"] < 60
+        # /metrics still serves exposition on the same port
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+            ).read().decode()
+        assert "pbox_passes_total" in body
+    finally:
+        fresh_hub.stop_prom_http()
+
+
+def test_add_sink_dual_capability_registers_both(fresh_hub):
+    """Regression (ISSUE 10 satellite): a sink exposing BOTH emit and
+    span used to be silently registered span-only — its events were
+    dropped. It must land in both lists; kind= narrows explicitly."""
+
+    class Dual:
+        def __init__(self):
+            self.events, self.spans = [], []
+
+        def emit(self, ev):
+            self.events.append(ev)
+
+        def span(self, name, start, dur, attrs):
+            self.spans.append(name)
+
+        def close(self):
+            pass
+
+    d = Dual()
+    fresh_hub.add_sink(d)
+    assert d in fresh_hub.event_sinks()
+    assert d in fresh_hub.span_sinks()
+    fresh_hub.emit("tick")
+    with fresh_hub.span("s1"):
+        pass
+    assert [e["event"] for e in d.events] == ["tick"]
+    assert d.spans == ["s1"]
+    # explicit kinds narrow; impossible kinds are loud
+    only_ev = Dual()
+    fresh_hub.add_sink(only_ev, kind="event")
+    assert only_ev in fresh_hub.event_sinks()
+    assert only_ev not in fresh_hub.span_sinks()
+    with pytest.raises(ValueError):
+        fresh_hub.add_sink(Dual(), kind="bogus")
+    with pytest.raises(TypeError):
+        fresh_hub.add_sink(object())
+    # close_sinks closes a dual sink exactly once
+    closes = []
+
+    class CountingDual(Dual):
+        def close(self):
+            closes.append(1)
+
+    fresh_hub.add_sink(CountingDual())
+    fresh_hub.close_sinks()
+    assert len(closes) == 1
+
+
 def test_chrome_span_sink(fresh_hub):
     from paddlebox_tpu.obs import ChromeSpanSink
     from paddlebox_tpu.utils.profiler import ChromeTraceWriter
