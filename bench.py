@@ -431,6 +431,116 @@ def measure_tiered(num_passes: int = 4, shape: str = "uniform") -> dict:
     }
 
 
+def measure_multichip(shape: str = "uniform") -> None:
+    """BENCH_MODE=multichip (ISSUE 11): one subprocess per chip count N
+    (``XLA_FLAGS=--xla_force_host_platform_device_count=N`` on the CPU
+    backend; on real hardware point it at slices instead), each running
+    the SHARDED bench at a fixed small per-chip workload, then emit
+
+        sharded.n{N}.{shape}.ex_per_sec_per_chip
+        sharded.n{N}.{shape}.scaling_efficiency   (vs the smallest N)
+
+    rows through emit_result — so they fold into BENCH_trajectory.json
+    and ``scripts/perf_gate.py --check`` guards multichip scaling the
+    same way it guards the resident bench. CPU-mesh numbers are
+    recorded as what they are (virtual devices share one socket, so
+    efficiency ≈ 1/N there); the gate compares each key ACROSS ROUNDS,
+    never across N. BENCH_A2A_CHUNKS sets FLAGS_a2a_chunks in the
+    children to measure the chunked schedule's scaling."""
+    import subprocess
+    ns = [int(x) for x in os.environ.get("BENCH_MULTICHIP_NS",
+                                         "1,2,4,8").split(",")]
+    bs = int(os.environ.get("BENCH_MULTICHIP_BS", "1024"))
+    gbatches = int(os.environ.get("BENCH_MULTICHIP_BATCHES", "3"))
+    passes = int(os.environ.get("BENCH_MULTICHIP_PASSES", "2"))
+    timeout_s = float(os.environ.get("BENCH_MULTICHIP_TIMEOUT", "600"))
+    chunks = os.environ.get("BENCH_A2A_CHUNKS", "")
+    here = os.path.dirname(os.path.abspath(__file__))
+    per_chip = {}
+    meta = {}
+    for n in ns:
+        env = dict(os.environ)
+        xf = [f for f in env.get("XLA_FLAGS", "").split()
+              if "xla_force_host_platform_device_count" not in f]
+        env["XLA_FLAGS"] = " ".join(
+            xf + [f"--xla_force_host_platform_device_count={n}"])
+        env.update(
+            JAX_PLATFORMS="cpu", BENCH_MODE="sharded", BENCH_SHAPE=shape,
+            BENCH_BATCH_SIZE=str(bs),
+            BENCH_RECORDS=str(bs * n * gbatches),
+            BENCH_PASSES=str(passes), BENCH_MAX_PASSES=str(passes),
+            BENCH_WALL_BUDGET_SEC="120", BENCH_XPLANE="0",
+            BENCH_TIERED_ROW="0", BENCH_TRAJECTORY="0",
+            BENCH_TELEMETRY_JSONL="0",
+            # the children measure throughput; the exchange probe runs
+            # once, chunk-aware, only when a chunk sweep is requested
+            BENCH_A2A_PROBE="1" if chunks else "0")
+        if chunks:
+            env["FLAGS_a2a_chunks"] = chunks
+        t0 = time.perf_counter()
+        try:
+            cp = subprocess.run(
+                [sys.executable, os.path.join(here, "bench.py")],
+                env=env, capture_output=True, text=True,
+                timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            print(f"multichip n={n}: timed out after {timeout_s:.0f}s",
+                  file=sys.stderr)
+            continue
+        row = None
+        for line in reversed(cp.stdout.splitlines()):
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "metric" in d and isinstance(d.get("value"), (int, float)):
+                row = d
+                break
+        if cp.returncode != 0 or row is None:
+            print(f"multichip n={n}: bench failed rc={cp.returncode}: "
+                  f"{cp.stderr[-500:]}", file=sys.stderr)
+            continue
+        per_chip[n] = float(row["value"])
+        meta[n] = dict(wall_sec=round(time.perf_counter() - t0, 1),
+                       records_per_pass=bs * n * gbatches)
+    if not per_chip:
+        print("multichip: no chip count produced a row", file=sys.stderr)
+        sys.exit(1)
+    # efficiency is DEFINED against the smallest REQUESTED N: if that
+    # child failed, emitting ratios against a shifted baseline would
+    # poison the key's gate history (a later healthy round's honest
+    # n/base ratio reads as a spurious regression) — skip them instead
+    base_n = min(ns)
+    base = per_chip.get(base_n)
+    if base is None:
+        print(f"multichip: baseline n={base_n} failed — emitting "
+              "per-chip rows only, no scaling_efficiency this round",
+              file=sys.stderr)
+    # a chunked-schedule ladder gates under its OWN keys (…{shape}.c{c}.…):
+    # perf_gate keys on the metric name, and comparing a chunks=2 round
+    # against a chunks=1 best would gate incompatible schedules
+    shape_key = shape if int(chunks or 1) <= 1 else f"{shape}.c{chunks}"
+    for n in sorted(per_chip):
+        common = {"mode": "multichip", "shape": shape, "n_chips": n,
+                  "batch_size": bs, "a2a_chunks": int(chunks or 1),
+                  **meta[n]}
+        emit_result({
+            "metric": f"sharded.n{n}.{shape_key}.ex_per_sec_per_chip",
+            "value": round(per_chip[n], 1),
+            "unit": "examples/sec/chip",
+            "vs_baseline": round(per_chip[n] / (1_000_000 / 16), 4),
+            **common})
+        if base is not None:
+            emit_result({
+                "metric": f"sharded.n{n}.{shape_key}.scaling_efficiency",
+                "value": round(per_chip[n] / base, 4),
+                "unit": f"frac of n{base_n} per-chip rate",
+                "vs_baseline": None, **common})
+
+
 def xplane_device_busy_sec(trace_dir: str) -> float:
     """Parse the jax.profiler XPlane dump: summed UNION of XLA-module
     execution intervals on every /device: plane → measured device busy
@@ -560,6 +670,11 @@ def main() -> None:
     mf_dim = int(os.environ.get("BENCH_MF_DIM", 8))
     num_passes = int(os.environ.get("BENCH_PASSES", 5))
     mode = os.environ.get("BENCH_MODE", "resident")
+    if mode == "multichip":
+        # subprocess-per-chip-count scaling bench (ISSUE 11) — the
+        # parent never touches jax itself
+        measure_multichip(shape=shape)
+        return
     FLAGS.log_period_steps = 10 ** 9
     # the exact f64 host AUC finalize pulls the [2, 1e6] bucket tables
     # over the tunnel per pass; the bench opts into the device reduce
@@ -937,6 +1052,34 @@ def main() -> None:
                 # companion when the raw headline rides a shared tunnel
                 ex_per_sec_per_wire_mb_per_sec=round(
                     value / max(kept_wire_rate, 1e-9), 1))
+        if (mode == "sharded"
+                and os.environ.get("BENCH_A2A_PROBE", "1") == "1"):
+            # measured exchange/compute attribution (ISSUE 11;
+            # train/a2a_probe): per-chunk a2a vs pool seconds, plus the
+            # fused-schedule A/B over the same wire. Runs AFTER every
+            # headline number (its timed steps are real training steps,
+            # same discipline as the wire-free rerun); emits
+            # a2a.pull.*/a2a.push spans when BENCH_TRACE is on, and
+            # exchange_wait rides the next pass event's critical_path.
+            try:
+                from paddlebox_tpu.train.a2a_probe import probe_exchange
+                pr = probe_exchange(tr, dataset=pool[0])
+                # one extra wire-free pass so the probe's exchange_wait
+                # part rides a pass event's critical_path block (the
+                # telemetry/report view of the attribution)
+                tr.train_pass_resident(rp)
+                extras.update(
+                    a2a_chunks=pr["a2a_chunks"],
+                    exchange_overlap_frac=pr["exchange_overlap_frac"],
+                    exchange_sec_total=pr["exchange_sec_total"],
+                    exchange_wait_sec=pr["exchange_wait_sec"],
+                    a2a_pull_sec=pr["a2a_pull_sec"],
+                    a2a_pool_sec=pr["pool_sec"],
+                    a2a_push_sec=pr["push_sec"],
+                    step_monolithic_sec=pr["step_monolithic_sec"],
+                    step_chunked_sec=pr["step_chunked_sec"])
+            except Exception as e:  # probe must never eat the headline
+                print(f"a2a probe failed: {e}", file=sys.stderr)
     baseline_per_chip = 1_000_000 / 16  # v5p-32 north-star / chips
     if (mode == "resident" and shape == "uniform"
             and os.environ.get("BENCH_TIERED_ROW", "1") == "1"):

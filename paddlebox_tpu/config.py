@@ -86,6 +86,21 @@ class Flags:
     use_pallas_gather: bool = False
     use_pallas_seqpool: bool = False
 
+    # --- fused computation-collective sharded step (ISSUE 11;
+    # docs/PERFORMANCE.md §Sharded-step overlap) ---
+    # number of slot-group chunks the sharded pull exchange decomposes
+    # into: chunk k+1's embedding all_to_all is in flight while chunk
+    # k's expand_pull → fused_seqpool_cvm pooling runs, and the push
+    # grad all_to_all interleaves with the independent dense sync.
+    # 1 (default) = the monolithic exchange-then-compute schedule,
+    # byte-for-byte today's program. >1 requires slot-qualified keys
+    # (each key belongs to one slot — the criteo/CTR schema); a plan
+    # build that finds a key spanning slot groups falls back to the
+    # monolithic schedule for that batch, loudly. Chunked and
+    # monolithic schedules are BIT-IDENTICAL (gated in tier-1:
+    # tests/test_sharded.py digest parity, scripts/scaling_check.py).
+    a2a_chunks: int = 1
+
     # --- metrics (reference: metrics.h:46 table_size 1e6+1) ---
     auc_num_buckets: int = 1_000_000
     # False (default) = exact f64 host finalize — BasicAucCalculator::compute

@@ -344,7 +344,8 @@ def emit_pass_event(kind: str, metrics: Dict, stage_timers=None,
         return
     ev: Dict = {"kind": kind}
     for k in ("batches", "elapsed_sec", "examples_per_sec", "auc",
-              "last_loss", "global_step", "pass_seq"):
+              "last_loss", "global_step", "pass_seq",
+              "exchange_overlap_frac"):
         if k in metrics:
             ev[k] = metrics[k]
     if examples is not None:

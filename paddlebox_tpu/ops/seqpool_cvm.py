@@ -367,6 +367,58 @@ def _bwd_conv(batch_size, num_slots, use_cvm, show_filter, pad_value,
 fused_seqpool_cvm_with_conv.defvjp(_fwd_conv, _bwd_conv)
 
 
+def slot_group_bounds(num_slots: int, groups: int):
+    """Contiguous slot partition for the chunked sharded exchange
+    (FLAGS.a2a_chunks; train/sharded): ``groups`` spans [lo, hi) covering
+    [0, num_slots), the first ``num_slots % groups`` spans one slot
+    wider. Shared by the host plan builder (ps/sharded.prepare_global)
+    and the device step so both sides agree on group membership."""
+    groups = max(1, min(groups, num_slots))
+    base, rem = divmod(num_slots, groups)
+    bounds = []
+    lo = 0
+    for g in range(groups):
+        hi = lo + base + (1 if g < rem else 0)
+        bounds.append((lo, hi))
+        lo = hi
+    return bounds
+
+
+def fused_seqpool_cvm_slot_group(
+    values: jax.Array,          # [K_g, D] the group's pulled embeddings
+    segments: jax.Array,        # [K_g] GLOBAL ins*S + slot ids; pads → B*S
+    batch_show_clk: jax.Array,  # [B, cvm_offset]
+    batch_size: int,
+    num_slots_total: int,
+    slot_lo: int,
+    slot_hi: int,
+    use_cvm: bool = True,
+    cvm_offset: int = 2,
+) -> jax.Array:
+    """Group-decomposable pooling entry: pool ONE contiguous slot group
+    [slot_lo, slot_hi) of the batch into its [B, S_g, D'] block.
+
+    The full fusion's (ins, slot) bins are independent across slots, so
+    pooling a slot group in isolation and concatenating the blocks in
+    canonical slot order is BIT-identical to the monolithic
+    ``fused_seqpool_cvm`` over all keys — PROVIDED every key of the
+    group's segment stream has its slot inside [slot_lo, slot_hi) (the
+    slot-qualified contract the chunked plan builder verifies; pads at
+    B*S are routed to the group's discard bin). Segment ids renumber
+    in-trace: ``ins*S + slot → ins*S_g + (slot - slot_lo)``."""
+    s, sg = num_slots_total, slot_hi - slot_lo
+    if slot_lo == 0 and slot_hi == s:
+        return fused_seqpool_cvm(values, segments, batch_show_clk,
+                                 batch_size, s, use_cvm, cvm_offset)
+    n_bins = batch_size * s
+    ins = segments // s
+    local = ins * sg + (segments - ins * s) - slot_lo
+    seg_local = jnp.where(segments >= n_bins, batch_size * sg,
+                          local).astype(segments.dtype)
+    return fused_seqpool_cvm(values, seg_local, batch_show_clk,
+                             batch_size, sg, use_cvm, cvm_offset)
+
+
 def fused_seqpool_concat(values, segments, batch_size, num_slots,
                          pad_value=0.0):
     """Plain seqpool + concat (fusion_seqpool_concat_op): our fused op with

@@ -74,6 +74,50 @@ class ShardedPullIndex(NamedTuple):
     serve_capacity: int      # A2
     req_need: int = 0        # max real requests per (dst, owner)
     serve_need: int = 0      # max real serve rows per owner (+1 sentinel)
+    # ---- chunked exchange layout (FLAGS.a2a_chunks > 1; ISSUE 11) ----
+    # empty/None = the monolithic plan (exactly the pre-chunking bytes).
+    # When set, the A axis is partitioned into per-slot-group sections
+    # (sum(a2a_sections) == A) so chunk g's all_to_all ships only its
+    # section, and the key stream is re-laid group-contiguous
+    # (sum(key_sections) == gather_idx.shape[1]) with the group's
+    # segments shipped as ``key_segments`` (the batch's own segment
+    # stream is in the ORIGINAL key order and no longer applies).
+    a2a_sections: Tuple[int, ...] = ()   # per-group A section widths
+    key_sections: Tuple[int, ...] = ()   # per-group K section widths
+    slot_sections: Tuple[int, ...] = ()  # per-group slot counts (contig)
+    key_segments: Optional[np.ndarray] = None  # int32 [N_dst, sum(K_g)]
+
+
+def plan_sections(idx: "ShardedPullIndex") -> Tuple:
+    """The static chunk-schedule key of a plan: ``(a2a_sections,
+    key_sections, slot_sections)`` for a grouped plan, ``()`` for a
+    monolithic one. The device step compiles one executable per
+    distinct value (train/sharded.ShardedTrainStep._step_fn_for)."""
+    if getattr(idx, "a2a_sections", ()):
+        return (tuple(idx.a2a_sections), tuple(idx.key_sections),
+                tuple(idx.slot_sections))
+    return ()
+
+
+def section_offsets(sections) -> List[int]:
+    """Start offset of each contiguous section (exclusive-prefix sum).
+    Shared by every consumer of a grouped plan's static layout — the
+    chunked device step and the exchange probe must slice the SAME
+    positions (train/sharded._device_step, train/a2a_probe)."""
+    off, t = [], 0
+    for x in sections:
+        off.append(t)
+        t += x
+    return off
+
+
+def chunk_local_positions(gi, a_total: int, a_lo: int, ag: int):
+    """Global exchange positions ``owner*A + j`` → chunk-local
+    ``owner*A_g + (j - a_lo)`` for the section at [a_lo, a_lo+ag).
+    Operator-only arithmetic: works on np AND traced jnp arrays — ONE
+    definition of the remap for the step and the probe."""
+    owner = gi // a_total
+    return owner * ag + (gi - owner * a_total) - a_lo
 
 
 def _bucket(n: int, bucket_min: int) -> int:
@@ -146,13 +190,33 @@ class ShardedEmbeddingTable:
     def prepare_global(self, batches: List[SlotBatch],
                        req_capacity: Optional[int] = None,
                        serve_capacity: Optional[int] = None,
-                       assign: bool = True) -> ShardedPullIndex:
+                       assign: bool = True,
+                       groups: int = 1,
+                       req_sections: Optional[Tuple[int, ...]] = None,
+                       key_sections: Optional[Tuple[int, ...]] = None
+                       ) -> ShardedPullIndex:
         """Build the routing plan for N per-device batches (one global
         batch). All batches must share K_pad/batch_size/num_slots.
         ``req_capacity``/``serve_capacity`` force the A/A2 buckets — the
         resident-pass builder uses this to give every batch in a pass
         identical shapes (gather_idx encodes positions as owner*A + j, so
-        A must be uniform across the staged pass)."""
+        A must be uniform across the staged pass).
+
+        ``groups > 1`` builds the CHUNKED exchange layout (ISSUE 11;
+        FLAGS.a2a_chunks): the A axis is partitioned into contiguous
+        per-slot-group sections so the device step can run one
+        all_to_all per group overlapped with the previous group's
+        pooling. Requires slot-qualified keys (every key's occurrences
+        in ONE slot group); a violating batch falls back to the
+        monolithic plan with a warning. ``req_sections``/
+        ``key_sections`` force per-group section widths (the resident
+        builder's uniform-shape contract, the grouped analogue of
+        ``req_capacity``)."""
+        if groups > 1:
+            return self._prepare_global_grouped(
+                batches, groups, serve_capacity=serve_capacity,
+                assign=assign, req_sections=req_sections,
+                key_sections=key_sections)
         n = self.n
         assert len(batches) == n, f"need {n} local batches, got {len(batches)}"
         k_pad = max(b.keys.shape[0] for b in batches)
@@ -269,6 +333,243 @@ class ShardedEmbeddingTable:
             serve_slot=serve_slot, gather_idx=gather_idx,
             key_valid=key_valid, req_capacity=A, serve_capacity=A2,
             req_need=a_max, serve_need=a2_max)
+
+    def _prepare_global_grouped(
+            self, batches: List[SlotBatch], groups: int,
+            serve_capacity: Optional[int] = None, assign: bool = True,
+            req_sections: Optional[Tuple[int, ...]] = None,
+            key_sections: Optional[Tuple[int, ...]] = None
+            ) -> ShardedPullIndex:
+        """Chunked-exchange plan (see prepare_global). Layout contract:
+
+        - Rows ASSIGN in the monolithic order (sorted-unique per
+          (dst, owner) pair) before any group re-layout, so new-key row
+          ids — and therefore the whole table state — are bit-identical
+          to an ``a2a_chunks=1`` run over the same stream.
+        - The A axis is ``sum(a2a_sections)`` wide; pair (dst, owner)'s
+          group-g requests sit at ``[a_lo[g], a_lo[g]+cnt)``. Every
+          section keeps ≥ 1 trailing pad position (A_g ≥ need_g + 1) so
+          the group's pad keys have an in-section zero read.
+        - The key stream re-lays group-contiguous (key_sections), each
+          section padded with keys that gather the section's last (pad)
+          position and pool into the discard bin; the matching segment
+          stream ships as ``key_segments``.
+        - Serve side is UNCHANGED: one canonical per-owner dedup, so
+          the push's merge_rows/apply_push segmentation — and the
+          per-row grad summation order (src-major, one contribution per
+          src) — match the monolithic plan exactly.
+
+        The slot-qualified check is deliberately PER-DEVICE: a key that
+        lands in different slot groups on different devices is still
+        exact, because groups only shape each device's OWN request
+        layout and key partition (each dst gathers from its own
+        sections; pooling bins are per-(device-local) occurrence slot),
+        while the serve side is group-agnostic — dedup is over row ids,
+        and the slot last-writer is decided by the cross-device concat
+        order, which the within-pair reorder preserves. Only a
+        within-device conflict (one key, occurrences in two groups on
+        the SAME batch) breaks the section layout, and that is exactly
+        what the check rejects."""
+        from paddlebox_tpu.ops.seqpool_cvm import slot_group_bounds
+        n = self.n
+        assert len(batches) == n, \
+            f"need {n} local batches, got {len(batches)}"
+        k_pad = max(b.keys.shape[0] for b in batches)
+        C = self.capacity
+        S = batches[0].num_slots
+        bounds = slot_group_bounds(S, groups)
+        c = len(bounds)
+        if c <= 1:
+            return self.prepare_global(batches, assign=assign,
+                                       serve_capacity=serve_capacity)
+        grp_of_slot = np.zeros(S, np.int64)
+        for g, (lo, hi) in enumerate(bounds):
+            grp_of_slot[lo:hi] = g
+
+        # uniques + the slot-qualified check BEFORE any index mutation,
+        # so the monolithic fallback is side-effect clean
+        dev_uniq: List[np.ndarray] = []
+        dev_inv: List[np.ndarray] = []
+        dev_uniq_slot: List[np.ndarray] = []
+        dev_key_grp: List[np.ndarray] = []
+        for b in batches:
+            uniq, first, inv = np.unique(
+                b.keys[:b.num_keys], return_index=True,
+                return_inverse=True)
+            occ_slot = (b.segments[:b.num_keys]
+                        % b.num_slots).astype(np.int64)
+            occ_grp = grp_of_slot[occ_slot]
+            key_grp = occ_grp[first]
+            if (occ_grp != key_grp[inv]).any():
+                log.warning(
+                    "a2a_chunks=%d: a key's occurrences span slot "
+                    "groups (keys are not slot-qualified) — falling "
+                    "back to the monolithic exchange for this batch", c)
+                return self.prepare_global(batches, assign=assign,
+                                           serve_capacity=serve_capacity)
+            dev_uniq.append(uniq)
+            dev_inv.append(inv)
+            dev_uniq_slot.append(occ_slot[first].astype(np.float32))
+            dev_key_grp.append(key_grp)
+
+        # request lists per (dst, owner): rows assigned in monolithic
+        # order, then re-laid group-contiguous with per-group ranks
+        req_rows = [[None] * n for _ in range(n)]
+        req_slots = [[None] * n for _ in range(n)]
+        req_grp = [[None] * n for _ in range(n)]
+        need_g = np.zeros(c, np.int64)
+        req_pos_of_uniq: List[np.ndarray] = []  # per dst: (owner, g, rank)
+        for d in range(n):
+            uniq = dev_uniq[d]
+            owners = (uniq % np.uint64(n)).astype(np.int64)
+            pos = np.empty((len(uniq), 3), dtype=np.int64)
+            for s in range(n):
+                sel = np.nonzero(owners == s)[0]
+                keys_s = uniq[sel]
+                with self.host_lock:
+                    if assign and self._plan_depth:
+                        pre = self.indexes[s].lookup(keys_s)
+                        rows_s = self.indexes[s].assign(keys_s)
+                        if (pre < 0).any():
+                            self._note_plan_assigned(s, keys_s[pre < 0])
+                    elif assign:
+                        rows_s = self.indexes[s].assign(keys_s)
+                        self._touched[s][rows_s] = True
+                    else:
+                        rows_s = self.indexes[s].lookup(keys_s)
+                        rows_s = np.where(rows_s < 0, C,
+                                          rows_s).astype(rows_s.dtype)
+                grp_s = dev_key_grp[d][sel]
+                order = np.argsort(grp_s, kind="stable")
+                req_rows[d][s] = rows_s[order]
+                req_slots[d][s] = dev_uniq_slot[d][sel][order]
+                req_grp[d][s] = grp_s[order]
+                ranks = np.empty(len(sel), np.int64)
+                for g in range(c):
+                    m = grp_s == g
+                    cnt = int(m.sum())
+                    ranks[m] = np.arange(cnt)
+                    need_g[g] = max(need_g[g], cnt)
+                pos[sel, 0] = s
+                pos[sel, 1] = grp_s
+                pos[sel, 2] = ranks
+            req_pos_of_uniq.append(pos)
+        if req_sections is not None:
+            a_secs = tuple(int(x) for x in req_sections)
+            for g in range(c):
+                if a_secs[g] < int(need_g[g]) + 1:
+                    raise ValueError(
+                        f"forced req_sections[{g}]={a_secs[g]} < needed "
+                        f"{int(need_g[g]) + 1}")
+        else:
+            bmin = max(1, self.req_bucket_min // c)
+            a_secs = tuple(_bucket(int(need_g[g]) + 1, bmin)
+                           for g in range(c))
+        a_lo = np.concatenate([[0], np.cumsum(a_secs)]).astype(np.int64)
+        A = int(a_lo[-1])
+
+        # owner-side dedup: IDENTICAL to the monolithic plan (same rows,
+        # same sorted-unique order); only resp positions move
+        resp_idx = np.zeros((n, n, A), dtype=np.int32)
+        serve_rows_l: List[np.ndarray] = []
+        serve_slot_l: List[np.ndarray] = []
+        a2_max = 1
+        for s in range(n):
+            all_rows = np.concatenate([req_rows[d][s] for d in range(n)])
+            all_slots = np.concatenate([req_slots[d][s] for d in range(n)])
+            su, sinv = (np.unique(all_rows, return_inverse=True)
+                        if len(all_rows) else
+                        (np.empty(0, np.int64), np.empty(0, np.int64)))
+            serve_rows_l.append(su)
+            slot_l = np.zeros(len(su), np.float32)
+            slot_l[sinv] = all_slots
+            serve_slot_l.append(slot_l)
+            a2_max = max(a2_max, len(su) + 1)
+            off = 0
+            for d in range(n):
+                cnt = len(req_rows[d][s])
+                row = np.full(A, len(su), np.int64)
+                if cnt:
+                    jpos = a_lo[req_grp[d][s]] + \
+                        np.concatenate([np.arange(int((req_grp[d][s] == g
+                                                       ).sum()))
+                                        for g in range(c)])
+                    row[jpos] = sinv[off:off + cnt]
+                resp_idx[s, d] = row
+                off += cnt
+        A2 = _bucket(a2_max, self.serve_bucket_min)
+        if serve_capacity is not None:
+            if serve_capacity < a2_max:
+                raise ValueError(
+                    f"forced serve_capacity {serve_capacity} < {a2_max}")
+            A2 = serve_capacity
+
+        serve_rows = np.empty((n, A2), dtype=np.int32)
+        serve_valid = np.zeros((n, A2), dtype=np.float32)
+        serve_slot = np.zeros((n, A2), dtype=np.float32)
+        for s in range(n):
+            u = len(serve_rows_l[s])
+            serve_rows[s, :u] = serve_rows_l[s]
+            fill_oob_pads(serve_rows[s], u, C)
+            serve_valid[s, :u] = 1.0
+            serve_slot[s, :u] = serve_slot_l[s]
+            resp_idx[s][resp_idx[s] == u] = A2 - 1
+
+        # dst-side gather: group-contiguous key sections
+        k_need = np.zeros(c, np.int64)
+        occ_grp_dev: List[np.ndarray] = []
+        for d in range(n):
+            og = dev_key_grp[d][dev_inv[d]]
+            occ_grp_dev.append(og)
+            for g in range(c):
+                k_need[g] = max(k_need[g], int((og == g).sum()))
+        if key_sections is not None:
+            k_secs = tuple(int(x) for x in key_sections)
+            for g in range(c):
+                if k_secs[g] < int(k_need[g]):
+                    raise ValueError(
+                        f"forced key_sections[{g}]={k_secs[g]} < needed "
+                        f"{int(k_need[g])}")
+        else:
+            # pow2 ladder from a FIXED min — never from the batch's
+            # k_pad, whose per-batch wobble would mint gratuitously
+            # distinct section tuples (and one jitted step executable
+            # per tuple in streaming mode)
+            k_secs = tuple(_bucket(max(1, int(k_need[g])), 8)
+                           for g in range(c))
+        k_lo = np.concatenate([[0], np.cumsum(k_secs)]).astype(np.int64)
+        kp = int(k_lo[-1])
+        gather_idx = np.empty((n, kp), dtype=np.int32)
+        key_valid = np.zeros((n, kp), dtype=np.float32)
+        key_segments = np.empty((n, kp), dtype=np.int32)
+        for d, b in enumerate(batches):
+            pos = req_pos_of_uniq[d]
+            oi = pos[dev_inv[d]]                       # [nk, 3]
+            gidx = (oi[:, 0] * A + a_lo[oi[:, 1]]
+                    + oi[:, 2]).astype(np.int32)
+            seg = b.segments[:b.num_keys]
+            og = occ_grp_dev[d]
+            for g in range(c):
+                m = np.nonzero(og == g)[0]             # original order
+                lo, kg = int(k_lo[g]), int(k_secs[g])
+                # section pads gather the section's guaranteed-pad
+                # exchange position (A_g ≥ need_g + 1 ⇒ the last j of
+                # every pair's section serves the zero sentinel row)
+                pad_flat = (n - 1) * A + int(a_lo[g]) + a_secs[g] - 1
+                gather_idx[d, lo:lo + kg] = pad_flat
+                gather_idx[d, lo:lo + len(m)] = gidx[m]
+                key_valid[d, lo:lo + len(m)] = 1.0
+                key_segments[d, lo:lo + kg] = b.pad_segment
+                key_segments[d, lo:lo + len(m)] = seg[m]
+        return ShardedPullIndex(
+            resp_idx=resp_idx, serve_rows=serve_rows,
+            serve_valid=serve_valid, serve_slot=serve_slot,
+            gather_idx=gather_idx, key_valid=key_valid,
+            req_capacity=A, serve_capacity=A2,
+            req_need=int(need_g.max()) if c else 0, serve_need=a2_max,
+            a2a_sections=a_secs, key_sections=k_secs,
+            slot_sections=tuple(hi - lo for lo, hi in bounds),
+            key_segments=key_segments)
 
     def _note_plan_assigned(self, s: int, new_keys: np.ndarray) -> None:
         """Hook (called under host_lock) for keys newly assigned during

@@ -1,0 +1,264 @@
+"""Measured exchange/compute attribution of the sharded step (ISSUE 11).
+
+The chunked schedule (train/sharded._device_step, FLAGS.a2a_chunks)
+restructures the DATAFLOW so the embedding all_to_all chunks and the
+pooling/dense compute are independent — but whether the hardware
+actually overlaps them is a scheduler/backend property that must be
+MEASURED, not assumed (CPU meshes serialize collectives; TPU's
+latency-hiding scheduler flies them). This module runs the decomposed
+step as separately-jitted pieces plus the two fused schedules and
+reports:
+
+- per-chunk ``a2a.pull.<k>`` exchange seconds vs ``pool.<k>`` pooling
+  seconds (the chunk-width tuning signal —
+  ``scripts/profile_sharded_step.py --a2a-chunks`` sweeps it),
+- ``exchange_overlap_frac``: the fraction of total exchange time the
+  chunked schedule hid relative to the monolithic schedule, from an
+  apples-to-apples A/B of the two fused programs over the SAME staged
+  wire (a grouped plan is a valid input to both schedules),
+- ``exchange_wait_sec``: the non-overlapped exchange remainder,
+  reported into the pass critical path (obs/trace.note_pass_part
+  ``exchange_wait``) so the next pass event's ``critical_path`` block
+  attributes it as its own part.
+
+When tracing is active (obs/trace), each measured piece re-runs once
+inside a span on the ``device.a2a`` lane — a depth-2 sharded bench
+trace (BENCH_TRACE=1) renders per-chunk ``a2a.pull.*``/``a2a.push``
+rows — and every chunk books ``pbox_a2a_chunk_seconds_total{chunk}``.
+
+NOTE: the probe's timed steps are REAL training steps (the step donates
+its state); callers run it after every headline number is taken, the
+same discipline as the bench's wire-free rerun.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+from paddlebox_tpu.obs import trace
+from paddlebox_tpu.obs.hub import get_hub
+from paddlebox_tpu.ops.seqpool_cvm import fused_seqpool_cvm_slot_group
+from paddlebox_tpu.parallel.mesh import DATA_AXIS
+from paddlebox_tpu.ps.sharded import (chunk_local_positions,
+                                      plan_sections, section_offsets)
+from paddlebox_tpu.ps.table import (expand_pull, gather_full_rows,
+                                    merge_rows, pull_values)
+
+
+def _timed(fn, *args, reps: int = 2):
+    """(result, best-of-reps seconds) with a warm/compile call first."""
+    out = fn(*args)
+    jax.block_until_ready(jax.tree.leaves(out))
+    best = float("inf")
+    for _ in range(max(1, reps)):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(jax.tree.leaves(out))
+        best = min(best, time.perf_counter() - t0)
+    return out, best
+
+
+def _respan(name: str, fn, *args, **attrs) -> None:
+    """One extra run of a measured piece inside a device-lane span, so
+    the Chrome trace shows the chunk rows (only when a span sink is
+    attached — inert otherwise)."""
+    if not trace.tracing_active():
+        return
+    with trace.span(name, lane=trace.LANE_DEVICE, **attrs):
+        jax.block_until_ready(jax.tree.leaves(fn(*args)))
+
+
+def probe_exchange(trainer, dataset=None, group: Optional[list] = None,
+                   chunks: Optional[int] = None, reps: int = 2) -> Dict:
+    """Measure the exchange/compute schedule of ``trainer``'s sharded
+    step on one global batch (the first group of ``dataset`` unless
+    ``group`` — a list of N SlotBatch — is given). ``chunks`` overrides
+    ``trainer.a2a_chunks`` so one trainer can sweep widths (the step
+    compiles one executable per schedule either way)."""
+    sf = trainer.step_fn
+    mesh, n = trainer.mesh, trainer.n
+    if group is None:
+        if dataset is None:
+            raise ValueError("probe_exchange needs a dataset or a group")
+        group = next(iter(trainer._group_iter(dataset.batches())))
+    c = trainer.a2a_chunks if chunks is None else max(1, int(chunks))
+    idx = trainer.table.prepare_global(group, groups=c)
+    gb = trainer._stage_batch(group, idx)
+    sections = plan_sections(idx)
+    a_cap, a2_cap = idx.req_capacity, idx.serve_capacity
+    k_tot = idx.gather_idx.shape[1]
+    s_tot = sf.num_slots
+    if sections:
+        a_secs, k_secs, s_secs = sections
+    else:
+        a_secs, k_secs, s_secs = (a_cap,), (k_tot,), (s_tot,)
+    a_off = section_offsets(a_secs)
+    k_off = section_offsets(k_secs)
+    s_off = section_offsets(s_secs)
+    d = 3 + trainer.table.mf_dim
+    bsz = sf.batch_size
+    shard0, rep = P(DATA_AXIS), P()
+
+    def sm(fn, in_specs, out_specs):
+        return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                     out_specs=out_specs,
+                                     check_vma=False))
+
+    # ---- serve gather (local HBM, no exchange) ----
+    def dev_serve(tstate, serve_rows):
+        t = tstate.with_packed(tstate.packed[0])
+        return pull_values(gather_full_rows(t, serve_rows[0]),
+                           t.mf_dim)[None]
+
+    f_serve = sm(dev_serve, (shard0, shard0), shard0)
+    serve_vals, t_serve = _timed(f_serve, trainer.state.table,
+                                 gb.serve_rows, reps=reps)
+
+    # ---- per-chunk pull exchange ----
+    hub = get_hub()
+    a2a_ctr = hub.counter("pbox_a2a_chunk_seconds_total",
+                          "measured seconds per sharded exchange chunk")
+    recvs: List = []
+    t_a2a: List[float] = []
+    for g, ag in enumerate(a_secs):
+        lo = a_off[g]
+
+        def dev_a2a(serve_vals, resp_idx, _lo=lo, _ag=ag):
+            resp = expand_pull(
+                serve_vals[0],
+                resp_idx[0][:, _lo:_lo + _ag].reshape(-1)
+            ).reshape(n, _ag, d)
+            recv = jax.lax.all_to_all(resp, DATA_AXIS, 0, 0, tiled=True)
+            return recv.reshape(n * _ag, d)[None]
+
+        f = sm(dev_a2a, (shard0, shard0), shard0)
+        recv_g, t = _timed(f, serve_vals, gb.resp_idx, reps=reps)
+        recvs.append(recv_g)
+        t_a2a.append(t)
+        a2a_ctr.inc(t, chunk=str(g))
+        _respan(f"a2a.pull.{g}", f, serve_vals, gb.resp_idx,
+                chunk=g, section=int(ag))
+
+    # ---- per-chunk expand + pool ----
+    pooled_parts: List = []
+    t_pool: List[float] = []
+    for g, (ag, kg, sg) in enumerate(zip(a_secs, k_secs, s_secs)):
+        lo_a, lo_k, lo_s = a_off[g], k_off[g], s_off[g]
+
+        def dev_pool(recv_g, gather_idx, segments, show, clk,
+                     _la=lo_a, _ag=ag, _lk=lo_k, _kg=kg, _ls=lo_s,
+                     _sg=sg):
+            gi = gather_idx[0][_lk:_lk + _kg]
+            seg = segments[0][_lk:_lk + _kg]
+            # the step's own remap (ps/sharded.chunk_local_positions) —
+            # the probe must slice exactly what the schedule runs
+            local = chunk_local_positions(gi, a_cap, _la, _ag)
+            vk = expand_pull(recv_g[0], local)
+            bsc = jnp.stack([show[0], clk[0]], axis=1)
+            return fused_seqpool_cvm_slot_group(
+                vk, seg, bsc, bsz, s_tot, _ls, _ls + _sg,
+                sf.use_cvm, sf.cvm_offset)[None]
+
+        f = sm(dev_pool, (shard0,) * 5, shard0)
+        args = (recvs[g], gb.gather_idx, gb.segments, gb.show, gb.clk)
+        pooled_g, t = _timed(f, *args, reps=reps)
+        pooled_parts.append(pooled_g)
+        t_pool.append(t)
+        _respan(f"pool.{g}", f, *args, chunk=g, keys=int(kg))
+
+    pooled = (pooled_parts[0] if len(pooled_parts) == 1
+              else jnp.concatenate(pooled_parts, axis=2))
+
+    # ---- dense fwd+bwd on the pooled input ----
+    def dev_dense(params, pooled, dense, label, show):
+        ins_w = (show[0] > 0).astype(jnp.float32)
+        wsum = jax.lax.psum(jnp.sum(ins_w), DATA_AXIS)
+
+        def lf(p, pl):
+            logits = sf.model.apply(p, pl, dense[0])
+            ls = optax.sigmoid_binary_cross_entropy(logits, label[0])
+            return jnp.sum(ls * ins_w) / jnp.maximum(wsum, 1.0)
+
+        loss, (gp, gpl) = jax.value_and_grad(lf, argnums=(0, 1))(
+            params, pooled[0])
+        return jax.lax.psum(loss, DATA_AXIS), gpl[None]
+
+    f_dense = sm(dev_dense, (rep, shard0, shard0, shard0, shard0),
+                 (rep, shard0))
+    _, t_dense = _timed(f_dense, trainer.state.params, pooled, gb.dense,
+                        gb.label, gb.show, reps=reps)
+
+    # ---- push exchange + owner-side merge (pseudo-grads: the recv
+    # values themselves — same shapes/layout, same transfer) ----
+    g_vals = jnp.concatenate(
+        [r.reshape(r.shape[0], n, ag, d)
+         for r, ag in zip(recvs, a_secs)], axis=2)
+
+    def dev_push(g_vals, resp_idx):
+        gbk = jax.lax.all_to_all(g_vals[0], DATA_AXIS, 0, 0, tiled=True)
+        return merge_rows(gbk.reshape(n * a_cap, d),
+                          resp_idx[0].reshape(n * a_cap),
+                          num_segments=a2_cap)[None]
+
+    f_push = sm(dev_push, (shard0, shard0), shard0)
+    _, t_push = _timed(f_push, g_vals, gb.resp_idx, reps=reps)
+    a2a_ctr.inc(t_push, chunk="push")
+    _respan("a2a.push", f_push, g_vals, gb.resp_idx)
+
+    # ---- dense sync (the psum the push overlaps with) ----
+    f_sync = sm(lambda t: jax.tree.map(
+        lambda l: jax.lax.psum(l, DATA_AXIS), t), rep, rep)
+    _, t_sync = _timed(f_sync, trainer.state.params, reps=reps)
+
+    # ---- the A/B: both fused schedules over the SAME staged wire ----
+    def run_step(secs):
+        def once():
+            t0 = time.perf_counter()
+            st, _ = trainer.step_fn(trainer.state, gb,
+                                    jax.random.fold_in(trainer._rng, 0),
+                                    secs)
+            jax.block_until_ready(st.step)
+            trainer.state = st      # donated input — keep state live
+            return time.perf_counter() - t0
+
+        once()                      # warm/compile
+        return min(once() for _ in range(max(1, reps)))
+
+    t_mono = run_step(())
+    t_chunk = run_step(sections) if sections else t_mono
+
+    exchange_total = sum(t_a2a) + t_push
+    overlap_sec = max(0.0, t_mono - t_chunk)
+    frac = (min(1.0, overlap_sec / exchange_total)
+            if exchange_total > 0 else 0.0)
+    wait = max(0.0, exchange_total - overlap_sec)
+    # ride the NEXT pass event's critical_path as its own part
+    trace.note_pass_part("exchange_wait", wait)
+    result = {
+        "a2a_chunks": len(a_secs),
+        "a2a_sections": [int(x) for x in a_secs],
+        "serve_sec": round(t_serve, 6),
+        "a2a_pull_sec": [round(t, 6) for t in t_a2a],
+        "pool_sec": [round(t, 6) for t in t_pool],
+        "dense_sec": round(t_dense, 6),
+        "push_sec": round(t_push, 6),
+        "dense_sync_sec": round(t_sync, 6),
+        "step_monolithic_sec": round(t_mono, 6),
+        "step_chunked_sec": round(t_chunk, 6),
+        "exchange_sec_total": round(exchange_total, 6),
+        "exchange_overlap_sec": round(min(overlap_sec, exchange_total),
+                                      6),
+        "exchange_overlap_frac": round(frac, 4),
+        "exchange_wait_sec": round(wait, 6),
+    }
+    # later pass events report the measured fraction
+    # (ShardedTrainer.train_pass_resident → emit_pass_event →
+    # telemetry_report's "a2a ovl" column)
+    trainer._last_exchange_probe = result
+    return result

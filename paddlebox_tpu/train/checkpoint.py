@@ -644,3 +644,22 @@ def state_digest(trainer) -> str:
                             trainer.state.auc))):
         h.update(_np.ascontiguousarray(_np.asarray(leaf)).tobytes())
     return h.hexdigest()
+
+
+def sharded_state_digest(trainer) -> str:
+    """sha256 over a ShardedTrainer's RAW state bytes: dense params +
+    the packed table shards + the per-shard AUC leaves. STRICTER than
+    ``state_digest`` (physical row-assignment order matters here, not
+    just logical content) — the chunk-schedule parity gates (ISSUE 11:
+    tests/test_sharded.py, scripts/scaling_check.py) compare two
+    schedules over the SAME batch stream, where bit-identity includes
+    the row layout the grouped plan builder promises to preserve."""
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(
+            jax.device_get(trainer.state.params)):
+        h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    h.update(np.asarray(
+        jax.device_get(trainer.state.table.packed)).tobytes())
+    for leaf in jax.device_get(tuple(trainer.state.auc)):
+        h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    return h.hexdigest()

@@ -69,6 +69,7 @@ class MultiMfShardedTrainStep:
                  table: MultiMfShardedTable, mesh: Mesh,
                  batch_size_per_device: int, use_cvm: bool = True,
                  cvm_offset: int = 2) -> None:
+        from paddlebox_tpu.config import FLAGS
         self.model = model
         self.tx = tx
         self.table = table
@@ -77,6 +78,14 @@ class MultiMfShardedTrainStep:
         self.batch_size = batch_size_per_device
         self.use_cvm = use_cvm
         self.cvm_offset = cvm_offset
+        # fused computation-collective schedule (ISSUE 11): the multi-mf
+        # pull is ALREADY class-chunked (one all_to_all per dim class,
+        # each pool independent of the others) — the flag here moves the
+        # push side to the overlapped order: issue every class's grad
+        # all_to_all, run the independent dense psum/update, THEN merge
+        # and apply per class. Bit-identical either way (pure op-order
+        # motion); default 1 keeps the sequential pre-ISSUE-11 program.
+        self.a2a_overlap = max(1, int(FLAGS.a2a_chunks)) > 1
         self.dims = table.dims
         self.class_slots = [len(s) for s in table.class_slots]
         self.route = table.slot_route()
@@ -165,15 +174,18 @@ class MultiMfShardedTrainStep:
                 state.params, tuple(vals_flats))
 
         # ---- per-class push: route back, merge, in-table optimizer ----
-        new_tables = []
-        for c, tbl in enumerate(tables):
+        def dense_update():
+            gp = jax.lax.psum(g_params, DATA_AXIS)
+            updates, opt_state = self.tx.update(gp, state.opt_state,
+                                                state.params)
+            return optax.apply_updates(state.params, updates), opt_state
+
+        def push_class(c, tbl, g_back):
             resp_idx, serve_rows, serve_valid, serve_slot, _, _ = \
                 plan_views[c]
             a = resp_idx.shape[1]
             a2 = serve_rows.shape[0]
             d = 3 + tbl.mf_dim
-            g_back = jax.lax.all_to_all(
-                g_vals[c].reshape(n, a, d), DATA_AXIS, 0, 0, tiled=True)
             g_serve = jax.ops.segment_sum(
                 g_back.reshape(n * a, d), resp_idx.reshape(n * a),
                 num_segments=a2)
@@ -184,12 +196,28 @@ class MultiMfShardedTrainStep:
                              rows_full=rows_fulls[c],
                              touched=serve_valid > 0,
                              slot_val=serve_slot)
-            new_tables.append(tbl.with_packed(tbl.packed[None]))
+            return tbl.with_packed(tbl.packed[None])
 
-        g_params = jax.lax.psum(g_params, DATA_AXIS)
-        updates, opt_state = self.tx.update(g_params, state.opt_state,
-                                            state.params)
-        params = optax.apply_updates(state.params, updates)
+        def back(c):
+            a = plan_views[c][0].shape[1]
+            d = 3 + tables[c].mf_dim
+            return jax.lax.all_to_all(
+                g_vals[c].reshape(n, a, d), DATA_AXIS, 0, 0, tiled=True)
+
+        new_tables = []
+        if self.a2a_overlap:
+            # overlapped order (FLAGS.a2a_chunks > 1): every class's grad
+            # all_to_all first, the independent dense psum/update next,
+            # merges/apply last — the exchanges fly while the dense sync
+            # computes. Same ops, same math, different schedule.
+            g_backs = [back(c) for c in range(len(tables))]
+            params, opt_state = dense_update()
+            for c, tbl in enumerate(tables):
+                new_tables.append(push_class(c, tbl, g_backs[c]))
+        else:
+            for c, tbl in enumerate(tables):
+                new_tables.append(push_class(c, tbl, back(c)))
+            params, opt_state = dense_update()
 
         pred = jax.nn.sigmoid(logits)
         auc = auc_add_batch(auc, pred, label, ins_w)

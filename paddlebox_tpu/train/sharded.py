@@ -24,9 +24,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from paddlebox_tpu.data.batch import SlotBatch
 from paddlebox_tpu.metrics import AucState, auc_add_batch, init_auc_state
 from paddlebox_tpu.ops import fused_seqpool_cvm
+from paddlebox_tpu.ops.seqpool_cvm import fused_seqpool_cvm_slot_group
 from paddlebox_tpu.parallel.mesh import DATA_AXIS
 from paddlebox_tpu.ps.sgd import SparseSGDConfig
-from paddlebox_tpu.ps.sharded import ShardedEmbeddingTable, ShardedPullIndex
+from paddlebox_tpu.ps.sharded import (ShardedEmbeddingTable,
+                                      ShardedPullIndex,
+                                      chunk_local_positions,
+                                      plan_sections, section_offsets)
 from paddlebox_tpu.ops.bitpack import (pack_delta_auto, pack_u16m,
                                        pack_u24, unpack_delta16,
                                        unpack_u16m, unpack_u24)
@@ -57,16 +61,30 @@ def make_global_arrays(batches: List[SlotBatch],
     """Stack N local batches + routing plan into HOST arrays (the
     resident builder consumes these directly — never round-trip the
     plan through device arrays)."""
-    k_pad = max(b.keys.shape[0] for b in batches)
-    segs, dense, label, show, clk = [], [], [], [], []
+    dense, label, show, clk = [], [], [], []
     for b in batches:
-        s = np.full(k_pad, b.pad_segment, np.int32)
-        s[:b.segments.shape[0]] = b.segments
-        segs.append(s)
         dense.append(b.dense)
         label.append(b.label)
         show.append(b.show)
         clk.append(b.clk)
+    if getattr(idx, "key_segments", None) is not None:
+        # grouped plan (a2a_chunks > 1): the key stream was re-laid
+        # group-contiguous, so the matching segment stream comes from
+        # the plan, not the batches' original-order segments
+        segs = list(idx.key_segments)
+        gi = idx.gather_idx
+        return dict(
+            resp_idx=idx.resp_idx, serve_rows=idx.serve_rows,
+            serve_valid=idx.serve_valid, serve_slot=idx.serve_slot,
+            gather_idx=gi, segments=np.stack(segs),
+            dense=np.stack(dense), label=np.stack(label),
+            show=np.stack(show), clk=np.stack(clk))
+    k_pad = max(b.keys.shape[0] for b in batches)
+    segs = []
+    for b in batches:
+        s = np.full(k_pad, b.pad_segment, np.int32)
+        s[:b.segments.shape[0]] = b.segments
+        segs.append(s)
     gi = idx.gather_idx
     if gi.shape[1] < k_pad:
         pad = ((0, 0), (0, k_pad - gi.shape[1]))
@@ -260,6 +278,8 @@ class ShardedTrainStep:
         self.state_spec = state_spec
         batch_spec = GlobalBatch(*([shard0] * len(GlobalBatch._fields)))
         stats_spec = {"loss": rep, "pred": shard0}
+        self._batch_spec = batch_spec
+        self._stats_spec = stats_spec
         self._sharded = jax.jit(
             jax.shard_map(
                 self._device_step, mesh=mesh,
@@ -267,6 +287,11 @@ class ShardedTrainStep:
                 out_specs=(state_spec, stats_spec),
                 check_vma=False),
             donate_argnums=(0,))
+        # chunked-schedule executables, one per distinct section layout
+        # (FLAGS.a2a_chunks > 1; ps/sharded.plan_sections). The
+        # monolithic ``self._sharded`` above stays byte-for-byte the
+        # pre-chunking program — sections=() routes to it.
+        self._sharded_chunked: Dict[tuple, object] = {}
 
     def init_params(self, mf_dim: int, dense_dim: int) -> Any:
         d = self.cvm_offset + 1 + mf_dim if self.use_cvm else 1 + mf_dim
@@ -305,72 +330,12 @@ class ShardedTrainStep:
             table=table.state, params=params, opt_state=opt_state,
             auc=init_sharded_auc(self.n), step=jnp.zeros((), jnp.int32))
 
-    # ---- per-device block program (runs under shard_map) ----
-    def _device_step(self, state: ShardedStepState, batch: GlobalBatch,
-                     rng: jax.Array):
-        n, b, s = self.n, self.batch_size, self.num_slots
-        me = jax.lax.axis_index(DATA_AXIS)
-        # blocks arrive with leading dim 1; drop it
-        table = state.table.with_packed(state.table.packed[0])
-        auc = AucState(*[l[0] for l in state.auc])
-        resp_idx = batch.resp_idx[0]       # [N, A]
-        serve_rows = batch.serve_rows[0]   # [A2]
-        serve_valid = batch.serve_valid[0]
-        serve_slot = batch.serve_slot[0]
-        gather_idx = batch.gather_idx[0]   # [K]
-        segments = batch.segments[0]
-        dense = batch.dense[0]
-        label = batch.label[0]
-        show = batch.show[0]
-        clk = batch.clk[0]
-        a = resp_idx.shape[1]
-        a2 = serve_rows.shape[0]
-        d = 3 + table.mf_dim
-
-        # ---- pull: serve my rows, exchange, reassemble ----
-        # one AoS gather serves the pull AND the push optimizer state
-        rows_full = gather_full_rows(table, serve_rows)    # [A2, F]
-        serve_vals = pull_values(rows_full, table.mf_dim)  # [A2, D]
-        # lane-packed expand (ps/table.expand_pull): narrow-row gathers
-        # and their autodiff transposes run at line granularity
-        resp = expand_pull(serve_vals,
-                           resp_idx.reshape(-1)).reshape(n, a, d)
-        recv = jax.lax.all_to_all(resp, DATA_AXIS, 0, 0, tiled=True)
-        vals_flat = recv.reshape(n * a, d)
-
-        ins_w = (show > 0).astype(jnp.float32)
-        wsum_global = jax.lax.psum(jnp.sum(ins_w), DATA_AXIS)
-        batch_show_clk = jnp.stack([show, clk], axis=1)
-
-        def loss_fn(params, vals_flat):
-            values_k = expand_pull(vals_flat, gather_idx)
-            pooled = fused_seqpool_cvm(
-                values_k, segments, batch_show_clk, b, s,
-                self.use_cvm, self.cvm_offset)
-            logits = self.model.apply(params, pooled, dense)
-            ls = optax.sigmoid_binary_cross_entropy(logits, label)
-            loss_local = jnp.sum(ls * ins_w) / jnp.maximum(wsum_global, 1.0)
-            return loss_local, logits
-
-        (loss_local, logits), (g_params, g_vals_flat) = jax.value_and_grad(
-            loss_fn, argnums=(0, 1), has_aux=True)(state.params, vals_flat)
-
-        # ---- push: route grads back to owners, merge, update ----
-        g_back = jax.lax.all_to_all(
-            g_vals_flat.reshape(n, a, d), DATA_AXIS, 0, 0, tiled=True)
-        g_serve = merge_rows(g_back.reshape(n * a, d),
-                             resp_idx.reshape(n * a), num_segments=a2)
-        # PushCopy scaling (box_wrapper.cu:368): negate embed grads × global
-        # batch size (loss above is the global mean)
-        gb = jnp.concatenate(
-            [g_serve[:, :2], g_serve[:, 2:] * (-1.0 * b * n)], axis=1)
-        touched = serve_valid > 0
-        table = apply_push(table, serve_rows, gb,
-                           self.sgd_cfg, jax.random.fold_in(rng, me),
-                           rows_full=rows_full, touched=touched,
-                           slot_val=serve_slot)
-
-        # ---- dense sync ----
+    # ---- dense grad sync + optimizer (shared by both schedules) ----
+    def _dense_sync(self, state: ShardedStepState, g_params, me):
+        """psum (SyncParam's allreduce) or ZeRO-1 reduce-scatter /
+        update / all-gather → (params, opt_state). Extracted from
+        ``_device_step`` unchanged (pure code motion at trace time) so
+        the chunked schedule can interleave it with the push exchange."""
         if self.zero1:
             # ZeRO-1: reduce-scatter grads, update the owned flat chunk
             # with per-device opt state, all-gather fresh params
@@ -410,6 +375,166 @@ class ShardedTrainStep:
                 updates = jax.tree.map(lambda u, s: u * s, updates,
                                        self.lr_scales)
             params = optax.apply_updates(state.params, updates)
+        return params, opt_state
+
+    # ---- per-device block program (runs under shard_map) ----
+    def _device_step(self, state: ShardedStepState, batch: GlobalBatch,
+                     rng: jax.Array, sections: tuple = ()):
+        """``sections`` = () runs the monolithic pull → compute → push →
+        dense-sync schedule (the pre-ISSUE-11 program, byte-for-byte).
+        A grouped plan's ``(a2a_sections, key_sections, slot_sections)``
+        runs the CHUNKED schedule: one all_to_all per slot group with
+        the previous group's expand_pull → fused_seqpool_cvm pooling
+        independent of it (the fused computation-collective
+        decomposition), and the push grad all_to_all issued BEFORE the
+        independent dense sync so exchange and psum/ZeRO-1 overlap.
+        Both schedules are bit-identical (tests/test_sharded.py digest
+        parity; docs/PERFORMANCE.md §Sharded-step overlap)."""
+        n, b, s = self.n, self.batch_size, self.num_slots
+        me = jax.lax.axis_index(DATA_AXIS)
+        # blocks arrive with leading dim 1; drop it
+        table = state.table.with_packed(state.table.packed[0])
+        auc = AucState(*[l[0] for l in state.auc])
+        resp_idx = batch.resp_idx[0]       # [N, A]
+        serve_rows = batch.serve_rows[0]   # [A2]
+        serve_valid = batch.serve_valid[0]
+        serve_slot = batch.serve_slot[0]
+        gather_idx = batch.gather_idx[0]   # [K]
+        segments = batch.segments[0]
+        dense = batch.dense[0]
+        label = batch.label[0]
+        show = batch.show[0]
+        clk = batch.clk[0]
+        a = resp_idx.shape[1]
+        a2 = serve_rows.shape[0]
+        d = 3 + table.mf_dim
+
+        if not sections:
+            # ---- pull: serve my rows, exchange, reassemble ----
+            # one AoS gather serves the pull AND the push optimizer state
+            rows_full = gather_full_rows(table, serve_rows)    # [A2, F]
+            serve_vals = pull_values(rows_full, table.mf_dim)  # [A2, D]
+            # lane-packed expand (ps/table.expand_pull): narrow-row
+            # gathers and their autodiff transposes run at line
+            # granularity
+            resp = expand_pull(serve_vals,
+                               resp_idx.reshape(-1)).reshape(n, a, d)
+            recv = jax.lax.all_to_all(resp, DATA_AXIS, 0, 0, tiled=True)
+            vals_flat = recv.reshape(n * a, d)
+
+            ins_w = (show > 0).astype(jnp.float32)
+            wsum_global = jax.lax.psum(jnp.sum(ins_w), DATA_AXIS)
+            batch_show_clk = jnp.stack([show, clk], axis=1)
+
+            def loss_fn(params, vals_flat):
+                values_k = expand_pull(vals_flat, gather_idx)
+                pooled = fused_seqpool_cvm(
+                    values_k, segments, batch_show_clk, b, s,
+                    self.use_cvm, self.cvm_offset)
+                logits = self.model.apply(params, pooled, dense)
+                ls = optax.sigmoid_binary_cross_entropy(logits, label)
+                loss_local = jnp.sum(ls * ins_w) / jnp.maximum(
+                    wsum_global, 1.0)
+                return loss_local, logits
+
+            (loss_local, logits), (g_params, g_vals_flat) = \
+                jax.value_and_grad(loss_fn, argnums=(0, 1),
+                                   has_aux=True)(state.params, vals_flat)
+
+            # ---- push: route grads back to owners, merge, update ----
+            g_back = jax.lax.all_to_all(
+                g_vals_flat.reshape(n, a, d), DATA_AXIS, 0, 0, tiled=True)
+            g_serve = merge_rows(g_back.reshape(n * a, d),
+                                 resp_idx.reshape(n * a), num_segments=a2)
+            # PushCopy scaling (box_wrapper.cu:368): negate embed grads ×
+            # global batch size (loss above is the global mean)
+            gb = jnp.concatenate(
+                [g_serve[:, :2], g_serve[:, 2:] * (-1.0 * b * n)], axis=1)
+            touched = serve_valid > 0
+            table = apply_push(table, serve_rows, gb,
+                               self.sgd_cfg, jax.random.fold_in(rng, me),
+                               rows_full=rows_full, touched=touched,
+                               slot_val=serve_slot)
+
+            # ---- dense sync ----
+            params, opt_state = self._dense_sync(state, g_params, me)
+        else:
+            # ---- chunked exchange-compute schedule (ISSUE 11) ----
+            # "Optimizing Distributed ML Communication with Fused
+            # Computation-Collective Operations" (PAPERS.md): decompose
+            # the pull all_to_all along slot groups; chunk g+1's
+            # exchange has no data dependency on chunk g's pooling, so
+            # XLA's latency-hiding scheduler can fly the ICI transfer
+            # while the MXU pools the previous group.
+            a_secs, k_secs, s_secs = sections
+            a_off = section_offsets(a_secs)
+            k_off = section_offsets(k_secs)
+            s_off = section_offsets(s_secs)
+            rows_full = gather_full_rows(table, serve_rows)    # [A2, F]
+            serve_vals = pull_values(rows_full, table.mf_dim)  # [A2, D]
+            recvs = []
+            for g, ag in enumerate(a_secs):
+                lo = a_off[g]
+                resp_g = expand_pull(
+                    serve_vals,
+                    resp_idx[:, lo:lo + ag].reshape(-1)).reshape(n, ag, d)
+                recv_g = jax.lax.all_to_all(resp_g, DATA_AXIS, 0, 0,
+                                            tiled=True)
+                recvs.append(recv_g.reshape(n * ag, d))
+
+            ins_w = (show > 0).astype(jnp.float32)
+            wsum_global = jax.lax.psum(jnp.sum(ins_w), DATA_AXIS)
+            batch_show_clk = jnp.stack([show, clk], axis=1)
+
+            def loss_fn(params, recvs):
+                # per-group expand → pool; blocks concat in canonical
+                # slot order, bit-identical to the monolithic pool
+                # (bins are per-slot; the grouped plan is stable)
+                parts = []
+                for g, (ag, kg, sg) in enumerate(
+                        zip(a_secs, k_secs, s_secs)):
+                    gi = gather_idx[k_off[g]:k_off[g] + kg]
+                    seg = segments[k_off[g]:k_off[g] + kg]
+                    # global position owner*A + j → chunk-local (ONE
+                    # definition, shared with the probe)
+                    local = chunk_local_positions(gi, a, a_off[g], ag)
+                    values_k = expand_pull(recvs[g], local)
+                    parts.append(fused_seqpool_cvm_slot_group(
+                        values_k, seg, batch_show_clk, b, s,
+                        s_off[g], s_off[g] + sg,
+                        self.use_cvm, self.cvm_offset))
+                pooled = jnp.concatenate(parts, axis=1)
+                logits = self.model.apply(params, pooled, dense)
+                ls = optax.sigmoid_binary_cross_entropy(logits, label)
+                loss_local = jnp.sum(ls * ins_w) / jnp.maximum(
+                    wsum_global, 1.0)
+                return loss_local, logits
+
+            (loss_local, logits), (g_params, g_recvs) = \
+                jax.value_and_grad(loss_fn, argnums=(0, 1),
+                                   has_aux=True)(state.params,
+                                                 tuple(recvs))
+
+            # ---- push: ONE grad all_to_all on the reassembled
+            # canonical [n, A, d] wire, issued BEFORE the independent
+            # dense sync so the exchange overlaps psum/ZeRO-1 (the
+            # monolithic path runs them strictly in sequence); merge /
+            # apply_push then see exactly the monolithic layout
+            g_vals = jnp.concatenate(
+                [gr.reshape(n, ag, d)
+                 for gr, ag in zip(g_recvs, a_secs)], axis=1)
+            g_back = jax.lax.all_to_all(g_vals, DATA_AXIS, 0, 0,
+                                        tiled=True)
+            params, opt_state = self._dense_sync(state, g_params, me)
+            g_serve = merge_rows(g_back.reshape(n * a, d),
+                                 resp_idx.reshape(n * a), num_segments=a2)
+            gb = jnp.concatenate(
+                [g_serve[:, :2], g_serve[:, 2:] * (-1.0 * b * n)], axis=1)
+            touched = serve_valid > 0
+            table = apply_push(table, serve_rows, gb,
+                               self.sgd_cfg, jax.random.fold_in(rng, me),
+                               rows_full=rows_full, touched=touched,
+                               slot_val=serve_slot)
 
         pred = jax.nn.sigmoid(logits)
         auc = auc_add_batch(auc, pred, label, ins_w)
@@ -424,9 +549,29 @@ class ShardedTrainStep:
         # fetch it only when configured
         return new_state, {"loss": loss, "pred": pred[None]}
 
+    def _step_fn_for(self, sections: tuple):
+        """The jitted step for a chunk-schedule key (() = monolithic).
+        One executable per distinct section layout; the resident
+        builder's uniform-shape contract keeps that to ~1 per pass."""
+        if not sections:
+            return self._sharded
+        fn = self._sharded_chunked.get(sections)
+        if fn is None:
+            def step(state, batch, rng, _s=sections):
+                return self._device_step(state, batch, rng, sections=_s)
+
+            fn = self._sharded_chunked[sections] = jax.jit(
+                jax.shard_map(
+                    step, mesh=self.mesh,
+                    in_specs=(self._state_spec, self._batch_spec, P()),
+                    out_specs=(self._state_spec, self._stats_spec),
+                    check_vma=False),
+                donate_argnums=(0,))
+        return fn
+
     def __call__(self, state: ShardedStepState, batch: GlobalBatch,
-                 rng: jax.Array):
-        return self._sharded(state, batch, rng)
+                 rng: jax.Array, sections: tuple = ()):
+        return self._step_fn_for(sections)(state, batch, rng)
 
     # ---- forward-only mesh eval (test-phase run) ----
     def _device_eval(self, table_st: TableState, params, auc_st: AucState,
@@ -478,8 +623,8 @@ class ShardedTrainStep:
 
     # ---- resident pass: the whole loop inside one shard_map program ----
     def _resident_runner(self, n_steps: int, fmt=None, capacity=0,
-                         collect: bool = False):
-        key = ("resident", n_steps, fmt, capacity, collect)
+                         collect: bool = False, sections: tuple = ()):
+        key = ("resident", n_steps, fmt, capacity, collect, sections)
         cached = getattr(self, "_resident_cache", None)
         if cached is None:
             cached = self._resident_cache = {}
@@ -498,7 +643,8 @@ class ShardedTrainStep:
                     # per-step rng matching the streaming trainer exactly:
                     # it folds the PRE-incremented global_step (1-based)
                     st, stats = self._device_step(
-                        st, gb, jax.random.fold_in(r, st.step + 1))
+                        st, gb, jax.random.fold_in(r, st.step + 1),
+                        sections=sections)
                     if collect:
                         # per-batch predictions collected inside the loop
                         # (the single-chip collect_preds pattern,
@@ -554,7 +700,8 @@ class ShardedTrainStep:
             n = min(c, nb - i)
             out = self._resident_runner(
                 n, fmt_key, getattr(rp, "capacity", 0) or 0,
-                collect=collect_preds)(
+                collect=collect_preds,
+                sections=getattr(rp, "sections", ()))(
                 state, rp.dev, jnp.asarray(i, jnp.int32), rng)
             if collect_preds:
                 state, preds = out
@@ -614,6 +761,11 @@ class ShardedTrainer:
         from paddlebox_tpu.utils.compile_cache import \
             enable_compilation_cache
         enable_compilation_cache()
+        from paddlebox_tpu.config import FLAGS
+        # chunked exchange-compute schedule (ISSUE 11): slot-group
+        # chunks for the pull all_to_all + push/dense-sync interleave.
+        # Read once at construction; 1 = the monolithic schedule.
+        self.a2a_chunks = max(1, int(FLAGS.a2a_chunks))
         self.float_wire = float_wire
         self.model = model
         self.table = table
@@ -708,8 +860,10 @@ class ShardedTrainer:
         from paddlebox_tpu.utils.prefetch import prefetch_iter
 
         def prep(group):
-            return group, self._stage_batch(
-                group, self.table.prepare_global(group))
+            idx = self.table.prepare_global(group,
+                                            groups=self.a2a_chunks)
+            return (group, self._stage_batch(group, idx),
+                    plan_sections(idx))
 
         return prefetch_iter(self._group_iter(batches), prep,
                              capacity=self.prefetch,
@@ -751,10 +905,10 @@ class ShardedTrainer:
                 if dev.process_index == jax.process_index():
                     writer_for(d)
 
-        for group, gb in self._prefetch_iter(dataset.batches()):
+        for group, gb, secs in self._prefetch_iter(dataset.batches()):
             self.global_step += 1
             rng = jax.random.fold_in(self._rng, self.global_step)
-            self.state, stats = self.step_fn(self.state, gb, rng)
+            self.state, stats = self.step_fn(self.state, gb, rng, secs)
             nb += 1
             want_dump = (self._dump_cfg is not None
                          and nb % self._dump_cfg.interval == 0)
@@ -1095,8 +1249,13 @@ class ShardedTrainer:
                  "auc=%.4f", log_prefix, rp.num_batches,
                  out["examples_per_sec"], res.auc)
         from paddlebox_tpu.obs.hub import emit_pass_event
-        emit_pass_event("train_pass_resident_sharded",
-                        dict(out, global_step=self.global_step),
+        ev = dict(out, global_step=self.global_step)
+        pr = getattr(self, "_last_exchange_probe", None)
+        if pr is not None:
+            # measured by train/a2a_probe (the sharded bench runs it);
+            # rides the pass event → telemetry_report's "a2a ovl" column
+            ev["exchange_overlap_frac"] = pr["exchange_overlap_frac"]
+        emit_pass_event("train_pass_resident_sharded", ev,
                         table=self.table, examples=rp.num_records)
         return out
 
@@ -1116,6 +1275,11 @@ class ShardedResidentPass:
         self.num_records = num_records
         self.mesh = mesh
         self.dev = None
+        # chunk-schedule key of the staged pass's (uniform) plans —
+        # (a2a_sections, key_sections, slot_sections), or () for the
+        # monolithic schedule. Set by build(); rides into
+        # run_resident's per-schedule executable.
+        self.sections: tuple = ()
         # host side channels for the post-pass registry replay
         # ({label, show, uid, rank, cmatch} as [nb, N, B], None where a
         # batch lacked the channel) — set by build(); kept OUT of the
@@ -1148,33 +1312,85 @@ class ShardedResidentPass:
         # a SIGTERM must not wait out a multi-second plan build; the
         # plan_scope bracket in build_resident_pass rolls the aborted
         # build's pending rows back
+        chunks = getattr(trainer, "a2a_chunks", 1)
         plans = []
         for g in groups:
             poll_preload_abort()
-            plans.append(table.prepare_global(g))
+            plans.append(table.prepare_global(g, groups=chunks))
         poll_preload_abort()
-        # ONE uniform shape per pass either way → the FINE bucket ladder
-        # (≤~6% padding) replaces the streaming pow2 buckets (≤100%) for
-        # the staged wire. Plans re-PAD host-side (pure array surgery —
-        # no second routing/assignment pass on the staging thread).
-        a = next_bucket_fine(1, max(p.req_need for p in plans))
-        a2 = next_bucket_fine(1, max(p.serve_need for p in plans))
-        repadded = []
-        for g, p in zip(groups, plans):
-            rp = cls._repad_plan(p, a, a2, trainer.n, table.capacity)
-            if rp is None:  # ambiguous full bucket — re-route this group
-                rp = table.prepare_global(g, req_capacity=a,
-                                          serve_capacity=a2)
-            repadded.append(rp)
-        plans = repadded
+        sections: tuple = ()
+        if chunks > 1 and all(p.a2a_sections for p in plans):
+            # chunked pass: uniform per-GROUP section widths across the
+            # staged pass (max per section over plans, the grouped
+            # analogue of the A/A2 re-bucket below). Plans off the
+            # common shape re-route with forced sections — no grouped
+            # _repad_plan surgery; re-preparing re-assigns idempotently.
+            # The serve target uses max(serve_capacity) — the SAME pow2
+            # ladder the grouped builder bucketed with — so plans of a
+            # same-shaped workload usually already match and the
+            # re-route is the exception, not the rule (the fine ladder
+            # the monolithic branch uses would mismatch every plan and
+            # re-route the whole pass).
+            c = len(plans[0].a2a_sections)
+            a2 = max(p.serve_capacity for p in plans)
+            req_secs = tuple(max(p.a2a_sections[g] for p in plans)
+                             for g in range(c))
+            key_secs = tuple(max(p.key_sections[g] for p in plans)
+                             for g in range(c))
+            uniformed = []
+            for g, p in zip(groups, plans):
+                if (p.a2a_sections != req_secs
+                        or p.key_sections != key_secs
+                        or p.serve_capacity != a2):
+                    poll_preload_abort()
+                    p = table.prepare_global(
+                        g, serve_capacity=a2, groups=chunks,
+                        req_sections=req_secs, key_sections=key_secs)
+                uniformed.append(p)
+            plans = uniformed
+            sections = plan_sections(plans[0])
+        else:
+            if chunks > 1:
+                # a batch with non-slot-qualified keys fell back — the
+                # whole pass runs the monolithic schedule (shapes must
+                # be uniform across the staged pass). Fallen-back plans
+                # ARE monolithic already; only the grouped survivors of
+                # a mixed pass rebuild.
+                rebuilt = []
+                for g, p in zip(groups, plans):
+                    if p.a2a_sections:
+                        poll_preload_abort()
+                        p = table.prepare_global(g)
+                    rebuilt.append(p)
+                plans = rebuilt
+                poll_preload_abort()
+            # ONE uniform shape per pass either way → the FINE bucket
+            # ladder (≤~6% padding) replaces the streaming pow2 buckets
+            # (≤100%) for the staged wire. Plans re-PAD host-side (pure
+            # array surgery — no second routing/assignment pass on the
+            # staging thread).
+            a = next_bucket_fine(1, max(p.req_need for p in plans))
+            a2 = next_bucket_fine(1, max(p.serve_need for p in plans))
+            repadded = []
+            for g, p in zip(groups, plans):
+                rp = cls._repad_plan(p, a, a2, trainer.n, table.capacity)
+                if rp is None:  # ambiguous full bucket — re-route group
+                    rp = table.prepare_global(g, req_capacity=a,
+                                              serve_capacity=a2)
+                repadded.append(rp)
+            plans = repadded
         gbs = [make_global_arrays(g, p) for g, p in zip(groups, plans)]
         k = max(gb["gather_idx"].shape[1] for gb in gbs)
         # pad values that stay inert: gather_idx pads → the recv sentinel
         # slot (n*A - 1, zero values), segments pads → the discarded
-        # pooling bin (bs * num_slots)
-        pad_of = {"gather_idx": trainer.n * a - 1,
-                  "segments": trainer.desc.batch_size *
-                  len(trainer.desc.sparse_slots)}
+        # pooling bin (bs * num_slots). A chunked pass's forced uniform
+        # sections already give every batch identical widths (and its
+        # pads are per-SECTION, placed by the grouped plan builder) —
+        # the pad loop is a no-op there.
+        pad_of = ({} if sections else
+                  {"gather_idx": trainer.n * a - 1,
+                   "segments": trainer.desc.batch_size *
+                   len(trainer.desc.sparse_slots)})
         arrays: Dict[str, np.ndarray] = {}
         for f in GlobalBatch._fields:
             parts = []
@@ -1186,8 +1402,12 @@ class ShardedResidentPass:
                 parts.append(arr)
             arrays[f] = np.stack(parts)
         n_rec = sum(int((b.show > 0).sum()) for g in groups for b in g)
-        trivial = all(getattr(b, "segments_trivial", False)
-                      for g in groups for b in g)
+        # the trivial-segment meta wire assumes the ORIGINAL slot-ordered
+        # key stream; a chunked pass re-laid it group-contiguous, so it
+        # ships the (encoded) segment stream instead
+        trivial = (not sections
+                   and all(getattr(b, "segments_trivial", False)
+                           for g in groups for b in g))
         if trivial:
             # num_keys/pad_segment per (step, device) — segments then
             # derive on device instead of shipping [nb, N, K] int32
@@ -1197,6 +1417,7 @@ class ShardedResidentPass:
         rp = cls(arrays, n_rec, trainer.mesh,
                  capacity=trainer.table.capacity, trivial=trivial,
                  float_wire=getattr(trainer, "float_wire", "f32"))
+        rp.sections = sections
 
         def stack_opt(field):
             if any(getattr(b, field) is None for g in groups for b in g):

@@ -46,9 +46,12 @@ from typing import Dict, List, Optional, Tuple
 
 DEFAULT_MAX_DROP = 0.5
 #: per-row fields copied into the trajectory when the bench reported
-#: them (the "where did the time go" companions of the headline value)
+#: them (the "where did the time go" companions of the headline value).
+#: n_chips/a2a_chunks/exchange_overlap_frac ride the multichip scaling
+#: rows (``sharded.n{N}.{shape}.*``, BENCH_MODE=multichip — ISSUE 11).
 EXTRA_FIELDS = ("device_busy_frac", "begin_delta_steady_sec",
-                "end_pass_overlap_frac", "vs_baseline")
+                "end_pass_overlap_frac", "vs_baseline", "n_chips",
+                "a2a_chunks", "exchange_overlap_frac")
 
 
 def _repo_root() -> str:
@@ -159,7 +162,7 @@ def check_rows(rows: List[Dict],
     by_key: Dict[Tuple, List[Dict]] = {}
     for r in rows:
         by_key.setdefault(row_key(r), []).append(r)
-    failures: List[str] = []
+    flagged: List[Tuple[float, str]] = []
     summary: List[str] = []
     for key in sorted(by_key):
         hist = by_key[key]
@@ -179,9 +182,14 @@ def check_rows(rows: List[Dict],
                 f"{best['value']:g} ({best.get('source', '?')}) — "
                 f"drop {drop:+.1%}, floor {floor:g}")
         if latest["value"] < floor:
-            failures.append("PERF REGRESSION:" + line)
+            flagged.append((drop, "PERF REGRESSION:" + line))
         else:
             summary.append(line)
+    # EVERY regressed key reports in one run, worst drop first — a
+    # multichip round regressing several sharded.n{N}.{shape} keys at
+    # once must name them all, not just the first (ISSUE 11)
+    failures = [line for _, line in
+                sorted(flagged, key=lambda t: -t[0])]
     return failures, summary
 
 

@@ -2,10 +2,18 @@
 """XPlane op-level attribution of the sharded resident step (round 5):
 where do the ~32 ms/step go that the single-chip step doesn't pay?
 
-Builds the sharded uniform bench shape, stages one resident pass, runs
-it wire-free under jax.profiler, and prints the top device ops by
-self-time.
+Default mode builds the sharded uniform bench shape, stages one
+resident pass, runs it wire-free under jax.profiler, and prints the top
+device ops by self-time.
+
+``--a2a-chunks 1,2,4,8`` (ISSUE 11) instead sweeps the chunked
+exchange schedule: for each chunk count it builds a grouped routing
+plan and prints the PER-CHUNK exchange vs pool seconds plus the
+fused-schedule A/B (train/a2a_probe) — chunk-width tuning without a
+full bench run. ``--records``/``--batch-size`` shrink the workload for
+quick sweeps.
 """
+import argparse
 import glob
 import json
 import os
@@ -15,6 +23,20 @@ import time
 from collections import defaultdict
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+ap.add_argument("--a2a-chunks", default=None,
+                help="comma list of chunk counts to sweep (e.g. 1,2,4,8)"
+                "; omit for the XPlane op-attribution mode")
+ap.add_argument("--records", type=int, default=None,
+                help="records per pass (default: 262144, or 32768 in "
+                "sweep mode)")
+ap.add_argument("--batch-size", type=int, default=None,
+                help="per-device batch size (default: 8192, or 2048 in "
+                "sweep mode)")
+args = ap.parse_args()
+sweep = ([int(x) for x in args.a2a_chunks.split(",")]
+         if args.a2a_chunks else None)
 
 import jax
 import optax
@@ -30,7 +52,8 @@ from paddlebox_tpu.train.sharded import ShardedTrainer
 
 FLAGS.log_period_steps = 10 ** 9
 FLAGS.auc_device_reduce = True
-bs, n_rec = 8192, 262_144
+bs = args.batch_size or (2048 if sweep else 8192)
+n_rec = args.records or (32_768 if sweep else 262_144)
 slots = [SlotDef("label", "float", 1), SlotDef("dense", "float", 13)]
 slots += [SlotDef(f"C{i}", "uint64") for i in range(1, 27)]
 desc = DataFeedDesc(slots=slots, batch_size=bs, label_slot="label",
@@ -48,6 +71,33 @@ table = ShardedEmbeddingTable(chips, mf_dim=8,
                               serve_bucket_min=1 << 12)
 tr = ShardedTrainer(DeepFM(hidden=(512, 256, 128)), table, desc, mesh,
                     tx=optax.adam(1e-3), float_wire="q8")
+
+if sweep:
+    # chunk-width sweep: per-chunk exchange vs pool seconds + the
+    # fused-schedule A/B, one line per width (train/a2a_probe — the
+    # same grouped plans the training step would build)
+    from paddlebox_tpu.train.a2a_probe import probe_exchange
+    group = next(iter(tr._group_iter(ds.batches())))
+    for c in sweep:
+        pr = probe_exchange(tr, group=group, chunks=c)
+        print(json.dumps({"probe": "a2a_sweep", "chunks": pr["a2a_chunks"],
+                          **{k: pr[k] for k in (
+                              "a2a_sections", "a2a_pull_sec", "pool_sec",
+                              "serve_sec", "dense_sec", "push_sec",
+                              "dense_sync_sec", "step_monolithic_sec",
+                              "step_chunked_sec", "exchange_sec_total",
+                              "exchange_overlap_frac",
+                              "exchange_wait_sec")}}), flush=True)
+        per = " ".join(
+            f"[{g}] a2a={a * 1e3:.2f}ms pool={p * 1e3:.2f}ms"
+            for g, (a, p) in enumerate(zip(pr["a2a_pull_sec"],
+                                           pr["pool_sec"])))
+        print(f"chunks={pr['a2a_chunks']}: {per}  "
+              f"step mono={pr['step_monolithic_sec'] * 1e3:.2f}ms "
+              f"chunked={pr['step_chunked_sec'] * 1e3:.2f}ms "
+              f"overlap={pr['exchange_overlap_frac']:.1%}", flush=True)
+    sys.exit(0)
+
 rp = tr.build_resident_pass(ds)
 rp.upload(materialize=True)
 tr.train_pass_resident(rp)          # warm/compile

@@ -1,0 +1,242 @@
+#!/usr/bin/env python
+"""Multichip scaling + chunked-parity gate (ISSUE 11).
+
+Two checks, wired into tier-1 by ``tests/test_scaling_check.py``:
+
+1. **Chunked parity end-to-end through train_pass**: on the in-process
+   CPU mesh, ``FLAGS.a2a_chunks=2`` reproduces the ``a2a_chunks=1``
+   model digest (params + packed table + AUC) BIT-FOR-BIT, and the
+   digest is deterministic across two seeded runs — the fused
+   computation-collective schedule (train/sharded) changes the
+   exchange's shape, never its math.
+2. **Multichip trajectory rows**: drive ``BENCH_MODE=multichip``
+   (bench.py — one subprocess per chip count) at a tiny workload into a
+   temp trajectory and assert the ``sharded.n{N}.{shape}.*`` rows land
+   well-formed and pass ``perf_gate`` over them.
+
+Graceful skips (exit 0 with a SKIP note): fewer than 2 visible devices
+for parity, or subprocess/device failure for the bench rows — CI boxes
+without the virtual-device backend must not fail tier-1 for missing
+hardware.
+
+``--record --source rXX`` additionally appends the measured multichip
+rows to the committed BENCH_trajectory.json under the given source, so
+they gate future rounds via ``perf_gate.py --check --ignore-live``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+from typing import List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: well-formed multichip gate keys (perf_gate keys on the metric name;
+#: the optional ``.c{chunks}`` segment keeps chunked-schedule ladders
+#: on their own gate history — BENCH_A2A_CHUNKS)
+KEY_RE = re.compile(
+    r"^sharded\.n\d+\.[a-z0-9_]+(\.c\d+)?\.(ex_per_sec_per_chip"
+    r"|scaling_efficiency)$")
+
+
+def _load_perf_gate():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "perf_gate", os.path.join(REPO, "scripts", "perf_gate.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _digest(trainer) -> str:
+    from paddlebox_tpu.train.checkpoint import sharded_state_digest
+    return sharded_state_digest(trainer)
+
+
+def parity_check(rows_per_file: int = 500,
+                 chunks: Tuple[int, ...] = (2,)) -> Optional[bool]:
+    """a2a_chunks ∈ chunks reproduce the chunks=1 digest through
+    train_pass (×2 seeded runs each). None = skipped (no mesh)."""
+    import jax
+    if len(jax.devices()) < 2:
+        print("scaling_check: SKIP parity — fewer than 2 devices "
+              "(needs a CPU mesh: XLA_FLAGS="
+              "--xla_force_host_platform_device_count=N)")
+        return None
+    import optax
+
+    from paddlebox_tpu.config import flags_scope
+    from paddlebox_tpu.data import DataFeedDesc, DatasetFactory
+    from paddlebox_tpu.data.criteo import generate_criteo_files
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.parallel import make_mesh
+    from paddlebox_tpu.ps import SparseSGDConfig
+    from paddlebox_tpu.ps.sharded import ShardedEmbeddingTable
+    from paddlebox_tpu.train.sharded import ShardedTrainer
+
+    n = min(8, len(jax.devices()))
+    mesh = make_mesh(n)
+    with tempfile.TemporaryDirectory(prefix="pbox_scaling_") as td:
+        files = generate_criteo_files(td, num_files=1,
+                                      rows_per_file=rows_per_file,
+                                      vocab_per_slot=40, seed=17)
+        desc = DataFeedDesc.criteo(batch_size=32)
+        desc.key_bucket_min = 1024
+        ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+        ds.set_filelist(files)
+        ds.load_into_memory()
+
+        def run(c: int) -> str:
+            cfg = SparseSGDConfig(mf_create_thresholds=0.0,
+                                  mf_initial_range=0.0,
+                                  learning_rate=0.1,
+                                  mf_learning_rate=0.1)
+            table = ShardedEmbeddingTable(
+                n, mf_dim=4, capacity_per_shard=4096, cfg=cfg,
+                req_bucket_min=256, serve_bucket_min=256)
+            with flags_scope(log_period_steps=10 ** 6, a2a_chunks=c):
+                tr = ShardedTrainer(DeepFM(hidden=(16, 16)), table,
+                                    desc, mesh, tx=optax.adam(2e-3))
+                tr.train_pass(ds)
+            return _digest(tr)
+
+        want = run(1)
+        if run(1) != want:
+            print("scaling_check: FAIL — chunks=1 digest is not "
+                  "deterministic across seeded runs", file=sys.stderr)
+            return False
+        for c in chunks:
+            got = run(c)
+            if got != want:
+                print(f"scaling_check: FAIL — a2a_chunks={c} digest "
+                      f"{got[:16]} != monolithic {want[:16]}",
+                      file=sys.stderr)
+                return False
+    print(f"scaling_check: parity OK — a2a_chunks {list(chunks)} "
+          f"bit-identical to monolithic on the {n}-way mesh "
+          f"(digest {want[:16]})")
+    return True
+
+
+def bench_rows_check(ns: str = "1,2", bs: int = 128, gbatches: int = 2,
+                     passes: int = 2, timeout_s: float = 480.0,
+                     shape: str = "uniform"
+                     ) -> Tuple[str, List[dict]]:
+    """Run the multichip bench into a temp trajectory; validate keys.
+    Returns ("ok"|"skip"|"fail", rows)."""
+    pg = _load_perf_gate()
+    with tempfile.TemporaryDirectory(prefix="pbox_scaling_") as td:
+        traj = os.path.join(td, "traj.json")
+        env = dict(os.environ)
+        env.update(BENCH_MODE="multichip", BENCH_SHAPE=shape,
+                   BENCH_MULTICHIP_NS=ns, BENCH_MULTICHIP_BS=str(bs),
+                   BENCH_MULTICHIP_BATCHES=str(gbatches),
+                   BENCH_MULTICHIP_PASSES=str(passes),
+                   BENCH_MULTICHIP_TIMEOUT=str(timeout_s / 2),
+                   BENCH_TRAJECTORY=traj, BENCH_TELEMETRY_JSONL="0")
+        try:
+            cp = subprocess.run(
+                [sys.executable, os.path.join(REPO, "bench.py")],
+                env=env, capture_output=True, text=True,
+                timeout=timeout_s)
+        except (subprocess.TimeoutExpired, OSError) as e:
+            print(f"scaling_check: SKIP bench rows — subprocess "
+                  f"unavailable ({e})")
+            return "skip", []
+        data = pg.load_trajectory(traj) if os.path.exists(traj) else None
+        if cp.returncode != 0 or not data or not data["rows"]:
+            print("scaling_check: SKIP bench rows — multichip bench "
+                  f"produced no rows (rc={cp.returncode}): "
+                  f"{cp.stderr[-400:]}")
+            return "skip", []
+        rows = data["rows"]
+        n_list = [int(x) for x in ns.split(",")]
+        want_keys = {f"sharded.n{n}.{shape}.{m}" for n in n_list
+                     for m in ("ex_per_sec_per_chip",
+                               "scaling_efficiency")}
+        got_keys = {r["metric"] for r in rows}
+        bad = [k for k in got_keys if not KEY_RE.match(k)]
+        if bad:
+            print(f"scaling_check: FAIL — malformed metric keys {bad}",
+                  file=sys.stderr)
+            return "fail", rows
+        missing = want_keys - got_keys
+        if missing:
+            print(f"scaling_check: FAIL — missing rows {sorted(missing)}",
+                  file=sys.stderr)
+            return "fail", rows
+        failures, _ = pg.check_rows(rows)
+        if failures:
+            print("\n".join(failures), file=sys.stderr)
+            return "fail", rows
+        eff = {r["metric"]: r["value"] for r in rows
+               if r["metric"].endswith("scaling_efficiency")}
+        print(f"scaling_check: multichip rows OK — {sorted(got_keys)}; "
+              f"efficiency {eff}")
+        return "ok", rows
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--skip-parity", action="store_true")
+    ap.add_argument("--skip-bench", action="store_true")
+    ap.add_argument("--ns", default="1,2",
+                    help="chip counts for the bench rows (default 1,2)")
+    ap.add_argument("--bs", type=int, default=128)
+    ap.add_argument("--batches", type=int, default=2,
+                    help="global batches per pass per child")
+    ap.add_argument("--timeout", type=float, default=480.0)
+    ap.add_argument("--shape", default="uniform")
+    ap.add_argument("--record", action="store_true",
+                    help="append the measured rows to the committed "
+                    "trajectory under --source")
+    ap.add_argument("--source", default=None,
+                    help="trajectory source tag for --record")
+    ap.add_argument("--trajectory", default=None)
+    args = ap.parse_args(argv)
+    rc = 0
+    if not args.skip_parity:
+        ok = parity_check()
+        if ok is False:
+            rc = 1
+    if not args.skip_bench:
+        status, rows = bench_rows_check(ns=args.ns, bs=args.bs,
+                                        gbatches=args.batches,
+                                        timeout_s=args.timeout,
+                                        shape=args.shape)
+        if status == "fail":
+            rc = 1
+        if args.record and status == "ok":
+            if not args.source:
+                print("--record needs --source", file=sys.stderr)
+                return 2
+            pg = _load_perf_gate()
+            path = args.trajectory or pg.default_trajectory_path()
+            for r in rows:
+                r = dict(r)
+                r["source"] = args.source
+                r.pop("recorded_at", None)
+                pg.append_row(r, path)
+            print(f"scaling_check: recorded {len(rows)} rows -> {path} "
+                  f"(source {args.source})")
+    return rc
+
+
+if __name__ == "__main__":
+    # a standalone run needs the virtual CPU mesh BEFORE jax imports
+    # (same trick as tests/conftest.py)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8").strip()
+    sys.exit(main())

@@ -79,6 +79,20 @@ def _bottleneck_cell(cp: Dict) -> str:
     return f"{b} +{stall:.3f}s"
 
 
+def _a2a_cell(ev: Dict) -> str:
+    """Per-pass exchange-overlap fraction (ISSUE 11): how much of the
+    sharded step's embedding all_to_all the chunked schedule hid behind
+    compute (train/a2a_probe, riding the pass event when the sharded
+    bench ran the probe; the critical_path's exchange_wait_sec is the
+    remainder)."""
+    v = ev.get("exchange_overlap_frac")
+    if v is None:
+        cp = ev.get("critical_path") or {}
+        w = cp.get("exchange_wait_sec")
+        return f"wait {float(w):.3f}s" if w is not None else ""
+    return f"{float(v):.0%}"
+
+
 def _begin_stall_cell(lp: Dict) -> str:
     """Render a pass event's begin_stall breakdown (tiered runs) —
     the per-stage boundary attribution without jq archaeology."""
@@ -146,6 +160,7 @@ def build_rows(events: List[dict]) -> List[Dict[str, str]]:
             "begin stall": begin_stall or "-",
             "bottleneck": _bottleneck_cell(ev.get("critical_path", {}))
             or "-",
+            "a2a ovl": _a2a_cell(ev) or "-",
             "hbm peak": _fmt_bytes(hbm.get("peak_bytes_in_use", 0)),
         })
     return rows

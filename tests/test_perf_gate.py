@@ -119,6 +119,52 @@ def test_check_single_row_and_improvements_pass(perf_gate):
     assert any("no history" in s for s in summary)
 
 
+def test_check_reports_every_regressed_key_worst_first(perf_gate,
+                                                       tmp_path):
+    """One --check run over a round that regressed SEVERAL keys — the
+    multichip scaling rows included — must name them all, ordered by
+    drop severity, in one pass (ISSUE 11)."""
+    rows = [
+        _row("sharded.n4.uniform.ex_per_sec_per_chip", 1000.0, "r06",
+             n_chips=4),
+        _row("sharded.n4.uniform.ex_per_sec_per_chip", 100.0, "r07",
+             n_chips=4),                                  # -90%
+        _row("sharded.n8.uniform.scaling_efficiency", 0.8, "r06"),
+        _row("sharded.n8.uniform.scaling_efficiency", 0.3, "r07"),  # -62%
+        _row("m_fine", 50.0, "r06"), _row("m_fine", 49.0, "r07"),
+    ]
+    failures, summary = perf_gate.check_rows(rows, max_drop_frac=0.5)
+    assert len(failures) == 2, failures
+    # worst drop first
+    assert "sharded.n4.uniform.ex_per_sec_per_chip" in failures[0]
+    assert "sharded.n8.uniform.scaling_efficiency" in failures[1]
+    assert any("m_fine" in s for s in summary)
+    # CLI still exits 1 and prints both
+    p = str(tmp_path / "t.json")
+    perf_gate._write(p, {"version": 1, "rows": rows})
+    assert perf_gate.main(["--check", "--trajectory", p]) == 1
+
+
+def test_multichip_extra_fields_ride_the_row(perf_gate, tmp_path):
+    """n_chips / a2a_chunks / exchange_overlap_frac are first-class
+    trajectory passthrough fields (EXTRA_FIELDS) on both the fold and
+    the live-append paths."""
+    tail = json.dumps({"metric": "sharded.n2.uniform.ex_per_sec_per_chip",
+                       "value": 5000.0, "unit": "examples/sec/chip",
+                       "mode": "multichip", "n_chips": 2,
+                       "a2a_chunks": 2})
+    p = tmp_path / "BENCH_r09.json"
+    p.write_text(json.dumps({"n": 9, "cmd": "x", "rc": 0, "tail": tail}))
+    rows = perf_gate.parse_bench_artifact(str(p))
+    assert rows[0]["n_chips"] == 2 and rows[0]["a2a_chunks"] == 2
+    traj = str(tmp_path / "traj.json")
+    perf_gate.record_result(
+        {"metric": "m_sharded", "value": 1.0, "unit": "u",
+         "exchange_overlap_frac": 0.4, "n_chips": 4}, path=traj)
+    live = json.load(open(traj))["rows"][-1]
+    assert live["exchange_overlap_frac"] == 0.4 and live["n_chips"] == 4
+
+
 def test_check_keys_are_per_metric(perf_gate):
     """The tiered metric regressing must flag even while resident is
     fine (per-mode/shape gating — the metric name carries both)."""

@@ -663,3 +663,200 @@ def test_sharded_resident_q8_wire_learns(mesh, tmp_path):
     assert np.isclose(rb["auc"], ra["auc"], atol=5e-3), (rb["auc"],
                                                          ra["auc"])
     assert rb["auc"] > 0.55
+
+
+# ---- fused computation-collective sharded step (ISSUE 11) --------------
+def _model_digest(tr):
+    """Raw-bytes identity (params + packed table + AUC) — the shared
+    chunk-parity digest (scripts/scaling_check.py uses the same one)."""
+    from paddlebox_tpu.train.checkpoint import sharded_state_digest
+    return sharded_state_digest(tr)
+
+
+@pytest.fixture(scope="module")
+def chunk_parity_ds(tmp_path_factory):
+    d = tmp_path_factory.mktemp("chunkds")
+    files = generate_criteo_files(str(d), num_files=1, rows_per_file=500,
+                                  vocab_per_slot=40, seed=29)
+    desc = DataFeedDesc.criteo(batch_size=32)
+    desc.key_bucket_min = 1024
+    ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    return ds, desc
+
+
+def _chunk_trainer(mesh, desc, chunks, zero1=False):
+    cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=0.0,
+                          learning_rate=0.1, mf_learning_rate=0.1)
+    table = ShardedEmbeddingTable(N, mf_dim=4, capacity_per_shard=4096,
+                                  cfg=cfg, req_bucket_min=256,
+                                  serve_bucket_min=256)
+    with flags_scope(log_period_steps=10 ** 6, a2a_chunks=chunks):
+        return ShardedTrainer(DeepFM(hidden=(16, 16)), table, desc, mesh,
+                              tx=optax.adam(2e-3), zero1=zero1)
+
+
+def test_a2a_chunked_digest_parity(mesh, chunk_parity_ds):
+    """a2a_chunks ∈ {2, 4} reproduce the monolithic (=1) model digest
+    BIT-FOR-BIT through train_pass, deterministically across 2 seeded
+    runs. chunks=4 over criteo's 26 slots is the uneven-group case
+    (26 % 4 != 0: groups of 7/7/6/6)."""
+    ds, desc = chunk_parity_ds
+
+    def run(chunks):
+        tr = _chunk_trainer(mesh, desc, chunks)
+        tr.train_pass(ds)
+        return _model_digest(tr)
+
+    want = run(1)
+    assert run(1) == want, "monolithic digest not deterministic"
+    for chunks in (2, 4):
+        got = run(chunks)
+        assert got == want, \
+            f"a2a_chunks={chunks} diverged from the monolithic schedule"
+
+
+def test_a2a_chunked_resident_digest_parity(mesh, chunk_parity_ds):
+    """The chunked RESIDENT pass (uniform forced sections, grouped wire
+    encode, per-schedule fori_loop runner) matches the monolithic
+    resident digest bit-for-bit."""
+    ds, desc = chunk_parity_ds
+
+    def run(chunks):
+        tr = _chunk_trainer(mesh, desc, chunks)
+        rp = tr.build_resident_pass(ds)
+        if chunks > 1:
+            assert rp.sections, "chunked build lost its sections"
+        tr.train_pass_resident(rp)
+        return _model_digest(tr)
+
+    assert run(2) == run(1)
+
+
+def test_a2a_chunked_zero1_digest_parity(mesh, chunk_parity_ds):
+    """ZeRO-1 variant: the chunked schedule interleaves the push
+    exchange with the reduce-scatter/update/all-gather — still
+    bit-identical to the monolithic order."""
+    ds, desc = chunk_parity_ds
+
+    def run(chunks):
+        tr = _chunk_trainer(mesh, desc, chunks, zero1=True)
+        tr.train_pass(ds)
+        return _model_digest(tr)
+
+    assert run(2) == run(1)
+
+
+def test_a2a_chunked_fallback_non_qualified_keys(mesh):
+    """make_batches keys are NOT slot-qualified (random ids across
+    slots): the grouped plan builder must detect it before mutating the
+    index and fall back to the monolithic layout — same plan bytes as
+    groups=1."""
+    cfg = SparseSGDConfig(mf_create_thresholds=1e9)
+    t1 = ShardedEmbeddingTable(N, mf_dim=4, capacity_per_shard=256,
+                               cfg=cfg, req_bucket_min=8,
+                               serve_bucket_min=8)
+    t2 = ShardedEmbeddingTable(N, mf_dim=4, capacity_per_shard=256,
+                               cfg=cfg, req_bucket_min=8,
+                               serve_bucket_min=8)
+    batches = make_batches(N, seed=71)
+    p1 = t1.prepare_global(batches)
+    p2 = t2.prepare_global(batches, groups=2)
+    assert p2.a2a_sections == () and p2.key_segments is None
+    np.testing.assert_array_equal(p1.resp_idx, p2.resp_idx)
+    np.testing.assert_array_equal(p1.gather_idx, p2.gather_idx)
+    np.testing.assert_array_equal(p1.serve_rows, p2.serve_rows)
+
+
+def test_a2a_grouped_plan_layout():
+    """Grouped plan invariants on slot-qualified batches: sections sum
+    to the A/K axes, every key's gather position lands inside its
+    group's section, and each section keeps the pad slack."""
+    from paddlebox_tpu.data.batch import SlotBatch
+    from paddlebox_tpu.ops.seqpool_cvm import slot_group_bounds
+    rng = np.random.default_rng(3)
+    bs, S, k_pad = 8, 5, 40
+    batches = []
+    for _ in range(N):
+        nk = int(rng.integers(S, k_pad // 2))
+        slots = rng.integers(0, S, size=nk)
+        keys = (slots * 1000 + rng.integers(1, 200, size=nk)).astype(
+            np.uint64)
+        segs = np.full(k_pad, bs * S, np.int32)
+        ins = np.sort(rng.integers(0, bs, size=nk))
+        segs[:nk] = (ins * S + slots).astype(np.int32)
+        kp = np.zeros(k_pad, np.uint64)
+        kp[:nk] = keys
+        batches.append(SlotBatch(
+            keys=kp, segments=segs, num_keys=nk,
+            dense=rng.normal(size=(bs, 4)).astype(np.float32),
+            label=rng.integers(0, 2, bs).astype(np.float32),
+            show=np.ones(bs, np.float32),
+            clk=np.zeros(bs, np.float32),
+            batch_size=bs, num_slots=S))
+    table = ShardedEmbeddingTable(N, mf_dim=4, capacity_per_shard=256,
+                                  req_bucket_min=8, serve_bucket_min=8)
+    c = 2
+    p = table.prepare_global(batches, groups=c)
+    assert len(p.a2a_sections) == c
+    assert sum(p.a2a_sections) == p.req_capacity
+    assert sum(p.key_sections) == p.gather_idx.shape[1]
+    assert p.slot_sections == tuple(hi - lo for lo, hi
+                                    in slot_group_bounds(S, c))
+    assert p.key_segments is not None \
+        and p.key_segments.shape == p.gather_idx.shape
+    a_lo = np.concatenate([[0], np.cumsum(p.a2a_sections)])
+    k_lo = np.concatenate([[0], np.cumsum(p.key_sections)])
+    s_lo = np.concatenate([[0], np.cumsum(p.slot_sections)])
+    for g in range(c):
+        sec_gi = p.gather_idx[:, k_lo[g]:k_lo[g + 1]]
+        j = sec_gi % p.req_capacity
+        assert (j >= a_lo[g]).all() and (j < a_lo[g + 1]).all(), \
+            f"group {g} gathers outside its A section"
+        sec_seg = p.key_segments[:, k_lo[g]:k_lo[g + 1]]
+        real = sec_seg < bs * S
+        slots = sec_seg[real] % S
+        assert (slots >= s_lo[g]).all() and (slots < s_lo[g + 1]).all()
+        # pad slack: the last j of each pair's section serves the
+        # sentinel (resp pad), so in-section pad keys read zeros
+        assert (p.resp_idx[:, :, a_lo[g + 1] - 1]
+                == p.serve_capacity - 1).all()
+
+
+def test_a2a_probe_reports_and_spans(mesh, chunk_parity_ds):
+    """train/a2a_probe: per-chunk a2a/pool seconds with the right
+    arity, a sane overlap fraction, the exchange_wait critical-path
+    part, and a2a.pull.*/a2a.push spans on the device.a2a lane when a
+    trace sink is attached."""
+    from paddlebox_tpu.obs import trace
+    from paddlebox_tpu.obs.hub import get_hub
+    from paddlebox_tpu.obs.trace import ChromeLaneTraceSink
+    from paddlebox_tpu.train.a2a_probe import probe_exchange
+    from paddlebox_tpu.utils.profiler import ChromeTraceWriter
+    ds, desc = chunk_parity_ds
+    tr = _chunk_trainer(mesh, desc, 2)
+    tr.train_pass(ds)
+    w = ChromeTraceWriter()
+    sink = ChromeLaneTraceSink(w)
+    hub = get_hub()
+    hub.add_sink(sink)
+    try:
+        trace.reset()
+        pr = probe_exchange(tr, dataset=ds, reps=1)
+    finally:
+        hub.remove_sink(sink)
+    assert pr["a2a_chunks"] == 2
+    assert len(pr["a2a_pull_sec"]) == 2 and len(pr["pool_sec"]) == 2
+    assert all(t > 0 for t in pr["a2a_pull_sec"] + pr["pool_sec"])
+    assert 0.0 <= pr["exchange_overlap_frac"] <= 1.0
+    assert pr["exchange_wait_sec"] >= 0.0
+    # the wait part rides the next pass event's critical_path
+    parts = trace.consume_pass_parts()
+    assert "exchange_wait" in parts
+    names = {e.get("name") for e in w._events}
+    assert {"a2a.pull.0", "a2a.pull.1", "pool.0", "pool.1",
+            "a2a.push"} <= names
+    lanes = {e.get("args", {}).get("lane") for e in w._events
+             if e.get("name", "").startswith("a2a.")}
+    assert lanes == {trace.LANE_DEVICE}
