@@ -82,8 +82,21 @@ class Flags:
     shrink_delete_threshold: float = 0.0
     show_click_decay_rate: float = 0.98
 
-    # --- pallas kernels (ops/pallas_kernels.py; interpret-mode off-TPU) ---
+    # --- pallas kernels (ops/pallas_kernels.py; interpret-mode off-TPU;
+    # docs/PERFORMANCE.md §Device kernels) ---
+    # table line-gather via the scalar-prefetch Pallas gather
+    # (ps/table.gather_full_rows) instead of XLA's per-element gather
     use_pallas_gather: bool = False
+    # route the seqpool family through the fused Pallas embed-pool-CVM
+    # kernel: ops/seqpool_cvm.fused_seqpool_cvm{,_with_conv} forward →
+    # fused_pool_cvm_forward (MXU one-hot pooling + in-VMEM CVM),
+    # backward → segment_gather_mxu (transposed one-hot matmul), and
+    # every _pool_core/segment_sum call → segment_sum_mxu. The trivial
+    # (segments=None) layout keeps its free reshape path. Off (default)
+    # = the XLA composition, byte-for-byte today's program; parity is
+    # gated in tier-1 (tests/test_pallas_kernels.py,
+    # tests/test_pallas_train_gate.py — forward AND pushed grads,
+    # uniform + zipf shapes).
     use_pallas_seqpool: bool = False
 
     # --- fused computation-collective sharded step (ISSUE 11;

@@ -76,6 +76,12 @@ LANE_READER = "stream.reader"
 #: measured by the decomposed probe (train/a2a_probe) — spans are
 #: ``a2a.pull.<k>`` / ``pool.<k>`` / ``a2a.push`` on this row
 LANE_DEVICE = "device.a2a"
+#: per-kernel device attribution (ISSUE 12): the embed-pool-CVM kernel
+#: family measured by the kernel microbench
+#: (scripts/profile_keypath.py --set kernels) — spans are
+#: ``kernel.{gather,pool_cvm,fused}[. _xla]`` on this row, one per
+#: timed probe re-run, so a trace shows Pallas vs XLA cost side by side
+LANE_KERNELS = "device.kernels"
 
 _TLS = threading.local()   # .lane: str, .stack: List[int] (open span ids)
 _ID_LOCK = threading.Lock()

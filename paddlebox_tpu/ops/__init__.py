@@ -1,6 +1,9 @@
 from paddlebox_tpu.ops.seqpool_cvm import (
     fused_seqpool_cvm, fused_seqpool_cvm_with_conv, fused_seqpool_concat,
 )
+from paddlebox_tpu.ops.pallas_kernels import (
+    fused_embed_pool_cvm, segment_gather_mxu, segment_sum_mxu,
+)
 from paddlebox_tpu.ops.cvm import cvm, cvm_grad_passthrough
 from paddlebox_tpu.ops.rank_attention import (rank_attention,
                                               rank_attention2)
@@ -30,5 +33,6 @@ __all__ = [
     "init_cross_norm_summary", "scaled_fc", "scaled_int8fc",
     "fused_seqpool_cvm_with_diff_thres", "fused_seqpool_cvm_tradew",
     "fused_seqpool_cvm_with_credit", "fused_seqpool_cvm_with_pcoc",
-    "fused_seq_tensor",
+    "fused_seq_tensor", "fused_embed_pool_cvm", "segment_gather_mxu",
+    "segment_sum_mxu",
 ]

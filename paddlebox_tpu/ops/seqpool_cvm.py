@@ -25,7 +25,13 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from paddlebox_tpu.ops.pallas_kernels import segment_sum
+from paddlebox_tpu.config import FLAGS
+from paddlebox_tpu.ops.pallas_kernels import (CVM_CONV, CVM_FULL, CVM_NONE,
+                                              CVM_SHOW, _book_dispatch,
+                                              fused_pool_cvm_forward,
+                                              segment_gather_mxu,
+                                              keep_or_ones, segment_sum,
+                                              show_clk_keep)
 
 
 @functools.partial(
@@ -92,8 +98,7 @@ def _keep_mask(v, cvm_offset, need_filter, show_coeff, clk_coeff, threshold,
     k, d = v.shape
     if not (need_filter or embed_threshold_filter):
         return jnp.ones((k,), dtype=bool)
-    show, clk = v[:, 0], v[:, 1]
-    keep = ((show - clk) * show_coeff + clk * clk_coeff) >= threshold
+    keep = show_clk_keep(v, show_coeff, clk_coeff, threshold)
     if embed_threshold_filter:
         ets = embed_thres_size if embed_thres_size > 0 else d - cvm_offset
         e = v[:, cvm_offset:cvm_offset + ets]
@@ -135,9 +140,29 @@ def _fwd(values, segments, batch_show_clk, batch_size, num_slots, use_cvm,
                       threshold, embed_threshold_filter, embed_threshold,
                       embed_thres_size)
     rank = None
+    fused_out = None
     if kk == 1:
-        pooled = _pool_core(v, segments, batch_size, num_slots, keep,
-                            pad_value)                    # [B, S, D]
+        if segments is not None and FLAGS.use_pallas_seqpool:
+            # THE dispatch seam (ISSUE 12): one fused Pallas pass —
+            # blocked gather of the pulled rows + MXU one-hot pooling +
+            # in-VMEM CVM epilogue — replaces _pool_core + the jnp CVM
+            # transform below. The trivial (segments=None) layout keeps
+            # its reshape fast path: it has no scatter to kill, and the
+            # reshape is free (see _pool_core).
+            _book_dispatch("fused_embed_pool_cvm", "pallas")
+            mode = CVM_NONE if not use_cvm else (
+                CVM_SHOW if clk_filter else CVM_FULL)
+            fused_out = fused_pool_cvm_forward(
+                v, segments, keep.astype(jnp.float32), batch_size,
+                num_slots, cvm_mode=mode, cvm_offset=cvm_offset,
+                ets=(0 if use_cvm else embed_thres_size),
+                pad_value=pad_value)
+        else:
+            _book_dispatch("fused_embed_pool_cvm",
+                           "reshape" if segments is None else "xla")
+        if fused_out is None:
+            pooled = _pool_core(v, segments, batch_size, num_slots, keep,
+                                pad_value)                # [B, S, D]
     else:
         # …EmbedxConcate kernels: the j-th block is the (start+j)-th key
         # of the sequence, NOT sum-pooled; keys at rank ≥ k drop
@@ -152,8 +177,15 @@ def _fwd(values, segments, batch_show_clk, batch_size, num_slots, use_cvm,
         if embedx_concate_filter:
             drop = drop | ~keep
         n2 = batch_size * num_slots * kk
-        seg2 = jnp.where(drop | (segs >= batch_size * num_slots),
-                         n2, segs * kk + rank)
+        drop_all = drop | (segs >= batch_size * num_slots)
+        if FLAGS.use_pallas_seqpool:
+            # −1 drop markers keep the non-drop id stream nondecreasing
+            # for the MXU pair grid (a mid-stream n2 marker would break
+            # the blocked one-hot's monotone output-visit order); the
+            # default path keeps its historical n2 discard bin verbatim
+            seg2 = jnp.where(drop_all, -1, segs * kk + rank)
+        else:
+            seg2 = jnp.where(drop_all, n2, segs * kk + rank)
         vv = jnp.where(drop[:, None], 0.0, v)
         pooled = segment_sum(vv, seg2, n2 + 1)[:-1]
         if pad_value:
@@ -162,7 +194,9 @@ def _fwd(values, segments, batch_show_clk, batch_size, num_slots, use_cvm,
                               n2 + 1)[:-1]
             pooled = jnp.where(cnt > 0, pooled, pad_value)
         pooled = pooled.reshape(batch_size, num_slots, kk, d)
-    if use_cvm:
+    if fused_out is not None:
+        out = fused_out
+    elif use_cvm:
         show_l = jnp.log1p(pooled[..., 0:1])
         if clk_filter:
             # FusedCVMKernelWithShow :301: [log(show+1), embedx…] — the
@@ -200,7 +234,10 @@ def _bwd(batch_size, num_slots, use_cvm, cvm_offset, pad_value, need_filter,
     # counters. Quant and the log transform are straight-through, exactly
     # as the CUDA grad kernels ignore them.
     kk = 1 if (use_cvm and not clk_filter) else kk
-    n_head = (1 if clk_filter else cvm_offset) if use_cvm else 0
+    # the use_cvm output head is the TRANSFORMED columns — one for the
+    # clk_filter head, TWO (log1p(show), ctr) otherwise, regardless of
+    # cvm_offset (which only sets how many input columns they replace)
+    n_head = (1 if clk_filter else 2) if use_cvm else 0
     ets = 0 if use_cvm else embed_thres_size
     w = d - cvm_offset - ets          # embedx dims receiving real grads
     if kk > 1:
@@ -209,15 +246,23 @@ def _bwd(batch_size, num_slots, use_cvm, cvm_offset, pad_value, need_filter,
     k_keys = keep.shape[0]
     n = batch_size * num_slots
     if kk > 1:
-        flat = jnp.concatenate(
-            [embedx_g.reshape(n * kk, w), jnp.zeros((1, w), g.dtype)])
         segs = (jnp.arange(k_keys, dtype=jnp.int32) if segments is None
                 else segments)
         drop = rank >= kk
         if embedx_concate_filter:
             drop = drop | ~keep
-        idx = jnp.where(drop | (segs >= n), n * kk, segs * kk + rank)
-        g_embedx = flat[idx]
+        if FLAGS.use_pallas_seqpool:
+            # transposed one-hot matmul on the MXU (bitwise a gather);
+            # −1 markers drop exactly like the n*kk discard row below
+            _book_dispatch("seqpool_grad", "mxu")
+            idx = jnp.where(drop | (segs >= n), -1, segs * kk + rank)
+            g_embedx = segment_gather_mxu(
+                embedx_g.reshape(n * kk, w), idx)
+        else:
+            flat = jnp.concatenate(
+                [embedx_g.reshape(n * kk, w), jnp.zeros((1, w), g.dtype)])
+            idx = jnp.where(drop | (segs >= n), n * kk, segs * kk + rank)
+            g_embedx = flat[idx]
         ins = jnp.minimum(segs // num_slots, batch_size - 1)
         pad = segs >= n
         contrib = ~drop
@@ -232,6 +277,15 @@ def _bwd(batch_size, num_slots, use_cvm, cvm_offset, pad_value, need_filter,
             seg_ids = jnp.arange(k_keys, dtype=jnp.int32)
             pad = seg_ids >= n
             ins = jnp.minimum(seg_ids // num_slots, batch_size - 1)
+        elif FLAGS.use_pallas_seqpool:
+            # the push-path grad gather on the MXU — the fused kernel's
+            # backward half (pads/OOB ids produce zero rows, exactly the
+            # discard-row contract of the XLA composition below)
+            _book_dispatch("seqpool_grad", "mxu")
+            g_embedx = segment_gather_mxu(embedx_g.reshape(n, w),
+                                          segments)       # [K, w]
+            ins = jnp.minimum(segments // num_slots, batch_size - 1)
+            pad = segments >= n
         else:
             flat = jnp.concatenate(
                 [embedx_g.reshape(n, w), jnp.zeros((1, w), g.dtype)])
@@ -289,12 +343,18 @@ _CONV_OFFSET = 3
 def _pool_core(values, segments, batch_size, num_slots, keep=None,
                pad_value=0.0):
     """The one shared pooling body: mask → segment-sum → [B, S, D]
-    (+pad). Every seqpool op and variant goes through here.
+    (+pad). Every seqpool op and variant goes through here; the
+    ``segment_sum`` call below is itself a dispatch seam
+    (``FLAGS.use_pallas_seqpool`` → the MXU one-hot kernel), and the
+    main ``fused_seqpool_cvm`` forward bypasses this body entirely
+    under the flag in favor of the FUSED pool+CVM Pallas pass
+    (ops/pallas_kernels.fused_pool_cvm_forward — ISSUE 12).
 
     ``segments=None`` declares the TRIVIAL layout (exactly one key per
     (instance, slot), slot-ordered — the common CTR schema): the pool is
     then a pure reshape, skipping the TPU scatter-add entirely (scatters
-    carry ~20ms fixed cost per call on v5p; the reshape is free)."""
+    carry ~20ms fixed cost per call on v5p; the reshape is free) — the
+    Pallas dispatch deliberately leaves this fast path alone."""
     if keep is not None:
         values = jnp.where(keep[:, None], values, 0.0)
     d = values.shape[1]
@@ -313,12 +373,8 @@ def _pool_core(values, segments, batch_size, num_slots, keep=None,
 def _filtered_pool(values, segments, batch_size, num_slots, pad_value,
                    need_filter, show_coeff, clk_coeff, threshold):
     """Shared filter + segment-sum (both seqpool variants)."""
-    k = values.shape[0]
-    if need_filter:
-        show, clk = values[:, 0], values[:, 1]
-        keep = ((show - clk) * show_coeff + clk * clk_coeff) >= threshold
-    else:
-        keep = jnp.ones((k,), dtype=bool)
+    keep = keep_or_ones(values, need_filter, show_coeff, clk_coeff,
+                        threshold)
     return _pool_core(values, segments, batch_size, num_slots, keep,
                       pad_value), keep
 
@@ -327,6 +383,21 @@ def _fwd_conv(values, segments, batch_cvm, batch_size, num_slots, use_cvm,
               show_filter, pad_value, need_filter, show_coeff, clk_coeff,
               threshold):
     d = values.shape[1]
+    if segments is not None and FLAGS.use_pallas_seqpool:
+        # same fused dispatch seam, conv head (CVM_CONV transforms the
+        # 3-column show/clk/conv head in-VMEM); show_filter slices the
+        # show column off the fused output
+        _book_dispatch("fused_embed_pool_cvm", "pallas")
+        keep = keep_or_ones(values, need_filter, show_coeff, clk_coeff,
+                            threshold)
+        out = fused_pool_cvm_forward(
+            values, segments, keep.astype(jnp.float32), batch_size,
+            num_slots, cvm_mode=CVM_CONV if use_cvm else CVM_NONE,
+            cvm_offset=_CONV_OFFSET, pad_value=pad_value)
+        if use_cvm and show_filter:
+            out = out[..., 1:]
+        vtoken = jnp.zeros((0, d), values.dtype)
+        return out, (segments, keep, vtoken, batch_cvm)
     pooled, keep = _filtered_pool(values, segments, batch_size, num_slots,
                                   pad_value, need_filter, show_coeff,
                                   clk_coeff, threshold)
@@ -349,10 +420,14 @@ def _bwd_conv(batch_size, num_slots, use_cvm, show_filter, pad_value,
     co = _CONV_OFFSET
     n_head = (co - 1 if show_filter else co) if use_cvm else 0
     embedx_g = g[..., n_head:]
-    flat = embedx_g.reshape(batch_size * num_slots, d - co)
-    flat = jnp.concatenate(
-        [flat, jnp.zeros((1, d - co), flat.dtype)], axis=0)
-    g_embedx = flat[segments]
+    if FLAGS.use_pallas_seqpool:
+        g_embedx = segment_gather_mxu(
+            embedx_g.reshape(batch_size * num_slots, d - co), segments)
+    else:
+        flat = embedx_g.reshape(batch_size * num_slots, d - co)
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((1, d - co), flat.dtype)], axis=0)
+        g_embedx = flat[segments]
     ins = jnp.minimum(segments // num_slots, batch_size - 1)
     g_cvm = batch_cvm[ins]
     pad = segments >= batch_size * num_slots

@@ -34,7 +34,7 @@ import numpy as np
 
 from paddlebox_tpu.config import FLAGS
 from paddlebox_tpu.data.batch import SlotBatch
-from paddlebox_tpu.ops.pallas_kernels import gather_rows
+from paddlebox_tpu.ops.pallas_kernels import _book_dispatch, gather_rows
 from paddlebox_tpu.ps.sgd import (RowState, SparseSGDConfig,
                                   opt_ext_width, sparse_update)
 from paddlebox_tpu.utils.logging import get_logger
@@ -543,8 +543,10 @@ def gather_full_rows(state: TableState, unique_rows: jax.Array) -> jax.Array:
     u = unique_rows.shape[0]
     rows = jnp.minimum(unique_rows, state.capacity)
     if FLAGS.use_pallas_gather:
+        _book_dispatch("gather_rows", "pallas")
         lines = gather_rows(state.packed, rows // rpl)
     else:
+        _book_dispatch("gather_rows", "xla")
         lines = state.packed[rows // rpl]                 # [U, 128]
     grouped = lines.reshape(u, rpl, fp)
     onehot = _lane_onehot(rows % rpl, rpl, lines.dtype)   # [U, rpl]

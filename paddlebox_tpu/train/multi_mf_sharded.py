@@ -6,7 +6,9 @@ global batch runs C pull all_to_alls → per-class fused_seqpool_cvm →
 canonical slot-order concat → dense net → backward → C push all_to_alls
 → per-class in-table optimizer + dense psum. Reference:
 feature_value.h:42-185 (the dy-mf accessor IS the sharded PS layout),
-ps_gpu_wrapper.cc multi-mf BuildGPUTask.
+ps_gpu_wrapper.cc multi-mf BuildGPUTask. The per-class
+``fused_seqpool_cvm`` calls ride the ``FLAGS.use_pallas_seqpool`` seam
+onto the fused Pallas MXU kernel (docs/PERFORMANCE.md §Device kernels).
 """
 
 from __future__ import annotations

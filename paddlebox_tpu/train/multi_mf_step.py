@@ -7,7 +7,10 @@ pull_gpups_sparse + seqpool + concat contract with per-slot widths,
 feature_value.h:42-185 / ps_gpu_wrapper.cc multi-mf build) before the
 dense model; the backward push applies per class table. Gather/scatter on
 TPU costs per index, so the class split adds no device cost beyond C
-small dispatch chains inside one XLA program."""
+small dispatch chains inside one XLA program. Each class's
+``fused_seqpool_cvm`` (forward and push-feeding backward) rides the
+``FLAGS.use_pallas_seqpool`` seam onto the fused Pallas MXU kernel
+(docs/PERFORMANCE.md §Device kernels)."""
 
 from __future__ import annotations
 

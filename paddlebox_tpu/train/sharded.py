@@ -389,7 +389,10 @@ class ShardedTrainStep:
         decomposition), and the push grad all_to_all issued BEFORE the
         independent dense sync so exchange and psum/ZeRO-1 overlap.
         Both schedules are bit-identical (tests/test_sharded.py digest
-        parity; docs/PERFORMANCE.md §Sharded-step overlap)."""
+        parity; docs/PERFORMANCE.md §Sharded-step overlap). Either
+        schedule's pooling (fused_seqpool_cvm / the slot-group variant)
+        rides the FLAGS.use_pallas_seqpool dispatch seam onto the fused
+        Pallas MXU kernel (docs/PERFORMANCE.md §Device kernels)."""
         n, b, s = self.n, self.batch_size, self.num_slots
         me = jax.lax.axis_index(DATA_AXIS)
         # blocks arrive with leading dim 1; drop it

@@ -8,6 +8,13 @@ Here the entire loop body is ONE traced function: XLA fuses the gather,
 segment ops, MXU matmuls, scatter update and AUC histogram into a single
 device program with zero host round-trips; buffer donation makes the table
 and optimizer states update in place.
+
+The pooling+CVM inside is itself a dispatch seam: under
+``FLAGS.use_pallas_seqpool`` the ``fused_seqpool_cvm`` call (and its
+backward feeding the push) routes to the fused Pallas MXU kernel
+(ops/pallas_kernels.fused_pool_cvm_forward / segment_gather_mxu —
+docs/PERFORMANCE.md §Device kernels); the trivial-layout fast path
+(``pool_segments is None``) keeps its free reshape either way.
 """
 
 from __future__ import annotations

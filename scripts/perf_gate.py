@@ -134,13 +134,19 @@ def _write(path: str, data: Dict) -> None:
 
 def fold(repo_root: Optional[str] = None,
          out_path: Optional[str] = None) -> Dict:
-    """BENCH_r0*.json → BENCH_trajectory.json (sorted by round)."""
+    """Recorded artifacts → BENCH_trajectory.json (sorted by family,
+    then round). Besides the driver's ``BENCH_r0*`` rounds this folds
+    the multichip scaling rounds (``MULTICHIP_r0*``, ISSUE 11) and the
+    kernel-microbench rounds (``KERNELS_r0*``,
+    ``scripts/profile_keypath.py --set kernels`` — ISSUE 12), so a
+    rebuild keeps their gate history instead of silently dropping it."""
     root = repo_root or _repo_root()
     out = out_path or os.path.join(root, "BENCH_trajectory.json")
     rows: List[Dict] = []
-    for path in sorted(glob.glob(os.path.join(root,
-                                              "BENCH_r[0-9]*.json"))):
-        rows.extend(parse_bench_artifact(path))
+    for pattern in ("BENCH_r[0-9]*.json", "MULTICHIP_r[0-9]*.json",
+                    "KERNELS_r[0-9]*.json"):
+        for path in sorted(glob.glob(os.path.join(root, pattern))):
+            rows.extend(parse_bench_artifact(path))
     data = {"version": 1, "rows": rows}
     _write(out, data)
     return data

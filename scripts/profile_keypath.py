@@ -21,6 +21,15 @@ Probe sets:
        levers left after the slot-wire decode fix)
     3  merge form/dtype, packed-line expand, dedup sort form (the
        levers left after the decode + gather-extract fixes)
+    kernels  the Pallas embed-pool-CVM family vs the XLA composition
+       (ISSUE 12): gather, pool+CVM forward, full fused fwd+bwd — one
+       JSON row per probe, and with ``--record`` higher-is-better
+       ``kernel.{gather,pool_cvm,fused}.{shape}.{backend}`` rows
+       appended to BENCH_trajectory.json for scripts/perf_gate.py
+       (--check --ignore-live gates them; interpret-mode CPU rows key
+       separately from real-TPU rows via the backend suffix). When a
+       trace span sink is attached each probe re-runs once inside a
+       ``kernel.*`` span on the ``device.kernels`` lane.
 
 ``PROF_ITERS`` / ``PROF_SHAPE`` env vars keep working (CLI wins).
 Sets 2 and 3 probe the ragged shape regardless of --shape (their
@@ -746,20 +755,188 @@ def run_set3(n_iter: int) -> None:
     timeit("merge_bucketed64", p_merge_bucketed64, g_k, gidx_stack)
 
 
+def _kernel_segments(shape: str, rng, b: int, s: int, k: int,
+                     n_iter: int) -> np.ndarray:
+    """Stacked nondecreasing segment streams [n_iter, K]: ``uniform``
+    draws one key per (ins, slot) bin in order, ``ragged`` Poisson
+    lengths, ``zipf`` heavy-tailed lengths (the hot-sequence CTR
+    shape); the tail of every stream is batch padding (→ B*S)."""
+    out = np.full((n_iter, k), b * s, np.int32)
+    for i in range(n_iter):
+        if shape == "uniform":
+            nk = min(k, b * s)
+            out[i, :nk] = np.arange(nk, dtype=np.int32)
+            continue
+        if shape == "zipf":
+            lens = np.minimum(rng.zipf(1.5, size=b * s), 32)
+        else:
+            lens = 1 + rng.poisson(4.0, size=b * s)
+        ids = np.repeat(np.arange(b * s, dtype=np.int32), lens)[:k]
+        out[i, :len(ids)] = ids
+    return out
+
+
+def run_set_kernels(shape: str, n_iter: int, record: bool = False) -> None:
+    """Per-kernel device cost of the Pallas embed-pool-CVM family vs the
+    XLA composition (ISSUE 12; docs/PERFORMANCE.md §Device kernels)."""
+    import jax.numpy as jnp
+
+    from paddlebox_tpu.config import flags_scope
+    from paddlebox_tpu.obs import trace
+    from paddlebox_tpu.ops import fused_seqpool_cvm
+    from paddlebox_tpu.ops.pallas_kernels import (fused_pool_cvm_forward,
+                                                  gather_rows)
+
+    backend = jax.default_backend()
+    if backend == "tpu":
+        b, s, cap, k = 4096, 26, 1 << 20, 1 << 19
+    else:
+        # interpret-mode round: the kernel body runs as a python loop
+        # per pair — keep it seconds, the row exists for gate HISTORY
+        b, s, cap, k = 64, 8, 1 << 12, 1 << 11
+    mf = MF
+    d = 2 + mf
+    rng = np.random.default_rng(0)
+
+    timeit = make_timeit(n_iter)
+    rows_out = []
+
+    def probe(name, fn, *args, keys=k):
+        if trace.tracing_active():
+            with trace.span(f"kernel.{name}", lane=trace.LANE_KERNELS,
+                            shape=shape, backend=backend):
+                jax.block_until_ready(fn(*args))
+        ms = timeit(f"kernel.{name}.{shape}", fn, *args, backend=backend)
+        if record and ms > 0:
+            # source="live" (the bench.py convention): a re-run on a
+            # slower box appends a row that --check --ignore-live SKIPS
+            # — the GATED history is the committed KERNELS_r0*.json
+            # round (folded with its artifact name as source)
+            rows_out.append({
+                "source": "live",
+                "metric": f"kernel.{name}.{shape}.{backend}",
+                "value": round(keys / ms * 1000.0, 1),
+                "unit": "keys/sec", "shape": shape,
+            })
+
+    print(json.dumps({"probe": "shape", "B": b, "S": s, "K": k,
+                      "CAP": cap, "D": d, "backend": backend}),
+          flush=True)
+
+    # ---- gather: pallas scalar-prefetch line gather vs XLA take ----
+    table = jnp.asarray(rng.normal(size=(cap, 128)).astype(np.float32))
+    rows_np = rng.integers(0, cap, size=(n_iter, k)).astype(np.int32)
+    rows_stack = jnp.asarray(rows_np)
+
+    @jax.jit
+    def p_gather_pallas(table, rows_stack):
+        def body(i, acc):
+            v = gather_rows(table, rows_stack[i])
+            return acc + v[0, 0] + v[-1, -1]
+        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+    @jax.jit
+    def p_gather_xla(table, rows_stack):
+        def body(i, acc):
+            v = table[rows_stack[i]]
+            return acc + v[0, 0] + v[-1, -1]
+        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+    probe("gather", p_gather_pallas, table, rows_stack)
+    probe("gather_xla", p_gather_xla, table, rows_stack)
+
+    # ---- pool+CVM forward: fused Pallas pass vs XLA composition ----
+    vals = rng.normal(size=(k, d)).astype(np.float32)
+    vals[:, :2] = np.abs(vals[:, :2])
+    vals_j = jnp.asarray(vals)
+    segs_stack = jnp.asarray(_kernel_segments(shape, rng, b, s, k, n_iter))
+    sc = jnp.asarray(np.abs(rng.normal(size=(b, 2))).astype(np.float32))
+
+    @jax.jit
+    def p_pool_fused(vals_j, segs_stack):
+        def body(i, acc):
+            out = fused_pool_cvm_forward(vals_j * (1.0 + acc * 1e-9),
+                                         segs_stack[i], None, b, s)
+            return acc + out[0, 0, 0] + out[-1, -1, -1]
+        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+    def _xla_fwd(v, segs):
+        with flags_scope(use_pallas_seqpool=False):
+            return fused_seqpool_cvm(v, segs, sc, b, s)
+
+    @jax.jit
+    def p_pool_xla(vals_j, segs_stack):
+        def body(i, acc):
+            out = _xla_fwd(vals_j * (1.0 + acc * 1e-9), segs_stack[i])
+            return acc + out[0, 0, 0] + out[-1, -1, -1]
+        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+    probe("pool_cvm", p_pool_fused, vals_j, segs_stack)
+    probe("pool_cvm_xla", p_pool_xla, vals_j, segs_stack)
+
+    # ---- full fused fwd+bwd (the train-step shape: pooled loss grad
+    # feeding the push path) vs the XLA composition ----
+    def make_fwd_bwd(flag):
+        def step(v, segs):
+            def loss(v):
+                out = fused_seqpool_cvm(v, segs, sc, b, s)
+                return jnp.sum(out * out)
+            return jax.grad(loss)(v)
+
+        @jax.jit
+        def run(vals_j, segs_stack):
+            def body(i, acc):
+                with flags_scope(use_pallas_seqpool=flag):
+                    g = step(vals_j * (1.0 + acc * 1e-9), segs_stack[i])
+                return acc + g[0, 0] + g[-1, -1]
+            return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+        return run
+
+    probe("fused", make_fwd_bwd(True), vals_j, segs_stack)
+    probe("fused_xla", make_fwd_bwd(False), vals_j, segs_stack)
+
+    if record and rows_out:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import perf_gate
+        path = os.environ.get("BENCH_TRAJECTORY") \
+            or perf_gate.default_trajectory_path()
+        for row in rows_out:
+            perf_gate.append_row(row, path)
+            # echo the row as a bench line so a captured stdout artifact
+            # (KERNELS_r0*.json) re-folds via perf_gate --fold
+            print(json.dumps(row), flush=True)
+        print(json.dumps({"probe": "recorded", "rows": len(rows_out),
+                          "path": path}), flush=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="device key-path cost probes")
     ap.add_argument("--set", dest="probe_set", default="1",
-                    choices=("1", "2", "3", "all"),
+                    choices=("1", "2", "3", "all", "kernels"),
                     help="probe set to run (default 1)")
     ap.add_argument("--shape",
                     default=os.environ.get("PROF_SHAPE", "ragged"),
-                    choices=("ragged", "uniform", "thousand"),
-                    help="workload shape for set 1")
+                    choices=("ragged", "uniform", "thousand", "zipf"),
+                    help="workload shape for sets 1/kernels")
     ap.add_argument("--iters", type=int,
                     default=int(os.environ.get("PROF_ITERS", 16)),
                     help="fori_loop iterations per probe")
+    ap.add_argument("--record", action="store_true",
+                    help="(kernels set) append kernel.* rows to the "
+                    "perf_gate trajectory (BENCH_TRAJECTORY overrides "
+                    "the path)")
     args = ap.parse_args(argv)
+    if args.probe_set == "kernels":
+        shape = args.shape if args.shape != "thousand" else "ragged"
+        print(json.dumps({"probe": "set", "set": "kernels"}), flush=True)
+        run_set_kernels(shape, args.iters, record=args.record)
+        print(json.dumps({"probe": "done"}), flush=True)
+        return 0
+    if args.shape == "zipf":
+        # shape_dims() has no zipf branch — sets 1-3 would silently run
+        # the uniform workload while claiming the heavy-tailed one
+        ap.error("--shape zipf is only valid with --set kernels")
     sets = ("1", "2", "3") if args.probe_set == "all" \
         else (args.probe_set,)
     for ps in sets:

@@ -1,13 +1,20 @@
 """Pallas kernel correctness vs XLA references (interpret mode on CPU)."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
+REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                         os.pardir))
+
 from paddlebox_tpu.config import flags_scope
 from paddlebox_tpu.ops.pallas_kernels import (
-    gather_rows, scatter_rows, segment_sum_mxu,
+    CVM_CONV, CVM_FULL, CVM_NONE, CVM_SHOW, fused_embed_pool_cvm,
+    fused_pool_cvm_forward, gather_rows, scatter_rows, segment_gather_mxu,
+    segment_sum_mxu,
 )
 
 
@@ -157,6 +164,275 @@ def test_table_pull_push_with_pallas_flags():
     v1, p1 = run(use_pallas_gather=True)
     np.testing.assert_allclose(v0, v1, rtol=1e-6)
     np.testing.assert_allclose(p0, p1, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# segment_gather_mxu (transposed one-hot backward kernel — ISSUE 12)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n,k", [(40, 300), (12, 50), (200, 700), (5, 4)])
+def test_segment_gather_mxu_matches_take(n, k):
+    rng = np.random.default_rng(8)
+    src = rng.normal(size=(n, 9)).astype(np.float32)
+    ids = np.sort(rng.integers(0, n, size=k)).astype(np.int32)
+    got = np.asarray(segment_gather_mxu(jnp.asarray(src),
+                                        jnp.asarray(ids)))
+    np.testing.assert_array_equal(got, src[ids])  # bitwise — a gather
+
+
+def test_segment_gather_mxu_drops_and_oob_zero():
+    rng = np.random.default_rng(9)
+    src = rng.normal(size=(16, 5)).astype(np.float32)
+    ids = np.sort(np.concatenate(
+        [rng.integers(0, 16, size=20), [16, 40, 1000]])).astype(np.int32)
+    ids[0] = -1  # drop marker anywhere
+    got = np.asarray(segment_gather_mxu(jnp.asarray(src),
+                                        jnp.asarray(ids)))
+    ok = (ids >= 0) & (ids < 16)
+    want = np.where(ok[:, None], src[np.clip(ids, 0, 15)], 0.0)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_segment_gather_mxu_under_jit_and_empty():
+    src = jnp.ones((8, 3), jnp.float32)
+    ids = jnp.full((12,), -1, jnp.int32)  # all drops
+    got = jax.jit(segment_gather_mxu)(src, ids)
+    np.testing.assert_array_equal(np.asarray(got), np.zeros((12, 3)))
+
+
+# ---------------------------------------------------------------------------
+# fused_embed_pool_cvm (pool + CVM in one Pallas pass — the tentpole)
+# ---------------------------------------------------------------------------
+
+def _fused_case(k=700, B=5, S=3, mf=6, seed=0, zipf=False, pads=30):
+    from paddlebox_tpu.ops import fused_seqpool_cvm
+    rng = np.random.default_rng(seed)
+    d = 2 + mf
+    vals = rng.normal(size=(k, d)).astype(np.float32)
+    vals[:, :2] = np.abs(vals[:, :2])  # show/clk columns nonnegative
+    if zipf:
+        lens = np.minimum(rng.zipf(1.5, size=B * S), 24)
+        ids = np.repeat(np.arange(B * S, dtype=np.int32), lens)[:k - pads]
+        segs = np.full(k, B * S, np.int32)
+        segs[:len(ids)] = ids
+    else:
+        segs = np.sort(rng.integers(0, B * S, size=k)).astype(np.int32)
+        if pads:
+            segs[-pads:] = B * S  # partial-batch tail padding
+    sc = np.abs(rng.normal(size=(B, 2))).astype(np.float32)
+    return (jnp.asarray(vals), jnp.asarray(segs), jnp.asarray(sc),
+            fused_seqpool_cvm)
+
+
+@pytest.mark.parametrize("zipf", [False, True])
+@pytest.mark.parametrize("use_cvm,need_filter,pad_value", [
+    (True, False, 0.0), (True, True, 0.0), (False, False, 0.0),
+    (True, False, 0.25), (False, True, 0.5),
+])
+def test_fused_embed_pool_cvm_matches_composition(use_cvm, need_filter,
+                                                  pad_value, zipf):
+    B, S = 5, 3
+    vals, segs, sc, composition = _fused_case(zipf=zipf)
+    ref = composition(vals, segs, sc, B, S, use_cvm, 2, pad_value,
+                      need_filter, 0.2, 1.0, 0.96, 0)
+    got = fused_embed_pool_cvm(vals, segs, sc, B, S, use_cvm, 2,
+                               pad_value, need_filter, 0.2, 1.0, 0.96)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=3e-5, atol=3e-5)
+
+
+def test_fused_embed_pool_cvm_empty_segments():
+    # every key is padding → CVM of an all-zero pool (the PaddingZeros
+    # contract) — and no uninitialized output block may leak through
+    B, S = 3, 4
+    vals = jnp.ones((64, 6), jnp.float32)
+    segs = jnp.full((64,), B * S, jnp.int32)
+    sc = jnp.ones((B, 2), jnp.float32)
+    got = np.asarray(fused_embed_pool_cvm(vals, segs, sc, B, S))
+    np.testing.assert_allclose(got, np.zeros((B, S, 6)), atol=1e-7)
+
+
+@pytest.mark.parametrize("use_cvm,need_filter", [
+    (True, False), (True, True), (False, False)])
+def test_fused_embed_pool_cvm_grads_bitwise(use_cvm, need_filter):
+    """custom_vjp grads vs jax.grad of the XLA composition: the
+    transposed one-hot backward is bitwise a gather, so given the same
+    upstream cotangent the pushed grads match EXACTLY."""
+    B, S = 5, 3
+    vals, segs, sc, composition = _fused_case(seed=4, zipf=True)
+    rng = np.random.default_rng(5)
+    out_shape = np.asarray(composition(
+        vals, segs, sc, B, S, use_cvm, 2, 0.0, need_filter,
+        0.2, 1.0, 0.96, 0)).shape
+    w = jnp.asarray(rng.normal(size=out_shape).astype(np.float32))
+
+    def f_ref(v):
+        return jnp.sum(composition(v, segs, sc, B, S, use_cvm, 2, 0.0,
+                                   need_filter, 0.2, 1.0, 0.96, 0) * w)
+
+    def f_new(v):
+        return jnp.sum(fused_embed_pool_cvm(
+            v, segs, sc, B, S, use_cvm, 2, 0.0, need_filter,
+            0.2, 1.0, 0.96) * w)
+
+    g_ref = np.asarray(jax.grad(f_ref)(vals))
+    g_new = np.asarray(jax.grad(f_new)(vals))
+    np.testing.assert_array_equal(g_new, g_ref)
+
+
+def test_fused_embed_pool_cvm_wide_cvm_offset_grads():
+    """cvm_offset > 2 with use_cvm: the output head is still the TWO
+    transformed columns, so the backward must slice at 2 (not at
+    cvm_offset) — regression for the head-width crash."""
+    B, S, K, d, co = 2, 2, 40, 6, 3
+    rng = np.random.default_rng(11)
+    vals = jnp.asarray(np.abs(rng.normal(size=(K, d))).astype(np.float32))
+    segs = jnp.asarray(np.sort(rng.integers(0, B * S, size=K))
+                       .astype(np.int32))
+    sc = jnp.asarray(np.abs(rng.normal(size=(B, co))).astype(np.float32))
+    out = fused_embed_pool_cvm(vals, segs, sc, B, S, True, co)
+    assert out.shape == (B, S, 2 + d - co)
+    g = np.asarray(jax.grad(
+        lambda v: jnp.sum(fused_embed_pool_cvm(v, segs, sc, B, S, True,
+                                               co)))(vals))
+    assert g.shape == (K, d)
+    ins = np.minimum(np.asarray(segs) // S, B - 1)
+    np.testing.assert_allclose(g[:, :co], np.asarray(sc)[ins])  # head
+    np.testing.assert_allclose(g[:, co:], 1.0)                  # embedx
+
+
+def test_fused_pool_cvm_forward_modes():
+    """Raw forward head modes against hand-built references."""
+    rng = np.random.default_rng(6)
+    B, S, d = 2, 2, 7
+    k = 40
+    vals = np.abs(rng.normal(size=(k, d))).astype(np.float32)
+    segs = np.sort(rng.integers(0, B * S, size=k)).astype(np.int32)
+    pooled = np.zeros((B * S, d), np.float32)
+    np.add.at(pooled, segs, vals)
+    pooled = pooled.reshape(B, S, d)
+    j = lambda x: jnp.asarray(x)
+    # CVM_SHOW (clk_filter): [log1p(show), embedx…]
+    got = np.asarray(fused_pool_cvm_forward(
+        j(vals), j(segs), None, B, S, cvm_mode=CVM_SHOW, cvm_offset=2))
+    want = np.concatenate([np.log1p(pooled[..., :1]), pooled[..., 2:]],
+                          axis=-1)
+    np.testing.assert_allclose(got, want, rtol=3e-5, atol=3e-5)
+    # CVM_CONV: [log1p(show), log1p(clk), log1p(conv)-log1p(clk), …]
+    got = np.asarray(fused_pool_cvm_forward(
+        j(vals), j(segs), None, B, S, cvm_mode=CVM_CONV, cvm_offset=3))
+    want = np.concatenate(
+        [np.log1p(pooled[..., 0:1]), np.log1p(pooled[..., 1:2]),
+         np.log1p(pooled[..., 2:3]) - np.log1p(pooled[..., 1:2]),
+         pooled[..., 3:]], axis=-1)
+    np.testing.assert_allclose(got, want, rtol=3e-5, atol=3e-5)
+    # CVM_NONE + ets: width cut only
+    got = np.asarray(fused_pool_cvm_forward(
+        j(vals), j(segs), None, B, S, cvm_mode=CVM_NONE, cvm_offset=2,
+        ets=1))
+    np.testing.assert_allclose(got, pooled[..., 3:], rtol=3e-5, atol=3e-5)
+    assert CVM_FULL == 1
+
+
+def test_fused_pool_cvm_keep_mask_folds_into_matmul():
+    B, S, k, d = 2, 2, 24, 5
+    rng = np.random.default_rng(7)
+    vals = np.abs(rng.normal(size=(k, d))).astype(np.float32)
+    segs = np.sort(rng.integers(0, B * S, size=k)).astype(np.int32)
+    keep = (rng.random(k) < 0.5).astype(np.float32)
+    got = np.asarray(fused_pool_cvm_forward(
+        jnp.asarray(vals), jnp.asarray(segs), jnp.asarray(keep), B, S,
+        cvm_mode=CVM_NONE, cvm_offset=0))
+    pooled = np.zeros((B * S, d), np.float32)
+    np.add.at(pooled, segs, vals * keep[:, None])
+    np.testing.assert_allclose(got, pooled.reshape(B, S, d),
+                               rtol=3e-5, atol=3e-5)
+
+
+# ---------------------------------------------------------------------------
+# satellites: dead-flag regression + DMA demotion (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+def test_use_pallas_flags_referenced_outside_config():
+    """Every use_pallas_* flag must be READ somewhere outside config.py
+    — a defined-but-never-consumed dispatch flag is a silent no-op
+    (the ISSUE 12 dead-flag class)."""
+    import dataclasses
+    import pathlib
+    import re
+
+    import paddlebox_tpu
+    from paddlebox_tpu.config import Flags
+    names = [f.name for f in dataclasses.fields(Flags)
+             if f.name.startswith("use_pallas_")]
+    assert names, "expected at least one use_pallas_* flag"
+    pkg = pathlib.Path(paddlebox_tpu.__file__).parent
+    text = "\n".join(p.read_text() for p in sorted(pkg.rglob("*.py"))
+                     if p.name != "config.py")
+    for n in names:
+        assert re.search(rf"FLAGS\.{n}\b", text), \
+            f"flag use_pallas flag {n!r} is never read outside config.py"
+
+
+def test_dma_reference_paths_refuse_real_tpu(monkeypatch):
+    """gather_rows_dma / scatter_rows_dma are demoted to interpret-only
+    reference code: on a real TPU backend they must raise, not run the
+    measured-1000x-off per-row DMA loop."""
+    import paddlebox_tpu.ops.pallas_kernels as pk
+    monkeypatch.setattr(pk, "_interpret", lambda: False)
+    t = jnp.zeros((65, 16), jnp.float32)
+    rows = jnp.zeros((32,), jnp.int32)
+    vals = jnp.zeros((32, 16), jnp.float32)
+    with pytest.raises(RuntimeError, match="interpret-mode reference"):
+        pk.gather_rows_dma(t, rows)
+    with pytest.raises(RuntimeError, match="interpret-mode reference"):
+        pk.scatter_rows_dma(t, rows, vals)
+
+
+def test_kernel_dispatch_counter_books():
+    from paddlebox_tpu.obs import MemorySink
+    from paddlebox_tpu.obs.hub import get_hub, reset_hub
+    reset_hub()
+    hub = get_hub()
+    hub.add_sink(MemorySink())
+    try:
+        vals = jnp.ones((8, 4), jnp.float32)
+        segs = jnp.zeros((8,), jnp.int32)
+        sc = jnp.ones((1, 2), jnp.float32)
+        from paddlebox_tpu.ops import fused_seqpool_cvm
+        with flags_scope(use_pallas_seqpool=True):
+            fused_seqpool_cvm(vals, segs, sc, 1, 1)
+        c = hub.counter("pbox_kernel_dispatch_total")
+        assert c.value(kernel="fused_embed_pool_cvm", impl="pallas") >= 1
+        with flags_scope(use_pallas_seqpool=False):
+            fused_seqpool_cvm(vals, segs, sc, 1, 1)
+        assert c.value(kernel="fused_embed_pool_cvm", impl="xla") >= 1
+    finally:
+        reset_hub()
+
+
+def test_kernel_microbench_smoke(tmp_path, monkeypatch):
+    """scripts/profile_keypath.py --set kernels: rows emit, record to a
+    trajectory, and perf_gate --check passes over them."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "profile_keypath", os.path.join(REPO_ROOT, "scripts",
+                                        "profile_keypath.py"))
+    pk = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pk)
+    traj = tmp_path / "traj.json"
+    monkeypatch.setenv("BENCH_TRAJECTORY", str(traj))
+    pk.run_set_kernels("zipf", 1, record=True)
+    import json
+    data = json.loads(traj.read_text())
+    metrics = {r["metric"] for r in data["rows"]}
+    assert any(m.startswith("kernel.pool_cvm.zipf") for m in metrics)
+    assert any(m.startswith("kernel.fused.zipf") for m in metrics)
+    spec2 = importlib.util.spec_from_file_location(
+        "perf_gate", os.path.join(REPO_ROOT, "scripts", "perf_gate.py"))
+    pg = importlib.util.module_from_spec(spec2)
+    spec2.loader.exec_module(pg)
+    assert pg.check(str(traj), ignore_live=True) == 0
 
 
 @pytest.mark.skipif(

@@ -1,0 +1,179 @@
+"""Tier-1 bit-closeness gates for the Pallas kernel dispatch (ISSUE 12).
+
+A seeded resident train job with ``use_pallas_seqpool=True`` (interpret
+mode on this CPU mesh) must reproduce the default XLA composition's
+logical state:
+
+- UNIFORM (trivial one-key-per-slot layout): the pool is a reshape on
+  both paths, so the ``state_digest`` must match EXACTLY — and this also
+  pins the inverse guarantee that default flags keep today's program.
+- ZIPF/ragged (real segment streams): the MXU one-hot pooling sums in a
+  different order than XLA's scatter-add, so the gate is numeric — table
+  rows (pushed grads applied in-table) and dense params within the
+  documented f32 tolerance (docs/PERFORMANCE.md §Device kernels:
+  rtol 2e-4 against per-step ~1e-6 drift compounding over two passes).
+- ``use_pallas_gather=True`` (the table.py line-gather): gather_rows
+  returns the identical lines bitwise, so the digest must match EXACTLY.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from paddlebox_tpu.config import flags_scope
+from paddlebox_tpu.data import DataFeedDesc, DatasetFactory, SlotDef
+from paddlebox_tpu.data.criteo import generate_criteo_files
+from paddlebox_tpu.models import DeepFM
+from paddlebox_tpu.ps import EmbeddingTable, SparseSGDConfig
+from paddlebox_tpu.train import Trainer
+from paddlebox_tpu.train.checkpoint import state_digest
+
+REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                         os.pardir))
+
+
+@pytest.fixture(scope="module")
+def criteo_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("criteo_pallas_gate")
+    return generate_criteo_files(str(d), num_files=1, rows_per_file=600,
+                                 vocab_per_slot=40, seed=21)
+
+
+def _trainer_uniform(files, bs=200):
+    desc = DataFeedDesc.criteo(batch_size=bs)
+    desc.key_bucket_min = 512
+    ds = DatasetFactory().create_dataset("InMemoryDataset", desc)
+    ds.set_filelist(files)
+    ds.set_thread(1)
+    ds.load_into_memory()
+    cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=0.0,
+                          learning_rate=0.05, mf_learning_rate=0.05)
+    table = EmbeddingTable(mf_dim=4, capacity=1 << 12, cfg=cfg,
+                           unique_bucket_min=512)
+    tr = Trainer(DeepFM(hidden=(16, 8)), table, desc, tx=optax.adam(1e-2),
+                 seed=3)
+    return tr, ds
+
+
+def _ragged_records(n=400, num_slots=4, seed=0):
+    """Zipf-ragged multi-key slots — the non-trivial segment stream that
+    actually exercises the fused pooling kernel."""
+    from paddlebox_tpu.data.record import SlotRecord
+    rng = np.random.default_rng(seed)
+    recs = []
+    for i in range(n):
+        counts = np.minimum(rng.zipf(1.5, size=num_slots), 8)
+        counts[rng.integers(0, num_slots)] = max(
+            1, counts[rng.integers(0, num_slots)])
+        offs = np.zeros(num_slots + 1, np.int32)
+        np.cumsum(counts, out=offs[1:])
+        keys = rng.integers(0, 3000, size=int(offs[-1])).astype(np.uint64)
+        recs.append(SlotRecord(
+            keys=keys, slot_offsets=offs,
+            dense=rng.normal(size=3).astype(np.float32),
+            label=float(i % 2), show=1.0, clk=float(i % 2)))
+    return recs
+
+
+def _trainer_ragged(bs=64, seed=0):
+    from paddlebox_tpu.data import InMemoryDataset
+    slots = [SlotDef("label", "float", 1), SlotDef("d", "float", 3)]
+    slots += [SlotDef(f"S{i}", "uint64") for i in range(4)]
+    desc = DataFeedDesc(slots=slots, label_slot="label", batch_size=bs,
+                        key_bucket_min=512)
+    ds = InMemoryDataset(desc)
+    ds.records = _ragged_records(seed=seed)
+    cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=0.0,
+                          learning_rate=0.05, mf_learning_rate=0.05)
+    table = EmbeddingTable(mf_dim=4, capacity=1 << 12, cfg=cfg,
+                           unique_bucket_min=512)
+    tr = Trainer(DeepFM(hidden=(16, 8)), table, desc, tx=optax.adam(1e-2),
+                 seed=3)
+    return tr, ds
+
+
+def _logical_state(tr):
+    """(sorted keys, host row blob, param leaves) — the numeric form of
+    state_digest, comparable with a tolerance."""
+    tr.sync_table()
+    with tr.table.host_lock:
+        keys, rows = tr.table.index.items()
+    order = np.argsort(keys)
+    blob = tr.table._gather_host(rows[order])
+    leaves = [np.asarray(l) for l in jax.tree.leaves(
+        jax.device_get(tr.state.params))]
+    return keys[order], blob, leaves
+
+
+def test_uniform_trivial_layout_digest_exact(criteo_files):
+    """Trivial layout: the flag leaves the reshape fast path alone —
+    the whole seeded train job is byte-for-byte identical."""
+    with flags_scope(use_pallas_seqpool=False):
+        tr0, ds = _trainer_uniform(criteo_files)
+        tr0.train_pass(ds)
+        d0 = state_digest(tr0)
+    with flags_scope(use_pallas_seqpool=True):
+        tr1, ds = _trainer_uniform(criteo_files)
+        tr1.train_pass(ds)
+        d1 = state_digest(tr1)
+    assert d0 == d1
+
+
+def test_pallas_gather_digest_exact(criteo_files):
+    """use_pallas_gather=True (the already-wired table.py line-gather):
+    gather_rows is bitwise a gather, so the digest matches exactly."""
+    with flags_scope(use_pallas_gather=False):
+        tr0, ds = _trainer_uniform(criteo_files)
+        tr0.train_pass(ds)
+        d0 = state_digest(tr0)
+    with flags_scope(use_pallas_gather=True):
+        tr1, ds = _trainer_uniform(criteo_files)
+        tr1.train_pass(ds)
+        d1 = state_digest(tr1)
+    assert d0 == d1
+
+
+def test_zipf_ragged_state_close(criteo_files):
+    """Zipf-ragged resident train, two passes: fused Pallas pooling vs
+    the XLA composition — same keys, table rows and dense params within
+    the documented f32 tolerance (forward pooled outputs and the pushed
+    grads both ride this: the table rows ARE the accumulated pushes)."""
+    def run(flag):
+        with flags_scope(use_pallas_seqpool=flag):
+            tr, ds = _trainer_ragged()
+            tr.train_pass(ds)
+            tr.train_pass(ds)
+            return _logical_state(tr)
+
+    k0, b0, p0 = run(False)
+    k1, b1, p1 = run(True)
+    np.testing.assert_array_equal(k0, k1)
+    for f in sorted(b0):
+        np.testing.assert_allclose(
+            b1[f], b0[f], rtol=2e-4, atol=2e-5,
+            err_msg=f"table field {f} diverged beyond f32 tolerance")
+    for a, b in zip(p0, p1):
+        np.testing.assert_allclose(b, a, rtol=2e-4, atol=2e-5)
+
+
+def test_committed_kernel_trajectory_gates():
+    """The interpret-mode CPU kernel round is recorded (satellite:
+    kernel.* rows live in BENCH_trajectory.json) and the perf gate
+    passes over it."""
+    import importlib.util
+    path = os.path.join(REPO_ROOT, "BENCH_trajectory.json")
+    with open(path) as fh:
+        data = json.load(fh)
+    metrics = {r["metric"] for r in data["rows"]}
+    for probe in ("gather", "pool_cvm", "fused"):
+        assert any(m.startswith(f"kernel.{probe}.") and m.endswith(".cpu")
+                   for m in metrics), f"no recorded kernel.{probe}.* row"
+    spec = importlib.util.spec_from_file_location(
+        "perf_gate", os.path.join(REPO_ROOT, "scripts", "perf_gate.py"))
+    pg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pg)
+    assert pg.check(path, ignore_live=True) == 0

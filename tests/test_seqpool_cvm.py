@@ -282,6 +282,194 @@ def test_seqpool_concate_backward_contract():
     np.testing.assert_allclose(g[nk:], 0.0)
 
 
+# ---------------------------------------------------------------------------
+# Pallas dispatch-seam parity (ISSUE 12): with use_pallas_seqpool=True
+# every variant must reproduce the XLA composition — forward within f32
+# tolerance (different summation order on the MXU matmul), grads
+# BITWISE (the transposed one-hot backward is exactly a gather).
+# ---------------------------------------------------------------------------
+
+def _parity_case(kind, B=4, S=3, D=6, seed=0):
+    rng = np.random.default_rng(seed)
+    if kind == "zipf":
+        lens = np.minimum(rng.zipf(1.5, size=B * S), 16)
+    elif kind == "empty":
+        lens = np.zeros(B * S, np.int64)
+    elif kind == "partial":
+        lens = np.ones(B * S, np.int64)
+        lens[-S:] = 0  # last instance empty (partial final batch)
+    else:  # "uniform" ragged-lite
+        lens = rng.integers(0, 4, size=B * S)
+    K = int(lens.sum())
+    cap = max(8, 1 << max(3, (max(K, 1) - 1).bit_length()))
+    values = np.zeros((cap, D), np.float32)
+    segments = np.full(cap, B * S, np.int32)
+    if K:
+        values[:K] = rng.uniform(0, 2, size=(K, D))
+        segments[:K] = np.repeat(np.arange(B * S, dtype=np.int32), lens)
+    sc = np.abs(rng.normal(size=(B, 2))).astype(np.float32) + 0.5
+    return values, segments, sc
+
+
+@pytest.mark.parametrize("kind", ["uniform", "zipf", "empty", "partial"])
+@pytest.mark.parametrize("use_cvm,need_filter,pad_value,clk_filter", [
+    (True, False, 0.0, False),
+    (True, True, 0.0, False),
+    (False, False, 0.0, False),
+    (True, False, 0.7, False),
+    (False, True, 0.3, False),
+    (True, False, 0.0, True),      # clk_filter head
+])
+def test_seqpool_pallas_flag_parity(kind, use_cvm, need_filter, pad_value,
+                                    clk_filter):
+    from paddlebox_tpu.config import flags_scope
+    B, S, D = 4, 3, 6
+    values, segments, sc = _parity_case(kind)
+
+    def fwd(v):
+        return fused_seqpool_cvm(
+            v, jnp.asarray(segments), jnp.asarray(sc), B, S, use_cvm, 2,
+            pad_value, need_filter, 0.2, 1.0, 0.96, 0, clk_filter)
+
+    def loss(v):
+        out = fwd(v)
+        return jnp.sum(out * jnp.arange(out.size).reshape(out.shape))
+
+    with flags_scope(use_pallas_seqpool=False):
+        o0 = np.asarray(fwd(jnp.asarray(values)))
+        g0 = np.asarray(jax.grad(loss)(jnp.asarray(values)))
+    with flags_scope(use_pallas_seqpool=True):
+        o1 = np.asarray(fwd(jnp.asarray(values)))
+        g1 = np.asarray(jax.grad(loss)(jnp.asarray(values)))
+    assert o0.shape == o1.shape
+    np.testing.assert_allclose(o1, o0, rtol=3e-5, atol=3e-5)
+    np.testing.assert_array_equal(g1, g0)
+
+
+def test_seqpool_pallas_flag_parity_trivial_and_key_valid():
+    """Trivial layout (segments=None) under the flag: the reshape fast
+    path stays (nothing to fuse) and key_valid pad masking holds —
+    forward AND grads byte-for-byte the default path."""
+    from paddlebox_tpu.config import flags_scope
+    B, S, D = 2, 2, 4
+    k_pad = 8
+    values = np.random.default_rng(0).uniform(
+        0, 1, size=(k_pad, D)).astype(np.float32)
+    sc = np.ones((B, 2), np.float32)
+    kv = np.zeros(k_pad, np.float32)
+    kv[:3] = 1.0
+
+    def loss(v):
+        out = fused_seqpool_cvm(
+            v, None, jnp.asarray(sc), B, S, True, 2, 0.0,
+            False, 0.2, 1.0, 0.96, 0, False, False, 0.0, 0, 1, False,
+            jnp.asarray(kv))
+        return jnp.sum(out)
+
+    with flags_scope(use_pallas_seqpool=False):
+        o0 = np.asarray(fused_seqpool_cvm(
+            jnp.asarray(values), None, jnp.asarray(sc), B, S,
+            key_valid=jnp.asarray(kv)))
+        g0 = np.asarray(jax.grad(loss)(jnp.asarray(values)))
+    with flags_scope(use_pallas_seqpool=True):
+        o1 = np.asarray(fused_seqpool_cvm(
+            jnp.asarray(values), None, jnp.asarray(sc), B, S,
+            key_valid=jnp.asarray(kv)))
+        g1 = np.asarray(jax.grad(loss)(jnp.asarray(values)))
+    np.testing.assert_array_equal(o1, o0)
+    np.testing.assert_array_equal(g1, g0)
+    np.testing.assert_allclose(g1[3:], 0.0)
+
+
+def test_seqpool_pallas_flag_parity_concate():
+    """kk>1 (embedx concate) under the flag: the −1 drop-marker remap
+    keeps the MXU pair grid's nondecreasing contract while matching the
+    historical n2-discard-bin composition exactly in value."""
+    from paddlebox_tpu.config import flags_scope
+    B, S, D, kk = 3, 2, 5, 2
+    values, segments, lens = make_batch(B, S, D, max_len=4, seed=13)
+    sc = np.abs(np.random.default_rng(1).normal(
+        size=(B, 2))).astype(np.float32)
+
+    def fwd(v):
+        return fused_seqpool_cvm(
+            v, jnp.asarray(segments), jnp.asarray(sc), B, S, True, 2,
+            0.0, False, 0.2, 1.0, 0.96, 0, True, False, 0.0, 0, kk, False)
+
+    def loss(v):
+        out = fwd(v)
+        return jnp.sum(out * jnp.arange(out.size).reshape(out.shape))
+
+    with flags_scope(use_pallas_seqpool=False):
+        o0, g0 = np.asarray(fwd(jnp.asarray(values))), \
+            np.asarray(jax.grad(loss)(jnp.asarray(values)))
+    with flags_scope(use_pallas_seqpool=True):
+        o1, g1 = np.asarray(fwd(jnp.asarray(values))), \
+            np.asarray(jax.grad(loss)(jnp.asarray(values)))
+    np.testing.assert_allclose(o1, o0, rtol=3e-5, atol=3e-5)
+    np.testing.assert_array_equal(g1, g0)
+
+
+@pytest.mark.parametrize("use_cvm,show_filter", [
+    (True, False), (True, True), (False, False)])
+def test_seqpool_conv_pallas_flag_parity(use_cvm, show_filter):
+    from paddlebox_tpu.config import flags_scope
+    from paddlebox_tpu.ops import fused_seqpool_cvm_with_conv
+    B, S, D = 3, 2, 7
+    rng = np.random.default_rng(3)
+    values, segments, sc2 = _parity_case("zipf", B, S, D, seed=3)
+    sc = np.abs(rng.normal(size=(B, 3))).astype(np.float32) + 0.5
+
+    def fwd(v):
+        return fused_seqpool_cvm_with_conv(
+            v, jnp.asarray(segments), jnp.asarray(sc), B, S, use_cvm,
+            show_filter, 0.0, True, 0.2, 1.0, 0.5)
+
+    def loss(v):
+        out = fwd(v)
+        return jnp.sum(out * jnp.arange(out.size).reshape(out.shape))
+
+    with flags_scope(use_pallas_seqpool=False):
+        o0, g0 = np.asarray(fwd(jnp.asarray(values))), \
+            np.asarray(jax.grad(loss)(jnp.asarray(values)))
+    with flags_scope(use_pallas_seqpool=True):
+        o1, g1 = np.asarray(fwd(jnp.asarray(values))), \
+            np.asarray(jax.grad(loss)(jnp.asarray(values)))
+    assert o0.shape == o1.shape
+    np.testing.assert_allclose(o1, o0, rtol=3e-5, atol=3e-5)
+    np.testing.assert_array_equal(g1, g0)
+
+
+def test_seqpool_wide_cvm_offset_backward():
+    """use_cvm with cvm_offset > 2: the output head is still the TWO
+    transformed CVM columns, so the backward slices at 2 — regression
+    for the head-width crash (both flag states)."""
+    from paddlebox_tpu.config import flags_scope
+    B, S, D, co = 2, 2, 6, 3
+    values, segments, sc2 = _parity_case("uniform", B, S, D, seed=17)
+    sc = np.abs(np.random.default_rng(17).normal(
+        size=(B, co))).astype(np.float32)
+
+    def loss(v):
+        return jnp.sum(fused_seqpool_cvm(
+            v, jnp.asarray(segments), jnp.asarray(sc), B, S, True, co))
+
+    out = fused_seqpool_cvm(jnp.asarray(values), jnp.asarray(segments),
+                            jnp.asarray(sc), B, S, True, co)
+    assert out.shape == (B, S, 2 + D - co)
+    with flags_scope(use_pallas_seqpool=False):
+        g0 = np.asarray(jax.grad(loss)(jnp.asarray(values)))
+    with flags_scope(use_pallas_seqpool=True):
+        g1 = np.asarray(jax.grad(loss)(jnp.asarray(values)))
+    assert g0.shape == (values.shape[0], D)
+    np.testing.assert_array_equal(g1, g0)
+    # real keys: head carries batch show/clk, embedx the upstream ones
+    nk = int((segments < B * S).sum())
+    ins = np.minimum(segments[:nk] // S, B - 1)
+    np.testing.assert_allclose(g0[:nk, :co], sc[ins])
+    np.testing.assert_allclose(g0[:nk, co:], 1.0)
+
+
 def test_seqpool_trivial_backward_masks_pads_with_key_valid():
     """ADVICE fix: the trivial (segments=None) backward must mask batch
     padding locally when key_valid is given, instead of relying on the
