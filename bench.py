@@ -541,6 +541,172 @@ def measure_multichip(shape: str = "uniform") -> None:
                 "vs_baseline": None, **common})
 
 
+def build_pv_records(n_pvs: int, num_slots: int, vocab_per_slot: int,
+                     dense_dim: int, seed: int = 0):
+    """Synthetic search pages for the PV rank-attention lane: 2-4 ads
+    per PV with shuffled 1-based ranks and valid cmatch, so every batch
+    carries a dense rank_offset matrix (data/pv.build_rank_offset)."""
+    from paddlebox_tpu.data.record import SlotRecord
+    rng = np.random.default_rng(seed)
+    recs = []
+    for sid in range(n_pvs):
+        n_ads = int(rng.integers(2, 5))
+        ranks = rng.permutation(n_ads) + 1
+        for a in range(n_ads):
+            keys = (rng.integers(0, vocab_per_slot, num_slots)
+                    + np.arange(num_slots) * vocab_per_slot).astype(
+                        np.uint64)
+            label = float(rng.random() < 0.25)
+            recs.append(SlotRecord(
+                keys=keys,
+                slot_offsets=np.arange(num_slots + 1, dtype=np.int32),
+                dense=rng.normal(size=dense_dim).astype(np.float32),
+                label=label, show=1.0, clk=label, search_id=sid,
+                rank=int(ranks[a]), cmatch=222))
+    return recs
+
+
+def measure_pv(num_passes: int = 3) -> list:
+    """BENCH_MODE=pv (ISSUE 13 / ROADMAP item 5): the PV-batch
+    rank-attention scenario — PvBatchBuilder batches (PV merge +
+    rank_offset) through an AdsRank net with ALL THREE device-side CTR
+    ops on its path (rank_attention, the slot_fc batch_fc tower, the
+    cross_norm hadamard block) over the sparse PS pull→train→push
+    loop. Emits one row per implementation:
+
+        adsrank_pv_examples_per_sec_per_chip           (XLA, default)
+        adsrank_pv_examples_per_sec_per_chip_pallas    (fused kernels)
+
+    keyed separately so perf_gate compares each impl against its OWN
+    history (interpret-mode CPU rows key apart from real-TPU rows the
+    same way the kernel.* microbench rows do — via recorded rounds).
+    BENCH_PV_IMPLS=xla|pallas|both selects; sizes scale down off-TPU."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from paddlebox_tpu.config import flags_scope
+    from paddlebox_tpu.data import DataFeedDesc, SlotDef
+    from paddlebox_tpu.data.pv import PvBatchBuilder
+    from paddlebox_tpu.models import AdsRank
+    from paddlebox_tpu.ops import (fused_seqpool_cvm,
+                                   init_cross_norm_summary)
+    from paddlebox_tpu.ps import EmbeddingTable, SparseSGDConfig
+
+    on_tpu = jax.default_backend() == "tpu"
+    n_pvs = int(os.environ.get("BENCH_PV_PVS",
+                               "8192" if on_tpu else "512"))
+    bs = int(os.environ.get("BENCH_BATCH_SIZE",
+                            "4096" if on_tpu else "256"))
+    s = int(os.environ.get("BENCH_PV_SLOTS", "8"))
+    d_model = int(os.environ.get("BENCH_PV_DMODEL",
+                                 "128" if on_tpu else "32"))
+    max_rank = 3
+    mf_dim = int(os.environ.get("BENCH_MF_DIM", 8))
+    dense_dim = 4
+    vocab = int(os.environ.get("BENCH_VOCAB", 10_000))
+    impls = os.environ.get("BENCH_PV_IMPLS", "both")
+    if impls not in ("xla", "pallas", "both"):
+        # a typo'd knob must not produce a silent empty round
+        raise SystemExit(
+            f"BENCH_PV_IMPLS={impls!r}: must be xla, pallas or both")
+
+    slots = [SlotDef("label", "float", 1),
+             SlotDef("dense", "float", dense_dim)]
+    slots += [SlotDef(f"C{i}", "uint64") for i in range(s)]
+    desc = DataFeedDesc(slots=slots, batch_size=bs, label_slot="label",
+                        pv_batch_size=max(1, bs // 8),
+                        key_bucket_min=max(512, bs * s))
+    recs = build_pv_records(n_pvs, s, vocab, dense_dim)
+    pvb = PvBatchBuilder(desc, max_rank=max_rank)
+    batches = pvb.batches(recs)
+    instances = len(recs)
+    d = 3 + mf_dim
+    cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=1e-3)
+    model = AdsRank(d_model=d_model, max_rank=max_rank,
+                    hidden=(128, 64), slot_fc=True, cross_norm=True)
+    summary = init_cross_norm_summary(1, d_model)
+
+    rows = []
+    flag_sets = {"xla": dict(use_pallas_rank_attention=False,
+                             use_pallas_batch_fc=False,
+                             use_pallas_cross_norm=False),
+                 "pallas": dict(use_pallas_rank_attention=True,
+                                use_pallas_batch_fc=True,
+                                use_pallas_cross_norm=True)}
+    for impl in ("xla", "pallas"):
+        if impls not in ("both", impl):
+            continue
+        table = EmbeddingTable(mf_dim=mf_dim, capacity=1 << 20, cfg=cfg,
+                               unique_bucket_min=512)
+        tx = optax.adam(5e-3)
+        b0, ro0 = batches[0]
+        with flags_scope(**flag_sets[impl]):
+            params = model.init(jax.random.PRNGKey(0),
+                                jnp.zeros((bs, s, d)),
+                                jnp.zeros((bs, dense_dim)),
+                                jnp.asarray(ro0), summary)
+            opt = tx.init(params)
+
+            @jax.jit
+            def step(params, opt, values_k, segments, show_clk, dense,
+                     label, ro, ins_w):
+                def loss_fn(params, values_k):
+                    pooled = fused_seqpool_cvm(values_k, segments,
+                                               show_clk, bs, s)
+                    logits = model.apply(params, pooled, dense, ro,
+                                         summary)
+                    ls = optax.sigmoid_binary_cross_entropy(logits, label)
+                    return (jnp.sum(ls * ins_w)
+                            / jnp.maximum(ins_w.sum(), 1.0))
+                loss, (gp, gk) = jax.value_and_grad(
+                    loss_fn, argnums=(0, 1))(params, values_k)
+                upd, opt = tx.update(gp, opt, params)
+                params = optax.apply_updates(params, upd)
+                return params, opt, loss, gk
+
+            def run_epoch(params, opt):
+                for batch, ro in batches:
+                    idx = table.prepare(batch)
+                    values_k = table.pull(idx)
+                    show_clk = jnp.stack([jnp.asarray(batch.show),
+                                          jnp.asarray(batch.clk)], axis=1)
+                    ins_w = jnp.asarray(
+                        (batch.show > 0).astype(np.float32))
+                    params, opt, loss, gk = step(
+                        params, opt, values_k,
+                        jnp.asarray(batch.segments), show_clk,
+                        jnp.asarray(batch.dense),
+                        jnp.asarray(batch.label), jnp.asarray(ro), ins_w)
+                    gk = jnp.concatenate(
+                        [gk[:, :2], gk[:, 2:] * (-1.0 * bs)], axis=1)
+                    table.push(idx, gk)
+                    jax.block_until_ready(loss)
+                return params, opt
+
+            params, opt = run_epoch(params, opt)     # warmup/compile
+            t0 = time.perf_counter()
+            for _ in range(num_passes):
+                params, opt = run_epoch(params, opt)
+            wall = time.perf_counter() - t0
+        value = instances * num_passes / max(wall, 1e-9)
+        metric = "adsrank_pv_examples_per_sec_per_chip"
+        if impl == "pallas":
+            metric += "_pallas"
+        rows.append({
+            "metric": metric, "value": round(value, 1),
+            "unit": "examples/sec/chip",
+            "vs_baseline": round(value / (1_000_000 / 16), 4),
+            "mode": "pv", "shape": "pv", "impl": impl,
+            "batch_size": bs, "pv_batch_size": desc.pv_batch_size,
+            "instances_per_pass": instances, "n_pvs": n_pvs,
+            "num_slots": s, "d_model": d_model, "max_rank": max_rank,
+            "passes": num_passes, "wall_sec": round(wall, 3),
+            "backend": jax.default_backend(),
+        })
+    return rows
+
+
 def xplane_device_busy_sec(trace_dir: str) -> float:
     """Parse the jax.profiler XPlane dump: summed UNION of XLA-module
     execution intervals on every /device: plane → measured device busy
@@ -674,6 +840,12 @@ def main() -> None:
         # subprocess-per-chip-count scaling bench (ISSUE 11) — the
         # parent never touches jax itself
         measure_multichip(shape=shape)
+        return
+    if mode == "pv":
+        # PV-batch rank-attention lane (ISSUE 13): proves the CTR op
+        # family in a real pull→train→push loop, one row per impl
+        for row in measure_pv(int(os.environ.get("BENCH_PASSES", 3))):
+            emit_result(row)
         return
     FLAGS.log_period_steps = 10 ** 9
     # the exact f64 host AUC finalize pulls the [2, 1e6] bucket tables

@@ -98,6 +98,25 @@ class Flags:
     # tests/test_pallas_train_gate.py — forward AND pushed grads,
     # uniform + zipf shapes).
     use_pallas_seqpool: bool = False
+    # route the remaining CTR op family through the fused Pallas device
+    # kernels (ops/pallas_ctr.py — ISSUE 13, the PR 11 seam pattern
+    # applied to rank_attention/batch_fc/cross_norm_hadamard). Each op
+    # reads its flag at ONE dispatch seam in its module; a shape that
+    # overflows the kernel's VMEM residency budget falls back to the
+    # XLA composition. Off (default) = the XLA composition,
+    # byte-for-byte today's program; parity matrices are gated in
+    # tier-1 (tests/test_pallas_ctr.py, tests/test_pallas_train_gate.py).
+    # block-grouped rank attention: ≤ max_rank² VMEM-resident param
+    # blocks, keep-mask folded into a one-hot × gathered-X MXU matmul
+    # (never materializing the [N, K, D, P] param gather)
+    use_pallas_rank_attention: bool = False
+    # per-slot blocked batched GEMM with the bias add fused in-VMEM
+    # (default, batchcount and transpose_weight modes)
+    use_pallas_batch_fc: bool = False
+    # one VMEM pass producing the [a, b, a⊙b, a·b] cross blocks with
+    # the data_norm mean/scale applied in the same residency (summary
+    # update and the sharded sync_stats psum stay outside, unchanged)
+    use_pallas_cross_norm: bool = False
 
     # --- fused computation-collective sharded step (ISSUE 11;
     # docs/PERFORMANCE.md §Sharded-step overlap) ---

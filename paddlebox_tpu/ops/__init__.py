@@ -4,6 +4,9 @@ from paddlebox_tpu.ops.seqpool_cvm import (
 from paddlebox_tpu.ops.pallas_kernels import (
     fused_embed_pool_cvm, segment_gather_mxu, segment_sum_mxu,
 )
+from paddlebox_tpu.ops.pallas_ctr import (
+    fused_batch_fc, fused_cross_norm_hadamard, fused_rank_attention,
+)
 from paddlebox_tpu.ops.cvm import cvm, cvm_grad_passthrough
 from paddlebox_tpu.ops.rank_attention import (rank_attention,
                                               rank_attention2)
@@ -34,5 +37,6 @@ __all__ = [
     "fused_seqpool_cvm_with_diff_thres", "fused_seqpool_cvm_tradew",
     "fused_seqpool_cvm_with_credit", "fused_seqpool_cvm_with_pcoc",
     "fused_seq_tensor", "fused_embed_pool_cvm", "segment_gather_mxu",
-    "segment_sum_mxu",
+    "segment_sum_mxu", "fused_rank_attention", "fused_batch_fc",
+    "fused_cross_norm_hadamard",
 ]

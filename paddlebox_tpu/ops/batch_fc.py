@@ -6,6 +6,15 @@ batched GEMM). Default mode: Input [slot_pairs, ins, in_dim] × W
 flattens a [bc*ins, in] input against [bc, in, out] weights
 (transpose_weight option). One einsum on the MXU replaces the hand-rolled
 stream-batched GEMMs.
+
+THE dispatch seam (ISSUE 13): under ``FLAGS.use_pallas_batch_fc`` (and
+the static VMEM residency check) the op runs as
+``ops.pallas_ctr.fused_batch_fc`` — one slot's weight block
+VMEM-resident per grid column, TN-row input blocks streamed through,
+the bias add fused before the output block leaves VMEM, and the
+transpose_weight mode riding dot_general dimension numbers instead of
+a materialized weight transpose. Both decisions book
+``pbox_kernel_dispatch_total{kernel="batch_fc"}``.
 """
 
 from __future__ import annotations
@@ -13,13 +22,28 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from paddlebox_tpu.config import FLAGS
+from paddlebox_tpu.ops.pallas_ctr import (_book_dispatch, batch_fc_fits,
+                                          fused_batch_fc)
+
 
 def batch_fc(x: jax.Array, w: jax.Array, bias: jax.Array,
              batchcount: int = 0, transpose_weight: bool = False) -> jax.Array:
+    if transpose_weight and batchcount <= 0:
+        # reference attr surface: transpose_weight exists only for the
+        # batchcount layout — fail loudly instead of contracting an
+        # [S, O, I] weight on the wrong axis
+        raise ValueError(
+            "batch_fc: transpose_weight requires batchcount > 0")
+    i_dim = x.shape[-1]
+    o_dim = w.shape[1] if transpose_weight else w.shape[2]
+    if FLAGS.use_pallas_batch_fc and batch_fc_fits(i_dim, o_dim):
+        _book_dispatch("batch_fc", "pallas")
+        return fused_batch_fc(x, w, bias, batchcount, transpose_weight)
+    _book_dispatch("batch_fc", "xla")
     if batchcount > 0:
-        ins = x.shape[0] // batchcount
-        xb = x.reshape(batchcount, ins, x.shape[-1])
+        xb = x.reshape(batchcount, x.shape[0] // batchcount, x.shape[-1])
         wb = jnp.swapaxes(w, 1, 2) if transpose_weight else w
         out = jnp.einsum("bni,bio->bno", xb, wb) + bias[:, None, :]
-        return out.reshape(batchcount * ins, -1)
+        return out.reshape(x.shape[0], -1)
     return jnp.einsum("sni,sio->sno", x, w) + bias[:, None, :]

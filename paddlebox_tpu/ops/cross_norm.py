@@ -7,8 +7,18 @@ PAIRS of embed_dim vectors ``[B, 2*n*d]``; per pair the output block of
 data_norm-style summary stats (mean = sum/size, scale = sqrt(size/sq_sum)).
 Output ``[B, n*(3d+1)]``. The summary updates with decay
 ``summary_decay_rate`` (default 0.9999999); ``sync_stats`` (multi-GPU NCCL
-reduce of batch stats) maps to a psum over the data axis before
-``cross_norm_update`` when training sharded.
+reduce of batch stats) maps to a psum over the data axis before the
+summary fold — pass ``sync_axis`` to :func:`cross_norm_update` inside a
+shard_map/pmap when training sharded.
+
+THE dispatch seam (ISSUE 13): under ``FLAGS.use_pallas_cross_norm``
+(and the static VMEM residency check) the forward runs as
+``ops.pallas_ctr.fused_cross_norm_hadamard`` — one VMEM pass per
+(row-block, field) emitting the normalized [a, b, a⊙b, a·b] block in
+the same residency. The summary-derived mean/scale are computed here
+(outside the kernel) so the summary cotangent chain is unchanged; the
+summary UPDATE (and its sync_stats psum) stays outside on every path.
+Both decisions book ``pbox_kernel_dispatch_total{kernel="cross_norm"}``.
 """
 
 from __future__ import annotations
@@ -16,9 +26,14 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from paddlebox_tpu.config import FLAGS
 from paddlebox_tpu.ops.data_norm import (DataNormSummary, data_norm,
+                                         data_norm_fold_stats,
+                                         data_norm_mean_scale,
                                          data_norm_update,
                                          init_data_norm_summary)
+from paddlebox_tpu.ops.pallas_ctr import (_book_dispatch, cross_norm_fits,
+                                          fused_cross_norm_hadamard)
 
 
 def cross_features(x: jax.Array, fields_num: int, embed_dim: int) -> jax.Array:
@@ -35,16 +50,40 @@ def cross_features(x: jax.Array, fields_num: int, embed_dim: int) -> jax.Array:
 def cross_norm_hadamard(x: jax.Array, summary: DataNormSummary,
                         fields_num: int, embed_dim: int,
                         epsilon: float = 1e-4) -> jax.Array:
+    if FLAGS.use_pallas_cross_norm and cross_norm_fits(embed_dim):
+        _book_dispatch("cross_norm", "pallas")
+        # the data_norm mean/scale derivation stays OUTSIDE the fused
+        # op (differentiable — the summary cotangent chain is the
+        # composition's); the kernel applies them in-residency
+        mean, scale = data_norm_mean_scale(summary, epsilon)
+        return fused_cross_norm_hadamard(x, mean, scale, fields_num,
+                                         embed_dim)
+    _book_dispatch("cross_norm", "xla")
     feats = cross_features(x, fields_num, embed_dim)
     return data_norm(feats, summary, epsilon=epsilon)
 
 
 def cross_norm_update(summary: DataNormSummary, x: jax.Array,
                       fields_num: int, embed_dim: int,
-                      decay: float = 0.9999999) -> DataNormSummary:
-    feats = cross_features(x, fields_num, embed_dim)
-    return data_norm_update(summary, jax.lax.stop_gradient(feats),
-                            decay=decay)
+                      decay: float = 0.9999999,
+                      sync_axis: str = None) -> DataNormSummary:
+    """Fold a batch's cross-feature stats into the summary.
+
+    ``sync_axis``: the reference's ``sync_stats`` attr (multi-GPU NCCL
+    allreduce of the batch count/sum/square-sum BEFORE the decayed fold,
+    cross_norm_hadamard_op.cu) — pass the data mesh axis name when
+    calling inside shard_map/pmap and every shard folds the GLOBAL
+    batch statistics, keeping summaries bit-identical across shards."""
+    feats = jax.lax.stop_gradient(
+        cross_features(x, fields_num, embed_dim))
+    if sync_axis is None:
+        return data_norm_update(summary, feats, decay=decay)
+    bsz = jax.lax.psum(jnp.asarray(feats.shape[0], jnp.float32), sync_axis)
+    s = jax.lax.psum(jnp.sum(feats, axis=0), sync_axis)
+    q = jax.lax.psum(jnp.sum(jnp.square(feats), axis=0), sync_axis)
+    # the data_norm fold over the psum'd GLOBAL stats — one shared
+    # definition, so sync and plain updates cannot drift
+    return data_norm_fold_stats(summary, bsz, s, q, decay=decay)
 
 
 def init_cross_norm_summary(fields_num: int,

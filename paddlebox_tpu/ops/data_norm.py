@@ -37,11 +37,22 @@ def init_data_norm_summary(c: int, init_size: float = 1e4) -> DataNormSummary:
     )
 
 
-def data_norm(x: jax.Array, summary: DataNormSummary,
-              slot_dim: int = -1, epsilon: float = 1e-7) -> jax.Array:
+def data_norm_mean_scale(summary: DataNormSummary,
+                         epsilon: float = 1e-7
+                         ) -> Tuple[jax.Array, jax.Array]:
+    """The ONE (mean, scale) derivation (means_arr/scales_arr,
+    data_norm_op.cc) — shared by :func:`data_norm` and the fused
+    cross_norm forward's in-kernel apply (ops/cross_norm), so the
+    flag-on and flag-off normalization formulas cannot drift."""
     mean = summary.batch_sum / summary.batch_size
     scale = jnp.sqrt(summary.batch_size /
                      jnp.maximum(summary.batch_square_sum, epsilon))
+    return mean, scale
+
+
+def data_norm(x: jax.Array, summary: DataNormSummary,
+              slot_dim: int = -1, epsilon: float = 1e-7) -> jax.Array:
+    mean, scale = data_norm_mean_scale(summary, epsilon)
     y = (x - mean[None, :]) * scale[None, :]
     if slot_dim > 0:
         # skip normalization for slot blocks whose first column (show) is 0
@@ -54,13 +65,27 @@ def data_norm(x: jax.Array, summary: DataNormSummary,
     return y
 
 
+def data_norm_fold_stats(summary: DataNormSummary, count, s: jax.Array,
+                         q: jax.Array, decay: float = 0.9999999,
+                         squared_sum_epsilon: float = 1e-4
+                         ) -> DataNormSummary:
+    """The ONE decayed summary fold over precomputed batch stats
+    (count, Σx, Σx²) — shared by the plain per-batch update and the
+    sync_stats psum path (ops/cross_norm.cross_norm_update), so the
+    fold/epsilon semantics cannot drift between them. The epsilon is
+    added once per UPDATE, not once per shard."""
+    return DataNormSummary(
+        batch_size=summary.batch_size * decay + count,
+        batch_sum=summary.batch_sum * decay + s,
+        batch_square_sum=summary.batch_square_sum * decay + q
+        + squared_sum_epsilon,
+    )
+
+
 def data_norm_update(summary: DataNormSummary, x: jax.Array,
                      decay: float = 0.9999999,
                      squared_sum_epsilon: float = 1e-4) -> DataNormSummary:
-    b = x.shape[0]
-    return DataNormSummary(
-        batch_size=summary.batch_size * decay + b,
-        batch_sum=summary.batch_sum * decay + jnp.sum(x, axis=0),
-        batch_square_sum=summary.batch_square_sum * decay +
-        jnp.sum(jnp.square(x), axis=0) + squared_sum_epsilon,
-    )
+    return data_norm_fold_stats(
+        summary, x.shape[0], jnp.sum(x, axis=0),
+        jnp.sum(jnp.square(x), axis=0), decay=decay,
+        squared_sum_epsilon=squared_sum_epsilon)

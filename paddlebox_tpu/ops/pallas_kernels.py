@@ -27,6 +27,11 @@ Reference hot kernels being replaced (SURVEY.md §2.1-2.2, §2.4):
 All kernels auto-fall back to interpret mode off-TPU so the whole suite is
 testable on the CPU mesh (SURVEY.md §4 implication).
 
+The suite's CTR op family half (``fused_rank_attention``,
+``fused_batch_fc``, ``fused_cross_norm_hadamard`` — ISSUE 13) lives in
+the sibling ``ops/pallas_ctr.py``, sharing this module's interpret/
+padding/dispatch-booking helpers and the same MXU one-hot recipe.
+
 Status / measured verdict (post ISSUE 12; one TPU chip, DeepFM/criteo
 bench, AoS table [8M+1, 16] f32, 213k rows/batch):
 - XLA's native gather/scatter lowers to PER-ELEMENT access: scatter

@@ -10,15 +10,46 @@ P[(own-1)*max_rank + (rank_k-1)] where RankParam is viewed as
 [max_rank*max_rank, input_dim, out_dim] blocks; invalid entries contribute 0.
 
 TPU-native: the CUDA path materializes expanded input/param then runs a
-batched GEMM; here it's two gathers + one einsum — XLA fuses the masking and
-batches the GEMM on the MXU. X gradients flow only when ``enable_input_bp``
-is True (rank_attention_op.cu computes dX only under EnableInputBp).
+batched GEMM. The XLA composition here is BLOCK-GROUPED (ISSUE 13): the
+sum regroups by param block b ∈ [0, max_rank²) —
+``out = Σ_b (Σ_{k: blk(i,k)=b} X[idx_k]) @ P[b]`` — two einsums over a
+[N, K, max_rank²] one-hot, so the peak intermediate is the [max_rank²,
+N, D] grouped input instead of the ``param[block]`` gather's
+[N, K, D, P] blow-up (~800 MB at N=4096, D=P=128; the absence of that
+tensor is pinned by an HLO check in tests/test_extended_ops.py). X
+gradients flow only when ``enable_input_bp`` is True
+(rank_attention_op.cu computes dX only under EnableInputBp).
+
+THE dispatch seam: under ``FLAGS.use_pallas_rank_attention`` (and the
+static VMEM residency check) the same math runs as the fused Pallas
+kernel ``ops.pallas_ctr.fused_rank_attention`` — param blocks
+VMEM-resident, one-hot folded into the MXU matmul. Both decisions book
+``pbox_kernel_dispatch_total{kernel="rank_attention"}``.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+from paddlebox_tpu.config import FLAGS
+from paddlebox_tpu.ops.pallas_ctr import (_book_dispatch,
+                                          decode_rank_offset,
+                                          fused_rank_attention,
+                                          normalize_rank_param,
+                                          rank_attention_fits)
+
+
+def _rank_attention_xla(x: jax.Array, rank_offset: jax.Array,
+                        param3: jax.Array, max_rank: int) -> jax.Array:
+    """Block-grouped XLA composition (see module docstring)."""
+    n = x.shape[0]
+    mr2 = max_rank * max_rank
+    blk, idx, valid = decode_rank_offset(rank_offset, max_rank, n)
+    x_k = jnp.where(valid[..., None], x[idx], 0.0)        # [N, K, D]
+    onehot = (blk[..., None] == jnp.arange(mr2)).astype(x.dtype)
+    gmat = jnp.einsum("nkd,nkb->bnd", x_k, onehot)        # [MR2, N, D]
+    return jnp.einsum("bnd,bdp->np", gmat, param3)
 
 
 def rank_attention(x: jax.Array, rank_offset: jax.Array,
@@ -28,28 +59,18 @@ def rank_attention(x: jax.Array, rank_offset: jax.Array,
     rank_param: [max_rank*max_rank*D, P] (reference layout) or
     [max_rank*max_rank, D, P]. Returns [N, P]."""
     n, d = x.shape
-    if rank_param.ndim == 2:
-        p = rank_param.shape[-1]
-        param = rank_param.reshape(max_rank * max_rank, d, p)
-    else:
-        param = rank_param
-        p = param.shape[-1]
+    param3 = normalize_rank_param(rank_param, max_rank, d)
+    p = param3.shape[-1]
+    if FLAGS.use_pallas_rank_attention and rank_attention_fits(max_rank,
+                                                              d, p):
+        # the fused kernel's custom_vjp owns the enable_input_bp gate
+        _book_dispatch("rank_attention", "pallas")
+        return fused_rank_attention(x, rank_offset, rank_param, max_rank,
+                                    enable_input_bp)
+    _book_dispatch("rank_attention", "xla")
     if not enable_input_bp:
         x = jax.lax.stop_gradient(x)
-
-    own = rank_offset[:, 0] - 1                      # [N] -1 ⇒ invalid
-    ks = jnp.arange(max_rank)
-    faster = rank_offset[:, 1 + 2 * ks] - 1          # [N, K]
-    idx = rank_offset[:, 2 + 2 * ks]                 # [N, K]
-    valid = (own[:, None] >= 0) & (faster >= 0)      # [N, K]
-
-    x_k = jnp.where(valid[..., None],
-                    x[jnp.clip(idx, 0, n - 1)], 0.0)          # [N, K, D]
-    block = jnp.clip(own[:, None], 0, max_rank - 1) * max_rank \
-        + jnp.clip(faster, 0, max_rank - 1)                   # [N, K]
-    # x_k is already zeroed for invalid (i,k), so the param gather needs no
-    # mask — the einsum contribution and the param cotangent are both 0
-    return jnp.einsum("nkd,nkdp->np", x_k, param[block])
+    return _rank_attention_xla(x, rank_offset, param3, max_rank)
 
 
 def rank_attention2(x: jax.Array, rank_offset: jax.Array,

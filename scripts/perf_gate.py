@@ -49,9 +49,12 @@ DEFAULT_MAX_DROP = 0.5
 #: them (the "where did the time go" companions of the headline value).
 #: n_chips/a2a_chunks/exchange_overlap_frac ride the multichip scaling
 #: rows (``sharded.n{N}.{shape}.*``, BENCH_MODE=multichip — ISSUE 11).
+#: pv_batch_size/instances_per_pass ride the PV rank-attention lane
+#: rows (``adsrank_pv_*``, BENCH_MODE=pv — ISSUE 13).
 EXTRA_FIELDS = ("device_busy_frac", "begin_delta_steady_sec",
                 "end_pass_overlap_frac", "vs_baseline", "n_chips",
-                "a2a_chunks", "exchange_overlap_frac")
+                "a2a_chunks", "exchange_overlap_frac",
+                "pv_batch_size", "instances_per_pass")
 
 
 def _repo_root() -> str:

@@ -776,9 +776,111 @@ def _kernel_segments(shape: str, rng, b: int, s: int, k: int,
     return out
 
 
-def run_set_kernels(shape: str, n_iter: int, record: bool = False) -> None:
-    """Per-kernel device cost of the Pallas embed-pool-CVM family vs the
-    XLA composition (ISSUE 12; docs/PERFORMANCE.md §Device kernels)."""
+def _ctr_probes(probe, n_iter: int, backend: str) -> None:
+    """The CTR op family (ISSUE 13): fused rank_attention / batch_fc /
+    cross_norm_hadamard vs their XLA compositions, probed THROUGH the
+    dispatch seams so the flag routing (and its
+    ``pbox_kernel_dispatch_total`` booking) is what gets measured.
+    Emits ``kernel.{rank_attention,batch_fc,cross_norm}[_xla]`` rows;
+    the per-iter work unit is rows (instances), not keys."""
+    from paddlebox_tpu.config import flags_scope
+    from paddlebox_tpu.ops import (batch_fc, cross_norm_hadamard,
+                                   cross_norm_update,
+                                   init_cross_norm_summary,
+                                   rank_attention)
+
+    rng = np.random.default_rng(0)
+    if backend == "tpu":
+        n_ra, d_ra, s_fc, n_fc, io_fc = 4096, 128, 26, 4096, 128
+        b_cn, f_cn, d_cn = 4096, 8, 64
+    else:
+        # interpret-mode round: keep it seconds (gate-history rows)
+        n_ra, d_ra, s_fc, n_fc, io_fc = 256, 32, 8, 128, 64
+        b_cn, f_cn, d_cn = 256, 4, 16
+    mr = 3
+
+    # ---- rank_attention: block-grouped Pallas vs XLA fallback ----
+    x = jnp.asarray(rng.normal(size=(n_ra, d_ra)).astype(np.float32))
+    param = jnp.asarray(
+        rng.normal(size=(mr * mr, d_ra, d_ra)).astype(np.float32))
+    ro_np = np.zeros((n_iter, n_ra, 1 + 2 * mr), np.int32)
+    for i in range(n_iter):
+        ro_np[i, :, 0] = rng.integers(0, mr + 1, size=n_ra)
+        for k in range(mr):
+            on = rng.random(n_ra) < 0.7
+            ro_np[i, :, 1 + 2 * k] = np.where(
+                on, rng.integers(1, mr + 1, size=n_ra), 0)
+            ro_np[i, :, 2 + 2 * k] = rng.integers(0, n_ra, size=n_ra)
+    ro_stack = jnp.asarray(ro_np)
+
+    def make_ra(flag):
+        @jax.jit
+        def run(x, param, ro_stack):
+            def body(i, acc):
+                with flags_scope(use_pallas_rank_attention=flag):
+                    out = rank_attention(x * (1.0 + acc * 1e-9),
+                                         ro_stack[i], param, mr)
+                return acc + out[0, 0] + out[-1, -1]
+            return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+        return run
+
+    probe("rank_attention", make_ra(True), x, param, ro_stack,
+          keys=n_ra, unit="rows/sec")
+    probe("rank_attention_xla", make_ra(False), x, param, ro_stack,
+          keys=n_ra, unit="rows/sec")
+
+    # ---- batch_fc: fused-bias blocked GEMM vs XLA einsum ----
+    xb = jnp.asarray(
+        rng.normal(size=(s_fc, n_fc, io_fc)).astype(np.float32))
+    wb = jnp.asarray(
+        rng.normal(size=(s_fc, io_fc, io_fc)).astype(np.float32))
+    bb = jnp.asarray(rng.normal(size=(s_fc, io_fc)).astype(np.float32))
+
+    def make_fc(flag):
+        @jax.jit
+        def run(xb, wb, bb):
+            def body(i, acc):
+                with flags_scope(use_pallas_batch_fc=flag):
+                    out = batch_fc(xb * (1.0 + acc * 1e-9), wb, bb)
+                return acc + out[0, 0, 0] + out[-1, -1, -1]
+            return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+        return run
+
+    probe("batch_fc", make_fc(True), xb, wb, bb, keys=s_fc * n_fc,
+          unit="rows/sec")
+    probe("batch_fc_xla", make_fc(False), xb, wb, bb,
+          keys=s_fc * n_fc, unit="rows/sec")
+
+    # ---- cross_norm_hadamard: one-VMEM-pass vs XLA composition ----
+    xc = jnp.asarray(
+        rng.normal(size=(b_cn, 2 * f_cn * d_cn)).astype(np.float32))
+    summ = cross_norm_update(init_cross_norm_summary(f_cn, d_cn), xc,
+                             f_cn, d_cn, decay=0.5)
+
+    def make_cn(flag):
+        @jax.jit
+        def run(xc, summ):
+            def body(i, acc):
+                with flags_scope(use_pallas_cross_norm=flag):
+                    out = cross_norm_hadamard(xc * (1.0 + acc * 1e-9),
+                                              summ, f_cn, d_cn)
+                return acc + out[0, 0] + out[-1, -1]
+            return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+        return run
+
+    probe("cross_norm", make_cn(True), xc, summ, keys=b_cn,
+          unit="rows/sec")
+    probe("cross_norm_xla", make_cn(False), xc, summ, keys=b_cn,
+          unit="rows/sec")
+
+
+def run_set_kernels(shape: str, n_iter: int, record: bool = False,
+                    probes: str = "all") -> None:
+    """Per-kernel device cost of the Pallas device-kernel suite vs the
+    XLA compositions (ISSUE 12 + 13; docs/PERFORMANCE.md §Device
+    kernels). ``probes``: "embed" = the embed-pool-CVM family,
+    "ctr" = the rank_attention/batch_fc/cross_norm family, "all" =
+    both."""
     import jax.numpy as jnp
 
     from paddlebox_tpu.config import flags_scope
@@ -801,7 +903,7 @@ def run_set_kernels(shape: str, n_iter: int, record: bool = False) -> None:
     timeit = make_timeit(n_iter)
     rows_out = []
 
-    def probe(name, fn, *args, keys=k):
+    def probe(name, fn, *args, keys=k, unit="keys/sec"):
         if trace.tracing_active():
             with trace.span(f"kernel.{name}", lane=trace.LANE_KERNELS,
                             shape=shape, backend=backend):
@@ -811,102 +913,112 @@ def run_set_kernels(shape: str, n_iter: int, record: bool = False) -> None:
             # source="live" (the bench.py convention): a re-run on a
             # slower box appends a row that --check --ignore-live SKIPS
             # — the GATED history is the committed KERNELS_r0*.json
-            # round (folded with its artifact name as source)
+            # round (folded with its artifact name as source).
+            # ``keys``/``unit`` name the probe's work item — the CTR
+            # probes count rows (instances), not keys.
             rows_out.append({
                 "source": "live",
                 "metric": f"kernel.{name}.{shape}.{backend}",
                 "value": round(keys / ms * 1000.0, 1),
-                "unit": "keys/sec", "shape": shape,
+                "unit": unit, "shape": shape,
             })
 
     print(json.dumps({"probe": "shape", "B": b, "S": s, "K": k,
                       "CAP": cap, "D": d, "backend": backend}),
           flush=True)
 
-    # ---- gather: pallas scalar-prefetch line gather vs XLA take ----
-    table = jnp.asarray(rng.normal(size=(cap, 128)).astype(np.float32))
-    rows_np = rng.integers(0, cap, size=(n_iter, k)).astype(np.int32)
-    rows_stack = jnp.asarray(rows_np)
-
-    @jax.jit
-    def p_gather_pallas(table, rows_stack):
-        def body(i, acc):
-            v = gather_rows(table, rows_stack[i])
-            return acc + v[0, 0] + v[-1, -1]
-        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
-
-    @jax.jit
-    def p_gather_xla(table, rows_stack):
-        def body(i, acc):
-            v = table[rows_stack[i]]
-            return acc + v[0, 0] + v[-1, -1]
-        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
-
-    probe("gather", p_gather_pallas, table, rows_stack)
-    probe("gather_xla", p_gather_xla, table, rows_stack)
-
-    # ---- pool+CVM forward: fused Pallas pass vs XLA composition ----
-    vals = rng.normal(size=(k, d)).astype(np.float32)
-    vals[:, :2] = np.abs(vals[:, :2])
-    vals_j = jnp.asarray(vals)
-    segs_stack = jnp.asarray(_kernel_segments(shape, rng, b, s, k, n_iter))
-    sc = jnp.asarray(np.abs(rng.normal(size=(b, 2))).astype(np.float32))
-
-    @jax.jit
-    def p_pool_fused(vals_j, segs_stack):
-        def body(i, acc):
-            out = fused_pool_cvm_forward(vals_j * (1.0 + acc * 1e-9),
-                                         segs_stack[i], None, b, s)
-            return acc + out[0, 0, 0] + out[-1, -1, -1]
-        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
-
-    def _xla_fwd(v, segs):
-        with flags_scope(use_pallas_seqpool=False):
-            return fused_seqpool_cvm(v, segs, sc, b, s)
-
-    @jax.jit
-    def p_pool_xla(vals_j, segs_stack):
-        def body(i, acc):
-            out = _xla_fwd(vals_j * (1.0 + acc * 1e-9), segs_stack[i])
-            return acc + out[0, 0, 0] + out[-1, -1, -1]
-        return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
-
-    probe("pool_cvm", p_pool_fused, vals_j, segs_stack)
-    probe("pool_cvm_xla", p_pool_xla, vals_j, segs_stack)
-
-    # ---- full fused fwd+bwd (the train-step shape: pooled loss grad
-    # feeding the push path) vs the XLA composition ----
-    def make_fwd_bwd(flag):
-        def step(v, segs):
-            def loss(v):
-                out = fused_seqpool_cvm(v, segs, sc, b, s)
-                return jnp.sum(out * out)
-            return jax.grad(loss)(v)
+    if probes in ("all", "embed"):
+        # ---- gather: pallas scalar-prefetch line gather vs XLA take ----
+        table = jnp.asarray(rng.normal(size=(cap, 128)).astype(np.float32))
+        rows_np = rng.integers(0, cap, size=(n_iter, k)).astype(np.int32)
+        rows_stack = jnp.asarray(rows_np)
 
         @jax.jit
-        def run(vals_j, segs_stack):
+        def p_gather_pallas(table, rows_stack):
             def body(i, acc):
-                with flags_scope(use_pallas_seqpool=flag):
-                    g = step(vals_j * (1.0 + acc * 1e-9), segs_stack[i])
-                return acc + g[0, 0] + g[-1, -1]
+                v = gather_rows(table, rows_stack[i])
+                return acc + v[0, 0] + v[-1, -1]
             return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
-        return run
 
-    probe("fused", make_fwd_bwd(True), vals_j, segs_stack)
-    probe("fused_xla", make_fwd_bwd(False), vals_j, segs_stack)
+        @jax.jit
+        def p_gather_xla(table, rows_stack):
+            def body(i, acc):
+                v = table[rows_stack[i]]
+                return acc + v[0, 0] + v[-1, -1]
+            return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+        probe("gather", p_gather_pallas, table, rows_stack)
+        probe("gather_xla", p_gather_xla, table, rows_stack)
+
+        # ---- pool+CVM forward: fused Pallas pass vs XLA composition ----
+        vals = rng.normal(size=(k, d)).astype(np.float32)
+        vals[:, :2] = np.abs(vals[:, :2])
+        vals_j = jnp.asarray(vals)
+        segs_stack = jnp.asarray(_kernel_segments(shape, rng, b, s, k, n_iter))
+        sc = jnp.asarray(np.abs(rng.normal(size=(b, 2))).astype(np.float32))
+
+        @jax.jit
+        def p_pool_fused(vals_j, segs_stack):
+            def body(i, acc):
+                out = fused_pool_cvm_forward(vals_j * (1.0 + acc * 1e-9),
+                                             segs_stack[i], None, b, s)
+                return acc + out[0, 0, 0] + out[-1, -1, -1]
+            return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+        def _xla_fwd(v, segs):
+            with flags_scope(use_pallas_seqpool=False):
+                return fused_seqpool_cvm(v, segs, sc, b, s)
+
+        @jax.jit
+        def p_pool_xla(vals_j, segs_stack):
+            def body(i, acc):
+                out = _xla_fwd(vals_j * (1.0 + acc * 1e-9), segs_stack[i])
+                return acc + out[0, 0, 0] + out[-1, -1, -1]
+            return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+
+        probe("pool_cvm", p_pool_fused, vals_j, segs_stack)
+        probe("pool_cvm_xla", p_pool_xla, vals_j, segs_stack)
+
+        # ---- full fused fwd+bwd (the train-step shape: pooled loss grad
+        # feeding the push path) vs the XLA composition ----
+        def make_fwd_bwd(flag):
+            def step(v, segs):
+                def loss(v):
+                    out = fused_seqpool_cvm(v, segs, sc, b, s)
+                    return jnp.sum(out * out)
+                return jax.grad(loss)(v)
+
+            @jax.jit
+            def run(vals_j, segs_stack):
+                def body(i, acc):
+                    with flags_scope(use_pallas_seqpool=flag):
+                        g = step(vals_j * (1.0 + acc * 1e-9), segs_stack[i])
+                    return acc + g[0, 0] + g[-1, -1]
+                return jax.lax.fori_loop(0, n_iter, body, jnp.zeros(()))
+            return run
+
+        probe("fused", make_fwd_bwd(True), vals_j, segs_stack)
+        probe("fused_xla", make_fwd_bwd(False), vals_j, segs_stack)
+
+    if probes in ("all", "ctr"):
+        _ctr_probes(probe, n_iter, backend)
 
     if record and rows_out:
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
         import perf_gate
-        path = os.environ.get("BENCH_TRAJECTORY") \
-            or perf_gate.default_trajectory_path()
+        # bench.py's convention: BENCH_TRAJECTORY=0 disables the live
+        # append (the rows still echo below for artifact capture)
+        dest = os.environ.get("BENCH_TRAJECTORY", "")
+        path = None if dest == "0" \
+            else (dest or perf_gate.default_trajectory_path())
         for row in rows_out:
-            perf_gate.append_row(row, path)
+            if path:
+                perf_gate.append_row(row, path)
             # echo the row as a bench line so a captured stdout artifact
             # (KERNELS_r0*.json) re-folds via perf_gate --fold
             print(json.dumps(row), flush=True)
         print(json.dumps({"probe": "recorded", "rows": len(rows_out),
-                          "path": path}), flush=True)
+                          "path": path or "(disabled)"}), flush=True)
 
 
 def main(argv=None) -> int:
@@ -926,11 +1038,16 @@ def main(argv=None) -> int:
                     help="(kernels set) append kernel.* rows to the "
                     "perf_gate trajectory (BENCH_TRAJECTORY overrides "
                     "the path)")
+    ap.add_argument("--probes", default="all",
+                    choices=("all", "embed", "ctr"),
+                    help="(kernels set) probe family: the embed-pool-"
+                    "CVM suite, the ISSUE 13 CTR op family, or both")
     args = ap.parse_args(argv)
     if args.probe_set == "kernels":
         shape = args.shape if args.shape != "thousand" else "ragged"
         print(json.dumps({"probe": "set", "set": "kernels"}), flush=True)
-        run_set_kernels(shape, args.iters, record=args.record)
+        run_set_kernels(shape, args.iters, record=args.record,
+                        probes=args.probes)
         print(json.dumps({"probe": "done"}), flush=True)
         return 0
     if args.shape == "zipf":

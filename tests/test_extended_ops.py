@@ -96,6 +96,86 @@ def test_rank_attention2_param_only_grads():
                                ref_gp, rtol=1e-4, atol=1e-5)
 
 
+def _einsum_rank_attention(x, ro, rank_param, max_rank,
+                           enable_input_bp=False):
+    """The HISTORICAL einsum formulation (pre-ISSUE 13): gathers
+    ``param[block]`` into an [N, K, D, P] tensor — kept here as the
+    numeric reference the block-grouped fallback is pinned against."""
+    n, d = x.shape
+    if rank_param.ndim == 2:
+        p = rank_param.shape[-1]
+        param = rank_param.reshape(max_rank * max_rank, d, p)
+    else:
+        param = rank_param
+    if not enable_input_bp:
+        x = jax.lax.stop_gradient(x)
+    own = ro[:, 0] - 1
+    ks = jnp.arange(max_rank)
+    faster = ro[:, 1 + 2 * ks] - 1
+    idx = ro[:, 2 + 2 * ks]
+    valid = (own[:, None] >= 0) & (faster >= 0)
+    x_k = jnp.where(valid[..., None], x[jnp.clip(idx, 0, n - 1)], 0.0)
+    block = jnp.clip(own[:, None], 0, max_rank - 1) * max_rank \
+        + jnp.clip(faster, 0, max_rank - 1)
+    return jnp.einsum("nkd,nkdp->np", x_k, param[block])
+
+
+def test_rank_attention_block_grouped_matches_old_einsum():
+    """ISSUE 13 satellite: the rewritten block-grouped XLA fallback is
+    numerically pinned to the historical einsum (forward AND grads) —
+    the memory-blowup fix must not move the math."""
+    rng = np.random.default_rng(10)
+    n, d, p, mr = 41, 9, 6, 3
+    x = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+    param = jnp.asarray(
+        rng.normal(size=(mr * mr * d, p)).astype(np.float32))
+    ro = np.zeros((n, 1 + 2 * mr), np.int32)
+    ro[:, 0] = rng.integers(0, mr + 1, size=n)
+    for k in range(mr):
+        on = rng.random(n) < 0.6
+        ro[:, 1 + 2 * k] = np.where(on, rng.integers(1, mr + 1, size=n),
+                                    0)
+        ro[:, 2 + 2 * k] = rng.integers(0, n, size=n)
+    ro = jnp.asarray(ro)
+    got = np.asarray(rank_attention(x, ro, param, mr))
+    want = np.asarray(_einsum_rank_attention(x, ro, param, mr))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    w = jnp.asarray(rng.normal(size=(n, p)).astype(np.float32))
+    g_new = jax.grad(lambda xx, pp: jnp.sum(rank_attention(
+        xx, ro, pp, mr, enable_input_bp=True) * w), argnums=(0, 1))(
+            x, param)
+    g_old = jax.grad(lambda xx, pp: jnp.sum(_einsum_rank_attention(
+        xx, ro, pp, mr, enable_input_bp=True) * w), argnums=(0, 1))(
+            x, param)
+    for a, b in zip(g_new, g_old):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_rank_attention_fallback_never_builds_nkdp():
+    """The blowup fix itself, pinned in HLO: at a production-ish shape
+    the compiled default (flag-off) program contains NO [N, K, D, P]
+    tensor (the old ``param[block]`` gather materialized f32[N,3,D,P] —
+    ~800 MB at the real N=4096, D=P=128)."""
+    n, d, p, mr = 512, 64, 32, 3
+    nkdp = f"tensor<{n}x{mr}x{d}x{p}xf32>"  # StableHLO shape spelling
+
+    def lowered(fn):
+        return jax.jit(fn).lower(
+            jax.ShapeDtypeStruct((n, d), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1 + 2 * mr), jnp.int32),
+            jax.ShapeDtypeStruct((mr * mr, d, p), jnp.float32)).as_text()
+
+    txt = lowered(lambda x, ro, pm: rank_attention(x, ro, pm, mr))
+    assert nkdp not in txt, \
+        "rank_attention fallback still materializes the [N,K,D,P] gather"
+    # the historical einsum DOES build it — prove the probe detects it
+    txt_old = lowered(
+        lambda x, ro, pm: _einsum_rank_attention(x, ro, pm, mr))
+    assert nkdp in txt_old
+
+
 def test_batch_fc_modes():
     rng = np.random.default_rng(1)
     s, n, i, o = 3, 5, 4, 2

@@ -390,8 +390,15 @@ def test_dma_reference_paths_refuse_real_tpu(monkeypatch):
 
 
 def test_kernel_dispatch_counter_books():
+    """EVERY dispatch seam books pbox_kernel_dispatch_total{kernel,impl}
+    for both implementations — the seqpool seam (ISSUE 12) and the
+    three CTR-family seams (ISSUE 13)."""
     from paddlebox_tpu.obs import MemorySink
     from paddlebox_tpu.obs.hub import get_hub, reset_hub
+    from paddlebox_tpu.ops import (batch_fc, cross_norm_hadamard,
+                                   fused_seqpool_cvm,
+                                   init_cross_norm_summary,
+                                   rank_attention)
     reset_hub()
     hub = get_hub()
     hub.add_sink(MemorySink())
@@ -399,14 +406,36 @@ def test_kernel_dispatch_counter_books():
         vals = jnp.ones((8, 4), jnp.float32)
         segs = jnp.zeros((8,), jnp.int32)
         sc = jnp.ones((1, 2), jnp.float32)
-        from paddlebox_tpu.ops import fused_seqpool_cvm
-        with flags_scope(use_pallas_seqpool=True):
+        x_ra = jnp.ones((4, 3), jnp.float32)
+        ro = jnp.asarray(np.tile(
+            np.array([[1, 1, 0, 0, 0, 0, 0]], np.int32), (4, 1)))
+        pm = jnp.ones((9, 3, 2), jnp.float32)
+        x_fc = jnp.ones((2, 4, 3), jnp.float32)
+        w_fc = jnp.ones((2, 3, 3), jnp.float32)
+        b_fc = jnp.ones((2, 3), jnp.float32)
+        x_cn = jnp.ones((4, 4), jnp.float32)
+        summ = init_cross_norm_summary(1, 2)
+
+        def run_all():
             fused_seqpool_cvm(vals, segs, sc, 1, 1)
+            rank_attention(x_ra, ro, pm, 3)
+            batch_fc(x_fc, w_fc, b_fc)
+            cross_norm_hadamard(x_cn, summ, 1, 2)
+
+        flags_on = dict(use_pallas_seqpool=True,
+                        use_pallas_rank_attention=True,
+                        use_pallas_batch_fc=True,
+                        use_pallas_cross_norm=True)
+        with flags_scope(**flags_on):
+            run_all()
+        with flags_scope(**{k: False for k in flags_on}):
+            run_all()
         c = hub.counter("pbox_kernel_dispatch_total")
-        assert c.value(kernel="fused_embed_pool_cvm", impl="pallas") >= 1
-        with flags_scope(use_pallas_seqpool=False):
-            fused_seqpool_cvm(vals, segs, sc, 1, 1)
-        assert c.value(kernel="fused_embed_pool_cvm", impl="xla") >= 1
+        for kernel in ("fused_embed_pool_cvm", "rank_attention",
+                       "batch_fc", "cross_norm"):
+            for impl in ("pallas", "xla"):
+                assert c.value(kernel=kernel, impl=impl) >= 1, \
+                    f"seam {kernel!r} never booked impl={impl!r}"
     finally:
         reset_hub()
 

@@ -160,6 +160,139 @@ def test_zipf_ragged_state_close(criteo_files):
         np.testing.assert_allclose(b, a, rtol=2e-4, atol=2e-5)
 
 
+def _pv_train_state(flag_overrides, n_pvs=40, bs=32, seed=0):
+    """Compact PV/AdsRank training job (the ISSUE 13 lane): PV-merged
+    batches through rank_attention + slot_fc batch_fc + cross_norm,
+    pull→train→push on a small table. Returns (params_leaves,
+    table_packed) — the byte-comparable logical state."""
+    import optax
+
+    import jax.numpy as jnp
+
+    from paddlebox_tpu.data import DataFeedDesc, SlotDef
+    from paddlebox_tpu.data.pv import PvBatchBuilder
+    from paddlebox_tpu.data.record import SlotRecord
+    from paddlebox_tpu.models import AdsRank
+    from paddlebox_tpu.ops import fused_seqpool_cvm, init_cross_norm_summary
+
+    from paddlebox_tpu.ps import EmbeddingTable
+
+    S, MR, DM = 4, 3, 8
+    rng = np.random.default_rng(seed)
+    recs = []
+    for sid in range(n_pvs):
+        n_ads = int(rng.integers(2, 4))
+        ranks = rng.permutation(n_ads) + 1
+        for a in range(n_ads):
+            keys = (rng.integers(0, 60, S)
+                    + np.arange(S) * 60).astype(np.uint64)
+            label = float(rng.random() < 0.3)
+            recs.append(SlotRecord(
+                keys=keys, slot_offsets=np.arange(S + 1, dtype=np.int32),
+                dense=rng.normal(size=2).astype(np.float32), label=label,
+                show=1.0, clk=label, search_id=sid, rank=int(ranks[a]),
+                cmatch=222))
+    slots = [SlotDef("label", "float", 1), SlotDef("dense", "float", 2)]
+    slots += [SlotDef(f"C{i}", "uint64") for i in range(S)]
+    desc = DataFeedDesc(slots=slots, batch_size=bs, label_slot="label",
+                        pv_batch_size=8, key_bucket_min=256)
+    from paddlebox_tpu.ps import SparseSGDConfig
+    cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=0.0,
+                          learning_rate=0.05, mf_learning_rate=0.05)
+    with flags_scope(**flag_overrides):
+        table = EmbeddingTable(mf_dim=4, capacity=1 << 10, cfg=cfg,
+                               unique_bucket_min=256)
+        model = AdsRank(d_model=DM, max_rank=MR, hidden=(8,),
+                        slot_fc=True, cross_norm=True)
+        summary = init_cross_norm_summary(1, DM)
+        batches = PvBatchBuilder(desc, max_rank=MR).batches(recs)
+        d = 3 + table.mf_dim
+        b0, ro0 = batches[0]
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((bs, S, d)), jnp.zeros((bs, 2)),
+                            jnp.asarray(ro0), summary)
+        import optax as _optax
+        tx = _optax.adam(5e-3)
+        opt = tx.init(params)
+
+        @jax.jit
+        def step(params, opt, values_k, segments, show_clk, dense,
+                 label, ro):
+            def loss_fn(params, values_k):
+                pooled = fused_seqpool_cvm(values_k, segments, show_clk,
+                                           bs, S)
+                logits = model.apply(params, pooled, dense, ro, summary)
+                return jnp.mean(
+                    _optax.sigmoid_binary_cross_entropy(logits, label))
+            _, (gp, gk) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1))(params, values_k)
+            upd, opt = tx.update(gp, opt, params)
+            return _optax.apply_updates(params, upd), opt, gk
+
+        for batch, ro in batches:
+            idx = table.prepare(batch)
+            values_k = table.pull(idx)
+            show_clk = jnp.stack([jnp.asarray(batch.show),
+                                  jnp.asarray(batch.clk)], axis=1)
+            params, opt, gk = step(
+                params, opt, values_k, jnp.asarray(batch.segments),
+                show_clk, jnp.asarray(batch.dense),
+                jnp.asarray(batch.label), jnp.asarray(ro))
+            table.push(idx, gk)
+        leaves = [np.asarray(l) for l in jax.tree.leaves(
+            jax.device_get(params))]
+        packed = np.asarray(table.state.packed)
+    return leaves, packed
+
+
+def test_pv_train_default_off_byte_identical():
+    """The ISSUE 13 acceptance digest gate, PV half: a seeded PV/
+    AdsRank train job under DEFAULT flags is byte-for-byte identical to
+    one with the three CTR flags explicitly off (defaults really are
+    off and the seams leave the program untouched)."""
+    l0, p0 = _pv_train_state({})
+    l1, p1 = _pv_train_state(dict(use_pallas_rank_attention=False,
+                                  use_pallas_batch_fc=False,
+                                  use_pallas_cross_norm=False))
+    assert p0.tobytes() == p1.tobytes()
+    for a, b in zip(l0, l1):
+        assert a.tobytes() == b.tobytes()
+
+
+def test_pv_train_pallas_state_close():
+    """Flag-on PV train vs the XLA composition: rank_attention/batch_fc
+    grads are bitwise, so the only drift is the fused forwards' MXU
+    summation order compounding through Adam — the same f32 tolerance
+    class as the zipf seqpool gate."""
+    l0, p0 = _pv_train_state({})
+    l1, p1 = _pv_train_state(dict(use_pallas_rank_attention=True,
+                                  use_pallas_batch_fc=True,
+                                  use_pallas_cross_norm=True))
+    np.testing.assert_allclose(p1, p0, rtol=2e-4, atol=2e-5)
+    for a, b in zip(l0, l1):
+        np.testing.assert_allclose(b, a, rtol=2e-3, atol=2e-4)
+
+
+def test_resident_digest_immune_to_ctr_flags(criteo_files):
+    """The ISSUE 13 acceptance digest gate, resident half: the CTR op
+    family is not on the DeepFM resident path, so flipping all three
+    flags ON must reproduce the flag-off resident state_digest EXACTLY
+    (no accidental coupling through shared modules)."""
+    with flags_scope(use_pallas_rank_attention=False,
+                     use_pallas_batch_fc=False,
+                     use_pallas_cross_norm=False):
+        tr0, ds = _trainer_uniform(criteo_files)
+        tr0.train_pass(ds)
+        d0 = state_digest(tr0)
+    with flags_scope(use_pallas_rank_attention=True,
+                     use_pallas_batch_fc=True,
+                     use_pallas_cross_norm=True):
+        tr1, ds = _trainer_uniform(criteo_files)
+        tr1.train_pass(ds)
+        d1 = state_digest(tr1)
+    assert d0 == d1
+
+
 def test_committed_kernel_trajectory_gates():
     """The interpret-mode CPU kernel round is recorded (satellite:
     kernel.* rows live in BENCH_trajectory.json) and the perf gate
@@ -169,9 +302,15 @@ def test_committed_kernel_trajectory_gates():
     with open(path) as fh:
         data = json.load(fh)
     metrics = {r["metric"] for r in data["rows"]}
-    for probe in ("gather", "pool_cvm", "fused"):
+    for probe in ("gather", "pool_cvm", "fused",
+                  # the ISSUE 13 CTR family round (KERNELS_r02)
+                  "rank_attention", "batch_fc", "cross_norm"):
         assert any(m.startswith(f"kernel.{probe}.") and m.endswith(".cpu")
                    for m in metrics), f"no recorded kernel.{probe}.* row"
+    # the PV rank-attention bench lane's rows (BENCH_MODE=pv) are
+    # folded and gated alongside the kernel rounds
+    assert "adsrank_pv_examples_per_sec_per_chip" in metrics
+    assert "adsrank_pv_examples_per_sec_per_chip_pallas" in metrics
     spec = importlib.util.spec_from_file_location(
         "perf_gate", os.path.join(REPO_ROOT, "scripts", "perf_gate.py"))
     pg = importlib.util.module_from_spec(spec)

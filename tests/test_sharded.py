@@ -851,9 +851,12 @@ def test_a2a_probe_reports_and_spans(mesh, chunk_parity_ds):
     assert all(t > 0 for t in pr["a2a_pull_sec"] + pr["pool_sec"])
     assert 0.0 <= pr["exchange_overlap_frac"] <= 1.0
     assert pr["exchange_wait_sec"] >= 0.0
-    # the wait part rides the next pass event's critical_path
+    # the wait part rides the next pass event's critical_path — unless
+    # the measured wait was exactly 0 (CPU timing noise can make the
+    # monolithic step read slower than chunked by more than the whole
+    # exchange; note_pass_part skips zero parts by design)
     parts = trace.consume_pass_parts()
-    assert "exchange_wait" in parts
+    assert "exchange_wait" in parts or pr["exchange_wait_sec"] == 0.0
     names = {e.get("name") for e in w._events}
     assert {"a2a.pull.0", "a2a.pull.1", "pool.0", "pool.1",
             "a2a.push"} <= names
