@@ -281,6 +281,22 @@ class Flags:
     # N completed windows (bounds replay after a hard kill)
     stream_ckpt_every_windows: int = 1
 
+    # --- artifact/publishing layer (artifacts.py; docs/RESILIENCE.md
+    # §Publishing) ---
+    # registry dir for versioned model artifacts; non-empty →
+    # CheckpointManager auto-attaches an ArtifactStore and publishes
+    # every BOUNDARY checkpoint (incl. train_stream stream-boundary
+    # saves) as a lineage-linked version. "" = publishing off.
+    artifact_root: str = ""
+    # reader-lease staleness TTL: a lease whose heartbeat mtime is
+    # older than this (or whose same-host writer pid is dead) is
+    # provably stale and may be reaped by the retention sweep; readers
+    # fence every access against lease loss (ArtifactLeaseLostError)
+    artifact_lease_ttl_sec: float = 300.0
+    # versions kept by ArtifactStore.retain (plus leased versions and
+    # lineage parents, which are NEVER swept); <=0 = keep everything
+    artifact_keep: int = 0
+
     # --- pipeline hang deadline (ps/epilogue.PassEpilogue.fence,
     # train/device_pass.PassPreloader.wait) ---
     # >0: a pipeline wait that sees no job/build COMPLETE for this long

@@ -39,6 +39,9 @@ class BoxPSHelper:
         self.table = table
         self.trainer = trainer
         self.pass_id = 0
+        #: last artifact published through this helper (the parent
+        #: lineage link for the next publish_delta)
+        self._published_tip = None
 
     def _store(self):
         """The full-model lifecycle surface: the single HostStore behind a
@@ -137,6 +140,77 @@ class BoxPSHelper:
 
     def save_delta(self, path: str) -> int:
         return self._store().save_delta(path)
+
+    # ---- versioned publishing (artifacts.ArtifactStore — the xbox
+    # day/delta publish flow, docs/RESILIENCE.md §Publishing) ----
+    # Two-phase flag discipline: the save STAGES with
+    # clear_touched=False (writer callables dump straight into the
+    # store's stage dir), and the delta bookkeeping is cleared only
+    # AFTER the publish commits — a publish that fails (or crashes)
+    # between the two loses no delta rows; the retry re-exports them.
+
+    def _publish_store(self):
+        """The staged-publish capability check: a clear error up front
+        beats a TypeError from inside the stage writer for table types
+        whose save surface predates the two-phase kwargs."""
+        store = self._store()
+        if not hasattr(store, "clear_touched_flags"):
+            raise TypeError(
+                f"{type(store).__name__} does not support staged "
+                "publishing — it needs save_base/save_delta("
+                "clear_touched=) plus clear_touched_flags() "
+                "(EmbeddingTable, HostStore and the tiered sharded "
+                "table have them); save to a file and publish the "
+                "path instead")
+        return store
+
+    def publish_base(self, artifacts, **meta) -> str:
+        """``save_base`` straight into a crash-safe artifact version;
+        returns the artifact id, which becomes the parent of the next
+        :meth:`publish_delta`."""
+        self._check_no_pass("publish_base")
+        store = self._publish_store()
+        self.fence()
+        refs = {}
+        manifest_fn = getattr(self.table, "spill_manifest", None)
+        if manifest_fn is not None:
+            m = manifest_fn()
+            if m:
+                refs["spill_manifest"] = {"digest": m.get("digest"),
+                                          "live_rows": m.get("live_rows")}
+        aid = artifacts.publish(
+            {"sparse.npz":
+             lambda p: store.save_base(p, clear_touched=False)},
+            kind="base", refs=refs,
+            meta={"pass_id": self.pass_id, "producer": "box_helper",
+                  **meta})
+        store.clear_touched_flags()   # the publish COMMITTED
+        self._published_tip = aid
+        return aid
+
+    def publish_delta(self, artifacts, **meta) -> str:
+        """``save_delta`` as a lineage-linked artifact version on top
+        of the last publish through THIS helper. Refuses without a
+        published parent — an unparented delta could never be
+        chain-verified by a consumer (serving.ServingModel.adopt)."""
+        parent = getattr(self, "_published_tip", None)
+        if parent is None:
+            from paddlebox_tpu.artifacts import ArtifactLineageError
+            raise ArtifactLineageError(
+                "publish_delta before any publish_base — the delta "
+                "would have no verifiable parent version")
+        self._check_no_pass("publish_delta")
+        store = self._publish_store()
+        self.fence()
+        aid = artifacts.publish(
+            {"sparse_delta.npz":
+             lambda p: store.save_delta(p, clear_touched=False)},
+            kind="delta", parent=parent,
+            meta={"pass_id": self.pass_id, "producer": "box_helper",
+                  **meta})
+        store.clear_touched_flags()   # the publish COMMITTED
+        self._published_tip = aid
+        return aid
 
     def _check_no_pass(self, what: str) -> None:
         """Refuse host-tier mutation BEFORE applying it when a pass is

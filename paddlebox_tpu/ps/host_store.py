@@ -454,24 +454,33 @@ class HostStore:
         out["keys"] = tk
         return out
 
-    def save_base(self, path: str) -> int:
+    def save_base(self, path: str, clear_touched: bool = True) -> int:
         """Full model dump — includes rows currently spilled to the disk
-        tier, so the exported base is always the COMPLETE model."""
+        tier, so the exported base is always the COMPLETE model.
+        ``clear_touched=False`` = a STAGED export (artifact publish):
+        the delta bookkeeping survives until the publish commits, so a
+        failed publish loses nothing (``clear_touched_flags`` is the
+        post-commit half)."""
         self._barrier()
         with self._lock:
             keys, rows = self.index.items()
-            n = self._dump(path, keys, rows, extra=self._ssd_extra())
-            self._touched[:] = False
+            n = self._dump(path, keys, rows,
+                           extra=self._ssd_extra(
+                               clear_touched=clear_touched))
+            if clear_touched:
+                self._touched[:] = False
         log.info("save_base: %d rows -> %s", n, path)
         return n
 
     # ---- in-memory export/import (sharded single-file save format) ----
-    def export_rows(self, delta: bool = False
+    def export_rows(self, delta: bool = False, clear_touched: bool = True
                     ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
         """(keys, {field: values}) snapshot — base includes disk-tier
         rows so the export is the COMPLETE model; ``delta`` restricts to
         rows touched since the last export/save (including tier rows
-        demoted with un-exported updates) and clears their flags."""
+        demoted with un-exported updates) and clears their flags —
+        unless ``clear_touched=False`` (staged artifact publish; see
+        save_base)."""
         self._barrier()
         with self._lock:
             keys, rows = self.index.items()
@@ -479,16 +488,50 @@ class HostStore:
                 m = self._touched[rows]
                 keys, rows = keys[m], rows[m]
             out = {f: self._arr[f][rows].copy() for f in self.fields}
-            extra = self._ssd_extra(delta=delta)
+            extra = self._ssd_extra(delta=delta,
+                                    clear_touched=clear_touched)
             if extra is not None:
                 keys = np.concatenate([keys, extra["keys"]])
                 for f in self.fields:
                     out[f] = np.concatenate([out[f], extra[f]])
-            if not delta:
-                self._touched[:] = False
-            else:
-                self._touched[rows] = False
+            if clear_touched:
+                if not delta:
+                    self._touched[:] = False
+                else:
+                    self._touched[rows] = False
         return keys, out
+
+    def clear_touched_flags(self) -> None:
+        """Post-commit half of a STAGED export: clear the delta
+        bookkeeping for every row, RAM and disk tier alike. Call only
+        between passes (the publish protocol fences first) — a staged
+        ``save_*(clear_touched=False)`` followed by this on publish
+        success is equivalent to the plain clearing save, but a publish
+        failure in between loses no delta rows."""
+        self._barrier()
+        with self._lock:
+            self._touched[:] = False
+            if self.ssd is not None:
+                self.ssd.clear_touched()
+
+    def rows_digest(self) -> str:
+        """sha256 over the store's COMPLETE logical content (RAM + disk
+        tier), keyed and sorted by feasign so row-assignment order
+        cancels out. Read-only: rides ``export_rows(clear_touched=
+        False)``, so it fingerprints exactly what a base export would
+        dump while clearing no delta bookkeeping. The bit-identity
+        oracle of the publish gates (scripts/publish_check.py,
+        scripts/chaos_check.py)."""
+        import hashlib
+        keys, out = self.export_rows(clear_touched=False)
+        order = np.argsort(keys)
+        h = hashlib.sha256()
+        h.update(np.ascontiguousarray(keys[order]).tobytes())
+        for f in sorted(out):
+            h.update(f.encode())
+            h.update(np.ascontiguousarray(
+                out[f][order], np.float32).tobytes())
+        return h.hexdigest()
 
     def import_rows(self, keys: np.ndarray, fields: Dict[str, np.ndarray],
                     merge: bool = False) -> int:
@@ -599,14 +642,18 @@ class HostStore:
             self._touched[rows_new] = True   # new rows to the tier
         return len(keys)
 
-    def save_delta(self, path: str) -> int:
+    def save_delta(self, path: str, clear_touched: bool = True) -> int:
+        """Touched-rows dump ("xbox delta"); ``clear_touched=False`` =
+        staged artifact publish (see save_base)."""
         self._barrier()
         with self._lock:
             keys, rows = self.index.items()
             m = self._touched[rows]
             n = self._dump(path, keys[m], rows[m],
-                           extra=self._ssd_extra(delta=True))
-            self._touched[:] = False
+                           extra=self._ssd_extra(
+                               delta=True, clear_touched=clear_touched))
+            if clear_touched:
+                self._touched[:] = False
         log.info("save_delta: %d rows -> %s", n, path)
         return n
 

@@ -523,6 +523,19 @@ class SsdTier:
                     self._index[int(k)] = (sid, off, False)
             return fkeys, rows, tch
 
+    def clear_touched(self) -> int:
+        """Drop the pending-delta bit from every tier row — the
+        post-commit half of a STAGED export (HostStore.
+        clear_touched_flags): index-only, no segment IO. Returns how
+        many rows were marked."""
+        n = 0
+        with self._lock:
+            for k, (sid, off, tch) in list(self._index.items()):
+                if tch:
+                    self._index[k] = (sid, off, False)
+                    n += 1
+        return n
+
     def discard(self, keys: np.ndarray) -> int:
         """Drop keys from the tier (shrink-deleted features, superseded
         demote snapshots) — their rows go dead; no stale copy can
@@ -644,7 +657,7 @@ class SsdTier:
             for s in segs:   # sealed => immutable: hash once, reuse
                 if s.sha256 is None:
                     s.sha256 = _io_retry().call(file_sha256, s.path)
-            return {
+            m = {
                 "width": self.width,
                 "live_rows": len(self._index),
                 "segments": [{
@@ -654,6 +667,13 @@ class SsdTier:
                     "live": int(s.live),
                 } for s in segs],
             }
+            # one digest NAMING this tier state — what an artifact
+            # manifest records as its spill-manifest REFERENCE
+            # (artifacts.py refs block): location-independent (segment
+            # basenames, not paths), so the same tier content yields
+            # the same reference wherever the registry lives
+            m["digest"] = manifest_digest(m)
+            return m
 
     # ---- telemetry -----------------------------------------------------
     _MIRRORED = (("demoted_rows", "pbox_ssd_demoted_rows_total",
@@ -697,6 +717,24 @@ class SsdTier:
                           st["live_rows"])
         except Exception:
             log.debug("ssd telemetry mirror failed", exc_info=True)
+
+
+def manifest_digest(manifest: dict) -> str:
+    """Stable sha256 naming a spill manifest's CONTENT: the sorted
+    (segment basename, sha256, rows) triples + width/live_rows. Used
+    as the spill-manifest reference in artifact manifests
+    (artifacts.py / train/checkpoint._publish_artifact) — two
+    checkpoints whose tiers hold the same bytes reference the same
+    digest, path layout notwithstanding."""
+    h = hashlib.sha256()
+    h.update(f"w{manifest.get('width')}:n{manifest.get('live_rows')}"
+             .encode())
+    for seg in sorted(manifest.get("segments", []),
+                      key=lambda s: os.path.basename(s["path"])):
+        h.update(os.path.basename(seg["path"]).encode())
+        h.update(str(seg["sha256"]).encode())
+        h.update(str(seg.get("rows", 0)).encode())
+    return h.hexdigest()
 
 
 def verify_manifest(manifest: dict) -> List[str]:

@@ -1152,6 +1152,16 @@ class EmbeddingTable:
         np.savez_compressed(path, keys=keys, **data)
         return len(keys)
 
+    def clear_touched_flags(self) -> None:
+        """Post-commit half of a STAGED export (artifacts publish,
+        BoxPSHelper.publish_*): a ``save_*(clear_touched=False)`` into
+        the stage dir followed by this after the publish COMMITS is
+        equivalent to the plain clearing save — but a publish failure
+        in between loses no delta rows (the flags survive for the
+        retry). Call only between passes."""
+        with self.host_lock:
+            self._touched[:] = False
+
     def _assign_file_rows(self, keys: np.ndarray,
                           slots_b: np.ndarray) -> np.ndarray:
         """Assign rows for a save-file's keys — slotted when the arena is

@@ -280,8 +280,30 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
                 shards[str(s)] = m
         if not shards:
             return None
+        import hashlib
+        # merged reference digest: fold the per-shard tier digests in
+        # shard order — the one name an artifact manifest records for
+        # this whole table's spill state (artifacts.py refs block)
+        h = hashlib.sha256()
+        for s in sorted(shards, key=int):
+            h.update(f"{s}:{shards[s].get('digest', '')}".encode())
         return {"version": 1, "shards": shards,
-                "live_rows": sum(m["live_rows"] for m in shards.values())}
+                "live_rows": sum(m["live_rows"] for m in shards.values()),
+                "digest": h.hexdigest()}
+
+    def rows_digest(self) -> str:
+        """Full-model fingerprint: the shard host stores' read-only
+        ``rows_digest`` folded in shard order (fences first so every
+        in-flight write-back is included). Publish gates compare a
+        consumer's adopted state against this."""
+        import hashlib
+        self.fence()
+        h = hashlib.sha256()
+        for s, host in enumerate(self.hosts):
+            if host is None:
+                continue
+            h.update(f"{s}:{host.rows_digest()}".encode())
+        return h.hexdigest()
 
     def has_spilled_rows(self) -> bool:
         """Cheap guard for the preloader's promote prefetch: True when
@@ -1032,15 +1054,18 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
     def feature_count(self) -> int:
         return sum(len(h) for h in self.hosts)
 
-    def save_base(self, path: str) -> int:
+    def save_base(self, path: str, clear_touched: bool = True) -> int:
         """Full model dump, single file, ShardedEmbeddingTable._dump
         format (n + keys_s/field_s blocks, + opt_ext_s) — includes
-        disk-spilled rows (SaveBase, box_wrapper.cc:1383)."""
+        disk-spilled rows (SaveBase, box_wrapper.cc:1383).
+        ``clear_touched=False`` = staged artifact publish: the delta
+        bookkeeping survives until the publish commits
+        (``clear_touched_flags`` is the post-commit half)."""
         self._no_pass("save_base")
         blobs: Dict[str, np.ndarray] = {}
         total = 0
         for s, hs in enumerate(self.hosts):
-            keys, fields = hs.export_rows()
+            keys, fields = hs.export_rows(clear_touched=clear_touched)
             blobs[f"keys_{s}"] = keys
             for f, v in fields.items():
                 blobs[f"{f}_{s}"] = v
@@ -1049,13 +1074,15 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         log.info("tiered save_base: %d rows -> %s", total, path)
         return total
 
-    def save_delta(self, path: str) -> int:
-        """Rows written back since the last save ("xbox delta")."""
+    def save_delta(self, path: str, clear_touched: bool = True) -> int:
+        """Rows written back since the last save ("xbox delta");
+        ``clear_touched=False`` = staged artifact publish (save_base)."""
         self._no_pass("save_delta")
         blobs: Dict[str, np.ndarray] = {}
         total = 0
         for s, hs in enumerate(self.hosts):
-            keys, fields = hs.export_rows(delta=True)
+            keys, fields = hs.export_rows(delta=True,
+                                          clear_touched=clear_touched)
             blobs[f"keys_{s}"] = keys
             for f, v in fields.items():
                 blobs[f"{f}_{s}"] = v
@@ -1063,6 +1090,14 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
         np.savez_compressed(path, n=self.n, **blobs)
         log.info("tiered save_delta: %d rows -> %s", total, path)
         return total
+
+    def clear_touched_flags(self) -> None:
+        """Post-commit half of a staged publish: clear every shard's
+        delta bookkeeping (RAM + disk tier). Fences first."""
+        self.fence()
+        for hs in self.hosts:
+            if hs is not None:
+                hs.clear_touched_flags()
 
     def load(self, path: str, merge: bool = False) -> int:
         self._no_pass("load")

@@ -44,6 +44,19 @@ site                        seam
                             failures surface through the demote/promote
                             caller (the epilogue fence for background
                             demotes — never silent zeros)
+``artifact.publish``        just before the atomic rename that publishes
+                            an artifact version (artifacts.py): a
+                            transient ``fail`` retries on the seeded
+                            RetryPolicy (site ``artifact.publish``), a
+                            ``crash`` models the writer dying after
+                            staging — recovery is the carcass sweep +
+                            the previous complete version
+``artifact.read``           every registry read (manifest, sidecar,
+                            payload digest) on the consumer side:
+                            ``corrupt`` mangles the bytes so the
+                            checksum chain refuses the version
+                            (``ArtifactCorruptError``) and adoption
+                            degrades to the newest verifiable one
 ``stream.window``           each streaming window dispatch (windowed
                             ``QueueDataset``, data/dataset.py): fires as
                             a window's readers are about to start, ctx

@@ -20,6 +20,7 @@ jit-compiled per batch bucket; suitable for a CPU host or a TPU chip.
 
 from __future__ import annotations
 
+import os
 import pickle
 from typing import Optional
 
@@ -27,6 +28,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from paddlebox_tpu.artifacts import (ArtifactLineageError,
+                                     manifest_beside, verify_payload)
 from paddlebox_tpu.data.batch import SlotBatch
 from paddlebox_tpu.data.schema import DataFeedDesc
 from paddlebox_tpu.ops import fused_seqpool_cvm
@@ -74,19 +77,149 @@ class ServingModel:
         self._fwd = _fwd  # jit retraces per batch-bucket shape itself
 
     # ---- artifact loading ----
+    # Published-version state (artifacts.py): the id the loaded state
+    # descends from, and the open handle's lease when adopted through
+    # an ArtifactStore — docs/RESILIENCE.md §Publishing.
+    _adopted_aid: Optional[str] = None
+    _handle = None
+
+    def _verify_managed(self, path: str, parent_check: bool) -> Optional[str]:
+        """When ``path`` sits inside a published version dir (a
+        MANIFEST.json lives next to it), verify the payload's sha256
+        and — for deltas — that the version's parent IS the currently
+        loaded version. Returns the manifest's artifact id, or None
+        for a plain legacy file. Refuses LOUDLY on any mismatch: an
+        out-of-order / wrong-parent / bit-flipped delta must never
+        merge silently (ISSUE 14 satellite)."""
+        m = manifest_beside(path)   # raises ArtifactCorruptError if torn
+        if m is None:
+            if parent_check and self._adopted_aid is not None:
+                raise ArtifactLineageError(
+                    f"refusing unmanaged delta {path}: this model was "
+                    f"adopted from artifact {self._adopted_aid} and a "
+                    "manifest-less file cannot be lineage-verified — "
+                    "publish the delta or load_base a fresh state")
+            return None
+        verify_payload(m, path)     # sha256 — refuses corrupt payloads
+        if parent_check and m.get("parent") != self._adopted_aid:
+            raise ArtifactLineageError(
+                f"refusing out-of-order delta {os.path.basename(path)}: "
+                f"artifact {m.get('artifact')} descends from "
+                f"{m.get('parent')!r} but the loaded state is "
+                f"{self._adopted_aid!r} — apply the chain in lineage "
+                "order")
+        return m.get("artifact")
+
     def load_base(self, path: str) -> int:
-        """Replace the table with a save_base artifact."""
+        """Replace the table with a save_base artifact. A base inside a
+        published version dir is checksum-verified first and pins the
+        lineage every later ``apply_delta`` must extend."""
+        aid = self._verify_managed(path, parent_check=False)
         n = self.table.load(path, merge=False)
+        self._adopted_aid = aid
+        self._rebase_handle(aid)
         self._host_data = None
-        log.info("serving: loaded base %s (%d rows)", path, n)
+        log.info("serving: loaded base %s (%d rows%s)", path, n,
+                 f", artifact {aid}" if aid else "")
         return n
 
     def apply_delta(self, path: str) -> int:
-        """Apply a save_delta artifact on top (incremental row updates)."""
+        """Apply a save_delta artifact on top (incremental row updates).
+
+        Deltas published through the artifact layer are verified BEFORE
+        they touch the table: payload sha256 against the manifest, and
+        the manifest's parent link against the currently loaded
+        version — a wrong-parent or bit-flipped delta raises
+        (``ArtifactLineageError`` / ``ArtifactCorruptError``) instead
+        of silently merging. Plain legacy files (no MANIFEST.json next
+        to them) keep the unverified behavior — unless the loaded
+        state itself came from an artifact, in which case an
+        unverifiable delta is refused too."""
+        aid = self._verify_managed(path, parent_check=True)
         n = self.table.load(path, merge=True)
+        if aid is not None:
+            self._adopted_aid = aid
+        self._rebase_handle(self._adopted_aid)
         self._host_data = None
-        log.info("serving: applied delta %s (%d rows)", path, n)
+        log.info("serving: applied delta %s (%d rows%s)", path, n,
+                 f", artifact {aid}" if aid else "")
         return n
+
+    def _rebase_handle(self, aid: Optional[str]) -> None:
+        """Path-based loads rebase the lineage; a handle still leasing
+        the PREVIOUS version would silently pin it (and its chain)
+        against retention while nothing serves from it — drop the
+        lease unless the handle matches the new state."""
+        if self._handle is not None and self._handle.aid != aid:
+            self._handle.close()
+            self._handle = None
+
+    # ---- store adoption (the lease-fenced consumer path) ----
+    def adopt(self, store, version: Optional[str] = None) -> str:
+        """Adopt a published version from an ``ArtifactStore``: takes a
+        reader lease, verifies the FULL checksum+lineage chain before
+        touching any state, then loads base → deltas (and the dense
+        params when the version carries them). With ``version=None``
+        adopts the newest VERIFIABLE version (corrupt tips are refused
+        loudly and skipped). Returns the adopted artifact id; the lease
+        is held until ``release()``/the next ``adopt`` so retention can
+        never sweep the version mid-serve."""
+        handle = store.open(version)
+        self._load_from(handle, start=0, fresh=True)
+        log.info("serving: adopted artifact %s (chain %s)", handle.aid,
+                 [m["artifact"] for m in handle.chain])
+        return handle.aid
+
+    def _load_from(self, handle, start: int, fresh: bool) -> None:
+        """Load a (suffix of a) verified chain from an open handle,
+        then swap it in as the held lease. The handle is closed on any
+        failure — no lease leaks, and the caller's old handle stays
+        live until the new state fully loaded."""
+        try:
+            first = fresh
+            for m in handle.chain[start:]:
+                name = ("sparse.npz" if m["kind"] == "base"
+                        else "sparse_delta.npz")
+                self.table.load(handle.path(name, m["artifact"]),
+                                merge=not first)
+                first = False
+            if "dense.pkl" in handle.manifest.get("files", {}):
+                self.load_dense(handle.path("dense.pkl"))
+        except BaseException:
+            handle.close()
+            raise
+        if self._handle is not None:
+            self._handle.close()
+        self._handle = handle
+        self._adopted_aid = handle.aid
+        self._host_data = None
+
+    def hot_reload(self, store) -> Optional[str]:
+        """Advance to the newest verifiable version, applying ONLY the
+        new deltas when its chain extends the adopted state (the
+        delta hot-reload path); falls back to a full re-adopt when the
+        lineage diverged. No-op (returns None) when already current."""
+        handle = store.open()
+        if handle.aid == self._adopted_aid:
+            handle.close()
+            return None
+        chain_ids = [m["artifact"] for m in handle.chain]
+        if self._adopted_aid in chain_ids:
+            # the new tip extends us: apply only the new deltas
+            self._load_from(
+                handle, start=chain_ids.index(self._adopted_aid) + 1,
+                fresh=False)
+        else:
+            # diverged lineage (rollback / new base): full re-adopt
+            self._load_from(handle, start=0, fresh=True)
+        log.info("serving: hot-reloaded to artifact %s", handle.aid)
+        return handle.aid
+
+    def release(self) -> None:
+        """Drop the artifact lease (retention may sweep the version)."""
+        if self._handle is not None:
+            self._handle.close()
+            self._handle = None
 
     def load_dense(self, path: str) -> None:
         """Load dense params — accepts the trainer's ``.dense.pkl``

@@ -86,7 +86,9 @@ def _fsync_path(path: str) -> None:
 
 
 class CheckpointManager:
-    def __init__(self, root: str, keep: int = 3) -> None:
+    def __init__(self, root: str, keep: int = 3,
+                 artifacts=None) -> None:
+        from paddlebox_tpu.config import FLAGS
         self.root = root
         self.keep = keep
         # the step this manager's TRAINER STATE descends from: set by
@@ -97,6 +99,29 @@ class CheckpointManager:
         # abandoned state into the restore).
         self._lineage_tip: Optional[int] = None
         os.makedirs(root, exist_ok=True)
+        # reader leases (artifacts.LeaseRegistry): restore() holds one
+        # while it adopts a chain, external readers (serving loads,
+        # consensus restores) take one via lease(step) — and _retain
+        # routes every sweep decision through them, so a concurrent
+        # adoption can never have its chain deleted underneath it
+        from paddlebox_tpu.artifacts import ArtifactStore, LeaseRegistry
+        self._leases = LeaseRegistry(
+            os.path.join(root, ".leases"),
+            ttl_sec=FLAGS.artifact_lease_ttl_sec)
+        # optional publishing layer (docs/RESILIENCE.md §Publishing):
+        # boundary checkpoints — incl. train_stream stream-boundary
+        # saves — also publish as lineage-linked ArtifactStore versions
+        if artifacts is None and FLAGS.artifact_root:
+            artifacts = FLAGS.artifact_root
+        if isinstance(artifacts, str):
+            artifacts = ArtifactStore(artifacts,
+                                      keep=FLAGS.artifact_keep)
+        self.artifacts = artifacts
+        #: last artifact this manager's lineage published/adopted —
+        #: the parent link for the next boundary delta publish — and
+        #: the checkpoint step it snapshots
+        self._artifact_tip: Optional[str] = None
+        self._artifact_tip_step: Optional[int] = None
         self._recover()
 
     def _recover(self) -> None:
@@ -344,6 +369,31 @@ class CheckpointManager:
         _fsync_path(self.root)  # persist the publish rename itself
         self._lineage_tip = step
         self._write_latest(step)
+        # BOUNDARY checkpoints (no cursor, or a stream cursor with an
+        # empty open window) also publish into the artifact store when
+        # one is attached — the day/delta "xbox publish" flow serving
+        # consumes (artifacts.py; docs/RESILIENCE.md §Publishing).
+        # Mid-pass cursor saves stay checkpoint-only: a consumer must
+        # never adopt a state whose pass is half trained.
+        stream = cursor.get("stream") if cursor else None
+        is_boundary = cursor is None or (
+            isinstance(stream, dict) and not stream.get("window_files"))
+        if self.artifacts is not None and is_boundary:
+            # best-effort: the checkpoint above is already DURABLE — a
+            # registry hiccup (ENOSPC, exhausted retries) must not fail
+            # the save; the next boundary publish backfills the gap.
+            # An InjectedCrash still propagates: it models the process
+            # dying, not the registry failing.
+            try:
+                self._publish_artifact(final, step, delta,
+                                       prev_step=prev_step)
+            except faults.InjectedCrash:
+                raise
+            except Exception as e:
+                log.error(
+                    "artifact publish failed at step %d (checkpoint "
+                    "is durable; the next boundary publish will "
+                    "backfill the chain): %r", step, e)
         self._retain()
         log.info("checkpoint %s saved at step %d (%d sparse rows%s)",
                  "delta" if delta else "base", step, n,
@@ -361,6 +411,169 @@ class CheckpointManager:
                 pass  # best-effort (FUSE): rename stays atomic
         os.replace(tmp, os.path.join(self.root, "LATEST"))
 
+    # ---- artifact publishing (artifacts.py) ----------------------------
+    def _step_artifact_map(self) -> Dict[int, str]:
+        """{step: newest published aid} for THIS checkpoint root — ONE
+        scan over the store serves a whole backfill/lookup, instead of
+        one scan per chain step. The root scope matters: several jobs
+        may share one store and step counters overlap — matching on
+        step alone could cross-link lineages."""
+        me = os.path.abspath(self.root)
+        out: Dict[int, str] = {}
+        for aid in self.artifacts.versions():   # epoch order: newest
+            try:                                # wins per step
+                m = self.artifacts.read_manifest(aid, verify=False)
+            except Exception:
+                continue
+            meta = m.get("meta", {})
+            if (meta.get("producer") == "checkpoint"
+                    and meta.get("root") == me
+                    and meta.get("step") is not None):
+                out[meta["step"]] = aid
+        return out
+
+    def _lookup_step_artifact(self, step: int) -> Optional[str]:
+        return self._step_artifact_map().get(step)
+
+    def _is_boundary_step(self, step: int) -> bool:
+        """Whether ``ckpt-<step>`` is a BOUNDARY checkpoint (no cursor,
+        or a stream cursor with an empty open window) — the
+        latest_boundary_step rule, for one step."""
+        path = os.path.join(self._dir(step), "cursor.json")
+        if not os.path.isfile(path):
+            return True
+        try:
+            with open(path) as fh:
+                stream = json.load(fh).get("stream")
+        except (OSError, ValueError, AttributeError):
+            return False
+        return isinstance(stream, dict) \
+            and not stream.get("window_files")
+
+    def _backfill_artifacts(self, chain: List[int],
+                            boundaries_only: bool = False
+                            ) -> Optional[str]:
+        """Publish the checkpoint-chain steps missing from the store,
+        oldest first, parent-linking successively — the chain-heal
+        path. Used (a) by ``restore()`` onto a step that never
+        published (publishing would otherwise halt until the next base
+        — and linking past the gap would lose the gap's rows), with
+        the FULL chain so the restored state is exactly representable;
+        and (b) before a delta publish whose predecessor boundary
+        failed to publish, with ``boundaries_only=True`` (mid-pass
+        deltas are subsets of their boundary's cumulative delta, so
+        only unpublished BOUNDARIES break the chain). Leaves
+        ``_artifact_tip`` at the newest published link."""
+        start = 0
+        self._artifact_tip = self._artifact_tip_step = None
+        published = self._step_artifact_map()   # ONE store scan
+        for i in reversed(range(len(chain))):
+            aid = published.get(chain[i])
+            if aid is not None:
+                self._artifact_tip = aid
+                self._artifact_tip_step = chain[i]
+                start = i + 1
+                break
+        for s in chain[start:]:
+            if boundaries_only and not self._is_boundary_step(s):
+                continue
+            try:
+                meta = self._meta(s)
+            except Exception as e:
+                log.warning("artifact backfill stopped at step %d "
+                            "(%r)", s, e)
+                break
+            if self._publish_artifact(
+                    self._dir(s), s, meta.get("kind") == "delta",
+                    prev_step=meta.get("prev_step"),
+                    backfill=True) is None:
+                break
+        return self._artifact_tip
+
+    def _publish_artifact(self, final: str, step: int, delta: bool,
+                          prev_step: Optional[int] = None,
+                          backfill: bool = False) -> Optional[str]:
+        """Publish the just-committed boundary checkpoint dir as an
+        artifact version. Payloads hardlink (same filesystem) so the
+        publish is metadata-cost; the files are immutable once the
+        checkpoint committed. A delta links to the last artifact this
+        lineage published — sound because boundary deltas are
+        cumulative since the previous boundary CLEAR (mid-pass saves
+        never clear the touched set). When the predecessor boundary
+        never published (fresh manager, or its publish failed), the
+        chain heals first via ``_backfill_artifacts`` — linking past
+        an unpublished boundary would silently drop its rows from the
+        artifact chain."""
+        kind = "delta" if delta else "base"
+        parent = None
+        if delta:
+            if not backfill and prev_step is not None and (
+                    self._artifact_tip is None
+                    or self._artifact_tip_step != prev_step):
+                # the step we descend from has no published artifact
+                # under our tip: publish any missing BOUNDARY
+                # ancestors before linking (a tip pointing at the last
+                # boundary while prev_step is a mid-pass save is the
+                # benign case — backfill finds it published and
+                # changes nothing)
+                try:
+                    chain = self._chain(prev_step)
+                except Exception:
+                    chain = []
+                if chain:
+                    self._backfill_artifacts(chain,
+                                             boundaries_only=True)
+            parent = self._artifact_tip
+            if parent is None:
+                log.warning(
+                    "artifact publish skipped at step %d: delta has no "
+                    "published parent in %s (publish a base first)",
+                    step, self.artifacts.root)
+                return None
+        files = {name: os.path.join(final, name)
+                 for name in sorted(os.listdir(final))
+                 if os.path.isfile(os.path.join(final, name))}
+        refs: Dict[str, object] = {}
+        spill = os.path.join(final, "spill_manifest.json")
+        if os.path.isfile(spill):
+            try:
+                with open(spill) as fh:
+                    m = json.load(fh)
+                refs["spill_manifest"] = {
+                    "file": "spill_manifest.json",
+                    "digest": m.get("digest"),
+                    "live_rows": m.get("live_rows"),
+                    "shards": len(m.get("shards", {}))}
+            except (OSError, ValueError):
+                pass
+        cpath = os.path.join(final, "cursor.json")
+        if os.path.isfile(cpath):
+            try:
+                with open(cpath) as fh:
+                    cur = json.load(fh)
+                stream = cur.get("stream") or {}
+                refs["cursor"] = {
+                    "file": "cursor.json",
+                    "files_completed": len(
+                        stream.get("files_completed", []) or []),
+                    "windows_completed": stream.get("windows_completed"),
+                    "global_step": cur.get("global_step")}
+            except (OSError, ValueError):
+                pass
+        boundary = self._is_boundary_step(step)
+        aid = self.artifacts.publish(
+            files, kind=kind, parent=parent, refs=refs,
+            # mid-pass links (restore backfill) are chain-only: an
+            # unpinned reader must never land on a half-trained pass
+            adoptable=boundary,
+            meta={"step": step, "producer": "checkpoint",
+                  "root": os.path.abspath(self.root),
+                  "boundary": boundary})
+        self._artifact_tip = aid
+        self._artifact_tip_step = step
+        self.artifacts.retain()
+        return aid
+
     def _latest_base(self) -> Optional[int]:
         for s in reversed(self.steps()):
             try:
@@ -376,11 +589,38 @@ class CheckpointManager:
         """True once a base checkpoint exists (delta saves are legal)."""
         return self._latest_base() is not None
 
+    # ---- reader leases (artifacts.py; docs/RESILIENCE.md §Publishing) --
+    @staticmethod
+    def _lease_name(step: int) -> str:
+        return f"step-{step}"
+
+    def lease(self, step: int):
+        """Claim ``ckpt-<step>`` against retention while adopting it —
+        ``with cm.lease(step): ...`` around any out-of-manager read
+        (serving load, consensus restore staging). ``restore()`` takes
+        one itself. The returned ``Lease`` fences: after a stale-reap,
+        its ``check()``/``heartbeat()`` raise ``ArtifactLeaseLostError``
+        instead of letting the reader serve from swept files."""
+        return self._leases.acquire(self._lease_name(step))
+
+    def _leased_steps(self) -> set:
+        out = set()
+        for name in self._leases.active_names():
+            if name.startswith("step-"):
+                try:
+                    out.add(int(name[5:]))
+                except ValueError:
+                    pass
+        return out
+
     def _retain(self) -> None:
         # finish/clean interrupted re-saves too (same logic as init):
         # a long-running process otherwise accumulates aside dirs from
         # crashes it survived without re-instantiating the manager
         self._recover()
+        # provably-stale leases (dead same-host pid / heartbeat older
+        # than the TTL) are reaped; LIVE leases defer deletion below
+        self._leases.reap_stale()
         # sweep half-deleted carcasses: steps() hides meta-less dirs
         # from restore, but their payloads (GBs of sparse.npz) must
         # not accumulate on disk forever
@@ -400,6 +640,15 @@ class CheckpointManager:
         if len(steps) <= self.keep:
             return
         kept = set(steps[-self.keep:])
+        # a LEASED step is mid-adoption somewhere (serving load,
+        # consensus restore, a restore() in flight) — deleting it (or
+        # its chain, closed over below) would hand that reader a
+        # half-deleted checkpoint; the lease defers the sweep
+        leased = self._leased_steps() & set(steps)
+        if leased:
+            log.info("retention deferring %s (held leases)",
+                     sorted(leased))
+            kept |= leased
         # a delta restores by replaying its base + EVERY intermediate
         # delta (each delta covers only rows touched since the previous
         # save) — the whole chain of every kept checkpoint must survive
@@ -409,7 +658,8 @@ class CheckpointManager:
             except (OSError, ValueError, KeyError):
                 pass  # broken/half-deleted link: keep what we can
         for s in steps:
-            if s not in kept:
+            if s not in kept and not self._leases.held(
+                    self._lease_name(s)):   # late-lease re-check
                 shutil.rmtree(self._dir(s), ignore_errors=True)
 
     # ---- mid-pass cursor (docs/RESILIENCE.md §Preemption) ----
@@ -514,27 +764,31 @@ class CheckpointManager:
         target = self.latest_step() if step is None else step
         if target is None:
             return None
-        chain = self._chain(target)
-        for s in chain:  # verify the WHOLE chain before touching state
-            self.verify(s)
-        self._verify_spill_manifest(target)
-        first = True
-        for s in chain:
-            d = self._dir(s)
-            meta = self._meta(s)
-            if meta["kind"] == "base":
-                trainer.table.load(os.path.join(d, "sparse.npz"),
-                                   merge=not first)
-            else:
-                trainer.table.load(os.path.join(d, "sparse_delta.npz"),
-                                   merge=True)
-            first = False
-        def read_dense():
-            path = os.path.join(self._dir(target), "dense.pkl")
-            faults.inject("checkpoint.io", path=path)
-            with open(path, "rb") as fh:
-                return pickle.load(fh)
-        params, opt_state, auc = _io_retry().call(read_dense)
+        # lease the target for the whole adoption: a concurrent
+        # _retain (another process sharing this root) must defer the
+        # sweep of this chain until the restore finishes
+        with self.lease(target):
+            chain = self._chain(target)
+            for s in chain:  # verify the WHOLE chain before touching state
+                self.verify(s)
+            self._verify_spill_manifest(target)
+            first = True
+            for s in chain:
+                d = self._dir(s)
+                meta = self._meta(s)
+                if meta["kind"] == "base":
+                    trainer.table.load(os.path.join(d, "sparse.npz"),
+                                       merge=not first)
+                else:
+                    trainer.table.load(os.path.join(d, "sparse_delta.npz"),
+                                       merge=True)
+                first = False
+            def read_dense():
+                path = os.path.join(self._dir(target), "dense.pkl")
+                faults.inject("checkpoint.io", path=path)
+                with open(path, "rb") as fh:
+                    return pickle.load(fh)
+            params, opt_state, auc = _io_retry().call(read_dense)
         if hasattr(trainer, "dense_snapshot"):
             # the trainer handles placement itself (pod staging) — a
             # device_put here would just round-trip device→host→device
@@ -544,6 +798,31 @@ class CheckpointManager:
                                   jax.device_put(opt_state),
                                   jax.device_put(auc), target)
         self._lineage_tip = target
+        if self.artifacts is not None:
+            # the next boundary delta publish must link to the artifact
+            # of the state we now descend from. A restore onto a step
+            # that never published (e.g. a mid-pass crash checkpoint)
+            # BACKFILLS the missing chain links from the checkpoint
+            # dirs — publishing must neither halt until the next base
+            # nor link past the gap (the gap's rows would silently
+            # leave the artifact chain). Backfilled mid-pass links
+            # carry their cursor ref, marking them.
+            try:
+                tip = self._lookup_step_artifact(target)
+                if tip is not None:
+                    self._artifact_tip = tip
+                    self._artifact_tip_step = target
+                else:
+                    self._backfill_artifacts(chain)
+            except faults.InjectedCrash:
+                raise
+            except Exception as e:
+                # the trainer state is fully restored — a registry
+                # failure must not fail the restore; the next boundary
+                # publish re-attempts the backfill
+                log.error("artifact backfill failed after restore to "
+                          "step %d (will retry at the next boundary "
+                          "publish): %r", target, e)
         log.info("restored step %d (chain: %s)", target, chain)
         return target
 
@@ -616,6 +895,39 @@ class CheckpointManager:
                     "(deleted or lost) — restore an older base or resave")
             chain.insert(0, prev)
             cur = prev
+
+
+def adopt_artifact(trainer, store, version: Optional[str] = None
+                   ) -> Optional[int]:
+    """Restore a trainer FROM the artifact store alone (no checkpoint
+    root needed — the consumer side of the publish flow). Verifies the
+    full checksum chain before touching any state, holds the reader
+    lease across the whole adoption, and replays base → deltas exactly
+    like ``CheckpointManager.restore``. Returns the restored step.
+
+    With ``version=None`` this adopts the newest VERIFIABLE version —
+    corrupt tips are refused loudly (``ArtifactCorruptError`` logged +
+    ``pbox_artifact_refused_total``) and the adoption degrades to the
+    newest chain that checks out."""
+    with store.open(version) as h:
+        first = True
+        for m in h.chain:
+            name = ("sparse.npz" if m["kind"] == "base"
+                    else "sparse_delta.npz")
+            trainer.table.load(h.path(name, m["artifact"]),
+                               merge=not first)
+            first = False
+        with open(h.path("dense.pkl"), "rb") as fh:
+            params, opt_state, auc = pickle.load(fh)
+        step = int(h.manifest.get("meta", {}).get("step") or 0)
+    if hasattr(trainer, "dense_snapshot"):
+        trainer.restore_state(params, opt_state, auc, step)
+    else:
+        trainer.restore_state(jax.device_put(params),
+                              jax.device_put(opt_state),
+                              jax.device_put(auc), step)
+    log.info("adopted artifact %s (step %s)", h.aid, step)
+    return step
 
 
 def state_digest(trainer) -> str:

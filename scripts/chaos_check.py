@@ -10,12 +10,17 @@ injects, in ONE run:
 3. a mid-save checkpoint crash (the second ``save`` dies just before
    its atomic publish),
 4. a transient ``stream.window`` dispatch failure on a WINDOWED
-   streaming job (docs/RESILIENCE.md §Streaming), and
+   streaming job (docs/RESILIENCE.md §Streaming),
 5. ``ssd.io`` faults on a THREE-TIER (HBM+mem+SSD) table
    (docs/STORAGE.md): a transient segment write during demotion and a
    transient segment read during promotion (both retried on the seeded
    RetryPolicy), a hard CRASH mid-demotion, and a flipped byte in a
-   manifested segment file,
+   manifested segment file, and
+6. ``artifact.publish`` / ``artifact.read`` faults on the versioned
+   publishing layer (artifacts.py; docs/RESILIENCE.md §Publishing): a
+   transient publish failure retried on the seeded RetryPolicy, and a
+   hard-corrupt read of the newest version refused loudly with a
+   graceful fallback to its verifiable parent,
 
 then asserts full recovery:
 
@@ -61,8 +66,6 @@ def _run_ssd_chaos(workdir: str, seed: int) -> dict:
     1-mesh tiered trainer whose host stores hold more rows than the
     demote watermark allows, so segments exist and the checkpoint
     records a spill manifest — then injects the ``ssd.io`` seam."""
-    import hashlib
-
     import jax
     import numpy as np
     import optax
@@ -101,15 +104,10 @@ def _run_ssd_chaos(workdir: str, seed: int) -> dict:
                 for f in FIELDS}
 
     def digest(table) -> str:
-        h = hashlib.sha256()
-        for hs in table.hosts:
-            keys, fields = hs.export_rows()
-            order = np.argsort(keys)
-            h.update(np.ascontiguousarray(keys[order]).tobytes())
-            for f in sorted(fields):
-                h.update(np.ascontiguousarray(
-                    fields[f][order], np.float32).tobytes())
-        return h.hexdigest()
+        # the layer's own read-only fingerprint (ps/host_store
+        # rows_digest folded per shard) — unlike an export_rows walk
+        # it clears no touched flags, so digesting twice is inert
+        return table.rows_digest()
 
     table, tr = mk("tier1")
     keys = np.arange(1, 801, dtype=np.uint64)
@@ -191,6 +189,80 @@ def _run_ssd_chaos(workdir: str, seed: int) -> dict:
         "ssd_restored_step": int(restored),
         "ssd_rows": int(total0),
         "ssd_digest": digest0,
+    }
+
+
+def _run_artifact_chaos(workdir: str, seed: int) -> dict:
+    """Fault (6): the versioned artifact/publishing layer under chaos
+    (artifacts.py). A writer publishes a base+delta chain through
+    ``BoxPSHelper``; the transient ``artifact.publish`` failure must be
+    retried to success on the seeded RetryPolicy, and a hard-corrupt
+    ``artifact.read`` of the tip must refuse LOUDLY while unpinned
+    adoption gracefully falls back to the verifiable parent."""
+    import jax
+    import numpy as np
+
+    from paddlebox_tpu.artifacts import (ArtifactCorruptError,
+                                         ArtifactStore)
+    from paddlebox_tpu.ps import EmbeddingTable, SparseSGDConfig
+    from paddlebox_tpu.ps.box_helper import BoxPSHelper
+    from paddlebox_tpu.ps.table import FIELD_COL, TableState
+    from paddlebox_tpu.resilience.faults import FaultPlan, installed
+
+    cfg = SparseSGDConfig(mf_create_thresholds=1e9)
+    table = EmbeddingTable(mf_dim=4, capacity=1 << 10, cfg=cfg)
+    helper = BoxPSHelper(table)
+    store = ArtifactStore(os.path.join(workdir, "artifacts"))
+
+    def write(lo: int, hi: int, scale: float) -> None:
+        keys = np.arange(lo, hi, dtype=np.uint64)
+        rows = table.index.assign(keys)
+        data = np.asarray(jax.device_get(table.state.data)).copy()
+        data[rows, FIELD_COL["embed_w"]] = keys.astype(np.float32) * scale
+        table.state = TableState.from_logical(data, table.capacity)
+        table._touched[rows] = True
+
+    # (6a) transient publish failure — retried to a successful commit
+    write(1, 101, 2.0)
+    with installed(FaultPlan.parse("artifact.publish:fail:nth=1",
+                                   seed=seed)) as pp:
+        base_aid = helper.publish_base(store)
+    assert pp.stats()["artifact.publish:fail"]["fired"] == 1, pp.stats()
+    write(80, 151, 3.0)
+    delta_aid = helper.publish_delta(store)
+
+    # (6b) hard-corrupt read of the tip: every registry read of the
+    # delta version mangles — explicit adoption refuses LOUDLY, and
+    # unpinned adoption degrades to the verifiable base
+    loud = False
+    with installed(FaultPlan.parse(
+            f"artifact.read:corrupt:times=0,match=*{delta_aid}*",
+            seed=seed)) as pr:
+        try:
+            store.open(delta_aid)
+        except ArtifactCorruptError:
+            loud = True
+        with store.open() as h:
+            fallback_aid = h.aid
+            reader = EmbeddingTable(mf_dim=4, capacity=1 << 10, cfg=cfg)
+            reader.load(h.path("sparse.npz"), merge=False)
+    assert loud, "corrupt artifact read adopted silently"
+    assert fallback_aid == base_aid, (fallback_aid, base_aid)
+    probe = reader.host_pull(np.array([1], np.uint64))
+    assert np.allclose(probe[0, 2], 2.0), "fallback lost base rows"
+    # the repaired (fault-free) tip adopts normally again
+    with store.open() as h:
+        healthy_aid = h.aid
+    assert healthy_aid == delta_aid
+    return {
+        "artifact_base": base_aid,
+        "artifact_delta": delta_aid,
+        "artifact_publish_fault_fired":
+            pp.stats()["artifact.publish:fail"]["fired"],
+        "artifact_read_fault_stats": pr.stats(),
+        "artifact_corrupt_loud": loud,
+        "artifact_fallback": fallback_aid,
+        "artifact_healthy_tip": healthy_aid,
     }
 
 
@@ -297,6 +369,10 @@ def run_scenario(workdir: str, seed: int) -> dict:
         # around each injection so the op counting stays trivial)
         ssd_outcome = _run_ssd_chaos(workdir, seed)
 
+        # (6) artifact.publish / artifact.read seams on the versioned
+        # publishing layer (same sub-plan discipline)
+        artifact_outcome = _run_artifact_chaos(workdir, seed)
+
     # telemetry JSONL: final pass event carries nonzero counters
     with open(jsonl) as fh:
         events = [json.loads(line) for line in fh]
@@ -319,6 +395,7 @@ def run_scenario(workdir: str, seed: int) -> dict:
         surviving_records=len(ds),
         stream_windows=int(sout["windows"]),
         **ssd_outcome,
+        **artifact_outcome,
     )
     return outcome
 

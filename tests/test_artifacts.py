@@ -1,0 +1,375 @@
+"""Artifact/publishing layer (artifacts.py, ISSUE 14): crash-safe
+versioned publish, checksum-chain adoption, lease-fenced readers,
+provably-stale reaping, lineage-aware retention — plus the
+cross-process REAL-SIGKILL publisher round trip."""
+
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.artifacts import (ArtifactCorruptError,
+                                     ArtifactLeaseLostError,
+                                     ArtifactLineageError, ArtifactStore,
+                                     LeaseRegistry, MANIFEST)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _dead_pid() -> int:
+    """A pid that PROVABLY belonged to a dead same-host process."""
+    proc = subprocess.Popen(["true"])
+    proc.wait()
+    return proc.pid
+
+
+def _writer(payload: bytes):
+    def write(p):
+        with open(p, "wb") as fh:
+            fh.write(payload)
+    return write
+
+
+@pytest.fixture()
+def store(tmp_path):
+    return ArtifactStore(str(tmp_path / "registry"))
+
+
+# ---------------------------------------------------------------------------
+# publish / manifest / adoption
+# ---------------------------------------------------------------------------
+
+def test_publish_roundtrip_and_manifest_schema(store):
+    a1 = store.publish({"rows.bin": _writer(b"base" * 64)}, kind="base",
+                       refs={"cursor": {"global_step": 7}},
+                       meta={"step": 7})
+    m = store.read_manifest(a1)
+    assert m["artifact"] == a1 and m["epoch"] == 1
+    assert m["kind"] == "base" and m["parent"] is None
+    rec = m["files"]["rows.bin"]
+    assert rec["bytes"] == 256
+    assert rec["sha256"] == hashlib.sha256(b"base" * 64).hexdigest()
+    assert m["refs"]["cursor"]["global_step"] == 7
+    assert m["meta"]["step"] == 7
+    with store.open() as h:
+        assert h.aid == a1
+        assert h.read("rows.bin") == b"base" * 64
+
+
+def test_epochs_monotone_and_lineage_chain(store):
+    a1 = store.publish({"f": _writer(b"1")}, kind="base")
+    a2 = store.publish({"f": _writer(b"2")}, kind="delta", parent=a1)
+    a3 = store.publish({"f": _writer(b"3")}, kind="delta", parent=a2)
+    assert store.versions() == [a1, a2, a3]
+    assert [store.epoch_of(a) for a in (a1, a2, a3)] == [1, 2, 3]
+    with store.open() as h:
+        assert [m["artifact"] for m in h.chain] == [a1, a2, a3]
+
+
+def test_delta_requires_published_parent(store):
+    with pytest.raises(ArtifactLineageError):
+        store.publish({"f": _writer(b"x")}, kind="delta")
+    with pytest.raises(ArtifactLineageError):
+        store.publish({"f": _writer(b"x")}, kind="delta",
+                      parent="v0000000099")
+
+
+def test_existing_files_hardlinked(store, tmp_path):
+    src = tmp_path / "payload.npz"
+    src.write_bytes(b"precomputed")
+    aid = store.publish({"payload.npz": str(src)}, kind="base")
+    with store.open(aid) as h:
+        assert h.read("payload.npz") == b"precomputed"
+
+
+def test_corrupt_payload_refused_and_degrades(store):
+    a1 = store.publish({"f": _writer(b"good-one")}, kind="base")
+    a2 = store.publish({"f": _writer(b"good-two")}, kind="delta",
+                       parent=a1)
+    p = os.path.join(store.version_dir(a2), "f")
+    with open(p, "wb") as fh:
+        fh.write(b"good-tw0")   # flipped byte, same length
+    with pytest.raises(ArtifactCorruptError):
+        store.open(a2)          # explicit version: loud refusal
+    with store.open() as h:     # unpinned: degrade to verifiable parent
+        assert h.aid == a1
+
+
+def test_torn_manifest_refused(store):
+    a1 = store.publish({"f": _writer(b"ok")}, kind="base")
+    a2 = store.publish({"f": _writer(b"ok2")}, kind="delta", parent=a1)
+    mp = os.path.join(store.version_dir(a2), MANIFEST)
+    with open(mp, "a") as fh:
+        fh.write(" ")           # torn/edited manifest: sidecar mismatch
+    with pytest.raises(ArtifactCorruptError):
+        store.open(a2)
+    with store.open() as h:
+        assert h.aid == a1
+
+
+def test_corrupt_parent_fails_whole_chain(store):
+    """Adoption verifies the FULL lineage — a corrupt BASE under a
+    healthy delta refuses the delta too (restoring through it would
+    replay garbage rows)."""
+    a1 = store.publish({"f": _writer(b"base")}, kind="base")
+    store.publish({"f": _writer(b"delta")}, kind="delta", parent=a1)
+    p = os.path.join(store.version_dir(a1), "f")
+    with open(p, "wb") as fh:
+        fh.write(b"b4se")
+    with pytest.raises(ArtifactCorruptError):
+        store.open()            # nothing verifiable left at all
+
+
+# ---------------------------------------------------------------------------
+# leases: fencing, reaping, retention
+# ---------------------------------------------------------------------------
+
+def test_lease_fences_after_reap_and_reader_reopens(store):
+    """Satellite: stale-lease reaping must not rely on wall-clock
+    alone — a paused reader whose lease was reaped detects the loss on
+    its next read (ArtifactLeaseLostError) and re-opens, instead of
+    serving from possibly-swept files."""
+    a1 = store.publish({"f": _writer(b"v1")}, kind="base")
+    h = store.open(a1)
+    assert h.read("f") == b"v1"
+    # a zero-TTL sweeper must NOT reap a same-host ALIVE holder — age
+    # alone is no proof of death on the holder's own host
+    sweeper = ArtifactStore(store.root, lease_ttl_sec=0.0, sweep=False)
+    assert sweeper.lease_registry().reap_stale() == []
+    assert h.lease.alive()
+    # the reader "dies" (paused-then-reaped from the sweeper's view):
+    # make the holder provably dead, then the reap takes the lease
+    with open(h.lease.path) as fh:
+        info = json.load(fh)
+    info["pid"] = _dead_pid()
+    with open(h.lease.path, "w") as fh:
+        json.dump(info, fh)
+    assert a1 in sweeper.lease_registry().reap_stale()
+    # the paused reader resumes: every access now FENCES
+    with pytest.raises(ArtifactLeaseLostError):
+        h.path("f")
+    with pytest.raises(ArtifactLeaseLostError):
+        h.read("f")
+    with pytest.raises(ArtifactLeaseLostError):
+        h.heartbeat()           # cannot resurrect a reaped lease
+    # re-open is the recovery path — the version still exists here
+    with store.open() as h2:
+        assert h2.aid == a1 and h2.read("f") == b"v1"
+
+
+def test_reap_only_provably_stale(tmp_path):
+    reg = LeaseRegistry(str(tmp_path / "leases"), ttl_sec=3600.0)
+    fresh = reg.acquire("keep-me")
+    # forge a lease from a dead same-host pid (a reaped subprocess
+    # gives us a guaranteed-dead pid without guessing)
+    pid = _dead_pid()
+    dead_path = os.path.join(reg.root, f"dead-one.{pid}-cafe.lease")
+    with open(dead_path, "w") as fh:
+        json.dump({"name": "dead-one", "pid": pid,
+                   "host": __import__("socket").gethostname(),
+                   "created_unix": time.time()}, fh)
+    reaped = reg.reap_stale()
+    assert reaped == ["dead-one"]
+    assert fresh.alive()
+    assert reg.held("keep-me") and not reg.held("dead-one")
+    # a FOREIGN-host lease can only be judged by heartbeat age
+    foreign = os.path.join(reg.root, "far-away.12345-beef.lease")
+    with open(foreign, "w") as fh:
+        json.dump({"name": "far-away", "pid": 12345,
+                   "host": "some-other-host"}, fh)
+    assert reg.reap_stale() == []          # fresh heartbeat: kept
+    old = time.time() - 7200
+    os.utime(foreign, (old, old))          # idle past the TTL: reaped
+    assert reg.reap_stale() == ["far-away"]
+    fresh.release()
+
+
+def test_heartbeat_refreshes_mtime(store):
+    a1 = store.publish({"f": _writer(b"v1")}, kind="base")
+    h = store.open(a1)
+    old = os.stat(h.lease.path).st_mtime
+    time.sleep(0.05)
+    h.heartbeat()
+    assert os.stat(h.lease.path).st_mtime >= old
+    h.close()
+    assert not h.lease.alive()
+
+
+def test_retention_keeps_leased_and_lineage(store):
+    a1 = store.publish({"f": _writer(b"1")}, kind="base")
+    a2 = store.publish({"f": _writer(b"2")}, kind="delta", parent=a1)
+    b1 = store.publish({"f": _writer(b"3")}, kind="base")
+    b2 = store.publish({"f": _writer(b"4")}, kind="delta", parent=b1)
+    h = store.open(a2)           # lease on the OLD chain's tip
+    assert store.retain(keep=2) == []   # a2 leased; a1 is its lineage
+    assert store.versions() == [a1, a2, b1, b2]
+    h.close()
+    assert store.retain(keep=2) == [a1, a2]
+    assert store.versions() == [b1, b2]
+    # b1 is b2's lineage parent: keep=1 still cannot remove it
+    assert store.retain(keep=1) == []
+
+
+def test_live_publisher_stage_not_swept(store):
+    """The carcass sweep only takes PROVABLY dead writers' stages —
+    a live same-host publisher's stage survives a concurrent open even
+    past the TTL (a long multi-GB staging is not a carcass), with or
+    without its marker file (the dir name carries the pid)."""
+    stage = os.path.join(store.root, f".stage-{os.getpid()}-aa")
+    os.makedirs(stage)
+    with open(os.path.join(stage, "stage.json"), "w") as fh:
+        json.dump({"pid": os.getpid(),
+                   "host": __import__("socket").gethostname(),
+                   "created_unix": time.time()}, fh)
+    ArtifactStore(store.root, lease_ttl_sec=0.0)  # zero TTL: age says
+    assert os.path.isdir(stage)                   # stale; pid says LIVE
+    os.unlink(os.path.join(stage, "stage.json"))  # markerless (commit
+    ArtifactStore(store.root, lease_ttl_sec=0.0)  # window): dirname pid
+    assert os.path.isdir(stage)                   # still protects
+    # a provably-dead writer's stage IS swept
+    with open(os.path.join(stage, "stage.json"), "w") as fh:
+        json.dump({"pid": _dead_pid(),
+                   "host": __import__("socket").gethostname()}, fh)
+    ArtifactStore(store.root)
+    assert not os.path.isdir(stage)
+
+
+# ---------------------------------------------------------------------------
+# cross-process: REAL SIGKILL mid-publish (ISSUE 14 satellite)
+# ---------------------------------------------------------------------------
+
+_PUBLISHER = r"""
+import os, sys, time
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import jax
+from paddlebox_tpu.artifacts import ArtifactStore
+from paddlebox_tpu.ps import EmbeddingTable, SparseSGDConfig
+from paddlebox_tpu.ps.table import FIELD_COL, TableState
+from scripts.publish_check import table_digest
+
+root = sys.argv[1]
+store = ArtifactStore(root)
+cfg = SparseSGDConfig(mf_create_thresholds=1e9)
+t = EmbeddingTable(mf_dim=4, capacity=1 << 10, cfg=cfg)
+keys = np.arange(1, 201, dtype=np.uint64)
+rows = t.index.assign(keys)
+data = np.asarray(jax.device_get(t.state.data)).copy()
+data[rows, FIELD_COL["embed_w"]] = keys.astype(np.float32) * 2.0
+data[rows, FIELD_COL["show"]] = 1.0
+t.state = TableState.from_logical(data, t.capacity)
+t._touched[rows] = True
+aid = store.publish({{"sparse.npz": lambda p: t.save_base(p)}},
+                    kind="base", meta={{"step": 1}})
+with open(os.path.join(root, "digest.txt"), "w") as fh:
+    fh.write(aid + " " + table_digest(t))
+
+# second publish: stage the payload, signal the parent, then HANG
+# inside the writer — the parent SIGKILLs us mid-publish
+def hang_writer(p):
+    t._touched[rows] = True
+    t.save_delta(p)
+    with open(os.path.join(root, "STAGED"), "w") as fh:
+        fh.write("1")
+    time.sleep(600)
+
+store.publish({{"sparse_delta.npz": hang_writer}}, kind="delta",
+              parent=aid)
+"""
+
+
+def test_sigkill_mid_publish_reader_adopts_previous(tmp_path):
+    """A subprocess publisher killed (real SIGKILL) mid-publish leaves
+    only a stage carcass; a fresh reader sweeps it (dead pid ⇒ provably
+    stale) and adopts the previous COMPLETE version with a
+    bit-identical state digest."""
+    from paddlebox_tpu.data.schema import DataFeedDesc
+    from paddlebox_tpu.models import CtrDnn
+    from paddlebox_tpu.serving import ServingModel
+    from scripts.publish_check import table_digest
+
+    root = str(tmp_path / "registry")
+    os.makedirs(root)
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _PUBLISHER.format(repo=REPO), root],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    try:
+        staged = os.path.join(root, "STAGED")
+        deadline = time.time() + 120
+        while not os.path.isfile(staged):
+            assert proc.poll() is None, "publisher died before staging"
+            assert time.time() < deadline, "publisher never staged"
+            time.sleep(0.05)
+        os.kill(proc.pid, signal.SIGKILL)   # mid-publish, pre-rename
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    with open(os.path.join(root, "digest.txt")) as fh:
+        v1, want_digest = fh.read().split()
+    carcasses = [n for n in os.listdir(root) if n.startswith(".stage-")]
+    assert carcasses, "SIGKILL left no stage carcass"
+    store = ArtifactStore(root)      # dead-pid carcass swept on open
+    assert not [n for n in os.listdir(root) if n.startswith(".stage-")]
+    assert store.versions() == [v1], "half-publish leaked a version"
+    srv = ServingModel(CtrDnn(hidden=(4,)),
+                       DataFeedDesc.criteo(batch_size=16), mf_dim=4,
+                       capacity=1 << 10)
+    assert srv.adopt(store) == v1
+    assert table_digest(srv.table) == want_digest, (
+        "adopted state diverges from the publisher's recorded digest")
+    srv.release()
+
+
+def test_failed_publish_loses_no_delta_rows(store):
+    """Review regression: publishing stages the delta with
+    clear_touched=False and clears only AFTER the commit — a publish
+    that dies pre-commit keeps every touched flag, so the retry's
+    delta still carries the rows (they never silently vanish from the
+    chain)."""
+    import jax
+    from paddlebox_tpu.ps import EmbeddingTable, SparseSGDConfig
+    from paddlebox_tpu.ps.box_helper import BoxPSHelper
+    from paddlebox_tpu.ps.table import FIELD_COL, TableState
+    from paddlebox_tpu.resilience.faults import (FaultPlan, InjectedCrash,
+                                                 installed)
+    from scripts.publish_check import table_digest
+
+    cfg = SparseSGDConfig(mf_create_thresholds=1e9)
+    t = EmbeddingTable(mf_dim=4, capacity=1 << 10, cfg=cfg)
+    helper = BoxPSHelper(t)
+
+    def write(lo, hi, scale):
+        keys = np.arange(lo, hi, dtype=np.uint64)
+        rows = t.index.assign(keys)
+        data = np.asarray(jax.device_get(t.state.data)).copy()
+        data[rows, FIELD_COL["embed_w"]] = keys.astype(np.float32) * scale
+        t.state = TableState.from_logical(data, t.capacity)
+        t._touched[rows] = True
+
+    write(1, 51, 2.0)
+    v1 = helper.publish_base(store)
+    assert not t._touched.any(), "commit did not clear the flags"
+    write(30, 81, 3.0)
+    with installed(FaultPlan.parse("artifact.publish:fail:nth=1,"
+                                   "exc=crash", seed=3)):
+        with pytest.raises(InjectedCrash):
+            helper.publish_delta(store)
+    assert t._touched.any(), (
+        "failed publish cleared the touched set — those rows would "
+        "silently leave the delta chain")
+    v2 = helper.publish_delta(store)     # retry carries every row
+    # reader replay of the chain == the writer table, bit for bit
+    reader = EmbeddingTable(mf_dim=4, capacity=1 << 10, cfg=cfg)
+    reader.load(os.path.join(store.version_dir(v1), "sparse.npz"))
+    reader.load(os.path.join(store.version_dir(v2),
+                             "sparse_delta.npz"), merge=True)
+    assert table_digest(reader) == table_digest(t)

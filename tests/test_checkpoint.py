@@ -196,3 +196,192 @@ def test_delta_includes_preloaded_pass_rows(setup):
     meta = cm._meta(tr.global_step)
     assert meta["sparse_rows"] > 0, \
         "delta lost the preloaded pass's trained rows"
+
+
+# ---------------------------------------------------------------------------
+# artifact/publishing layer integration (artifacts.py, ISSUE 14)
+# ---------------------------------------------------------------------------
+
+def test_retention_defers_leased_checkpoint(setup):
+    """Satellite: _retain must not sweep a checkpoint a concurrent
+    reader is mid-adopting — a held lease (cm.lease / restore's own)
+    defers deletion; release lets the next sweep reclaim it."""
+    ds, mk, root = setup
+    tr = mk()
+    cm = CheckpointManager(root, keep=1)
+    tr.train_pass(ds)
+    cm.save(tr)
+    s1 = tr.global_step
+    d1 = os.path.join(root, f"ckpt-{s1:012d}")
+    lease = cm.lease(s1)                 # a reader mid-adoption
+    try:
+        tr.train_pass(ds)
+        cm.save(tr)                      # keep=1 would sweep s1 …
+        assert os.path.isdir(d1), (
+            "retention swept a checkpoint under a held lease")
+    finally:
+        lease.release()
+    tr.train_pass(ds)
+    cm.save(tr)                          # lease gone: reclaimed now
+    assert not os.path.isdir(d1)
+    # the stale lease FENCES instead of pretending it still holds
+    from paddlebox_tpu.artifacts import ArtifactLeaseLostError
+    with pytest.raises(ArtifactLeaseLostError):
+        lease.check()
+
+
+def test_boundary_saves_publish_artifacts(setup):
+    """Boundary checkpoints (no cursor, or a stream cursor whose open
+    window is empty — train_stream's stream-boundary saves) publish
+    into an attached ArtifactStore with parent lineage; mid-pass cursor
+    saves stay checkpoint-only."""
+    from paddlebox_tpu.artifacts import ArtifactStore
+    ds, mk, root = setup
+    store = ArtifactStore(root + "_art")
+    tr = mk()
+    cm = CheckpointManager(root, artifacts=store)
+    tr.train_pass(ds)
+    cm.save(tr)                               # base boundary → publishes
+    assert len(store.versions()) == 1
+    tr.train_pass(ds)
+    cm.save(tr, delta=True,                   # MID-PASS cursor: no publish
+            cursor={"pass_seq": 2, "batch_index": 3,
+                    "global_step": int(tr.global_step)})
+    assert len(store.versions()) == 1
+    tr.train_pass(ds)
+    cm.save(tr, delta=True)                   # boundary delta → publishes
+    tr.train_pass(ds)
+    cm.save(tr, delta=True,                   # STREAM boundary (empty
+            cursor={"global_step": int(tr.global_step),   # open window)
+                    "stream": {"window_files": [],        # → publishes
+                               "files_completed": ["a", "b"],
+                               "windows_completed": 2}})
+    vs = store.versions()
+    assert len(vs) == 3
+    m_base = store.read_manifest(vs[0])
+    m_delta = store.read_manifest(vs[1])
+    m_stream = store.read_manifest(vs[2])
+    assert m_base["kind"] == "base" and m_base["parent"] is None
+    assert m_delta["kind"] == "delta" and m_delta["parent"] == vs[0]
+    assert m_stream["parent"] == vs[1]
+    assert m_stream["refs"]["cursor"]["files_completed"] == 2
+    assert m_base["meta"]["producer"] == "checkpoint"
+    assert "sparse.npz" in m_base["files"]
+    assert "dense.pkl" in m_base["files"]
+
+
+def test_artifact_publish_path_byte_identical(setup):
+    """Acceptance: a batch job publishing through ArtifactStore
+    produces a restore state_digest bit-identical to the pre-PR
+    checkpoint path — both via CheckpointManager.restore and via
+    artifact-only adoption (adopt_artifact)."""
+    from paddlebox_tpu.artifacts import ArtifactStore
+    from paddlebox_tpu.train.checkpoint import (adopt_artifact,
+                                                state_digest)
+    ds, mk, root = setup
+    # pre-PR path: plain manager, no store attached
+    tr1 = mk()
+    tr1.train_pass(ds)
+    cm1 = CheckpointManager(root + "_plain")
+    cm1.save(tr1)
+    tr1.train_pass(ds)
+    cm1.save(tr1, delta=True)
+    r1 = mk()
+    CheckpointManager(root + "_plain").restore(r1)
+    d_pre = state_digest(r1)
+    # publish-enabled path: identical job with an ArtifactStore attached
+    store = ArtifactStore(root + "_art2")
+    tr2 = mk()
+    tr2.train_pass(ds)
+    cm2 = CheckpointManager(root + "_pub", artifacts=store)
+    cm2.save(tr2)
+    tr2.train_pass(ds)
+    cm2.save(tr2, delta=True)
+    r2 = mk()
+    CheckpointManager(root + "_pub").restore(r2)
+    assert state_digest(r2) == d_pre, (
+        "attaching the artifact store changed the checkpoint path")
+    # artifact-only restore: verify chain → base+delta replay
+    r3 = mk()
+    assert adopt_artifact(r3, store) == tr2.global_step
+    assert state_digest(r3) == d_pre, (
+        "artifact adoption diverges from the checkpoint restore")
+
+
+def test_shared_store_roots_do_not_cross_link(setup, tmp_path):
+    """Review regression: two jobs (different checkpoint roots) sharing
+    ONE artifact store must keep their lineages apart — step counters
+    overlap, so the lookup is scoped by root, never by step alone."""
+    from paddlebox_tpu.artifacts import ArtifactStore
+    ds, mk, root = setup
+    store = ArtifactStore(str(tmp_path / "shared_art"))
+    tra, trb = mk(), mk()
+    cma = CheckpointManager(root + "_jobA", artifacts=store)
+    cmb = CheckpointManager(root + "_jobB", artifacts=store)
+    tra.train_pass(ds)
+    cma.save(tra)                    # both jobs publish a base at the
+    trb.train_pass(ds)
+    cmb.save(trb)                    # SAME step number
+    tra.train_pass(ds)
+    cma.save(tra, delta=True)
+    trb.train_pass(ds)
+    cmb.save(trb, delta=True)
+    roots = {}
+    for aid in store.versions():
+        m = store.read_manifest(aid)
+        roots.setdefault(m["meta"]["root"], []).append(m)
+    assert len(roots) == 2
+    for chain in roots.values():    # each delta links to ITS OWN base
+        base = [m for m in chain if m["kind"] == "base"]
+        delta = [m for m in chain if m["kind"] == "delta"]
+        assert len(base) == 1 and len(delta) == 1
+        assert delta[0]["parent"] == base[0]["artifact"]
+
+
+def test_restore_to_unpublished_step_backfills_chain(setup):
+    """Review regression: a restore onto a step that never published
+    (a mid-pass crash checkpoint) must neither halt publishing until
+    the next base nor link past the gap — the missing chain links
+    backfill from the checkpoint dirs, and the next boundary delta
+    chains soundly on top."""
+    from paddlebox_tpu.artifacts import ArtifactStore
+    from paddlebox_tpu.train.checkpoint import (adopt_artifact,
+                                                state_digest)
+    ds, mk, root = setup
+    store = ArtifactStore(root + "_art3")
+    tr = mk()
+    cm = CheckpointManager(root, artifacts=store)
+    tr.train_pass(ds)
+    cm.save(tr)                              # published base
+    tr.train_pass(ds)
+    mid_step = int(tr.global_step)
+    cm.save(tr, delta=True,                  # mid-pass: NOT published
+            cursor={"pass_seq": 2, "batch_index": 3,
+                    "global_step": mid_step})
+    assert len(store.versions()) == 1
+    # crash + restart: fresh manager restores the mid-pass checkpoint
+    tr2 = mk()
+    cm2 = CheckpointManager(root, artifacts=store)
+    assert cm2.restore(tr2) == mid_step
+    # the restore BACKFILLED the unpublished chain link
+    assert len(store.versions()) == 2
+    backfilled = store.read_manifest(store.versions()[-1])
+    assert backfilled["meta"]["step"] == mid_step
+    assert backfilled["parent"] == store.versions()[0]
+    assert "cursor" in backfilled["refs"]    # marked as a mid-pass link
+    # ... but it is CHAIN-ONLY: an unpinned reader never lands on the
+    # half-trained pass state — open(None) skips to the boundary base
+    assert backfilled["adoptable"] is False
+    with store.open() as h:
+        assert h.aid == store.versions()[0]
+    # the next boundary delta publishes and chains on the backfill
+    tr2.train_pass(ds)
+    cm2.save(tr2, delta=True)
+    vs = store.versions()
+    assert len(vs) == 3
+    tip = store.read_manifest(vs[-1])
+    assert tip["parent"] == vs[-2]
+    # and the artifact chain reproduces the trainer bit-for-bit
+    r = mk()
+    assert adopt_artifact(r, store) == tr2.global_step
+    assert state_digest(r) == state_digest(tr2)

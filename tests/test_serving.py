@@ -124,3 +124,114 @@ def test_serving_consumes_sharded_save(tmp_path):
     assert t2.merge_model(path) == 100
     got = t2.host_pull(keys[:1])
     assert got[0, 0] == 3.0  # 1 + 2 accumulated
+
+
+# ---------------------------------------------------------------------------
+# artifact-layer consumption (artifacts.py, ISSUE 14)
+# ---------------------------------------------------------------------------
+
+def _published_chain(tmp_path):
+    """A base + two deltas published through BoxPSHelper → ArtifactStore
+    from a directly-written table (no training — keys carry their value
+    in embed_w so reads are checkable)."""
+    import os
+    import jax as _jax
+    from paddlebox_tpu.artifacts import ArtifactStore
+    from paddlebox_tpu.ps.box_helper import BoxPSHelper
+    from paddlebox_tpu.ps.table import FIELD_COL, TableState
+
+    cfg = SparseSGDConfig(mf_create_thresholds=1e9)
+    t = EmbeddingTable(mf_dim=4, capacity=1 << 10, cfg=cfg)
+
+    def write(lo, hi, scale):
+        keys = np.arange(lo, hi, dtype=np.uint64)
+        rows = t.index.assign(keys)
+        data = np.asarray(_jax.device_get(t.state.data)).copy()
+        data[rows, FIELD_COL["embed_w"]] = keys.astype(np.float32) * scale
+        data[rows, FIELD_COL["show"]] = 1.0
+        t.state = TableState.from_logical(data, t.capacity)
+        t._touched[rows] = True
+
+    store = ArtifactStore(str(tmp_path / "registry"))
+    helper = BoxPSHelper(t)
+    write(1, 51, 2.0)
+    v1 = helper.publish_base(store)
+    write(40, 61, 3.0)
+    v2 = helper.publish_delta(store)
+    write(55, 71, 5.0)
+    v3 = helper.publish_delta(store)
+    return t, store, (v1, v2, v3)
+
+
+def _srv():
+    from paddlebox_tpu.data.schema import DataFeedDesc
+    return ServingModel(CtrDnn(hidden=(4,)),
+                        DataFeedDesc.criteo(batch_size=16), mf_dim=4,
+                        capacity=1 << 10)
+
+
+def test_apply_delta_verifies_artifact_lineage(tmp_path):
+    """Satellite: apply_delta on a managed (published) payload verifies
+    parent id + sha256 BEFORE applying — out-of-order, wrong-parent,
+    unmanaged-after-adoption, and bit-flipped deltas all refuse
+    loudly instead of silently merging."""
+    import os
+    import pytest as _pytest
+    from paddlebox_tpu.artifacts import (ArtifactCorruptError,
+                                         ArtifactLineageError)
+    t, store, (v1, v2, v3) = _published_chain(tmp_path)
+    base = os.path.join(store.version_dir(v1), "sparse.npz")
+    d2 = os.path.join(store.version_dir(v2), "sparse_delta.npz")
+    d3 = os.path.join(store.version_dir(v3), "sparse_delta.npz")
+
+    srv = _srv()
+    srv.load_base(base)
+    with _pytest.raises(ArtifactLineageError):
+        srv.apply_delta(d3)          # skips v2: out-of-order
+    srv.apply_delta(d2)              # lineage order: fine
+    srv.apply_delta(d3)
+    v = srv.embed_lookup(np.array([1, 45, 70], np.uint64))
+    np.testing.assert_allclose(v[:, 2], [2.0, 135.0, 350.0])
+    # an unmanaged (manifest-less) delta cannot extend artifact lineage
+    raw = str(tmp_path / "raw_delta.npz")
+    t._touched[:] = True
+    t.save_delta(raw, clear_touched=False)
+    with _pytest.raises(ArtifactLineageError):
+        srv.apply_delta(raw)
+    # a bit-flipped managed delta refuses on sha256
+    srv2 = _srv()
+    srv2.load_base(base)
+    with open(d2, "rb") as fh:
+        blob = fh.read()
+    with open(d2, "wb") as fh:
+        fh.write(blob[:9] + bytes([blob[9] ^ 0xFF]) + blob[10:])
+    with _pytest.raises(ArtifactCorruptError):
+        srv2.apply_delta(d2)
+    # legacy raw-path flow (no adoption, no manifests) stays available
+    srv3 = _srv()
+    srv3.load_base(raw)                 # raw npz, no MANIFEST beside it
+    assert srv3._adopted_aid is None
+
+
+def test_adopt_and_hot_reload_chain(tmp_path):
+    """Store adoption verifies the whole chain, holds the lease, and
+    hot_reload applies ONLY the new deltas (or fully re-adopts on a
+    diverged lineage)."""
+    from paddlebox_tpu.ps.box_helper import BoxPSHelper
+    t, store, (v1, v2, v3) = _published_chain(tmp_path)
+    srv = _srv()
+    assert srv.adopt(store) == v3
+    assert store.leased_versions() == [v3]
+    assert srv.hot_reload(store) is None     # already current
+    # publish one more delta; hot reload advances incrementally
+    helper = BoxPSHelper(t)
+    helper._published_tip = v3
+    t._touched[:] = False
+    keys = np.arange(100, 111, dtype=np.uint64)
+    t.index.assign(keys)
+    t._touched[t.index.lookup(keys)] = True
+    v4 = helper.publish_delta(store)
+    assert srv.hot_reload(store) == v4
+    assert store.leased_versions() == [v4]   # old lease swapped out
+    srv.release()
+    assert store.leased_versions() == []
