@@ -707,6 +707,121 @@ def measure_pv(num_passes: int = 3) -> list:
     return rows
 
 
+def measure_serve(shape: str = "uniform") -> list:
+    """BENCH_MODE=serve (ISSUE 15 / ROADMAP item 3): the concurrent-
+    serving lane. Trains a small DeepFM, publishes it through the
+    artifact layer (``BoxPSHelper.publish_base`` → ``ArtifactStore``),
+    adopts it into a snapshot-isolated ``ServingModel`` and then
+    sustains batched inference (``predict_many`` micro-batches) over
+    the training data, measuring:
+
+        serving.{shape}.qps       queries (micro-batches)/sec — higher
+                                  is better, the usual gate rule
+        serving.{shape}.p99_ms    per-query p99 latency — gated
+                                  LOWER-is-better (perf_gate ``*_ms``)
+
+    The p99 comes from exact client-side timings; the same samples
+    also land in the ``pbox_serving_latency_seconds`` histogram (the
+    scrapeable p50/p99 lines — which additionally carry the cold-start
+    compile sample the headline row excludes, so the two are close but
+    not identical). BENCH_SERVE_QUERIES overrides the query count;
+    sizes scale down off-TPU."""
+    import tempfile
+
+    import jax
+    import optax
+
+    from paddlebox_tpu.artifacts import ArtifactStore
+    from paddlebox_tpu.data import DataFeedDesc, InMemoryDataset, SlotDef
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.ps import EmbeddingTable, SparseSGDConfig
+    from paddlebox_tpu.ps.box_helper import BoxPSHelper
+    from paddlebox_tpu.serving import ServingModel
+    from paddlebox_tpu.train import Trainer
+
+    on_tpu = jax.default_backend() == "tpu"
+    (shape_slots, shape_avg, _bs, _recs, shape_vocab,
+     shape_dist) = SHAPES[shape]
+    bs = int(os.environ.get("BENCH_BATCH_SIZE",
+                            "4096" if on_tpu else "512"))
+    num_records = int(os.environ.get("BENCH_RECORDS",
+                                     str(bs * (32 if on_tpu else 16))))
+    n_queries = int(os.environ.get("BENCH_SERVE_QUERIES",
+                                   "256" if on_tpu else "96"))
+    mf_dim = int(os.environ.get("BENCH_MF_DIM", 8))
+
+    slots = [SlotDef("label", "float", 1), SlotDef("dense", "float", 13)]
+    slots += [SlotDef(f"C{i}", "uint64")
+              for i in range(1, shape_slots + 1)]
+    desc = DataFeedDesc(slots=slots, batch_size=bs, label_slot="label",
+                        key_bucket_min=(bs * shape_slots
+                                        if shape_avg <= 1.0 else 4096))
+    ds = InMemoryDataset(desc)
+    ds.records = build_records(num_records, num_slots=shape_slots,
+                               vocab_per_slot=shape_vocab, seed=11,
+                               avg_keys_per_slot=shape_avg,
+                               key_dist=shape_dist)
+    ds.columnarize()
+
+    cfg = SparseSGDConfig(mf_create_thresholds=0.0, mf_initial_range=1e-3)
+    table = EmbeddingTable(mf_dim=mf_dim, capacity=1 << 21, cfg=cfg,
+                           unique_bucket_min=desc.key_bucket_min)
+    tr = Trainer(DeepFM(hidden=(64, 32)), table, desc,
+                 tx=optax.adam(1e-3))
+    tr.train_pass(ds)
+    tr.sync_table()
+
+    workdir = tempfile.mkdtemp(prefix="pbox_serve_bench_")
+    store = ArtifactStore(os.path.join(workdir, "registry"))
+    helper = BoxPSHelper(table)
+    helper.publish_base(store)
+    dense = os.path.join(workdir, "m")
+    tr.save(dense)
+
+    srv = ServingModel(DeepFM(hidden=(64, 32)), desc, mf_dim=mf_dim,
+                       capacity=1 << 21)
+    srv.adopt(store)
+    srv.load_dense(dense + ".dense.pkl")
+    srv.register_health()
+    batches = list(ds.batches())
+
+    # warmup: compile the serving forward + fault in the host mirror
+    srv.predict(batches[0])
+    lat: list = []
+    examples = 0
+    t0 = time.perf_counter()
+    done = 0
+    while done < n_queries:
+        for batch in batches:
+            if done >= n_queries:
+                break
+            q0 = time.perf_counter()
+            pred, ins_w = srv.predict(batch, return_valid=True)
+            lat.append(time.perf_counter() - q0)
+            examples += int(ins_w.sum())
+            done += 1
+    wall = time.perf_counter() - t0
+    lat.sort()
+    p99_ms = lat[int(0.99 * (len(lat) - 1))] * 1e3
+    p50_ms = lat[len(lat) // 2] * 1e3
+    qps = done / max(wall, 1e-9)
+
+    srv.release()
+    if not os.environ.get("BENCH_SERVE_KEEP", ""):
+        shutil.rmtree(workdir, ignore_errors=True)
+    common = dict(mode="serve", shape=shape, batch=bs, queries=done,
+                  backend=jax.default_backend(),
+                  examples_per_sec=round(examples / max(wall, 1e-9), 1))
+    return [
+        {"metric": f"serving.{shape}.qps", "value": round(qps, 2),
+         "unit": "queries/sec", "p50_ms": round(p50_ms, 4),
+         "p99_ms": round(p99_ms, 4), **common},
+        {"metric": f"serving.{shape}.p99_ms",
+         "value": round(p99_ms, 4), "unit": "ms/query",
+         "qps": round(qps, 2), **common},
+    ]
+
+
 def xplane_device_busy_sec(trace_dir: str) -> float:
     """Parse the jax.profiler XPlane dump: summed UNION of XLA-module
     execution intervals on every /device: plane → measured device busy
@@ -845,6 +960,13 @@ def main() -> None:
         # PV-batch rank-attention lane (ISSUE 13): proves the CTR op
         # family in a real pull→train→push loop, one row per impl
         for row in measure_pv(int(os.environ.get("BENCH_PASSES", 3))):
+            emit_result(row)
+        return
+    if mode == "serve":
+        # concurrent-serving lane (ISSUE 15): snapshot-isolated
+        # batched inference qps + p99 latency (p99 gates lower-is-
+        # better — scripts/perf_gate.py *_ms rule)
+        for row in measure_serve(shape):
             emit_result(row)
         return
     FLAGS.log_period_steps = 10 ** 9

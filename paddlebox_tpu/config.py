@@ -297,6 +297,22 @@ class Flags:
     # lineage parents, which are NEVER swept); <=0 = keep everything
     artifact_keep: int = 0
 
+    # --- concurrent serving (serving.py; docs/SERVING.md) ---
+    # background hot-reload cadence: serving.ReloadLoop polls the
+    # ArtifactStore tip this often while healthy (failed polls back off
+    # on the seeded RetryPolicy schedule instead — site serving.reload)
+    serving_reload_poll_sec: float = 2.0
+    # snapshot-staleness SLO: when a newer adoptable version has been
+    # published for longer than this without the serving snapshot
+    # advancing, the reload loop marks the serving block stale
+    # (healthz "serving".stale, pbox_serving_staleness_sec) and logs
+    # loudly — the degrade state is visible, never silent
+    serving_staleness_max_sec: float = 60.0
+    # predict_many micro-batch cap (instances per forward); <=0 = the
+    # model desc's batch_size (one compiled bucket). Smaller caps trade
+    # throughput for per-query latency under mixed traffic.
+    serving_batch_max: int = 0
+
     # --- pipeline hang deadline (ps/epilogue.PassEpilogue.fence,
     # train/device_pass.PassPreloader.wait) ---
     # >0: a pipeline wait that sees no job/build COMPLETE for this long

@@ -53,6 +53,10 @@ class TelemetryHub:
         self.started_at = time.time()
         self._last_pass_ts: Optional[float] = None
         self._pass_count = 0
+        # serving surface (serving.ServingModel/ReloadLoop register a
+        # probe): /healthz grows a "serving" block and /readyz refuses
+        # (503) until the probe reports a first snapshot adoption
+        self._serving_probe = None
         # fast-path flag: any sink attached / endpoint running. Hot call
         # sites read this one attribute and skip all payload assembly.
         self.active = False
@@ -230,16 +234,57 @@ class TelemetryHub:
             self._last_pass_ts = time.time()
             self._pass_count += 1
 
+    # ---- serving surface (docs/SERVING.md) -----------------------------
+    def set_serving_probe(self, probe) -> None:
+        """Register (or clear, with None) the process's serving status
+        provider — a callable returning the ``serving`` block for
+        /healthz: ``{adopted, epoch, last_reload_ts, staleness_sec,
+        stale}`` (serving.ServingModel.serving_status). One serving
+        model per process owns the block; the last registration wins."""
+        with self._lock:
+            self._serving_probe = probe
+
+    def serving_info(self) -> Optional[Dict]:
+        """The registered probe's current block (None: no serving model
+        in this process, or the probe failed — a broken probe must not
+        take the health endpoint down)."""
+        with self._lock:
+            probe = self._serving_probe
+        if probe is None:
+            return None
+        try:
+            return probe()
+        except Exception:
+            log.warning("serving health probe failed", exc_info=True)
+            return {"adopted": None, "error": "probe failed"}
+
+    def readiness(self) -> Dict:
+        """The /readyz payload: ready only after the serving model's
+        FIRST snapshot adoption (a serving process must not receive
+        traffic while it still answers from an empty table). Processes
+        with no serving probe registered are unready by definition —
+        /readyz is a serving-role endpoint; training liveness is
+        /healthz."""
+        info = self.serving_info()
+        if info is None:
+            return {"ready": False, "reason": "no serving model"}
+        if not info.get("adopted"):
+            return {"ready": False, "reason": "no snapshot adopted yet",
+                    "serving": info}
+        return {"ready": True, "serving": info}
+
     def health(self) -> Dict:
         """The /healthz payload: run identity, uptime, and how stale
         the latest pass is — the liveness probe the serving/streaming
         loops poll (a wedged always-on trainer shows a growing
-        ``last_pass_age_sec`` while the process still answers)."""
+        ``last_pass_age_sec`` while the process still answers). When a
+        serving model registered its probe, a ``serving`` block rides
+        along (adopted version, last reload, snapshot staleness)."""
         now = time.time()
         with self._lock:
             last = self._last_pass_ts
             count = self._pass_count
-        return {
+        out = {
             "status": "ok",
             "run_id": self.run_id,
             "uptime_sec": round(now - self.started_at, 3),
@@ -248,6 +293,10 @@ class TelemetryHub:
             "last_pass_age_sec": (None if last is None
                                   else round(now - last, 3)),
         }
+        serving = self.serving_info()
+        if serving is not None:
+            out["serving"] = serving
+        return out
 
     # ---- Prometheus HTTP endpoint --------------------------------------
     def start_prom_http(self, port: int = 0):
@@ -264,13 +313,22 @@ class TelemetryHub:
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server API)
-                if self.path.split("?", 1)[0] == "/healthz":
+                route = self.path.split("?", 1)[0]
+                status = 200
+                if route == "/healthz":
                     body = _json.dumps(hub.health()).encode()
+                    ctype = "application/json"
+                elif route == "/readyz":
+                    # the serving readiness gate: 503 until the first
+                    # snapshot adoption (docs/SERVING.md)
+                    ready = hub.readiness()
+                    status = 200 if ready["ready"] else 503
+                    body = _json.dumps(ready).encode()
                     ctype = "application/json"
                 else:
                     body = hub.snapshot_prom().encode()
                     ctype = "text/plain; version=0.0.4"
-                self.send_response(200)
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

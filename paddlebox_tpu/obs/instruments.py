@@ -158,6 +158,53 @@ class Histogram(Instrument):
         with self._lock:
             return sorted(self._series.items(), key=lambda kv: kv[0])
 
+    @staticmethod
+    def _quantile_from_counts(buckets: Sequence[float], counts,
+                              count: int, q: float) -> float:
+        """Estimate quantile ``q`` from fixed-bucket counts the way
+        Prometheus's ``histogram_quantile`` does: find the bucket the
+        target rank lands in and interpolate linearly inside it. Ranks
+        past the last finite bucket clamp to its upper bound (the +Inf
+        bucket has no width to interpolate over)."""
+        if count <= 0:
+            return 0.0
+        rank = q * count
+        cum = 0
+        lo = 0.0
+        for le, c in zip(buckets, counts):
+            if cum + c >= rank and c > 0:
+                return lo + (le - lo) * (rank - cum) / c
+            cum += c
+            lo = le
+        return float(buckets[-1])
+
+    def quantile(self, q: float, **labels: object) -> float:
+        """Interpolated quantile (0 < q <= 1) of one series; 0.0 for an
+        empty series. Accuracy is bucket-bounded — pick buckets that
+        bracket the latencies you care about (SERVING_LATENCY_BUCKETS
+        for the serving path)."""
+        if not 0.0 < q <= 1.0:
+            raise ValueError(f"histogram {self.name}: quantile {q} "
+                             "outside (0, 1]")
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            if s is None:
+                return 0.0
+            return self._quantile_from_counts(self.buckets, s.counts,
+                                              s.count, q)
+
+
+#: quantiles every histogram exports in the Prometheus text format
+#: (scrapeable p50/p90/p99 without server-side histogram_quantile —
+#: the serving latency SLO lines; docs/SERVING.md)
+EXPORT_QUANTILES: Tuple[float, ...] = (0.5, 0.9, 0.99)
+
+#: serving-latency ladder: batched CPU/TPU lookups + dense forwards sit
+#: in the 100µs..100ms band the default seconds ladder cannot resolve
+SERVING_LATENCY_BUCKETS: Tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+
 
 def iter_prom_lines(inst: Instrument) -> Iterator[str]:
     """Prometheus text-exposition lines for one instrument."""
@@ -172,7 +219,8 @@ def iter_prom_lines(inst: Instrument) -> Iterator[str]:
         yield f"# HELP {inst.name} {inst.help}"
     yield f"# TYPE {inst.name} {inst.kind}"
     if isinstance(inst, Histogram):
-        for k, s in inst.series():
+        series = inst.series()
+        for k, s in series:
             acc = 0
             for le, c in zip(inst.buckets, s.counts):
                 acc += c
@@ -182,6 +230,20 @@ def iter_prom_lines(inst: Instrument) -> Iterator[str]:
             yield f"{inst.name}_bucket{fmt_labels(k, inf_lbl)} {s.count}"
             yield f"{inst.name}_sum{fmt_labels(k)} {s.sum}"
             yield f"{inst.name}_count{fmt_labels(k)} {s.count}"
+        # interpolated p50/p90/p99 as a SIBLING gauge family
+        # (`<name>_quantile`) so dashboards scrape latency SLOs without
+        # server-side histogram_quantile. A separate declared family on
+        # purpose: bare-name quantile samples are summary-type syntax,
+        # and strict parsers reject them inside a histogram family.
+        if series:
+            yield f"# TYPE {inst.name}_quantile gauge"
+            for k, s in series:
+                for q in EXPORT_QUANTILES:
+                    v = Histogram._quantile_from_counts(
+                        inst.buckets, s.counts, s.count, q)
+                    q_lbl = 'quantile="%s"' % q
+                    yield (f"{inst.name}_quantile"
+                           f"{fmt_labels(k, q_lbl)} {v:g}")
     else:
         for k, v in inst.series():
             yield f"{inst.name}{fmt_labels(k)} {v}"

@@ -1162,6 +1162,23 @@ class EmbeddingTable:
         with self.host_lock:
             self._touched[:] = False
 
+    def rows_digest(self) -> str:
+        """sha256 over the logical rows sorted by feasign — the
+        read-only full-model fingerprint (row-assignment order cancels
+        out; no touched flags change, so digesting is inert). The
+        single-table sibling of ``HostStore.rows_digest`` /
+        ``TieredShardedEmbeddingTable.rows_digest`` — serving gates
+        compare served snapshots against it (scripts/serve_check.py)."""
+        import hashlib
+        with self.host_lock:
+            keys, rows = self.index.items()
+        order = np.argsort(keys)
+        data = np.asarray(jax.device_get(self.state.data))
+        h = hashlib.sha256()
+        h.update(np.ascontiguousarray(keys[order]).tobytes())
+        h.update(np.ascontiguousarray(data[rows[order]]).tobytes())
+        return h.hexdigest()
+
     def _assign_file_rows(self, keys: np.ndarray,
                           slots_b: np.ndarray) -> np.ndarray:
         """Assign rows for a save-file's keys — slotted when the arena is

@@ -57,6 +57,18 @@ site                        seam
                             checksum chain refuses the version
                             (``ArtifactCorruptError``) and adoption
                             degrades to the newest verifiable one
+``serving.reload``          start of every background hot-reload poll
+                            (serving.ReloadLoop.poll_once): a ``fail``
+                            fault here (or anywhere inside the poll's
+                            store reads) NEVER reaches the query path —
+                            the loop books
+                            ``pbox_serving_reload_refused_total``,
+                            keeps serving the prior snapshot and
+                            re-polls on the seeded RetryPolicy backoff
+                            (docs/SERVING.md); transient
+                            ``artifact.read`` failures inside the poll
+                            retry on their own seeded policy without a
+                            refusal (chaos fault 7)
 ``stream.window``           each streaming window dispatch (windowed
                             ``QueueDataset``, data/dataset.py): fires as
                             a window's readers are about to start, ctx

@@ -12,35 +12,115 @@ into a read-only ``ServingModel`` that answers:
 
 - ``embed_lookup(keys)`` — raw feature rows for feature-store style use;
 - ``predict(batch)``     — full CTR forward (pull → fused_seqpool_cvm →
-  dense net), eval semantics: unknown keys read as zeros, nothing trains.
+  dense net), eval semantics: unknown keys read as zeros, nothing trains;
+- ``predict_many(...)``  — the batched inference path: micro-batches a
+  request stream through ONE snapshot (docs/SERVING.md).
 
-Kept deliberately dependency-light: one table + a flax module + params,
-jit-compiled per batch bucket; suitable for a CPU host or a TPU chip.
+Concurrent serving (ISSUE 15 tentpole — serve-while-training): queries
+never read mutable loader state. Every adoption **materializes an
+immutable ``ServingSnapshot``** (copy-on-publish: a frozen key index +
+the persistent jax table value + a host mirror + the dense params +
+the artifact id, all captured together) and swaps it in with a single
+atomic pointer assignment. A query fences ONCE (one attribute read of
+``self._snap``) and then works exclusively off that snapshot — it can
+never block on, or be torn by, a concurrent hot-reload; a snapshot that
+has been swapped out keeps answering readers already inside it (the
+data is fully in-memory — no file access after materialization, so even
+a retention sweep of its version cannot hurt in-flight queries).
+
+The **background hot-reload loop** (:class:`ReloadLoop`) polls the
+``ArtifactStore`` tip, verifies-before-swap on the lease/chain machinery
+(artifacts.py) and on a corrupt or torn tip DEGRADES LOUDLY — keeps
+serving the prior snapshot, books
+``pbox_serving_reload_{adopted,refused,degraded}_total`` and the
+``pbox_serving_staleness_sec`` gauge, re-polls on the seeded
+RetryPolicy backoff — and never crashes or blocks the query path.
 """
 
 from __future__ import annotations
 
 import os
 import pickle
-from typing import Optional
+import threading
+import time
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from paddlebox_tpu.artifacts import (ArtifactLineageError,
+from paddlebox_tpu.artifacts import (ArtifactCorruptError,
+                                     ArtifactLineageError,
                                      manifest_beside, verify_payload)
-from paddlebox_tpu.data.batch import SlotBatch
+from paddlebox_tpu.config import FLAGS
+from paddlebox_tpu.data.batch import BatchBuilder, SlotBatch
 from paddlebox_tpu.data.schema import DataFeedDesc
 from paddlebox_tpu.ops import fused_seqpool_cvm
 from paddlebox_tpu.ps.sgd import SparseSGDConfig
-from paddlebox_tpu.ps.table import (EmbeddingTable, expand_pull,
-                                    gather_full_rows, pull_values)
-from paddlebox_tpu.train.step import (DeviceBatch, make_device_batch,
-                                      unpack_floats)
+from paddlebox_tpu.ps.table import (EmbeddingTable, TableState,
+                                    expand_pull, gather_full_rows,
+                                    pull_values)
+from paddlebox_tpu.train.step import DeviceBatch, make_device_batch
 from paddlebox_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
+
+
+def _counter(name: str, help_: str, **labels) -> None:
+    try:
+        from paddlebox_tpu.obs.hub import get_hub
+        get_hub().counter(name, help_).inc(**labels)
+    except Exception:
+        log.debug("serving counter failed", exc_info=True)
+
+
+def _emit(event: str, **fields) -> None:
+    try:
+        from paddlebox_tpu.obs.hub import get_hub
+        hub = get_hub()
+        if hub.active:
+            hub.emit(event, **fields)
+    except Exception:
+        log.debug("serving event emit failed", exc_info=True)
+
+
+class ServingSnapshot:
+    """One immutable, fully-materialized read view: a frozen
+    ``EmbeddingTable`` (private index, the persistent jax state value),
+    a host mirror for lock-free lookups, the dense params and the
+    artifact identity they were captured with. NOTHING mutates a
+    snapshot after construction — the serving contract; the private
+    ``host_lock`` inside ``prepare_eval`` is uncontended by design (no
+    writer ever takes a snapshot's lock)."""
+
+    __slots__ = ("aid", "epoch", "created_unix", "adopted_ts", "table",
+                 "params", "host_data", "rows")
+
+    def __init__(self, table: EmbeddingTable, params,
+                 host_data: np.ndarray, aid: Optional[str],
+                 epoch: Optional[int],
+                 created_unix: Optional[float]) -> None:
+        self.table = table
+        self.params = params
+        self.host_data = host_data
+        self.aid = aid
+        self.epoch = epoch
+        self.created_unix = created_unix
+        self.adopted_ts = time.time()
+        self.rows = len(table.index)
+
+    def lookup(self, keys: np.ndarray) -> np.ndarray:
+        """[n] uint64 → [n, 3+mf] pull values off the host mirror;
+        unknown keys → zeros. Pure numpy over frozen arrays — lock-free
+        and immune to concurrent reloads."""
+        return self.table.host_pull(keys, data=self.host_data)
+
+    def digest(self) -> str:
+        """sha256 over the snapshot's logical rows sorted by feasign —
+        ONE definition shared with the writer-side fingerprint: the
+        frozen table's ``EmbeddingTable.rows_digest`` (the table is
+        immutable, so this is as read-only as everything else here)."""
+        return self.table.rows_digest()
 
 
 class ServingModel:
@@ -59,22 +139,45 @@ class ServingModel:
         self.cvm_offset = cvm_offset
         self.need_filter = need_filter
         self.quant_ratio = quant_ratio
+        self.mf_dim = mf_dim
+        self.capacity = capacity
+        self._cfg = SparseSGDConfig()
+        #: the LOADER table: the single-writer working state the
+        #: load/adopt paths mutate. Queries never read it — they read
+        #: the immutable snapshot materialized from it.
         self.table = EmbeddingTable(mf_dim=mf_dim, capacity=capacity,
-                                    cfg=SparseSGDConfig())
+                                    cfg=self._cfg)
         self.params = None
-        self._host_data: Optional[np.ndarray] = None  # lookup cache
-        b = self.desc.batch_size
+        import functools
         s = len(self.desc.sparse_slots)
 
-        @jax.jit
-        def _fwd(table_state, params, dev: DeviceBatch):
+        @functools.partial(jax.jit, static_argnums=(3,))
+        def _fwd(table_state, params, dev: DeviceBatch, bs: int):
             from paddlebox_tpu.train.step import ctr_forward
             return ctr_forward(
-                table_state, params, self.model, dev, b, s,
+                table_state, params, self.model, dev, bs, s,
                 self.use_cvm, self.cvm_offset, self.need_filter,
                 self.quant_ratio)
 
-        self._fwd = _fwd  # jit retraces per batch-bucket shape itself
+        # jit retraces per (batch bucket, batch size): the full desc
+        # bucket for predict(), plus one variant per predict_many
+        # micro-batch width — that's what makes serving_batch_max a
+        # REAL latency knob (chunks compute chunk-wide dense forwards,
+        # not full-bucket ones)
+        self._fwd = _fwd
+        # ---- concurrent-serving state (docs/SERVING.md) ----
+        # one atomic pointer: queries read it ONCE (the fence) and then
+        # never touch model state again. Writers (adopt/hot_reload/
+        # load_*) serialize on _reload_lock and assign a fully-built
+        # replacement — the swap is a plain attribute store.
+        self._snap: Optional[ServingSnapshot] = None
+        self._reload_lock = threading.RLock()
+        # False after a failed/partial chain load: the next reload must
+        # re-adopt from scratch instead of stacking deltas on a state
+        # of unknown completeness
+        self._loader_clean = True
+        self._last_reload_ts: Optional[float] = None
+        self._staleness_sec: float = 0.0
 
     # ---- artifact loading ----
     # Published-version state (artifacts.py): the id the loaded state
@@ -83,14 +186,18 @@ class ServingModel:
     _adopted_aid: Optional[str] = None
     _handle = None
 
-    def _verify_managed(self, path: str, parent_check: bool) -> Optional[str]:
+    @property
+    def adopted_aid(self) -> Optional[str]:
+        return self._adopted_aid
+
+    def _verify_managed(self, path: str, parent_check: bool) -> Optional[dict]:
         """When ``path`` sits inside a published version dir (a
         MANIFEST.json lives next to it), verify the payload's sha256
         and — for deltas — that the version's parent IS the currently
-        loaded version. Returns the manifest's artifact id, or None
-        for a plain legacy file. Refuses LOUDLY on any mismatch: an
-        out-of-order / wrong-parent / bit-flipped delta must never
-        merge silently (ISSUE 14 satellite)."""
+        loaded version. Returns the manifest, or None for a plain
+        legacy file. Refuses LOUDLY on any mismatch: an out-of-order /
+        wrong-parent / bit-flipped delta must never merge silently
+        (ISSUE 14 satellite)."""
         m = manifest_beside(path)   # raises ArtifactCorruptError if torn
         if m is None:
             if parent_check and self._adopted_aid is not None:
@@ -108,17 +215,21 @@ class ServingModel:
                 f"{m.get('parent')!r} but the loaded state is "
                 f"{self._adopted_aid!r} — apply the chain in lineage "
                 "order")
-        return m.get("artifact")
+        return m
 
     def load_base(self, path: str) -> int:
         """Replace the table with a save_base artifact. A base inside a
         published version dir is checksum-verified first and pins the
         lineage every later ``apply_delta`` must extend."""
-        aid = self._verify_managed(path, parent_check=False)
-        n = self.table.load(path, merge=False)
-        self._adopted_aid = aid
-        self._rebase_handle(aid)
-        self._host_data = None
+        with self._reload_lock:
+            m = self._verify_managed(path, parent_check=False)
+            aid = m.get("artifact") if m else None
+            self._loader_clean = False
+            n = self.table.load(path, merge=False)
+            self._loader_clean = True
+            self._adopted_aid = aid
+            self._rebase_handle(aid)
+            self._refresh_snapshot(m)
         log.info("serving: loaded base %s (%d rows%s)", path, n,
                  f", artifact {aid}" if aid else "")
         return n
@@ -135,12 +246,16 @@ class ServingModel:
         to them) keep the unverified behavior — unless the loaded
         state itself came from an artifact, in which case an
         unverifiable delta is refused too."""
-        aid = self._verify_managed(path, parent_check=True)
-        n = self.table.load(path, merge=True)
-        if aid is not None:
-            self._adopted_aid = aid
-        self._rebase_handle(self._adopted_aid)
-        self._host_data = None
+        with self._reload_lock:
+            m = self._verify_managed(path, parent_check=True)
+            aid = m.get("artifact") if m else None
+            self._loader_clean = False
+            n = self.table.load(path, merge=True)
+            self._loader_clean = True
+            if aid is not None:
+                self._adopted_aid = aid
+            self._rebase_handle(self._adopted_aid)
+            self._refresh_snapshot(m)
         log.info("serving: applied delta %s (%d rows%s)", path, n,
                  f", artifact {aid}" if aid else "")
         return n
@@ -154,28 +269,129 @@ class ServingModel:
             self._handle.close()
             self._handle = None
 
+    # ---- snapshot materialization (copy-on-publish) --------------------
+    def _materialize(self, manifest: Optional[dict]) -> ServingSnapshot:
+        """Freeze the loader's current state into an immutable
+        snapshot. Cheap by construction: the jax table state is a
+        persistent value (every load builds a NEW ``TableState``), so
+        only the key index is copied; the one host D2H mirrors the
+        packed rows for lock-free lookups."""
+        loader = self.table
+        with loader.host_lock:
+            keys, rows = loader.index.items()
+        state = loader.state
+        frozen = EmbeddingTable(mf_dim=loader.mf_dim,
+                                capacity=loader.capacity, cfg=loader.cfg)
+        frozen.slot_host = loader.slot_host.copy()
+        if len(keys):
+            order = np.argsort(rows)
+            got = frozen.index.assign(keys[order])
+            if not np.array_equal(got, rows[order]):
+                # allocator gave the fresh index a different layout
+                # (holes after a shrink, arena tables): repack the
+                # state — and the per-row slot metadata — into the
+                # frozen index's row order instead of assuming row
+                # identity
+                data = np.asarray(jax.device_get(state.data))
+                logical = np.zeros_like(data)
+                logical[got] = data[rows[order]]
+                state = TableState.from_logical(logical, loader.capacity,
+                                                ext=loader.opt_ext)
+                frozen.slot_host = np.zeros_like(loader.slot_host)
+                frozen.slot_host[got] = loader.slot_host[rows[order]]
+        frozen.state = state
+        host_data = np.asarray(jax.device_get(state.data))
+        m = manifest or {}
+        return ServingSnapshot(
+            frozen, self.params, host_data,
+            aid=self._adopted_aid,
+            epoch=m.get("epoch"), created_unix=m.get("created_unix"))
+
+    def _refresh_snapshot(self, manifest: Optional[dict]) -> None:
+        """Build-then-swap (caller holds ``_reload_lock``): readers on
+        the old snapshot finish there; new fences see the new one."""
+        self._snap = self._materialize(manifest)
+        self._last_reload_ts = time.time()
+        self._staleness_sec = 0.0
+
+    def _ensure_snapshot(self) -> ServingSnapshot:
+        """THE query fence: one atomic read. The slow path (first query
+        before any load, or after a legacy path-based load sequence)
+        materializes under the reload lock; store adoptions always
+        swap eagerly so concurrent queries never take this lock."""
+        snap = self._snap
+        if snap is not None:
+            return snap
+        with self._reload_lock:
+            if self._snap is None:
+                self._refresh_snapshot(None)
+            return self._snap
+
+    def snapshot(self) -> ServingSnapshot:
+        """The currently-serving immutable snapshot (public fence —
+        callers doing multi-query work pin one and reuse it)."""
+        return self._ensure_snapshot()
+
+    def serving_status(self) -> dict:
+        """The /healthz ``serving`` block (obs/hub.set_serving_probe):
+        adopted version id, adoption epoch, last reload wall clock,
+        snapshot staleness vs the newest published version, and the
+        SLO verdict against ``FLAGS.serving_staleness_max_sec``."""
+        snap = self._snap
+        stale_max = FLAGS.serving_staleness_max_sec
+        return {
+            "adopted": self._adopted_aid,
+            "epoch": snap.epoch if snap is not None else None,
+            "rows": snap.rows if snap is not None else 0,
+            "last_reload_ts": self._last_reload_ts,
+            "staleness_sec": round(self._staleness_sec, 3),
+            "stale": bool(stale_max > 0
+                          and self._staleness_sec > stale_max),
+        }
+
+    def register_health(self, hub=None) -> None:
+        """Register this model as the process's serving health surface:
+        /healthz grows the ``serving`` block and /readyz starts
+        answering 503-until-first-adoption (obs/hub). NOT automatic on
+        ``adopt`` — auxiliary consumers (replay oracles, verification
+        readers) adopt too, and the last registration wins; the health
+        surface belongs to the model explicitly registered (or driven
+        by a :class:`ReloadLoop`, whose ``start`` registers it)."""
+        from paddlebox_tpu.obs.hub import get_hub
+        (hub or get_hub()).set_serving_probe(self.serving_status)
+
     # ---- store adoption (the lease-fenced consumer path) ----
     def adopt(self, store, version: Optional[str] = None) -> str:
         """Adopt a published version from an ``ArtifactStore``: takes a
         reader lease, verifies the FULL checksum+lineage chain before
         touching any state, then loads base → deltas (and the dense
-        params when the version carries them). With ``version=None``
+        params when the version carries them), materializes the
+        immutable snapshot and swaps it in. With ``version=None``
         adopts the newest VERIFIABLE version (corrupt tips are refused
         loudly and skipped). Returns the adopted artifact id; the lease
         is held until ``release()``/the next ``adopt`` so retention can
         never sweep the version mid-serve."""
-        handle = store.open(version)
-        self._load_from(handle, start=0, fresh=True)
-        log.info("serving: adopted artifact %s (chain %s)", handle.aid,
-                 [m["artifact"] for m in handle.chain])
-        return handle.aid
+        with self._reload_lock:
+            handle = store.open(version)
+            self._load_from(handle, start=0, fresh=True)
+            log.info("serving: adopted artifact %s (chain %s)",
+                     handle.aid, [m["artifact"] for m in handle.chain])
+            return handle.aid
 
     def _load_from(self, handle, start: int, fresh: bool) -> None:
-        """Load a (suffix of a) verified chain from an open handle,
-        then swap it in as the held lease. The handle is closed on any
-        failure — no lease leaks, and the caller's old handle stays
-        live until the new state fully loaded."""
+        """Load a (suffix of a) verified chain from an open handle into
+        the loader, then materialize + swap the snapshot and take over
+        the lease. The handle is closed on any failure — no lease
+        leaks, the old snapshot keeps serving, and the loader is marked
+        dirty so the next reload re-adopts from scratch."""
         try:
+            if fresh:
+                # copy-on-publish: a FRESH loader (never the serving
+                # snapshot's index) absorbs the chain
+                self.table = EmbeddingTable(mf_dim=self.mf_dim,
+                                            capacity=self.capacity,
+                                            cfg=self._cfg)
+            self._loader_clean = False
             first = fresh
             for m in handle.chain[start:]:
                 name = ("sparse.npz" if m["kind"] == "base"
@@ -184,7 +400,12 @@ class ServingModel:
                                 merge=not first)
                 first = False
             if "dense.pkl" in handle.manifest.get("files", {}):
-                self.load_dense(handle.path("dense.pkl"))
+                # raw read — the snapshot below publishes table AND
+                # params together (load_dense's own swap would pair
+                # new params with the still-serving OLD table)
+                self.params = self._read_dense(
+                    handle.path("dense.pkl"))
+            self._loader_clean = True
         except BaseException:
             handle.close()
             raise
@@ -192,53 +413,112 @@ class ServingModel:
             self._handle.close()
         self._handle = handle
         self._adopted_aid = handle.aid
-        self._host_data = None
+        self._refresh_snapshot(handle.manifest)
+        _counter("pbox_serving_reload_adopted_total",
+                 "serving snapshot adoptions",
+                 kind=handle.manifest.get("kind", "base"))
 
     def hot_reload(self, store) -> Optional[str]:
         """Advance to the newest verifiable version, applying ONLY the
         new deltas when its chain extends the adopted state (the
         delta hot-reload path); falls back to a full re-adopt when the
-        lineage diverged. No-op (returns None) when already current."""
-        handle = store.open()
-        if handle.aid == self._adopted_aid:
-            handle.close()
-            return None
-        chain_ids = [m["artifact"] for m in handle.chain]
-        if self._adopted_aid in chain_ids:
-            # the new tip extends us: apply only the new deltas
-            self._load_from(
-                handle, start=chain_ids.index(self._adopted_aid) + 1,
-                fresh=False)
-        else:
-            # diverged lineage (rollback / new base): full re-adopt
-            self._load_from(handle, start=0, fresh=True)
-        log.info("serving: hot-reloaded to artifact %s", handle.aid)
-        return handle.aid
+        lineage diverged or a previous load left the loader dirty.
+        No-op (returns None) when already current. Queries keep
+        serving the prior snapshot for the whole duration — the new
+        one swaps in only after it fully verified AND materialized."""
+        with self._reload_lock:
+            handle = store.open()
+            if handle.aid == self._adopted_aid:
+                handle.close()
+                self._staleness_sec = 0.0
+                return None
+            chain_ids = [m["artifact"] for m in handle.chain]
+            if self._adopted_aid in chain_ids and self._loader_clean:
+                # the new tip extends us: apply only the new deltas
+                self._load_from(
+                    handle,
+                    start=chain_ids.index(self._adopted_aid) + 1,
+                    fresh=False)
+            else:
+                # diverged lineage (rollback / new base) or dirty
+                # loader: full re-adopt
+                self._load_from(handle, start=0, fresh=True)
+            log.info("serving: hot-reloaded to artifact %s", handle.aid)
+            return handle.aid
 
     def release(self) -> None:
-        """Drop the artifact lease (retention may sweep the version)."""
-        if self._handle is not None:
-            self._handle.close()
-            self._handle = None
+        """Drop the artifact lease (retention may sweep the version).
+        Idempotent under concurrent callers; readers inside the current
+        snapshot are unaffected — its data is in-memory."""
+        with self._reload_lock:
+            if self._handle is not None:
+                self._handle.close()
+                self._handle = None
+
+    def note_staleness(self, sec: float) -> None:
+        """ReloadLoop's staleness report (serving epoch age vs the
+        newest published version) — rides /healthz and the
+        ``pbox_serving_staleness_sec`` gauge."""
+        self._staleness_sec = float(sec)
+
+    @staticmethod
+    def _read_dense(path: str):
+        with open(path, "rb") as fh:
+            blob = pickle.load(fh)
+        return jax.device_put(
+            blob[0] if isinstance(blob, tuple) else blob)
 
     def load_dense(self, path: str) -> None:
         """Load dense params — accepts the trainer's ``.dense.pkl``
         (params, opt_state) or a CheckpointManager ``dense.pkl``
-        (params, opt_state, auc); only params are used."""
-        with open(path, "rb") as fh:
-            blob = pickle.load(fh)
-        self.params = jax.device_put(
-            blob[0] if isinstance(blob, tuple) else blob)
+        (params, opt_state, auc); only params are used. A serving
+        snapshot already in place gets a PARAMS-ONLY swap (same frozen
+        table, new params published atomically) so a dense-only
+        refresh reaches queries immediately — and never blocks them."""
+        self.params = self._read_dense(path)
+        with self._reload_lock:
+            snap = self._snap
+            if snap is not None:
+                self._snap = ServingSnapshot(
+                    snap.table, self.params, snap.host_data,
+                    aid=snap.aid, epoch=snap.epoch,
+                    created_unix=snap.created_unix)
 
-    # ---- queries ----
+    # ---- queries (snapshot-pinned; docs/SERVING.md) ----
+    def _observe_latency(self, op: str, sec: float) -> None:
+        try:
+            from paddlebox_tpu.obs.hub import get_hub
+            from paddlebox_tpu.obs.instruments import \
+                SERVING_LATENCY_BUCKETS
+            hub = get_hub()
+            if hub.active:
+                hub.histogram(
+                    "pbox_serving_latency_seconds",
+                    "serving query latency (per lookup/predict call)",
+                    buckets=SERVING_LATENCY_BUCKETS).observe(sec, op=op)
+        except Exception:
+            log.debug("serving latency observe failed", exc_info=True)
+
     def embed_lookup(self, keys: np.ndarray) -> np.ndarray:
         """[n] uint64 → [n, 3+mf] pull values (show, clk, w, embedx…);
-        unknown keys → zeros. Serves from a cached host mirror of the
-        table (invalidated by load_base/apply_delta)."""
-        if self._host_data is None:
-            self._host_data = np.asarray(
-                jax.device_get(self.table.state.data))
-        return self.table.host_pull(keys, data=self._host_data)
+        unknown keys → zeros. Served lock-free off the current
+        snapshot's host mirror."""
+        t0 = time.perf_counter()
+        out = self._ensure_snapshot().lookup(keys)
+        self._observe_latency("lookup", time.perf_counter() - t0)
+        return out
+
+    def _predict_on(self, snap: ServingSnapshot, batch: SlotBatch,
+                    return_valid: bool):
+        if snap.params is None:
+            raise RuntimeError("load_dense first")
+        idx = snap.table.prepare_eval(batch)
+        dev = make_device_batch(batch, idx)
+        pred, ins_w = self._fwd(snap.table.state, snap.params, dev,
+                                batch.batch_size)
+        if return_valid:
+            return np.asarray(pred), np.asarray(ins_w)
+        return np.asarray(pred)
 
     def predict(self, batch: SlotBatch,
                 return_valid: bool = False):
@@ -248,14 +528,268 @@ class ServingModel:
         entries hold the net's output on zero rows, NOT real
         predictions — pass ``return_valid=True`` to also get the 0/1
         validity mask and filter them."""
-        if self.params is None:
-            raise RuntimeError("load_dense first")
-        idx = self.table.prepare_eval(batch)
-        dev = make_device_batch(batch, idx)
-        pred, ins_w = self._fwd(self.table.state, self.params, dev)
+        t0 = time.perf_counter()
+        out = self._predict_on(self._ensure_snapshot(), batch,
+                               return_valid)
+        self._observe_latency("predict", time.perf_counter() - t0)
+        return out
+
+    def predict_many(self, requests, return_valid: bool = False):
+        """The batched inference path: run a request stream through ONE
+        pinned snapshot (a hot-reload mid-stream cannot mix versions
+        inside the call). ``requests`` is either an iterable of
+        ``SlotBatch`` (pre-batched traffic) or a sequence of
+        ``SlotRecord`` — records are micro-batched into chunks of at
+        most ``FLAGS.serving_batch_max`` (0 = the desc batch size, one
+        compiled bucket; a smaller cap builds CHUNK-SIZED batches, so
+        each forward computes a chunk-wide dense net — the actual
+        per-query latency trade, at the cost of one extra compiled
+        variant per chunk width) and only the valid predictions are
+        returned. Chunks build and run STREAMED — a long request list
+        never materializes all its padded batches up front. Returns
+        the concatenated [N] predictions (plus the validity mask with
+        ``return_valid=True``); each micro-batch observes its own
+        latency sample in ``pbox_serving_latency_seconds``."""
+        import dataclasses
+
+        snap = self._ensure_snapshot()
+        reqs = list(requests)
+        preds: List[np.ndarray] = []
+        valids: List[np.ndarray] = []
+
+        def run(batch: SlotBatch, n_valid: int) -> None:
+            t0 = time.perf_counter()
+            pred, ins_w = self._predict_on(snap, batch,
+                                           return_valid=True)
+            self._observe_latency("predict",
+                                  time.perf_counter() - t0)
+            preds.append(pred[:n_valid])
+            valids.append(ins_w[:n_valid])
+
+        if reqs and not isinstance(reqs[0], SlotBatch):
+            cap = self.desc.batch_size
+            m = FLAGS.serving_batch_max
+            chunk = cap if m <= 0 else max(1, min(int(m), cap))
+            builder = BatchBuilder(
+                self.desc if chunk == cap
+                else dataclasses.replace(self.desc, batch_size=chunk))
+            for i in range(0, len(reqs), chunk):
+                part = reqs[i:i + chunk]
+                run(builder.build(part), len(part))
+        else:
+            for b in reqs:
+                run(b, b.batch_size)
+        if not preds:
+            empty = np.empty(0, np.float32)
+            return (empty, empty) if return_valid else empty
+        pred = np.concatenate(preds)
         if return_valid:
-            return np.asarray(pred), np.asarray(ins_w)
-        return np.asarray(pred)
+            return pred, np.concatenate(valids)
+        return pred
+
+
+class ReloadLoop:
+    """Background hot-reload: polls the ``ArtifactStore`` tip every
+    ``FLAGS.serving_reload_poll_sec`` and advances the serving snapshot
+    through ``ServingModel.hot_reload``. The robustness contract
+    (docs/SERVING.md §Reload/degrade state machine):
+
+    - **verify-before-swap**: adoption rides the store's lease + full
+      checksum-chain verification; the snapshot swaps only after the
+      new state fully materialized.
+    - **degrade, never crash or block**: any poll failure (corrupt tip,
+      torn manifest, transient IO past its retries, an injected
+      ``serving.reload`` fault) leaves the prior snapshot serving,
+      books ``pbox_serving_reload_refused_total{reason}`` + a
+      ``serving_reload_refused`` event, and re-polls on the seeded
+      RetryPolicy backoff schedule (site ``serving.reload``). A tip
+      that exists but cannot be adopted (corrupt → store degraded to
+      an older version) additionally books
+      ``pbox_serving_reload_degraded_total`` and the staleness gauge —
+      the degrade state is loud.
+    - **staleness**: ``pbox_serving_staleness_sec`` = how long a newer
+      adoptable version has been published without the serving
+      snapshot advancing (0 when current); past
+      ``FLAGS.serving_staleness_max_sec`` the /healthz serving block
+      flips ``stale``.
+    """
+
+    def __init__(self, model: ServingModel, store,
+                 poll_sec: Optional[float] = None) -> None:
+        self.model = model
+        self.store = store
+        self.poll_sec = (FLAGS.serving_reload_poll_sec
+                         if poll_sec is None else float(poll_sec))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._backoff = None   # armed after a failed poll
+        # poll outcome counts (mirrored into pbox_serving_reload_*):
+        # gates read these without needing an active hub
+        self.polls = 0
+        self.adopted = 0
+        self.refused = 0
+        self.degraded = 0
+
+    # ---- one poll ------------------------------------------------------
+    def poll_once(self) -> Optional[str]:
+        """One reload poll. Returns the newly adopted artifact id (None
+        when already current or the poll failed). NEVER raises — the
+        query path must survive any reload failure."""
+        from paddlebox_tpu.resilience import faults
+        self.polls += 1
+        try:
+            faults.inject("serving.reload", op="poll",
+                          adopted=self.model.adopted_aid or "")
+            aid = self.model.hot_reload(self.store)
+        except Exception as e:
+            self.refused += 1
+            reason = ("corrupt" if isinstance(e, ArtifactCorruptError)
+                      else "lineage" if isinstance(e, ArtifactLineageError)
+                      else "empty" if isinstance(e, FileNotFoundError)
+                      else "io")
+            _counter("pbox_serving_reload_refused_total",
+                     "hot-reload polls that failed (prior snapshot "
+                     "kept serving)", reason=reason)
+            _emit("serving_reload_refused", reason=reason,
+                  error=repr(e), adopted=self.model.adopted_aid or "")
+            log.error("serving hot-reload REFUSED (%s) — keeping the "
+                      "prior snapshot (%s): %s", reason,
+                      self.model.adopted_aid, e)
+            self._arm_backoff()
+            self._note_staleness()
+            return None
+        self._backoff = None
+        if aid is not None:
+            self.adopted += 1
+            _emit("serving_reload", artifact=aid,
+                  rows=self.model.serving_status()["rows"])
+        self._note_staleness()
+        return aid
+
+    def _note_staleness(self) -> None:
+        """Serving epoch age vs the newest published version: 0 when
+        the snapshot IS the tip; otherwise how long the newer tip has
+        existed unadopted (a corrupt tip counts — that is exactly the
+        degraded state the gauge must show)."""
+        lag, tip = 0.0, None
+        try:
+            adopted = self.model.adopted_aid
+            for aid in reversed(self.store.versions()):
+                try:
+                    m = self.store.read_manifest(aid, verify=False)
+                except Exception:
+                    m = None   # torn manifest: still a newer tip
+                if m is not None and not m.get("adoptable", True):
+                    continue   # chain-only link: never a serving tip
+                tip = aid
+                if aid != adopted:
+                    created = (m or {}).get("created_unix")
+                    if created is None:
+                        try:
+                            created = os.stat(
+                                self.store.version_dir(aid)).st_mtime
+                        except OSError:
+                            created = time.time()
+                    lag = max(0.0, time.time() - float(created))
+                break
+        except Exception:
+            log.debug("staleness probe failed", exc_info=True)
+        self.model.note_staleness(lag)
+        if lag > 0.0 and tip is not None:
+            self.degraded += 1
+            _counter("pbox_serving_reload_degraded_total",
+                     "polls that left serving BEHIND the newest "
+                     "published version")
+            _emit("serving_degraded", tip=tip,
+                  adopted=self.model.adopted_aid or "",
+                  staleness_sec=round(lag, 3))
+            if FLAGS.serving_staleness_max_sec > 0 \
+                    and lag > FLAGS.serving_staleness_max_sec:
+                log.error(
+                    "serving snapshot STALE: %s published %.1fs ago, "
+                    "still serving %s (SLO %.1fs)", tip, lag,
+                    self.model.adopted_aid,
+                    FLAGS.serving_staleness_max_sec)
+        try:
+            from paddlebox_tpu.obs.hub import get_hub
+            get_hub().gauge("pbox_serving_staleness_sec",
+                            "serving snapshot age vs newest published "
+                            "version").set(lag)
+            if self.model._snap is not None:
+                self._emit_stats()
+        except Exception:
+            log.debug("staleness gauge failed", exc_info=True)
+
+    def _emit_stats(self) -> None:
+        """Per-poll ``serving_stats`` event: adopted version, staleness
+        and the latency quantiles so a run's JSONL alone shows the
+        serving SLO timeline (scripts/telemetry_report.py renders the
+        ``serve p99`` column from these)."""
+        from paddlebox_tpu.obs.hub import get_hub
+        hub = get_hub()
+        if not hub.active:
+            return
+        from paddlebox_tpu.obs.instruments import \
+            SERVING_LATENCY_BUCKETS
+        h = hub.histogram("pbox_serving_latency_seconds",
+                          "serving query latency (per lookup/predict "
+                          "call)", buckets=SERVING_LATENCY_BUCKETS)
+        status = self.model.serving_status()
+        fields = dict(adopted=status["adopted"] or "",
+                      staleness_sec=status["staleness_sec"])
+        total = 0
+        for op in ("lookup", "predict"):
+            s = h.snapshot(op=op)
+            if s["count"]:
+                total += s["count"]
+                fields[f"{op}_p50_ms"] = round(
+                    h.quantile(0.5, op=op) * 1e3, 4)
+                fields[f"{op}_p99_ms"] = round(
+                    h.quantile(0.99, op=op) * 1e3, 4)
+        fields["queries"] = total
+        hub.emit("serving_stats", **fields)
+
+    def _arm_backoff(self) -> None:
+        if self._backoff is None:
+            from paddlebox_tpu.resilience.retry import RetryPolicy
+            self._backoff = RetryPolicy.from_flags(
+                site="serving.reload").delays()
+
+    # ---- thread lifecycle ----------------------------------------------
+    def start(self) -> "ReloadLoop":
+        if self._thread is not None:
+            return self
+        self.model.register_health()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="serving-reload")
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:   # poll_once is defensive; belt anyway
+                log.warning("reload poll crashed", exc_info=True)
+            if self._backoff is not None:
+                delay = next(self._backoff, self.poll_sec)
+            else:
+                delay = self.poll_sec
+            self._stop.wait(delay)
+
+    def stop(self, join: bool = True) -> None:
+        self._stop.set()
+        t = self._thread
+        if join and t is not None:
+            t.join(timeout=30)
+        self._thread = None
+
+    def __enter__(self) -> "ReloadLoop":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
 
 
 class MultiMfServingModel:
@@ -319,7 +853,9 @@ class MultiMfServingModel:
         log.info("serving: applied multi-mf delta %s (%d rows)", path, n)
         return n
 
-    load_dense = ServingModel.load_dense
+    def load_dense(self, path: str) -> None:
+        """Load dense params (same file formats as ServingModel)."""
+        self.params = ServingModel._read_dense(path)
 
     # ---- queries ----
     def embed_lookup(self, keys: np.ndarray,

@@ -20,7 +20,12 @@ injects, in ONE run:
    publishing layer (artifacts.py; docs/RESILIENCE.md §Publishing): a
    transient publish failure retried on the seeded RetryPolicy, and a
    hard-corrupt read of the newest version refused loudly with a
-   graceful fallback to its verifiable parent,
+   graceful fallback to its verifiable parent, and
+7. a transient ``artifact.read`` failure during the SERVING hot-reload
+   poll (serving.ReloadLoop; docs/SERVING.md): the store's seeded
+   RetryPolicy retries it INSIDE the poll — the new version still
+   adopts on that same poll, no refusal is booked, and the query path
+   never sees a gap (the prior snapshot answers throughout),
 
 then asserts full recovery:
 
@@ -266,6 +271,83 @@ def _run_artifact_chaos(workdir: str, seed: int) -> dict:
     }
 
 
+def _run_serving_chaos(workdir: str, seed: int) -> dict:
+    """Fault (7): transient ``artifact.read`` during the background
+    hot-reload poll (serving.ReloadLoop.poll_once). The read retries on
+    the seeded RetryPolicy inside ``store.open`` — the poll itself
+    succeeds (no refusal, no degrade) and serving never gaps: queries
+    issued before, during and after the faulted poll all answer a
+    published version bit-exactly."""
+    import jax
+    import numpy as np
+
+    from paddlebox_tpu.artifacts import ArtifactStore
+    from paddlebox_tpu.data.schema import DataFeedDesc
+    from paddlebox_tpu.models import CtrDnn
+    from paddlebox_tpu.ps import EmbeddingTable, SparseSGDConfig
+    from paddlebox_tpu.ps.box_helper import BoxPSHelper
+    from paddlebox_tpu.ps.table import FIELD_COL, TableState
+    from paddlebox_tpu.resilience.faults import FaultPlan, installed
+    from paddlebox_tpu.serving import ReloadLoop, ServingModel
+
+    cfg = SparseSGDConfig(mf_create_thresholds=1e9)
+    table = EmbeddingTable(mf_dim=4, capacity=1 << 10, cfg=cfg)
+    helper = BoxPSHelper(table)
+    store = ArtifactStore(os.path.join(workdir, "artifacts_serving"))
+
+    def write(lo: int, hi: int, scale: float) -> None:
+        keys = np.arange(lo, hi, dtype=np.uint64)
+        rows = table.index.assign(keys)
+        data = np.asarray(jax.device_get(table.state.data)).copy()
+        data[rows, FIELD_COL["embed_w"]] = keys.astype(np.float32) * scale
+        table.state = TableState.from_logical(data, table.capacity)
+        table._touched[rows] = True
+
+    write(1, 101, 2.0)
+    base_aid = helper.publish_base(store)
+    desc = DataFeedDesc.criteo(batch_size=16)
+    srv = ServingModel(CtrDnn(hidden=(4,)), desc, mf_dim=4,
+                       capacity=1 << 10)
+    assert srv.adopt(store) == base_aid
+    loop = ReloadLoop(srv, store, poll_sec=0.01)
+    probe = np.arange(1, 121, dtype=np.uint64)
+
+    def lookup_scale() -> np.ndarray:
+        return srv.embed_lookup(probe)[:, 2]
+
+    before = lookup_scale()
+    assert np.allclose(before[:100], probe[:100].astype(np.float32) * 2)
+    write(80, 121, 3.0)
+    delta_aid = helper.publish_delta(store)
+    refused0 = loop.refused
+    with installed(FaultPlan.parse("artifact.read:fail:nth=1",
+                                   seed=seed)) as plan:
+        during = lookup_scale()     # query while the poll will retry
+        adopted = loop.poll_once()
+    assert plan.stats()["artifact.read:fail"]["fired"] == 1, plan.stats()
+    assert adopted == delta_aid, (
+        "transient read during the reload poll was not retried to a "
+        f"successful adoption (got {adopted})")
+    assert loop.refused == refused0, (
+        "a retried transient read must not book a reload refusal")
+    assert np.array_equal(during, before), (
+        "a query during the faulted poll saw a torn state")
+    after = lookup_scale()
+    assert np.allclose(after[79:120],
+                       probe[79:120].astype(np.float32) * 3), (
+        "adopted delta rows not served after the retried poll")
+    srv.release()
+    return {
+        "serving_base": base_aid,
+        "serving_delta": delta_aid,
+        "serving_reload_fault_fired":
+            plan.stats()["artifact.read:fail"]["fired"],
+        "serving_reload_adopted": adopted,
+        "serving_reload_refusals": loop.refused - refused0,
+        "serving_no_gap": True,
+    }
+
+
 def run_scenario(workdir: str, seed: int) -> dict:
     """One full chaos run; returns the resilience outcome summary."""
     import optax
@@ -373,6 +455,10 @@ def run_scenario(workdir: str, seed: int) -> dict:
         # publishing layer (same sub-plan discipline)
         artifact_outcome = _run_artifact_chaos(workdir, seed)
 
+        # (7) transient artifact.read during the serving hot-reload
+        # poll: retried inside the poll, no serving gap
+        serving_outcome = _run_serving_chaos(workdir, seed)
+
     # telemetry JSONL: final pass event carries nonzero counters
     with open(jsonl) as fh:
         events = [json.loads(line) for line in fh]
@@ -396,6 +482,7 @@ def run_scenario(workdir: str, seed: int) -> dict:
         stream_windows=int(sout["windows"]),
         **ssd_outcome,
         **artifact_outcome,
+        **serving_outcome,
     )
     return outcome
 

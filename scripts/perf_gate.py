@@ -54,7 +54,18 @@ DEFAULT_MAX_DROP = 0.5
 EXTRA_FIELDS = ("device_busy_frac", "begin_delta_steady_sec",
                 "end_pass_overlap_frac", "vs_baseline", "n_chips",
                 "a2a_chunks", "exchange_overlap_frac",
-                "pv_batch_size", "instances_per_pass")
+                "pv_batch_size", "instances_per_pass",
+                "qps", "queries", "batch")
+
+#: metric-name suffixes gated LOWER-is-better: latency rows
+#: (``serving.{shape}.p99_ms``, BENCH_MODE=serve — ISSUE 15) regress
+#: when the latest value RISES past best*(1+max_drop_frac), the mirror
+#: of the throughput rule. Everything else stays higher-is-better.
+LOWER_IS_BETTER_SUFFIXES = ("_ms",)
+
+
+def lower_is_better(metric: str) -> bool:
+    return str(metric).endswith(LOWER_IS_BETTER_SUFFIXES)
 
 
 def _repo_root() -> str:
@@ -139,15 +150,17 @@ def fold(repo_root: Optional[str] = None,
          out_path: Optional[str] = None) -> Dict:
     """Recorded artifacts → BENCH_trajectory.json (sorted by family,
     then round). Besides the driver's ``BENCH_r0*`` rounds this folds
-    the multichip scaling rounds (``MULTICHIP_r0*``, ISSUE 11) and the
+    the multichip scaling rounds (``MULTICHIP_r0*``, ISSUE 11), the
     kernel-microbench rounds (``KERNELS_r0*``,
-    ``scripts/profile_keypath.py --set kernels`` — ISSUE 12), so a
-    rebuild keeps their gate history instead of silently dropping it."""
+    ``scripts/profile_keypath.py --set kernels`` — ISSUE 12) and the
+    serving-lane rounds (``SERVE_r0*``, BENCH_MODE=serve — ISSUE 15),
+    so a rebuild keeps their gate history instead of silently dropping
+    it."""
     root = repo_root or _repo_root()
     out = out_path or os.path.join(root, "BENCH_trajectory.json")
     rows: List[Dict] = []
     for pattern in ("BENCH_r[0-9]*.json", "MULTICHIP_r[0-9]*.json",
-                    "KERNELS_r[0-9]*.json"):
+                    "KERNELS_r[0-9]*.json", "SERVE_r[0-9]*.json"):
         for path in sorted(glob.glob(os.path.join(root, pattern))):
             rows.extend(parse_bench_artifact(path))
     data = {"version": 1, "rows": rows}
@@ -182,15 +195,29 @@ def check_rows(rows: List[Dict],
             summary.append(f"  {label}: {latest['value']:g} "
                            f"(1 row, no history)")
             continue
-        best = max(prior, key=lambda r: r["value"])
-        floor = best["value"] * (1.0 - max_drop_frac)
-        drop = 1.0 - latest["value"] / best["value"] \
-            if best["value"] > 0 else 0.0
-        line = (f"  {label}: latest {latest['value']:g} "
-                f"({latest.get('source', '?')}) vs best "
-                f"{best['value']:g} ({best.get('source', '?')}) — "
-                f"drop {drop:+.1%}, floor {floor:g}")
-        if latest["value"] < floor:
+        if lower_is_better(key[0]):
+            # latency keys: best = the LOWEST recorded value; the gate
+            # fails when the latest RISES past best*(1+max_drop_frac)
+            best = min(prior, key=lambda r: r["value"])
+            ceil = best["value"] * (1.0 + max_drop_frac)
+            drop = (latest["value"] / best["value"] - 1.0
+                    if best["value"] > 0 else 0.0)
+            line = (f"  {label}: latest {latest['value']:g} "
+                    f"({latest.get('source', '?')}) vs best "
+                    f"{best['value']:g} ({best.get('source', '?')}) — "
+                    f"rise {drop:+.1%}, ceiling {ceil:g}")
+            bad = latest["value"] > ceil
+        else:
+            best = max(prior, key=lambda r: r["value"])
+            floor = best["value"] * (1.0 - max_drop_frac)
+            drop = 1.0 - latest["value"] / best["value"] \
+                if best["value"] > 0 else 0.0
+            line = (f"  {label}: latest {latest['value']:g} "
+                    f"({latest.get('source', '?')}) vs best "
+                    f"{best['value']:g} ({best.get('source', '?')}) — "
+                    f"drop {drop:+.1%}, floor {floor:g}")
+            bad = latest["value"] < floor
+        if bad:
             flagged.append((drop, "PERF REGRESSION:" + line))
         else:
             summary.append(line)

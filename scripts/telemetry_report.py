@@ -106,11 +106,35 @@ def _begin_stall_cell(lp: Dict) -> str:
     return " ".join(bits) or "~0"
 
 
+def _serving_cell(st: Optional[Dict]) -> str:
+    """Render the latest ``serving_stats`` event (serving.ReloadLoop)
+    seen before this pass: the serving-latency column for
+    serve-while-training runs — 'p99 5.99ms @v0000000003 (+2.1s
+    stale)'. Empty when the run has no serving model."""
+    if not st:
+        return ""
+    p99 = st.get("predict_p99_ms", st.get("lookup_p99_ms"))
+    bits = []
+    if p99 is not None:
+        bits.append(f"p99 {float(p99):.2f}ms")
+    if st.get("adopted"):
+        bits.append(f"@{st['adopted']}")
+    stale = float(st.get("staleness_sec", 0.0) or 0.0)
+    if stale > 0:
+        bits.append(f"(+{stale:.1f}s stale)")
+    return " ".join(bits)
+
+
 def build_rows(events: List[dict]) -> List[Dict[str, str]]:
     """Pass events → printable row dicts (the unit tests call this)."""
     rows = []
     prev_blocked: Dict[int, Dict[str, float]] = {}  # per process
+    last_serving: Optional[Dict] = None
+    any_serving = any(e.get("event") == "serving_stats" for e in events)
     for ev in events:
+        if ev.get("event") == "serving_stats":
+            last_serving = ev
+            continue
         if ev.get("event") != "pass":
             continue
         proc = int(ev.get("proc", 0))
@@ -163,6 +187,10 @@ def build_rows(events: List[dict]) -> List[Dict[str, str]]:
             "a2a ovl": _a2a_cell(ev) or "-",
             "hbm peak": _fmt_bytes(hbm.get("peak_bytes_in_use", 0)),
         })
+        if any_serving:
+            # serving-latency column only when the run served (a
+            # training-only JSONL keeps its compact row)
+            rows[-1]["serve p99"] = _serving_cell(last_serving) or "-"
     return rows
 
 
@@ -225,6 +253,43 @@ def critical_path_summary(events: List[dict]) -> str:
     return "critical path: " + ", ".join(bits)
 
 
+def serving_summary(events: List[dict]) -> str:
+    """Whole-run serving verdict from the serving_* events
+    (serving.ReloadLoop; docs/SERVING.md): adoption count, refusals/
+    degrades, the final adopted version, peak staleness and the last
+    observed p99 — 'serving: 4 reloads → v0000000005, p99 0.21ms, max
+    staleness 0.4s'. Empty when the run served nothing."""
+    reloads = [e for e in events if e.get("event") == "serving_reload"]
+    refused = [e for e in events
+               if e.get("event") == "serving_reload_refused"]
+    degraded = [e for e in events
+                if e.get("event") == "serving_degraded"]
+    stats = [e for e in events if e.get("event") == "serving_stats"]
+    if not (reloads or refused or degraded or stats):
+        return ""
+    bits = [f"{len(reloads)} reloads"]
+    adopted = (reloads[-1].get("artifact") if reloads
+               else stats[-1].get("adopted") if stats else None)
+    if adopted:
+        bits[-1] += f" → {adopted}"
+    if refused:
+        bits.append(f"{len(refused)} refused")
+    if degraded:
+        bits.append(f"{len(degraded)} degraded polls")
+    last_p99 = next(
+        (e.get("predict_p99_ms", e.get("lookup_p99_ms"))
+         for e in reversed(stats)
+         if e.get("predict_p99_ms") is not None
+         or e.get("lookup_p99_ms") is not None), None)
+    if last_p99 is not None:
+        bits.append(f"p99 {float(last_p99):.2f}ms")
+    stale = max((float(e.get("staleness_sec", 0.0) or 0.0)
+                 for e in stats + degraded), default=0.0)
+    if stale > 0:
+        bits.append(f"max staleness {stale:.1f}s")
+    return "serving: " + ", ".join(bits)
+
+
 def render_report(events: List[dict], show_events: bool = False) -> str:
     rows = build_rows(events)
     out = [render_table(rows)]
@@ -240,6 +305,9 @@ def render_report(events: List[dict], show_events: bool = False) -> str:
     cp_line = critical_path_summary(events)
     if cp_line:
         out.append(cp_line)
+    sv_line = serving_summary(events)
+    if sv_line:
+        out.append(sv_line)
     recovery = [e for e in events if e.get("event") in RECOVERY_EVENTS]
     if recovery:
         out.append("recovery: " + " -> ".join(_fmt_recovery(e)

@@ -73,6 +73,70 @@ def test_histogram_semantics():
     assert s["buckets"][10.0] == 4
 
 
+def test_histogram_quantiles():
+    """Bucket-interpolated p50/p90/p99 (ISSUE 15 satellite): the
+    serving-latency SLO surface. Linear interpolation inside the
+    target bucket; ranks past the last finite bucket clamp to it."""
+    h = Histogram("lat", buckets=(0.001, 0.01, 0.1, 1.0))
+    for _ in range(50):
+        h.observe(0.0005)
+    for _ in range(40):
+        h.observe(0.005)
+    for _ in range(10):
+        h.observe(0.05)
+    # rank 50 lands exactly at the first bucket's upper bound
+    assert h.quantile(0.5) == pytest.approx(0.001)
+    # rank 90 at the second bucket's bound; rank 99 interpolates 9/10
+    # into the third bucket [0.01, 0.1)
+    assert h.quantile(0.9) == pytest.approx(0.01)
+    assert h.quantile(0.99) == pytest.approx(0.01 + 0.09 * 0.9)
+    # labeled series are independent (one sample in [0.1, 1.0):
+    # rank q interpolates q of the way through its bucket); empty
+    # series read 0
+    h.observe(0.5, op="predict")
+    assert h.quantile(0.5, op="predict") == pytest.approx(0.55)
+    assert h.quantile(0.99, op="predict") == pytest.approx(0.991)
+    assert h.quantile(0.5, op="nope") == 0.0
+    with pytest.raises(ValueError):
+        h.quantile(0.0)
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+    # +Inf overflow clamps to the last finite bucket
+    h2 = Histogram("of", buckets=(0.1, 1.0))
+    for _ in range(10):
+        h2.observe(50.0)
+    assert h2.quantile(0.99) == pytest.approx(1.0)
+
+
+def test_histogram_prom_quantile_lines():
+    """The text exposition carries scrapeable p50/p90/p99 quantile
+    lines per series alongside the buckets and _count/_sum."""
+    from paddlebox_tpu.obs.instruments import iter_prom_lines
+    h = Histogram("pbox_lat_seconds", "latency",
+                  buckets=(0.001, 0.01, 0.1))
+    for _ in range(99):
+        h.observe(0.005, op="lookup")
+    h.observe(0.05, op="lookup")
+    text = "\n".join(iter_prom_lines(h))
+    assert "# TYPE pbox_lat_seconds histogram" in text
+    assert 'pbox_lat_seconds_bucket{op="lookup",le="0.01"} 99' in text
+    assert 'pbox_lat_seconds_bucket{op="lookup",le="+Inf"} 100' in text
+    # quantiles live in a SIBLING declared gauge family — bare-name
+    # quantile samples inside a histogram family are invalid exposition
+    assert "# TYPE pbox_lat_seconds_quantile gauge" in text
+    q50 = h.quantile(0.5, op="lookup")
+    q99 = h.quantile(0.99, op="lookup")
+    assert (f'pbox_lat_seconds_quantile{{op="lookup",quantile="0.5"}} '
+            f"{q50:g}") in text
+    assert (f'pbox_lat_seconds_quantile{{op="lookup",quantile="0.99"}} '
+            f"{q99:g}") in text
+    assert 'pbox_lat_seconds_count{op="lookup"} 100' in text
+    assert "pbox_lat_seconds_sum" in text
+    # the quantile family declaration comes after the histogram block
+    assert text.index("# TYPE pbox_lat_seconds_quantile gauge") \
+        > text.index("pbox_lat_seconds_count")
+
+
 def test_instrument_kind_collision(fresh_hub):
     fresh_hub.counter("x_total")
     with pytest.raises(TypeError):
@@ -176,6 +240,93 @@ def test_healthz_route(fresh_hub):
         assert "pbox_passes_total" in body
     finally:
         fresh_hub.stop_prom_http()
+
+
+def test_readyz_route_and_serving_block(fresh_hub):
+    """/readyz (ISSUE 15 satellite): 503 until the serving probe
+    reports a first snapshot adoption; /healthz grows the ``serving``
+    block once a probe registers."""
+    srv = fresh_hub.start_prom_http(0)
+    try:
+        port = srv.server_address[1]
+
+        def get(route):
+            try:
+                resp = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{route}", timeout=5)
+                return resp.status, json.loads(resp.read().decode())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read().decode())
+
+        # no serving model in the process: unready, no serving block
+        code, body = get("/readyz")
+        assert code == 503 and body["ready"] is False
+        assert "serving" not in fresh_hub.health()
+        # a registered probe with no adoption yet: still 503, but the
+        # health endpoint now shows the serving state
+        state = {"adopted": None, "epoch": None,
+                 "last_reload_ts": None, "staleness_sec": 0.0,
+                 "stale": False}
+        fresh_hub.set_serving_probe(lambda: dict(state))
+        code, body = get("/readyz")
+        assert code == 503
+        assert body["reason"] == "no snapshot adopted yet"
+        h = get("/healthz")[1]
+        assert h["serving"]["adopted"] is None
+        # first adoption flips readiness; the block carries the id
+        state.update(adopted="v0000000007", epoch=7,
+                     last_reload_ts=123.0, staleness_sec=1.5)
+        code, body = get("/readyz")
+        assert code == 200 and body["ready"] is True
+        assert body["serving"]["adopted"] == "v0000000007"
+        h = get("/healthz")[1]
+        assert h["serving"]["staleness_sec"] == 1.5
+        # a crashing probe degrades the block, never the endpoint
+        def boom():
+            raise RuntimeError("probe died")
+        fresh_hub.set_serving_probe(boom)
+        code, body = get("/readyz")
+        assert code == 503
+        assert get("/healthz")[0] == 200
+    finally:
+        fresh_hub.stop_prom_http()
+
+
+def test_serving_report_column():
+    """telemetry_report renders the serving-latency column + summary
+    line from serving_stats/serving_reload events (ISSUE 15
+    satellite); training-only JSONLs keep their compact rows."""
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_report_sv",
+        os.path.join(os.path.dirname(__file__), os.pardir, "scripts",
+                     "telemetry_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    events = [
+        {"event": "serving_stats", "adopted": "v0000000001",
+         "staleness_sec": 0.0, "lookup_p99_ms": 0.21, "queries": 10},
+        {"event": "pass", "kind": "train_pass", "batches": 4,
+         "elapsed_sec": 1.0, "examples": 128,
+         "examples_per_sec": 128.0, "proc": 0},
+        {"event": "serving_reload", "artifact": "v0000000002"},
+        {"event": "serving_stats", "adopted": "v0000000002",
+         "staleness_sec": 2.1, "predict_p99_ms": 5.99, "queries": 30},
+        {"event": "pass", "kind": "train_pass", "batches": 4,
+         "elapsed_sec": 1.0, "examples": 128,
+         "examples_per_sec": 128.0, "proc": 0},
+        {"event": "serving_degraded", "tip": "v0000000003",
+         "adopted": "v0000000002", "staleness_sec": 4.0},
+    ]
+    rows = mod.build_rows(events)
+    assert rows[0]["serve p99"] == "p99 0.21ms @v0000000001"
+    assert rows[1]["serve p99"] \
+        == "p99 5.99ms @v0000000002 (+2.1s stale)"
+    rep = mod.render_report(events)
+    assert "serving: 1 reloads → v0000000002" in rep
+    assert "1 degraded polls" in rep and "max staleness 4.0s" in rep
+    # training-only runs: no serving column
+    rows = mod.build_rows([e for e in events if e["event"] == "pass"])
+    assert "serve p99" not in rows[0]
 
 
 def test_add_sink_dual_capability_registers_both(fresh_hub):

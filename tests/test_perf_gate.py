@@ -145,6 +145,49 @@ def test_check_reports_every_regressed_key_worst_first(perf_gate,
     assert perf_gate.main(["--check", "--trajectory", p]) == 1
 
 
+# ---- lower-is-better latency keys (BENCH_MODE=serve — ISSUE 15) --------
+def test_ms_keys_gate_lower_is_better(perf_gate):
+    """``*_ms`` metrics (serving latency) regress when the latest
+    value RISES past best*(1+frac): best is the LOWEST recorded row,
+    improvements (lower latency) always pass."""
+    assert perf_gate.lower_is_better("serving.uniform.p99_ms")
+    assert not perf_gate.lower_is_better("serving.uniform.qps")
+    rows = [_row("serving.uniform.p99_ms", 2.0, "SERVE_r01"),
+            _row("serving.uniform.p99_ms", 2.4, "SERVE_r02"),
+            _row("serving.uniform.p99_ms", 8.0, "live")]  # 4x the best
+    failures, _ = perf_gate.check_rows(rows, max_drop_frac=0.5)
+    assert len(failures) == 1
+    assert "PERF REGRESSION" in failures[0]
+    assert "ceiling" in failures[0]
+    # within the ceiling: passes; an IMPROVEMENT (lower) always passes
+    ok = [_row("serving.uniform.p99_ms", 2.0, "SERVE_r01"),
+          _row("serving.uniform.p99_ms", 2.9, "live")]
+    failures, summary = perf_gate.check_rows(ok, max_drop_frac=0.5)
+    assert failures == [] and len(summary) == 1
+    better = [_row("serving.uniform.p99_ms", 2.0, "SERVE_r01"),
+              _row("serving.uniform.p99_ms", 0.5, "live")]
+    failures, _ = perf_gate.check_rows(better, max_drop_frac=0.5)
+    assert failures == []
+
+
+def test_ms_regression_ranks_with_throughput_drops(perf_gate, tmp_path):
+    """A mixed round (throughput drop + latency rise) reports BOTH,
+    worst severity first, and the CLI exits 1."""
+    rows = [
+        _row("serving.uniform.p99_ms", 1.0, "SERVE_r01"),
+        _row("serving.uniform.p99_ms", 4.0, "live"),      # +300%
+        _row("serving.uniform.qps", 1000.0, "SERVE_r01"),
+        _row("serving.uniform.qps", 400.0, "live"),       # -60%
+    ]
+    failures, _ = perf_gate.check_rows(rows, max_drop_frac=0.5)
+    assert len(failures) == 2, failures
+    assert "p99_ms" in failures[0]     # +300% outranks -60%
+    assert "qps" in failures[1]
+    p = str(tmp_path / "t.json")
+    perf_gate._write(p, {"version": 1, "rows": rows})
+    assert perf_gate.main(["--check", "--trajectory", p]) == 1
+
+
 def test_multichip_extra_fields_ride_the_row(perf_gate, tmp_path):
     """n_chips / a2a_chunks / exchange_overlap_frac are first-class
     trajectory passthrough fields (EXTRA_FIELDS) on both the fold and
