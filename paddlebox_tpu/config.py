@@ -222,6 +222,63 @@ class Flags:
     # >0 → a stall persisting this long arms an abort: the training
     # thread's next heartbeat raises StragglerTimeout
     straggler_abort_sec: float = 0.0
+    # JSONL sink rotation (always-on daemon: bound the event log).
+    # >0 → when the live segment exceeds this many MiB it rotates to
+    # <path>.1 (older segments shift to .2, .3, ...); 0 = one unbounded
+    # file (the seed behavior). telemetry_report reads rotated sets in
+    # order automatically.
+    telemetry_jsonl_max_mb: float = 0.0
+    # rotated segments kept per JSONL path (the live file rides on top)
+    telemetry_jsonl_keep: int = 3
+    # quarantine a telemetry sink after this many CONSECUTIVE
+    # emit/span failures (pbox_sink_errors_total books every failure;
+    # a broken sink must never take the training hot path down)
+    telemetry_sink_errors_max: int = 8
+
+    # --- anomaly flight recorder (obs/flightrec;
+    # docs/OBSERVABILITY.md §Flight recorder) ---
+    # non-empty → keep a bounded in-memory ring of recent events/spans
+    # and publish a self-contained postmortem bundle (ring + instrument
+    # snapshot + critical-path blocks + FLAGS + live thread stacks)
+    # into this directory whenever a trigger fires (NaN rollback,
+    # reload degrade, pipeline hang, watchdog escalation, SLO breach,
+    # hub.dump_blackbox). "" = recorder off (zero per-event cost).
+    flightrec_dir: str = ""
+    # ring capacity (events + spans, newest win)
+    flightrec_ring_events: int = 512
+    # per-trigger debounce: repeat fires inside this window are
+    # suppressed (counted in pbox_flightrec_suppressed_total) — an
+    # anomaly storm yields ONE bundle per trigger per window
+    flightrec_debounce_sec: float = 60.0
+    # newest bundles kept on disk per recorder dir (retention cap)
+    flightrec_keep: int = 16
+
+    # --- model-quality drift monitor (obs/quality;
+    # docs/OBSERVABILITY.md §Model quality) ---
+    # >0 → windowed per-pass quality stats ride every train/stream
+    # pass event: key coverage/churn, embedding-norm drift vs the
+    # trailing baseline, predicted-vs-observed CTR calibration buckets
+    # and a windowed AUC trend with a degradation verdict
+    # (pbox_quality_* instruments + quality_window events). 0 = off.
+    quality_window_passes: int = 0
+    # windowed-AUC degradation verdict: trailing-half mean AUC below
+    # leading-half mean by more than this → pbox_quality_degraded=1
+    quality_auc_drop: float = 0.01
+    # coarse calibration buckets the 1e6-bin AUC tables collapse into
+    quality_calibration_buckets: int = 10
+
+    # --- SLO alert engine (obs/alerts; docs/OBSERVABILITY.md §Alerts) ---
+    # >0 → evaluate the default alert rules on a cadence thread this
+    # often (serving staleness / p99 / stream lag / hang / NaN-rollback
+    # rate / AUC degradation → pbox_alerts_active{rule,severity},
+    # alert_fired/alert_cleared events, /alertz). 0 = engine not
+    # started (construct AlertEngine explicitly for manual evaluation).
+    alerts_eval_interval_sec: float = 0.0
+    # default-rule thresholds (staleness reuses
+    # serving_staleness_max_sec; hang / NaN-rollback fire on any
+    # counter increase between evaluations)
+    alerts_serving_p99_ms: float = 250.0
+    alerts_stream_lag_files: int = 100
 
     # --- resilience (resilience/; docs/RESILIENCE.md) ---
     # RetryPolicy.from_flags defaults, applied at the IO seams

@@ -57,6 +57,13 @@ class TelemetryHub:
         # probe): /healthz grows a "serving" block and /readyz refuses
         # (503) until the probe reports a first snapshot adoption
         self._serving_probe = None
+        # alerts surface (obs/alerts.AlertEngine registers its status):
+        # /healthz grows an "alerts" block and /alertz serves it whole
+        self._alerts_probe = None
+        # per-sink CONSECUTIVE failure counts (sink fault isolation): a
+        # sink that keeps raising gets quarantined — removed from the
+        # fan-out — after FLAGS.telemetry_sink_errors_max failures
+        self._sink_fails: Dict[int, int] = {}
         # fast-path flag: any sink attached / endpoint running. Hot call
         # sites read this one attribute and skip all payload assembly.
         self.active = False
@@ -172,8 +179,10 @@ class TelemetryHub:
         for s in sinks:
             try:
                 s.emit(ev)
+                if self._sink_fails:
+                    self._sink_fails.pop(id(s), None)
             except Exception:
-                log.warning("telemetry event sink failed", exc_info=True)
+                self._sink_error(s, "emit")
 
     @contextlib.contextmanager
     def span(self, name: str, **attrs) -> Iterator[None]:
@@ -190,9 +199,41 @@ class TelemetryHub:
             for s in sinks:
                 try:
                     s.span(name, t0, dur, attrs)
+                    if self._sink_fails:
+                        self._sink_fails.pop(id(s), None)
                 except Exception:
-                    log.warning("telemetry span sink failed",
-                                exc_info=True)
+                    self._sink_error(s, "span")
+
+    def _sink_error(self, sink, surface: str) -> None:
+        """Sink fault isolation: a raising sink never reaches the
+        training hot path — book the failure, and after
+        ``FLAGS.telemetry_sink_errors_max`` CONSECUTIVE failures
+        quarantine it (remove from the fan-out) so a wedged sink can't
+        keep burning the emit path on exceptions."""
+        name = type(sink).__name__
+        log.warning("telemetry %s sink failed (%s)", surface, name,
+                    exc_info=True)
+        try:
+            self.counter("pbox_sink_errors_total",
+                         "telemetry sink emit/span failures").inc(
+                             sink=name)
+            try:
+                from paddlebox_tpu.config import FLAGS
+                limit = int(FLAGS.telemetry_sink_errors_max)
+            except Exception:
+                limit = 8
+            fails = self._sink_fails.get(id(sink), 0) + 1
+            self._sink_fails[id(sink)] = fails
+            if limit > 0 and fails >= limit:
+                self._sink_fails.pop(id(sink), None)
+                self.remove_sink(sink)
+                self.counter("pbox_sinks_quarantined_total",
+                             "sinks removed after consecutive "
+                             "failures").inc(sink=name)
+                log.error("telemetry sink %s QUARANTINED after %d "
+                          "consecutive failures", name, fails)
+        except Exception:
+            log.debug("sink error bookkeeping failed", exc_info=True)
 
     # ---- snapshots -----------------------------------------------------
     def snapshot(self) -> Dict[str, Dict]:
@@ -219,12 +260,15 @@ class TelemetryHub:
         lines: List[str] = []
         for inst in insts:
             lines.extend(iter_prom_lines(inst))
+        from paddlebox_tpu.obs.instruments import escape_label_value
         from paddlebox_tpu.utils.monitor import STATS
         stats = STATS.snapshot()
         if stats:
             lines.append("# TYPE pbox_stat gauge")
             for name, val in sorted(stats.items()):
-                lines.append(f'pbox_stat{{name="{name}"}} {val}')
+                lines.append(
+                    f'pbox_stat{{name="{escape_label_value(name)}"}}'
+                    f' {val}')
         return "\n".join(lines) + "\n"
 
     def note_pass(self) -> None:
@@ -257,6 +301,33 @@ class TelemetryHub:
         except Exception:
             log.warning("serving health probe failed", exc_info=True)
             return {"adopted": None, "error": "probe failed"}
+
+    # ---- alerts surface (docs/OBSERVABILITY.md §Alerts) ----------------
+    def set_alerts_probe(self, probe) -> None:
+        """Register (or clear, with None) the alert engine's status
+        provider (obs/alerts.AlertEngine.status) — the ``alerts`` block
+        for /healthz and the whole /alertz payload."""
+        with self._lock:
+            self._alerts_probe = probe
+
+    def alerts_info(self) -> Optional[Dict]:
+        with self._lock:
+            probe = self._alerts_probe
+        if probe is None:
+            return None
+        try:
+            return probe()
+        except Exception:
+            log.warning("alerts probe failed", exc_info=True)
+            return {"error": "probe failed"}
+
+    def dump_blackbox(self, reason: str) -> Optional[str]:
+        """Explicitly publish a flight-recorder postmortem bundle (the
+        ``manual`` trigger). Returns the bundle path, or None when no
+        recorder is installed (``FLAGS.flightrec_dir`` unset) or the
+        trigger was debounced."""
+        from paddlebox_tpu.obs import flightrec
+        return flightrec.trigger("manual", reason=reason)
 
     def readiness(self) -> Dict:
         """The /readyz payload: ready only after the serving model's
@@ -296,6 +367,13 @@ class TelemetryHub:
         serving = self.serving_info()
         if serving is not None:
             out["serving"] = serving
+        alerts = self.alerts_info()
+        if alerts is not None:
+            # /healthz carries the compact alarm view; /alertz the
+            # full per-rule table
+            out["alerts"] = {"firing": alerts.get("firing", 0),
+                             "active": alerts.get("active", []),
+                             "rules": len(alerts.get("rules", []))}
         return out
 
     # ---- Prometheus HTTP endpoint --------------------------------------
@@ -324,6 +402,18 @@ class TelemetryHub:
                     ready = hub.readiness()
                     status = 200 if ready["ready"] else 503
                     body = _json.dumps(ready).encode()
+                    ctype = "application/json"
+                elif route == "/alertz":
+                    # the alert engine's full rule table (503 with the
+                    # firing list non-empty — a dumb prober can alarm
+                    # on status alone)
+                    alerts = hub.alerts_info()
+                    if alerts is None:
+                        alerts = {"firing": 0, "active": [],
+                                  "rules": [],
+                                  "note": "no alert engine installed"}
+                    status = 503 if alerts.get("firing") else 200
+                    body = _json.dumps(alerts).encode()
                     ctype = "application/json"
                 else:
                     body = hub.snapshot_prom().encode()
@@ -364,10 +454,19 @@ def get_hub() -> TelemetryHub:
 
 
 def reset_hub() -> TelemetryHub:
-    """Fresh global hub (tests). Closes the old hub's sinks/endpoint."""
+    """Fresh global hub (tests). Closes the old hub's sinks/endpoint
+    and uninstalls the flag-configured flight recorder / alert engine /
+    quality monitor so the next configure_from_flags starts clean."""
     global _HUB, _configured_jsonl
     _HUB.close_sinks()
     _HUB.stop_prom_http()
+    try:
+        from paddlebox_tpu.obs import alerts, flightrec, quality
+        flightrec.install_recorder(None)
+        alerts.install_engine(None, register_probe=False)
+        quality.reset_monitor()
+    except Exception:
+        log.debug("obs singleton reset failed", exc_info=True)
     _HUB = TelemetryHub()
     _configured_jsonl = None
     return _HUB
@@ -383,15 +482,24 @@ def configure_from_flags() -> TelemetryHub:
     path = FLAGS.telemetry_jsonl
     if path and path != _configured_jsonl:
         from paddlebox_tpu.obs.sinks import JsonlSink
-        hub.add_sink(JsonlSink(path))
+        hub.add_sink(JsonlSink(
+            path,
+            max_bytes=int(FLAGS.telemetry_jsonl_max_mb * 1024 * 1024),
+            keep=FLAGS.telemetry_jsonl_keep))
         _configured_jsonl = path
     if FLAGS.telemetry_prom_port >= 0:
         hub.start_prom_http(FLAGS.telemetry_prom_port)
+    # the anomaly flight recorder and the SLO alert engine ride the
+    # same flag seam (both default-off; docs/OBSERVABILITY.md)
+    from paddlebox_tpu.obs import alerts, flightrec
+    flightrec.configure_from_flags()
+    alerts.configure_from_flags()
     return hub
 
 
 def emit_pass_event(kind: str, metrics: Dict, stage_timers=None,
-                    table=None, examples: Optional[int] = None) -> None:
+                    table=None, examples: Optional[int] = None,
+                    auc_state=None) -> None:
     """THE per-pass telemetry record: pass metrics + stage timers +
     channel gauges + table occupancy + HBM watermarks, in one event and
     mirrored into instruments for the Prometheus view. Trainers call
@@ -403,7 +511,7 @@ def emit_pass_event(kind: str, metrics: Dict, stage_timers=None,
     ev: Dict = {"kind": kind}
     for k in ("batches", "elapsed_sec", "examples_per_sec", "auc",
               "last_loss", "global_step", "pass_seq",
-              "exchange_overlap_frac"):
+              "exchange_overlap_frac", "actual_ctr", "predicted_ctr"):
         if k in metrics:
             ev[k] = metrics[k]
     if examples is not None:
@@ -508,4 +616,14 @@ def emit_pass_event(kind: str, metrics: Dict, stage_timers=None,
         hub.gauge("pbox_last_pass_examples_per_sec",
                   "throughput of the latest pass").set(
                       ev["examples_per_sec"], kind=kind)
+    # model-quality drift monitor (obs/quality; docs/OBSERVABILITY.md
+    # §Model quality): windowed per-slot coverage/churn, norm drift,
+    # calibration buckets and the AUC-trend verdict ride THIS seam —
+    # off (the default) costs one flag read
+    from paddlebox_tpu.config import FLAGS
+    if FLAGS.quality_window_passes > 0 and kind.startswith(
+            ("train_pass", "stream")):
+        from paddlebox_tpu.obs import quality
+        quality.note_pass_event(ev, table=table, auc_state=auc_state,
+                                hub=hub)
     hub.emit("pass", **ev)

@@ -206,11 +206,20 @@ SERVING_LATENCY_BUCKETS: Tuple[float, ...] = (
     0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
 
 
+def escape_label_value(v: str) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote and newline must be escaped or the exposition line is
+    unparseable (label values are arbitrary strings — error reprs,
+    file paths — by the time they reach a series key)."""
+    return (v.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def iter_prom_lines(inst: Instrument) -> Iterator[str]:
     """Prometheus text-exposition lines for one instrument."""
 
     def fmt_labels(k: LabelKey, extra: str = "") -> str:
-        parts = [f'{n}="{v}"' for n, v in k]
+        parts = [f'{n}="{escape_label_value(v)}"' for n, v in k]
         if extra:
             parts.append(extra)
         return "{" + ",".join(parts) + "}" if parts else ""

@@ -13,15 +13,26 @@ chrome://tracing timeline as StageTimers stages.
 from __future__ import annotations
 
 import json
+import os
 import threading
 from typing import Dict, List, Optional
 
 
 class JsonlSink:
-    """Append one JSON line per event to ``path``."""
+    """Append one JSON line per event to ``path``.
 
-    def __init__(self, path: str, truncate: bool = False) -> None:
+    With ``max_bytes > 0`` the live segment rotates logrotate-style
+    once it reaches that size: ``path`` → ``path.1``, older segments
+    shift to ``path.2`` … ``path.<keep>``, anything beyond ``keep`` is
+    dropped — an always-on daemon's event log stays bounded at roughly
+    ``(keep + 1) * max_bytes``. ``scripts/telemetry_report.py`` reads a
+    rotated set back oldest-first automatically."""
+
+    def __init__(self, path: str, truncate: bool = False,
+                 max_bytes: int = 0, keep: int = 3) -> None:
         self.path = path
+        self.max_bytes = int(max_bytes)
+        self.keep = max(int(keep), 1)
         self._lock = threading.Lock()
         self._fh = open(path, "w" if truncate else "a")
 
@@ -32,6 +43,28 @@ class JsonlSink:
                 return
             self._fh.write(line + "\n")
             self._fh.flush()
+            if self.max_bytes > 0 \
+                    and self._fh.tell() >= self.max_bytes:
+                self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        """Shift ``path.i`` → ``path.i+1`` (dropping past ``keep``),
+        move the live file to ``path.1`` and reopen fresh. Rename
+        failures leave the sink appending to the live file — rotation
+        is best-effort, losing events is not an option."""
+        try:
+            self._fh.close()
+            last = f"{self.path}.{self.keep}"
+            if os.path.exists(last):
+                os.unlink(last)
+            for i in range(self.keep - 1, 0, -1):
+                seg = f"{self.path}.{i}"
+                if os.path.exists(seg):
+                    os.replace(seg, f"{self.path}.{i + 1}")
+            os.replace(self.path, f"{self.path}.1")
+        except OSError:
+            pass
+        self._fh = open(self.path, "a")
 
     def close(self) -> None:
         with self._lock:

@@ -299,6 +299,17 @@ class StragglerWatchdog:
                          after_sec=after_sec,
                          stalled_for_sec=round(stalled_for, 3))
             try:
+                # black-box seam (obs/flightrec): capture the mesh
+                # state as each escalation rung fires, before the
+                # action (abort/restart) mutates it
+                from paddlebox_tpu.obs import flightrec
+                flightrec.trigger(
+                    "watchdog_escalation", reason=name, action=name,
+                    after_sec=after_sec,
+                    stalled_for_sec=round(stalled_for, 3))
+            except Exception:
+                log.debug("flightrec trigger failed", exc_info=True)
+            try:
                 action(self, reports, stalled_for)
             except Exception:
                 log.error("straggler escalation %r failed", name,

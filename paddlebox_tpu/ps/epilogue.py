@@ -86,6 +86,15 @@ def note_hang(stage: str) -> None:
                 stage=stage)
     except Exception:
         log.debug("hang telemetry emit failed", exc_info=True)
+    try:
+        # black-box seam (obs/flightrec): the hang deadline tripping is
+        # exactly when the live thread stacks in the bundle matter —
+        # they name the wedged worker the PipelineHangError can't see
+        from paddlebox_tpu.obs import flightrec
+        flightrec.trigger("pipeline_hang", reason=f"stage {stage}",
+                          stage=stage)
+    except Exception:
+        log.debug("flightrec trigger failed", exc_info=True)
 
 
 def wait_with_deadline(cv: threading.Condition, done: Callable[[], bool],

@@ -655,6 +655,16 @@ class ReloadLoop:
             log.error("serving hot-reload REFUSED (%s) — keeping the "
                       "prior snapshot (%s): %s", reason,
                       self.model.adopted_aid, e)
+            try:
+                # black-box seam (obs/flightrec): a refused reload IS
+                # the serving.reload degrade anomaly — one debounced
+                # postmortem bundle while the prior snapshot serves on
+                from paddlebox_tpu.obs import flightrec
+                flightrec.trigger(
+                    "reload_degrade", reason=reason, error=repr(e),
+                    adopted=self.model.adopted_aid or "")
+            except Exception:
+                log.debug("flightrec trigger failed", exc_info=True)
             self._arm_backoff()
             self._note_staleness()
             return None
@@ -703,6 +713,19 @@ class ReloadLoop:
             _emit("serving_degraded", tip=tip,
                   adopted=self.model.adopted_aid or "",
                   staleness_sec=round(lag, 3))
+            try:
+                # black-box seam (obs/flightrec): serving left BEHIND
+                # the tip after a poll (refused reload OR a tip the
+                # store itself rejected — e.g. a corrupt delta never
+                # reaches hot_reload). Debounce collapses the per-poll
+                # repeats into one bundle
+                from paddlebox_tpu.obs import flightrec
+                flightrec.trigger(
+                    "reload_degrade", reason="stale behind tip",
+                    tip=tip, adopted=self.model.adopted_aid or "",
+                    staleness_sec=round(lag, 3))
+            except Exception:
+                log.debug("flightrec trigger failed", exc_info=True)
             if FLAGS.serving_staleness_max_sec > 0 \
                     and lag > FLAGS.serving_staleness_max_sec:
                 log.error(

@@ -654,6 +654,21 @@ class Trainer:
                              global_step=self.global_step)
                 if checkpoint is not None:
                     if isinstance(e, NanInfError):
+                        # the black-box seam (obs/flightrec): a NaN
+                        # rollback is a postmortem-worthy anomaly —
+                        # dump the recent-event ring + instrument
+                        # snapshot BEFORE the restore overwrites the
+                        # poisoned state, and book the counter the
+                        # nan_rollback alert rule watches
+                        from paddlebox_tpu.obs import flightrec
+                        hub.counter(
+                            "pbox_nan_rollbacks_total",
+                            "NaN/Inf passes rolled back to a clean "
+                            "boundary").inc()
+                        flightrec.trigger(
+                            "nan_rollback", reason=repr(e),
+                            global_step=self.global_step,
+                            attempt=attempt, limit=limit)
                         # mid-pass snapshots are suspect (see above):
                         # roll all the way back to the clean boundary.
                         # A STREAM boundary still carries its stream
@@ -950,7 +965,11 @@ class Trainer:
             kind, dict(out, global_step=self.global_step,
                        pass_seq=self._pass_seq),
             stage_timers=self.stage_timers if stage_timers else None,
-            table=self.table, examples=examples)
+            table=self.table, examples=examples,
+            # the quality monitor (obs/quality) diffs the AUC bucket
+            # tables per pass for its calibration windows; a bare
+            # reference costs nothing when quality is off
+            auc_state=getattr(self.state, "auc", None))
 
     def _feed_registry_resident(self, rp, preds) -> None:
         """Post-pass metric registry feed (the per-batch AddAucMonitor

@@ -15,21 +15,49 @@ at the end. Stdlib only — runs anywhere the JSONL lands.
 from __future__ import annotations
 
 import json
+import os
 import sys
 from typing import Dict, List, Optional
 
 
+def expand_rotated(path: str) -> List[str]:
+    """A rotated JSONL set (obs/sinks.JsonlSink with
+    ``FLAGS_telemetry_jsonl_max_mb``) read oldest-first:
+    ``path.<K> … path.1`` then the live ``path``. A path with no
+    rotated siblings expands to itself."""
+    segs = []
+    i = 1
+    while os.path.exists(f"{path}.{i}"):
+        segs.append(f"{path}.{i}")
+        i += 1
+    segs.reverse()               # .N is oldest, .1 newest rotated
+    if os.path.exists(path) or not segs:
+        segs.append(path)
+    return segs
+
+
 def load_events(path: str) -> List[dict]:
+    """All events for ``path``'s rotated segment set, oldest first. A
+    torn line (a process killed mid-write leaves a truncated tail —
+    and the next append can land after it) is skipped with a warning,
+    never a crash: the report must render what survived."""
     events = []
-    with open(path) as fh:
-        for ln, line in enumerate(fh, 1):
-            line = line.strip()
-            if not line:
+    for seg in expand_rotated(path):
+        with open(seg) as fh:
+            lines = fh.readlines()
+        for ln, line in enumerate(lines, 1):
+            stripped = line.strip()
+            if not stripped:
                 continue
             try:
-                events.append(json.loads(line))
+                events.append(json.loads(stripped))
             except json.JSONDecodeError:
-                print(f"warning: {path}:{ln}: bad JSON line skipped",
+                torn_tail = (ln == len(lines)
+                             and not line.endswith("\n"))
+                print(f"warning: {seg}:{ln}: "
+                      + ("torn final line skipped (writer killed "
+                         "mid-write?)" if torn_tail
+                         else "bad JSON line skipped"),
                       file=sys.stderr)
     return events
 
@@ -131,7 +159,20 @@ def build_rows(events: List[dict]) -> List[Dict[str, str]]:
     prev_blocked: Dict[int, Dict[str, float]] = {}  # per process
     last_serving: Optional[Dict] = None
     any_serving = any(e.get("event") == "serving_stats" for e in events)
+    # alert timeline column (obs/alerts): the rules firing as of each
+    # pass, tracked from the alert_fired/alert_cleared stream
+    any_alerts = any(e.get("event") in ("alert_fired", "alert_cleared")
+                     for e in events)
+    firing: List[str] = []
     for ev in events:
+        if ev.get("event") == "alert_fired":
+            if ev.get("rule") not in firing:
+                firing.append(str(ev.get("rule")))
+            continue
+        if ev.get("event") == "alert_cleared":
+            if ev.get("rule") in firing:
+                firing.remove(ev.get("rule"))
+            continue
         if ev.get("event") == "serving_stats":
             last_serving = ev
             continue
@@ -191,6 +232,10 @@ def build_rows(events: List[dict]) -> List[Dict[str, str]]:
             # serving-latency column only when the run served (a
             # training-only JSONL keeps its compact row)
             rows[-1]["serve p99"] = _serving_cell(last_serving) or "-"
+        if any_alerts:
+            # alert timeline column only when the run alerted: which
+            # rules were firing as of this pass
+            rows[-1]["alerts"] = ",".join(firing) or "-"
     return rows
 
 
@@ -290,6 +335,46 @@ def serving_summary(events: List[dict]) -> str:
     return "serving: " + ", ".join(bits)
 
 
+def alerts_summary(events: List[dict]) -> str:
+    """Whole-run alert timeline (obs/alerts): every fire/clear
+    transition in order — 'alerts: stream_lag fired(seq 12) ->
+    stream_lag cleared(seq 19); 1 still firing'. Empty when the run
+    never alerted."""
+    transitions = [e for e in events
+                   if e.get("event") in ("alert_fired",
+                                         "alert_cleared")]
+    if not transitions:
+        return ""
+    bits = []
+    open_rules: List[str] = []
+    for e in transitions:
+        rule = str(e.get("rule", "?"))
+        if e.get("event") == "alert_fired":
+            if rule not in open_rules:
+                open_rules.append(rule)
+            bits.append(f"{rule} fired(seq {e.get('seq', '?')})")
+        else:
+            if rule in open_rules:
+                open_rules.remove(rule)
+            bits.append(f"{rule} cleared(seq {e.get('seq', '?')})")
+    line = "alerts: " + " -> ".join(bits)
+    if open_rules:
+        line += f"; still firing: {','.join(open_rules)}"
+    return line
+
+
+def bundles_summary(events: List[dict]) -> str:
+    """Flight-recorder bundle pointers (obs/flightrec): every
+    ``blackbox_dump`` the run published, trigger + path — the first
+    thing a postmortem reaches for. Empty when nothing triggered."""
+    dumps = [e for e in events if e.get("event") == "blackbox_dump"]
+    if not dumps:
+        return ""
+    return "bundles: " + ", ".join(
+        f"{e.get('trigger', '?')} -> {e.get('path', '?')}"
+        for e in dumps)
+
+
 def render_report(events: List[dict], show_events: bool = False) -> str:
     rows = build_rows(events)
     out = [render_table(rows)]
@@ -308,6 +393,12 @@ def render_report(events: List[dict], show_events: bool = False) -> str:
     sv_line = serving_summary(events)
     if sv_line:
         out.append(sv_line)
+    al_line = alerts_summary(events)
+    if al_line:
+        out.append(al_line)
+    bx_line = bundles_summary(events)
+    if bx_line:
+        out.append(bx_line)
     recovery = [e for e in events if e.get("event") in RECOVERY_EVENTS]
     if recovery:
         out.append("recovery: " + " -> ".join(_fmt_recovery(e)

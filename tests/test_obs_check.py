@@ -1,0 +1,68 @@
+"""Tier-1 wiring of scripts/obs_check.py — the black-box observability
+gate (ISSUE 16): each injected anomaly (NaN rollback with a boundary
+checkpoint, corrupt reload tip, pipeline hang, SLO breach via the
+alert engine, manual operator dump) yields exactly ONE debounced,
+schema-complete postmortem bundle; every default alert rule fires AND
+clears with matching events and gauge transitions; JSONL rotation +
+torn-tail tolerance hold; the /alertz route serves; and the flags-off
+black-box layer stays inert and cheap — deterministic across two
+identically-seeded runs. The standalone script prints the full outcome
+and exits nonzero on any divergence."""
+
+import os
+
+from scripts.obs_check import BUNDLE_KEYS, run_obs_check
+
+
+def test_obs_check_gate_deterministic(tmp_path):
+    outs = []
+    for run in (1, 2):
+        wd = str(tmp_path / f"run{run}")
+        os.makedirs(wd)
+        outs.append(run_obs_check(wd, seed=7))
+    out = outs[0]
+    # quality leg: a window event per pass, all mirrors present
+    assert out["quality_windows"] == 3
+    assert out["quality_degraded_flag_seen"]
+    assert "pbox_quality_auc_trend" in out["quality_instruments"]
+    assert "pbox_quality_key_churn_frac" in out["quality_instruments"]
+    # NaN leg: rolled back once, recovered, counter booked
+    assert out["nan_retried_and_recovered"]
+    assert out["nan_rollbacks_total"] == 1.0
+    # corrupt tip: never adopted, degrade was loud
+    assert out["corrupt_tip_not_adopted"] and out["corrupt_refused_loud"]
+    # hang leg
+    assert out["hang_raised"]
+    # bundle audit: exactly one bundle per trigger, in seq order, all
+    # five anomaly classes represented, every bundle schema-complete
+    assert out["one_bundle_per_trigger"] and out["bundles_schema_ok"]
+    assert out["bundle_triggers"] == [
+        "nan_rollback", "reload_degrade", "pipeline_hang",
+        "slo_breach", "manual"]
+    assert out["bundles"] == sorted(out["bundles"])
+    assert out["slo_breach_suppressed"] >= 1.0  # debounce ate the storm
+    # alerts: quiet baseline, every default rule fired AND cleared,
+    # nothing left firing
+    assert out["alerts_baseline_clean"]
+    assert out["alerts_all_fired_and_cleared"]
+    assert out["alerts_none_left_firing"]
+    assert all(v >= 1.0 for v in out["alerts_fired_total"].values())
+    # rotation + torn tail
+    assert len(out["rotated_set"]) == 3  # live + keep-2
+    assert out["rotation_oldest_first"] and out["torn_tail_skipped"]
+    # debug routes
+    assert out["alertz_ok"] and out["healthz_alerts_block"]
+    assert out["metrics_expose_alerts"] and out["metrics_expose_bundles"]
+    # flags-off: inert and bounded
+    assert out["inert_hub_inactive"] and out["still_inactive_after"]
+    assert out["inert_no_recorder"] and out["overhead_ok"]
+    # seeded anomalies are reproducible: outcome identical across runs
+    assert outs[0] == outs[1]
+
+
+def test_bundle_keys_frozen():
+    # the postmortem bundle contract the gate checks against — a
+    # schema drift must be a deliberate, visible change here
+    assert BUNDLE_KEYS == frozenset((
+        "schema", "trigger", "reason", "ctx", "ts", "run", "health",
+        "ring", "instruments", "critical_path", "flags", "threads"))
