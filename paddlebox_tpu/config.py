@@ -81,6 +81,14 @@ class Flags:
     # feature shrink: drop rows whose decayed show falls below this
     shrink_delete_threshold: float = 0.0
     show_click_decay_rate: float = 0.98
+    # online-learning daemon (online.py; docs/ONLINE.md): run a shrink
+    # cycle every N completed stream windows, counted on the dataset's
+    # monotone windows_completed clock so the cadence survives
+    # preemption/resume; the boundary checkpoint after a shrink is
+    # forced to a BASE save (deltas cannot carry whole-table decay).
+    # 0 = lifecycle aging off (keys then accrete without bound — fine
+    # for finite jobs, a slow-motion OOM for always-on streams).
+    shrink_every_windows: int = 0
 
     # --- pallas kernels (ops/pallas_kernels.py; interpret-mode off-TPU;
     # docs/PERFORMANCE.md §Device kernels) ---
@@ -279,6 +287,11 @@ class Flags:
     # counter increase between evaluations)
     alerts_serving_p99_ms: float = 250.0
     alerts_stream_lag_files: int = 100
+    # online daemon lifecycle rules (docs/ONLINE.md): shrink_overdue
+    # fires when pbox_online_windows_since_shrink exceeds this; 0 =
+    # auto (2 × shrink_every_windows, rule absent when aging is off).
+    # backlog_growth fires on a rising pbox_stream_lag_files trend.
+    alerts_shrink_overdue_windows: int = 0
 
     # --- resilience (resilience/; docs/RESILIENCE.md) ---
     # RetryPolicy.from_flags defaults, applied at the IO seams

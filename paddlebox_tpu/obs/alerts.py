@@ -331,6 +331,19 @@ def default_rules() -> List[Rule]:
              kind="threshold", severity="critical", op=">", value=0.5,
              help="windowed AUC trend breached the degradation "
                   "threshold (obs/quality)"),
+        # online-daemon lifecycle rules (docs/ONLINE.md): each fire is
+        # a flight-recorder trigger like every other rule (_publish)
+        Rule("shrink_overdue", "pbox_online_windows_since_shrink",
+             kind="threshold", severity="warn", op=">",
+             value=float(FLAGS.alerts_shrink_overdue_windows
+                         or 2 * max(1, FLAGS.shrink_every_windows)),
+             help="feature-lifecycle shrink cycles stopped firing — "
+                  "the key space is growing unbounded"),
+        Rule("backlog_growth", "pbox_stream_lag_files",
+             kind="trend", severity="warn", op=">", value=0.0,
+             trend_window=3, for_count=3,
+             help="stream backlog rose across three consecutive "
+                  "evaluations — ingest is outrunning training"),
     ]
 
 

@@ -41,7 +41,8 @@ BUNDLE_SCHEMA = 1
 #: outside this set are rejected — a typo'd trigger must fail loudly in
 #: tests, not silently produce an unknown bundle family.
 TRIGGERS = ("nan_rollback", "reload_degrade", "pipeline_hang",
-            "watchdog_escalation", "slo_breach", "manual")
+            "watchdog_escalation", "slo_breach", "manual",
+            "shrink_skipped", "online_degrade")
 
 #: critical-path blocks retained for the bundle (newest last)
 KEEP_CRITICAL_PATH = 16

@@ -60,6 +60,10 @@ class TelemetryHub:
         # alerts surface (obs/alerts.AlertEngine registers its status):
         # /healthz grows an "alerts" block and /alertz serves it whole
         self._alerts_probe = None
+        # online-daemon surface (online.OnlineLearner registers its
+        # status): /healthz grows an "online" block — windows, backlog,
+        # publish/shrink timestamps, and the daemon's degrade mode
+        self._online_probe = None
         # per-sink CONSECUTIVE failure counts (sink fault isolation): a
         # sink that keeps raising gets quarantined — removed from the
         # fan-out — after FLAGS.telemetry_sink_errors_max failures
@@ -302,6 +306,31 @@ class TelemetryHub:
             log.warning("serving health probe failed", exc_info=True)
             return {"adopted": None, "error": "probe failed"}
 
+    # ---- online-daemon surface (docs/ONLINE.md) ------------------------
+    def set_online_probe(self, probe) -> None:
+        """Register (or clear, with None) the online-learning daemon's
+        status provider — a callable returning the ``online`` block for
+        /healthz: ``{mode, windows_completed, files_backlog,
+        last_publish_ts, last_shrink_ts, shrunk_rows_total, ...}``
+        (online.OnlineLearner.online_status). One daemon per process;
+        the last registration wins."""
+        with self._lock:
+            self._online_probe = probe
+
+    def online_info(self) -> Optional[Dict]:
+        """The registered daemon probe's current block (None: no online
+        daemon in this process; a broken probe must not take the
+        health endpoint down)."""
+        with self._lock:
+            probe = self._online_probe
+        if probe is None:
+            return None
+        try:
+            return probe()
+        except Exception:
+            log.warning("online daemon probe failed", exc_info=True)
+            return {"mode": "unknown", "error": "probe failed"}
+
     # ---- alerts surface (docs/OBSERVABILITY.md §Alerts) ----------------
     def set_alerts_probe(self, probe) -> None:
         """Register (or clear, with None) the alert engine's status
@@ -367,6 +396,11 @@ class TelemetryHub:
         serving = self.serving_info()
         if serving is not None:
             out["serving"] = serving
+        online = self.online_info()
+        if online is not None:
+            # the daemon's train+publish+serve verdict in one block:
+            # mode != "full" means a leg degraded (docs/ONLINE.md)
+            out["online"] = online
         alerts = self.alerts_info()
         if alerts is not None:
             # /healthz carries the compact alarm view; /alertz the

@@ -764,17 +764,28 @@ class HostStore:
                if delete_threshold is None else delete_threshold)
         dk = FLAGS.show_click_decay_rate if decay is None else decay
         self._barrier()  # decay/score must see every written-back row
+        freed: np.ndarray = np.empty(0, np.int64)
         with self._lock:
             keys, rows = self.index.items()
-            if len(keys) == 0:
-                return 0
-            self._arr["show"] *= dk
-            self._arr["clk"] *= dk
-            self._arr["delta_score"] *= dk
-            drop = self._score(rows, nonclk_coeff, clk_coeff) < thr
-            freed = self._free(keys[drop])
-            if self.ssd is not None and len(self.ssd):
-                # an aged-out feature's disk copy must never resurrect
-                self.ssd.discard(keys[drop])
-        log.info("host shrink: freed %d/%d rows", len(freed), len(keys))
-        return int(len(freed))
+            if len(keys):
+                self._arr["show"] *= dk
+                self._arr["clk"] *= dk
+                self._arr["delta_score"] *= dk
+                drop = self._score(rows, nonclk_coeff, clk_coeff) < thr
+                freed = self._free(keys[drop])
+                if self.ssd is not None and len(self.ssd):
+                    # an aged-out feature's disk copy must never
+                    # resurrect
+                    self.ssd.discard(keys[drop])
+        dropped_ssd = 0
+        if self.ssd is not None and len(self.ssd):
+            # age the DEMOTED rows too (SsdTier.shrink) — without this
+            # the disk tier is immortal and an always-on stream's SSD
+            # footprint never plateaus; compact afterward so the
+            # vacated + dropped copies actually free disk
+            dropped_ssd = self.ssd.shrink(thr, dk, nonclk_coeff,
+                                          clk_coeff)
+            self.ssd.maybe_compact()
+        log.info("host shrink: freed %d/%d RAM rows, %d SSD rows",
+                 len(freed), len(keys), dropped_ssd)
+        return int(len(freed)) + dropped_ssd

@@ -308,6 +308,28 @@ class PassScopedTable(EmbeddingTable):
                  "async" if FLAGS.async_end_pass else "sync")
         return len(keys)
 
+    def shrink(self, delete_threshold: Optional[float] = None,
+               decay: Optional[float] = None) -> int:
+        """Age the FULL model, not just the resident window: fence the
+        async epilogue (a draining end_pass job's counters must land
+        before they are decayed or scored — see
+        tests/test_shrink_fence.py), delegate to ``HostStore.shrink``
+        (RAM + SSD tiers), then ``drop_window`` so stale resident rows
+        cannot shadow the aged host values. Refused mid-pass: the open
+        window's updates are not in the host store yet and a shrink
+        under them would resurrect dropped rows at write-back."""
+        if self.in_pass:
+            raise RuntimeError(
+                "shrink while a pass is open — the window's updates are "
+                "not written back yet; end_pass first")
+        self.fence()  # pre-write-back counters must not drive aging
+        freed = self.host.shrink(delete_threshold=delete_threshold,
+                                 decay=decay,
+                                 nonclk_coeff=self.cfg.nonclk_coeff,
+                                 clk_coeff=self.cfg.clk_coeff)
+        self.drop_window()
+        return freed
+
     def drop_window(self) -> None:
         """Invalidate HBM residency (between passes): the next
         begin_pass re-fetches everything from the host store. Required

@@ -553,6 +553,40 @@ class SsdTier:
             self._mirror()
         return n
 
+    def shrink(self, delete_threshold: float, decay: float,
+               nonclk_coeff: float = 0.1, clk_coeff: float = 1.0,
+               batch: int = 65536) -> int:
+        """Age DEMOTED rows — the disk half of ShrinkTable (ctr_accessor
+        shrink rules applied to rows RAM never sees): decay
+        show/clk/delta_score, drop rows whose decayed score falls below
+        threshold, rewrite the survivors. Rewrites go through
+        take/append with ``book=False`` (compaction-style internal
+        churn, not demote/promote traffic), so the vacated copies age
+        their old segments toward ``maybe_compact``'s live-fraction
+        trigger and fully-dead segments unlink immediately. Survivors'
+        pending-delta (touched) bits are preserved; the decayed values
+        themselves are NOT re-marked touched — a shrink cycle must be
+        followed by a BASE save (train/checkpoint), which captures every
+        live row regardless. Batched so the working set stays bounded on
+        a large tier. Returns rows dropped."""
+        keys = self.keys()
+        dropped = 0
+        for i in range(0, len(keys), batch):
+            fkeys, rows, tch = self.take(keys[i:i + batch], book=False)
+            if not len(fkeys):
+                continue
+            rows[:, 0:3] *= decay  # decay show/clk/delta_score
+            score = (nonclk_coeff * (rows[:, 0] - rows[:, 1])
+                     + clk_coeff * rows[:, 1])
+            keep = score >= delete_threshold
+            dropped += int((~keep).sum())
+            if keep.any():
+                self.append(fkeys[keep], rows[keep],
+                            touched=tch[keep], book=False)
+        if dropped:
+            self._mirror()
+        return dropped
+
     def clear(self) -> None:
         """Reset the tier (a wholesale host-store load: the old model's
         tiers don't carry over). Segment files unlink — they belong to

@@ -1304,6 +1304,14 @@ class EmbeddingTable:
         thr = (FLAGS.shrink_delete_threshold
                if delete_threshold is None else delete_threshold)
         dk = FLAGS.show_click_decay_rate if decay is None else decay
+        fence = getattr(self, "fence", None)
+        if callable(fence):
+            # tables with an async end_pass epilogue (pass_table,
+            # tiered) must drain in-flight write-backs first: aging on
+            # pre-write-back counters would drop rows the draining job
+            # is about to refresh (HostStore.shrink has the same
+            # audit via _barrier)
+            fence()
         with self.host_lock:
             keys, rows = self.index.items()
             if len(keys) == 0:

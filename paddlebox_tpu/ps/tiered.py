@@ -1126,6 +1126,9 @@ class TieredShardedEmbeddingTable(ShardedEmbeddingTable):
                decay: Optional[float] = None) -> int:
         """ShrinkTable over every shard's host store (box_wrapper.h:638)."""
         self._no_pass("shrink")
+        self.fence()  # draining end_pass write-backs must land before
+        # aging — per-host _barrier repeats the audit, but fencing once
+        # here keeps the contract visible at the entry point
         freed = sum(h.shrink(delete_threshold=delete_threshold, decay=decay,
                              nonclk_coeff=self.cfg.nonclk_coeff,
                              clk_coeff=self.cfg.clk_coeff)

@@ -77,6 +77,24 @@ site                        seam
                             stream recovery path (run_pass rolls back to
                             the last stream checkpoint and REPLAYS the
                             window, at-least-once)
+``online.supervise``        the online daemon's supervisor seams
+                            (online.OnlineLearner.run / serve-leg
+                            start): a transient ``fail`` on the train
+                            leg retries on the seeded RetryPolicy (site
+                            ``online.supervise``, mode ``degraded``
+                            while backing off); a deterministic one
+                            degrades the daemon to ``serve_only`` /
+                            ``train_only`` LOUDLY instead of dying
+                            (docs/ONLINE.md)
+``online.shrink``           start of every feature-lifecycle shrink
+                            attempt (online.OnlineLearner): transient
+                            failures retry on the seeded policy (site
+                            ``online.shrink``); a hard/exhausted
+                            failure SKIPS the cycle loudly
+                            (``pbox_online_shrink_skipped_total`` + a
+                            ``shrink_skipped`` flight-recorder trigger)
+                            without stalling training — the cadence
+                            re-fires ``shrink_every_windows`` later
 ==========================  =============================================
 
 Fault kinds: ``fail`` (raise — ``exc=transient|crash|os`` picks the

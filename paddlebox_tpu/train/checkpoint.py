@@ -558,6 +558,13 @@ class CheckpointManager:
                         stream.get("files_completed", []) or []),
                     "windows_completed": stream.get("windows_completed"),
                     "global_step": cur.get("global_step")}
+                if cur.get("lifecycle"):
+                    # feature-aging decisions this boundary was built
+                    # under (online.OnlineLearner shrink cycles) — the
+                    # manifest records the live-key-set provenance so
+                    # a consumer can tell WHICH shrink state a version
+                    # serves (docs/ONLINE.md)
+                    refs["lifecycle"] = dict(cur["lifecycle"])
             except (OSError, ValueError):
                 pass
         boundary = self._is_boundary_step(step)

@@ -115,6 +115,28 @@ class Trainer:
         # with the host SlotBatch — streaming record accounting and the
         # at-least-once gates (scripts/stream_check.py) key off it
         self.on_batch_trained: Optional[Callable[[SlotBatch], None]] = None
+        # per-window hook for the online daemon (online.OnlineLearner):
+        # called from _stream_loop AFTER a window's accounting/telemetry
+        # and BEFORE the boundary-save decision, with the completed
+        # window index and the dataset — the shrink scheduler and
+        # /healthz bookkeeping run here, never mid-pass
+        self.on_window_complete: Optional[Callable[[int, object],
+                                                   None]] = None
+        # set (by the hook) to publish a boundary checkpoint at THIS
+        # window boundary regardless of the stream_ckpt_every_windows
+        # cadence — a shrink cycle must persist before training resumes
+        self.stream_save_now = False
+        # set to force the next stream-boundary save to a BASE: shrink
+        # decays EVERY row without marking it touched, so a delta save
+        # would silently miss the decay on untouched rows and a restore
+        # would diverge from the live table. Cleared only after a save
+        # actually lands (the no-op dedup path keeps it pending).
+        self.stream_force_base = False
+        # lifecycle bookkeeping published into every checkpoint cursor
+        # (and the boundary artifact manifest): shrink cycle count,
+        # last shrink window/rows, live rows — a restore replays to the
+        # same live-key set and the daemon resumes its cadence from it
+        self.lifecycle: Optional[Dict[str, float]] = None
 
     # ---- host-side prefetch: batch build + dedup + row assign + H2D ----
     def _prefetch_iter(
@@ -370,6 +392,11 @@ class Trainer:
             s = state_fn(int(batch_index))
             if s is not None:
                 cur["stream"] = s
+        if self.lifecycle is not None:
+            # shrink/aging decisions ride EVERY cursor (boundary and
+            # emergency alike) so a restore replays to the same
+            # live-key set and the daemon's cadence survives resume
+            cur["lifecycle"] = dict(self.lifecycle)
         return cur
 
     def _boundary_cursor(self, dataset) -> Optional[dict]:
@@ -774,6 +801,25 @@ class Trainer:
                              stream.get("window_files", [])])
                 seen = set(prefix)
                 known = prefix + [f for f in known if f not in seen]
+                if not stream.get("window_files"):
+                    # a fresh process resuming at a window BOUNDARY
+                    # (e.g. after SIGKILL): _adopt_cursor will treat
+                    # the now-positioned dataset as an in-process
+                    # continuation and stay silent, so this seam is
+                    # the only place the restart-resume is visible —
+                    # record it (mid-window cursors keep their single
+                    # replay event from _adopt_cursor)
+                    hub = get_hub()
+                    hub.counter(
+                        "pbox_cursor_resumes_total",
+                        "passes resumed mid-pass from a cursor").inc()
+                    if hub.active:
+                        hub.emit(
+                            "cursor_resume", stream=True,
+                            global_step=int(self.global_step),
+                            batch_index=0, replay_files=0,
+                            files_completed=len(
+                                dataset.files_completed))
         hub = get_hub()
         totals = {"windows": 0, "files": 0, "batches": 0,
                   "examples": 0, "replayed_files": 0, "idle_polls": 0}
@@ -890,10 +936,20 @@ class Trainer:
                          lag_files=max(0, len(pending) - len(window)),
                          replayed_files=replayed,
                          global_step=int(self.global_step))
-            if checkpoint is not None and since_ckpt >= max(
-                    1, FLAGS.stream_ckpt_every_windows):
+            if self.on_window_complete is not None:
+                # the online daemon's boundary work (shrink scheduling,
+                # /healthz bookkeeping) — between passes by
+                # construction, and BEFORE the save decision so a
+                # shrink cycle's stream_save_now/stream_force_base
+                # requests take effect at THIS boundary (no training
+                # lands between the shrink and its base save)
+                self.on_window_complete(int(widx), dataset)
+            if checkpoint is not None and (
+                    since_ckpt >= max(1, FLAGS.stream_ckpt_every_windows)
+                    or self.stream_save_now):
                 self._stream_boundary_save(dataset, checkpoint)
                 since_ckpt = 0
+                self.stream_save_now = False
 
     def _stream_boundary_save(self, dataset, checkpoint) -> str:
         """Publish a boundary checkpoint: for a windowed stream it
@@ -904,6 +960,11 @@ class Trainer:
         after a mid-pass save or a cursor resume — a re-save would
         refuse as a delta over a base)."""
         if checkpoint.latest_step() == int(self.global_step):
+            # NOTE: a pending stream_force_base stays pending through
+            # this dedup — the post-shrink state is then captured by
+            # the next boundary that actually saves (deterministic
+            # either way: a restore replays the shrink at the same
+            # windows_completed index)
             return checkpoint._dir(int(self.global_step))
         cursor = self._boundary_cursor(dataset)
         # clear_touched=True only with a stream cursor: a cursor-free
@@ -911,9 +972,12 @@ class Trainer:
         # save surface predates the kwarg (sharded/tiered/multi_mf)
         # keep working on the generic graceful-stop path
         path = checkpoint.save(
-            self, delta=checkpoint.has_base(), cursor=cursor,
+            self,
+            delta=checkpoint.has_base() and not self.stream_force_base,
+            cursor=cursor,
             clear_touched=True if cursor is not None else None,
             metrics=self.metrics if len(self.metrics) else None)
+        self.stream_force_base = False
         if cursor is not None:
             # this boundary checkpoint now records every completed file
             # BY NAME — fold them into the compact count+fingerprint

@@ -242,6 +242,7 @@ def _run_alerts_leg(out: dict) -> None:
     hub.gauge("pbox_serving_staleness_sec", "").set(0.0)
     hub.gauge("pbox_stream_lag_files", "").set(0.0)
     hub.gauge("pbox_quality_degraded", "").set(0.0)
+    hub.gauge("pbox_online_windows_since_shrink", "").set(0.0)
     hist = hub.histogram("pbox_serving_latency_seconds", "",
                          buckets=SERVING_LATENCY_BUCKETS)
     for _ in range(50):
@@ -283,6 +284,20 @@ def _run_alerts_leg(out: dict) -> None:
     ev()
     hub.counter("pbox_nan_rollbacks_total", "").inc()
     ev()
+    ev()
+    # online lifecycle rules (docs/ONLINE.md): shrink_overdue is a
+    # plain threshold on windows since the last shrink cycle...
+    hub.gauge("pbox_online_windows_since_shrink", "").set(1e4)
+    ev()
+    hub.gauge("pbox_online_windows_since_shrink", "").set(0.0)
+    ev()
+    # ...backlog_growth needs the lag RISING across three consecutive
+    # evaluations (values stay far under the stream_lag threshold so
+    # the sibling rule on the same metric sleeps through this)
+    for lag in (1.0, 2.0, 3.0, 4.0):
+        hub.gauge("pbox_stream_lag_files", "").set(lag)
+        ev()
+    hub.gauge("pbox_stream_lag_files", "").set(0.0)
     ev()
 
     out["alerts_baseline_clean"] = baseline_clean

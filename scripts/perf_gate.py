@@ -160,7 +160,8 @@ def fold(repo_root: Optional[str] = None,
     out = out_path or os.path.join(root, "BENCH_trajectory.json")
     rows: List[Dict] = []
     for pattern in ("BENCH_r[0-9]*.json", "MULTICHIP_r[0-9]*.json",
-                    "KERNELS_r[0-9]*.json", "SERVE_r[0-9]*.json"):
+                    "KERNELS_r[0-9]*.json", "SERVE_r[0-9]*.json",
+                    "ONLINE_r[0-9]*.json"):
         for path in sorted(glob.glob(os.path.join(root, pattern))):
             rows.extend(parse_bench_artifact(path))
     data = {"version": 1, "rows": rows}

@@ -163,8 +163,17 @@ def build_rows(events: List[dict]) -> List[Dict[str, str]]:
     # pass, tracked from the alert_fired/alert_cleared stream
     any_alerts = any(e.get("event") in ("alert_fired", "alert_cleared")
                      for e in events)
+    # feature-lifecycle column (docs/ONLINE.md): the shrink cycle (or
+    # loud skip) landing between passes, shown on the next pass row
+    any_lifecycle = any(e.get("event") in ("online_shrink",
+                                           "online_shrink_skipped")
+                        for e in events)
+    last_shrink = ""
+    last_lag: Optional[int] = None
     firing: List[str] = []
     for ev in events:
+        if ev.get("event") == "stream_window" and "lag_files" in ev:
+            last_lag = int(ev["lag_files"])
         if ev.get("event") == "alert_fired":
             if ev.get("rule") not in firing:
                 firing.append(str(ev.get("rule")))
@@ -175,6 +184,14 @@ def build_rows(events: List[dict]) -> List[Dict[str, str]]:
             continue
         if ev.get("event") == "serving_stats":
             last_serving = ev
+            continue
+        if ev.get("event") == "online_shrink":
+            last_shrink = (f"w{ev.get('window', '?')}:"
+                           f"-{ev.get('freed', 0)}"
+                           f" ({ev.get('live_rows', '?')} live)")
+            continue
+        if ev.get("event") == "online_shrink_skipped":
+            last_shrink = f"w{ev.get('window', '?')}:SKIPPED"
             continue
         if ev.get("event") != "pass":
             continue
@@ -236,6 +253,16 @@ def build_rows(events: List[dict]) -> List[Dict[str, str]]:
             # alert timeline column only when the run alerted: which
             # rules were firing as of this pass
             rows[-1]["alerts"] = ",".join(firing) or "-"
+        if any_lifecycle:
+            # lifecycle column only when shrink cycles ran: the cycle
+            # (rows freed, live rows after) or loud skip since the
+            # previous pass row, plus the stream backlog as of the
+            # latest window boundary
+            cell = last_shrink or "-"
+            if last_lag is not None:
+                cell += f" lag {last_lag}"
+            rows[-1]["lifecycle"] = cell
+            last_shrink = ""
     return rows
 
 

@@ -263,14 +263,18 @@ def test_disk_tier_read_through_and_no_resurrection(tmp_path):
     got = hs.fetch(keys[:3])
     np.testing.assert_allclose(got["embed_w"], 3.0)
     assert len(hs) == 3
-    # shrink a promoted key; it must not resurrect into the next base
+    # shrink a promoted key; it must not resurrect into the next base.
+    # Lifecycle aging reaches the WHOLE tier stack (docs/ONLINE.md): a
+    # gentle shrink keeps RAM and spilled rows alike...
     hs._arr["show"][hs.index.lookup(keys[:1])] = 0.0
-    hs.shrink(delete_threshold=10.0, decay=1.0)  # drops all 3 promoted
+    assert hs.shrink(delete_threshold=0.0, decay=1.0) == 0
+    # ...a harsh one ages out the 3 promoted AND the 7 still-spilled
+    assert hs.shrink(delete_threshold=10.0, decay=1.0) == 10
     full = str(tmp_path / "full.npz")
     n = hs.save_base(full)
     blob = np.load(full)
     assert keys[0] not in blob["keys"]           # no resurrection
-    assert n == 7                                # the 7 still-spilled rows
+    assert n == 0                                # nothing survives anywhere
     # reset-load forgets old spill registration
     hs.load(str(tmp_path / "b.npz"), merge=False)
     assert hs._spill_files == []

@@ -1,0 +1,139 @@
+"""Feature-lifecycle shrink vs the async end_pass epilogue
+(docs/ONLINE.md): aging must never score a row on pre-write-back
+counters, and the SSD tier must age alongside host RAM."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config import FLAGS, flags_scope
+from paddlebox_tpu.ps import (EmbeddingTable, HostStore, PassScopedTable,
+                              SparseSGDConfig)
+from paddlebox_tpu.ps.ssd import SsdTier
+from paddlebox_tpu.ps.table import FIELD_COL
+
+
+def _rows(n, v, mf_dim=2):
+    return {f: np.full((n, mf_dim) if f == "embedx_w" else (n,), v,
+                       np.float32) for f in
+            ("show", "clk", "delta_score", "slot", "embed_w",
+             "embed_g2sum", "embedx_w", "embedx_g2sum", "mf_size")}
+
+
+def test_shrink_fences_draining_epilogue():
+    """Regression (PassScopedTable.shrink): a row refreshed by a
+    draining async end_pass write-back must not be aged on its stale
+    host counters. The shrink fences the epilogue lane first, so the
+    write-back lands before any score is computed."""
+    with flags_scope(async_end_pass=True):
+        hs = HostStore(mf_dim=2, capacity=1 << 12)
+        t = PassScopedTable(hs, pass_capacity=64, cfg=SparseSGDConfig())
+        key = np.array([7], np.uint64)
+        # stale host counters: show=0 scores 0.0 -> below threshold
+        hs.update(key, _rows(1, 0.0))
+
+        gate = threading.Event()
+        landed = threading.Event()
+        orig = hs.update_rows
+
+        def gated_update_rows(*a, **k):
+            gate.wait(10)
+            orig(*a, **k)
+            landed.set()
+
+        hs.update_rows = gated_update_rows
+        t.begin_pass(key)
+        # a mid-pass shrink is a protocol error, not a silent no-op
+        with pytest.raises(RuntimeError):
+            t.shrink(delete_threshold=0.5, decay=1.0)
+        # train the row hot: show=10 scores 1.0 -> survives threshold
+        rows = t.index.lookup(key)
+        d = np.asarray(t.state.data).copy()
+        d[rows, FIELD_COL["show"]] = 10.0
+        t.state = type(t.state).from_logical(d, t.state.capacity)
+        t._touched[rows] = True
+        t.end_pass()  # dispatches the write-back, blocked on the gate
+
+        out = {}
+
+        def run_shrink():
+            out["freed"] = t.shrink(delete_threshold=0.5, decay=1.0)
+
+        th = threading.Thread(target=run_shrink)
+        th.start()
+        time.sleep(0.2)
+        # the fence holds shrink behind the in-flight write-back; had it
+        # proceeded, show=0 scores 0.0 < 0.5 and key 7 would be freed
+        assert th.is_alive(), "shrink ran past a draining epilogue job"
+        gate.set()
+        th.join(10)
+        assert not th.is_alive()
+        assert landed.is_set(), "shrink finished before the write-back"
+        assert out["freed"] == 0
+        got = hs.fetch(key)
+        np.testing.assert_allclose(got["show"], 10.0)
+
+
+def test_embedding_table_shrink_calls_fence():
+    """Base-class audit: EmbeddingTable.shrink drains an attached
+    epilogue fence before mutating rows."""
+    table = EmbeddingTable(mf_dim=2, capacity=256,
+                          cfg=SparseSGDConfig(), unique_bucket_min=64)
+    calls = []
+    table.fence = lambda: calls.append("fence")
+    table.shrink(delete_threshold=0.0, decay=1.0)
+    assert calls == ["fence"]
+
+
+def test_ssd_tier_shrink(tmp_path):
+    """SsdTier.shrink decays show/clk/delta_score in place, drops rows
+    whose decayed score falls below the threshold, preserves survivors'
+    touched bits, and frees fully-dead segments from disk."""
+    with flags_scope(ssd_segment_rows=4):
+        tier = SsdTier(str(tmp_path / "tier"), width=8)
+        keys = np.arange(1, 9, dtype=np.uint64)
+        rows = np.zeros((8, 8), np.float32)
+        rows[:, 0] = np.arange(8, dtype=np.float32)  # show = 0..7
+        rows[:, 4] = 3.5                             # a payload column
+        touched = np.zeros(8, bool)
+        touched[::2] = True
+        tier.append(keys, rows, touched=touched)
+        assert len(tier) == 8
+        bytes_before = tier.stats()["bytes"]
+        # decay 0.5 halves show; score = 0.1 * decayed show = 0.05*show,
+        # so threshold 0.2 drops show 0..3 and keeps show 4..7
+        dropped = tier.shrink(delete_threshold=0.2, decay=0.5)
+        assert dropped == 4
+        assert len(tier) == 4
+        fk, sub, tch = tier.take(keys)
+        order = np.argsort(fk)
+        fk, sub, tch = fk[order], sub[order], tch[order]
+        np.testing.assert_array_equal(fk, keys[4:])
+        np.testing.assert_allclose(sub[:, 0],
+                                   np.arange(4, 8, dtype=np.float32) * 0.5)
+        np.testing.assert_allclose(sub[:, 4], 3.5)  # payload untouched
+        np.testing.assert_array_equal(tch, touched[4:])
+        tier.maybe_compact()
+        assert tier.stats()["bytes"] <= bytes_before
+
+
+def test_host_store_shrink_reaches_ssd(tmp_path):
+    """HostStore.shrink ages the disk tier too — including when every
+    row has been demoted and host RAM is empty (regression: the old
+    early-return skipped the tier entirely)."""
+    hs = HostStore(mf_dim=2, capacity=1 << 10,
+                   ssd_dir=str(tmp_path / "tier"))
+    keys = np.arange(10, 20, dtype=np.uint64)
+    data = _rows(10, 0.0)
+    data["show"] = np.where(keys >= 15, 10.0, 0.0).astype(np.float32)
+    hs.update(keys, data)
+    assert hs.demote_cold() == 10 and len(hs) == 0
+    assert len(hs.ssd) == 10
+    # RAM empty: the tier must still age. score(show=0)=0 < 0.5 drops 5
+    freed = hs.shrink(delete_threshold=0.5, decay=1.0)
+    assert freed == 5
+    assert len(hs.ssd) == 5
+    got = hs.fetch(np.arange(15, 20, dtype=np.uint64))
+    np.testing.assert_allclose(got["show"], 10.0)
