@@ -336,6 +336,17 @@ class Flags:
     restore_consensus_dir: str = ""
     # how long a consensus gather waits for the full mesh to publish
     consensus_timeout_sec: float = 60.0
+    # elastic membership (distributed/elastic, train/multihost): shared
+    # directory backing the FileKVStore lease/rendezvous protocol
+    # ("" = make_elastic_manager requires an explicit store)
+    elastic_dir: str = ""
+    # lease TTL: a host whose heartbeat mtime is older than this is a
+    # candidate death (confirmed after elastic_dead_checks polls)
+    elastic_ttl_sec: float = 10.0
+    # dead-rank hysteresis: consecutive boundary polls a host must miss
+    # before a scale event fires (1 = legacy immediate detection; the
+    # default 2 absorbs one delayed-but-alive heartbeat)
+    elastic_dead_checks: int = 2
 
     # --- streaming ingest (data/dataset.QueueDataset windowed mode +
     # Trainer.train_stream; docs/RESILIENCE.md §Streaming) ---

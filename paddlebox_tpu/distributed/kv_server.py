@@ -13,10 +13,12 @@ reachable (e.g. alongside rank 0 or a scheduler), point every host's
 Wire protocol (length-framed, one request per connection round):
   request : op u8 | klen u32 | key | vlen u64 | value
   response: ok u8 | vlen u64 | value
-ops: 1=PUT 2=GET 3=DEL 4=LIST(prefix) 5=MTIME. LIST value = repeated
-[klen u32 | key | vlen u64 | value]; MTIME value = the entry's AGE in
-seconds as f64 (server now − write stamp) — ages, not absolute
-timestamps, so lease liveness is immune to cross-host clock skew."""
+ops: 1=PUT 2=GET 3=DEL 4=LIST(prefix) 5=MTIME 6=TOUCH. LIST value =
+repeated [klen u32 | key | vlen u64 | value]; MTIME value = the entry's
+AGE in seconds as f64 (server now − write stamp) — ages, not absolute
+timestamps, so lease liveness is immune to cross-host clock skew. TOUCH
+refreshes the stamp without rewriting the payload (the heartbeat op);
+its value is 1 byte: 1=refreshed, 0=key gone (lease was deleted)."""
 
 from __future__ import annotations
 
@@ -32,7 +34,7 @@ from paddlebox_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
 
-_PUT, _GET, _DEL, _LIST, _MTIME = 1, 2, 3, 4, 5
+_PUT, _GET, _DEL, _LIST, _MTIME, _TOUCH = 1, 2, 3, 4, 5, 6
 _MAX_KEY = 1 << 16   # sanity caps: elastic keys/payloads are tiny;
 _MAX_VAL = 1 << 26   # anything bigger is a stray/garbage connection
 _VERY_OLD = 1e12     # age reported for missing keys
@@ -129,7 +131,7 @@ class KVServer:
                     conn.settimeout(30.0)
                     op = hdr[0]
                     (klen,) = struct.unpack("<I", _recv_exact(conn, 4))
-                    if not _PUT <= op <= _MTIME or klen > _MAX_KEY:
+                    if not _PUT <= op <= _TOUCH or klen > _MAX_KEY:
                         raise ValueError(f"bad kv request op={op}")
                     key = _recv_exact(conn, klen).decode("utf-8")
                     (vlen,) = struct.unpack("<Q", _recv_exact(conn, 8))
@@ -167,6 +169,12 @@ class KVServer:
                 ent = self._data.get(key)
                 age = (time.time() - ent[1]) if ent else _VERY_OLD
                 return struct.pack("<d", age)
+            if op == _TOUCH:
+                ent = self._data.get(key)
+                if ent is None:
+                    return b"\x00"
+                self._data[key] = (ent[0], time.time())
+                return b"\x01"
         raise ValueError(f"bad kv op {op}")
 
 
@@ -249,3 +257,8 @@ class TcpKVStore(KVStore):
         (now − mtime ≤ ttl) are immune to cross-host clock skew."""
         (age,) = struct.unpack("<d", self._request(_MTIME, key))
         return max(time.time() - age, 0.0)
+
+    def touch(self, key: str) -> bool:
+        """Refresh the lease stamp server-side without resending the
+        payload — the heartbeat op; False when the lease was deleted."""
+        return self._request(_TOUCH, key) == b"\x01"

@@ -215,6 +215,9 @@ class AlertEngine:
                 "value": value, "threshold": rule.value,
                 "metric": rule.metric}
 
+    #: rules whose fires are world-membership changes, not SLO breaches
+    _MEMBERSHIP_RULE_NAMES = ("rank_dead", "world_degraded")
+
     def _publish(self, tr: Dict) -> None:
         hub = self.hub
         fired = tr["to"] == "fired"
@@ -239,9 +242,14 @@ class AlertEngine:
                      threshold=tr["threshold"])
         if fired:
             # every firing rule IS an SLO breach — flight-recorder
-            # debounce collapses storms into one bundle per window
+            # debounce collapses storms into one bundle per window.
+            # Membership rules route to their own trigger so a world
+            # change and a concurrent SLO breach each get a bundle.
             from paddlebox_tpu.obs import flightrec
-            flightrec.trigger("slo_breach",
+            trigger = ("membership_change"
+                       if tr["rule"] in self._MEMBERSHIP_RULE_NAMES
+                       else "slo_breach")
+            flightrec.trigger(trigger,
                               reason=f"alert {tr['rule']}",
                               rule=tr["rule"], severity=tr["severity"],
                               metric=tr["metric"], value=tr["value"],
@@ -344,6 +352,18 @@ def default_rules() -> List[Rule]:
              trend_window=3, for_count=3,
              help="stream backlog rose across three consecutive "
                   "evaluations — ingest is outrunning training"),
+        # elastic-membership rules (docs/RESILIENCE.md §Elastic
+        # membership): routed to the membership_change flight-recorder
+        # trigger in _publish
+        Rule("rank_dead", "pbox_membership_scale_events_total",
+             kind="trend", severity="critical", op=">", value=0.0,
+             labels={"direction": "lost"},
+             help="a rank left the effective membership since the "
+                  "last evaluation (TTL expiry or watchdog eviction)"),
+        Rule("world_degraded", "pbox_membership_degraded",
+             kind="threshold", severity="warn", op=">", value=0.5,
+             help="effective membership below the target np — the job "
+                  "is running shrunk until the lost ranks rejoin"),
     ]
 
 

@@ -42,7 +42,7 @@ BUNDLE_SCHEMA = 1
 #: tests, not silently produce an unknown bundle family.
 TRIGGERS = ("nan_rollback", "reload_degrade", "pipeline_hang",
             "watchdog_escalation", "slo_breach", "manual",
-            "shrink_skipped", "online_degrade")
+            "shrink_skipped", "online_degrade", "membership_change")
 
 #: critical-path blocks retained for the bundle (newest last)
 KEEP_CRITICAL_PATH = 16

@@ -64,6 +64,11 @@ class TelemetryHub:
         # status): /healthz grows an "online" block — windows, backlog,
         # publish/shrink timestamps, and the daemon's degrade mode
         self._online_probe = None
+        # elastic-membership surface (distributed.elastic.ElasticManager
+        # registers its status on register()): /healthz grows a
+        # "membership" block — alive set, np window, last scale event,
+        # re-shard count (docs/RESILIENCE.md §Elastic membership)
+        self._membership_probe = None
         # per-sink CONSECUTIVE failure counts (sink fault isolation): a
         # sink that keeps raising gets quarantined — removed from the
         # fan-out — after FLAGS.telemetry_sink_errors_max failures
@@ -331,6 +336,30 @@ class TelemetryHub:
             log.warning("online daemon probe failed", exc_info=True)
             return {"mode": "unknown", "error": "probe failed"}
 
+    # ---- elastic-membership surface (RESILIENCE.md §Elastic) -----------
+    def set_membership_probe(self, probe) -> None:
+        """Register (or clear, with None) the elastic manager's status
+        provider — a callable returning the ``membership`` block for
+        /healthz: ``{alive, np, min_np, max_np, last_scale_event_ts,
+        reshard_count}`` (ElasticManager.membership_status). One manager
+        per process; the last registration wins."""
+        with self._lock:
+            self._membership_probe = probe
+
+    def membership_info(self) -> Optional[Dict]:
+        """The registered membership probe's current block (None: no
+        elastic manager in this process; a broken probe must not take
+        the health endpoint down)."""
+        with self._lock:
+            probe = self._membership_probe
+        if probe is None:
+            return None
+        try:
+            return probe()
+        except Exception:
+            log.warning("membership health probe failed", exc_info=True)
+            return {"alive": None, "error": "probe failed"}
+
     # ---- alerts surface (docs/OBSERVABILITY.md §Alerts) ----------------
     def set_alerts_probe(self, probe) -> None:
         """Register (or clear, with None) the alert engine's status
@@ -401,6 +430,11 @@ class TelemetryHub:
             # the daemon's train+publish+serve verdict in one block:
             # mode != "full" means a leg degraded (docs/ONLINE.md)
             out["online"] = online
+        membership = self.membership_info()
+        if membership is not None:
+            # the elastic world in one block: alive set vs the
+            # [min_np, max_np] window, last scale event, re-shards
+            out["membership"] = membership
         alerts = self.alerts_info()
         if alerts is not None:
             # /healthz carries the compact alarm view; /alertz the

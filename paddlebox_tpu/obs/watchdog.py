@@ -106,6 +106,26 @@ def requeue_pass_action(handler: Callable[[List[StragglerReport]], None],
     return action
 
 
+def shrink_and_continue_action(evict_fn: Callable[[List[StragglerReport]],
+                                                  None],
+                               name: str = "shrink_and_continue"):
+    """Escalation action factory — the rung BETWEEN requeue and abort
+    (docs/RESILIENCE.md §Elastic membership): hand the wedged ranks to
+    ``evict_fn``, which deregisters their elastic leases
+    (``ElasticManager.evict_host``) so the next boundary membership poll
+    confirms the death immediately (eviction bypasses the dead-check
+    hysteresis) and the survivors re-shard and continue. A hung host
+    costs one rollback-to-boundary instead of the job.
+
+    ``evict_fn`` runs on the MONITOR thread; lease deletion is a KV op,
+    safe under concurrent training — the re-shard itself happens at the
+    training loop's next pass boundary, never here."""
+    def action(wd: "StragglerWatchdog", reports, stalled_for: float):
+        evict_fn(reports)
+    action.escalation_name = name
+    return action
+
+
 def abort_with_checkpoint_action(save_fn: Callable[[], object],
                                  name: str = "abort_with_checkpoint"):
     """Escalation action factory: snapshot state (``save_fn``) and THEN

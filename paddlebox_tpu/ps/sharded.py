@@ -709,18 +709,28 @@ class ShardedEmbeddingTable:
         mesh size, or a single-table EmbeddingTable/HostStore save) keys
         re-split by key % N."""
         want = list(FIELDS) + (["opt_ext"] if self.opt_ext else [])
-        if "n" in blob and int(blob["n"]) == self.n:
+        if "n" in blob and int(blob["n"]) == self.n \
+                and all(f"keys_{s}" in blob for s in range(self.n)):
             for s in range(self.n):
                 fields = {f: blob[f"{f}_{s}"] for f in want
                           if f"{f}_{s}" in blob}
                 yield blob[f"keys_{s}"], fields
             return
         if "n" in blob:
+            # tolerate files holding only SOME shards (a multihost
+            # per-process save): concatenate what is present — the
+            # key%N re-split below re-derives ownership either way
             fn = int(blob["n"])
-            keys = np.concatenate([blob[f"keys_{s}"] for s in range(fn)])
-            fields = {f: np.concatenate([blob[f"{f}_{s}"]
-                                         for s in range(fn)])
-                      for f in want if f"{f}_0" in blob}
+            present = [s for s in range(fn) if f"keys_{s}" in blob]
+            if present:
+                keys = np.concatenate([blob[f"keys_{s}"]
+                                       for s in present])
+                fields = {f: np.concatenate([blob[f"{f}_{s}"]
+                                             for s in present])
+                          for f in want if f"{f}_{present[0]}" in blob}
+            else:
+                keys = np.zeros(0, np.uint64)
+                fields = {}
         else:
             keys = blob["keys"]
             fields = {f: blob[f] for f in want if f in blob}

@@ -30,6 +30,8 @@ the jitted step itself.
 
 from __future__ import annotations
 
+import os
+
 from typing import Dict, Optional
 
 import jax
@@ -329,6 +331,44 @@ class MultihostTieredShardedTable(TieredShardedEmbeddingTable):
                       if f"{f}_{s}" in blob}
             total += self.hosts[s].import_rows(blob[f"keys_{s}"], fields,
                                                merge=merge)
+        self.drop_window()
+        return total
+
+    def load_reshard(self, paths, merge: bool = False) -> int:
+        """Re-import saves written at ANY shard count — the elastic
+        re-shard path (docs/RESILIENCE.md §Elastic membership). Unlike
+        ``load`` (which refuses foreign counts), every process reads
+        EVERY file of one logical save epoch (``paths`` = the full
+        per-process file set, or one single-controller/base file),
+        re-splits all keys by ``key % n`` via ``_file_per_shard``, and
+        imports only the rows routed to its OWNED shards — so a
+        6-process world can adopt an 8-process save without a
+        single-controller intermediary. Call in lockstep across the new
+        world, outside a pass window."""
+        self._no_pass("load_reshard")
+        if isinstance(paths, (str, os.PathLike)):
+            paths = [paths]
+        total = 0
+        fresh: set = set()
+        for path in paths:
+            blob = np.load(path)
+            for s, (keys, fields) in enumerate(self._file_per_shard(blob)):
+                if s not in self.owned or not len(keys):
+                    continue
+                want = set(self.hosts[s].fields)
+                use = {f: v for f, v in fields.items() if f in want}
+                # first import into a shard resets it (load semantics);
+                # rows from the remaining files merge on top
+                first = not merge and s not in fresh
+                fresh.add(s)
+                total += self.hosts[s].import_rows(keys, use,
+                                                   merge=not first)
+        if not merge:
+            # owned shards no file routed keys to must still reset —
+            # load(merge=False) semantics are "the file set IS the model"
+            for s in sorted(self.owned - fresh):
+                self.hosts[s].import_rows(
+                    np.empty(0, np.uint64), {}, merge=False)
         self.drop_window()
         return total
 

@@ -119,12 +119,20 @@ class RestoreConsensus:
     def __init__(self, store, process_index: int, num_processes: int,
                  timeout: Optional[float] = None,
                  poll_interval: float = 0.05, epoch: int = 0,
-                 clock=time.monotonic, sleep=time.sleep) -> None:
+                 clock=time.monotonic, sleep=time.sleep,
+                 participants: Optional[Sequence[int]] = None) -> None:
         if isinstance(store, str):
             store = DirConsensusStore(store)
         self.store = store
         self.process_index = int(process_index)
         self.num_processes = int(num_processes)
+        # elastic worlds: the set of ranks expected to publish. Default
+        # = the full mesh; after a scale-down the survivors call
+        # set_participants() so agreements stop waiting on the dead.
+        self._participants: List[int] = sorted(
+            int(p) for p in (participants
+                             if participants is not None
+                             else range(int(num_processes))))
         if timeout is None:
             from paddlebox_tpu.config import FLAGS
             timeout = FLAGS.consensus_timeout_sec
@@ -141,6 +149,30 @@ class RestoreConsensus:
         if hasattr(self.store, "clear_process"):
             self.store.clear_process(self.process_index)
 
+    # ---- elastic membership --------------------------------------------
+    @property
+    def participants(self) -> List[int]:
+        return list(self._participants)
+
+    def set_participants(self, ranks: Sequence[int]) -> None:
+        """Restrict agreements to ``ranks`` (the surviving world after a
+        scale event). Every surviving rank must apply the SAME set
+        before its next agreement call — the set is part of the lockstep
+        contract. Publishes from non-participants are ignored, so a dead
+        rank's stale (or late) files can neither satisfy nor skew a
+        survivor agreement."""
+        ranks = sorted(int(p) for p in ranks)
+        if not ranks:
+            raise ValueError("participants must be non-empty")
+        if self.process_index not in ranks:
+            raise ValueError(
+                f"process {self.process_index} cannot agree in a world "
+                f"it is not part of ({ranks})")
+        if ranks != self._participants:
+            log.info("restore consensus: participants %s -> %s",
+                     self._participants, ranks)
+        self._participants = ranks
+
     # ---- core gather ---------------------------------------------------
     def _gather_once(self, topic: str, payload: dict) -> Dict[int, dict]:
         """Publish this process's view under a per-call topic, then
@@ -155,7 +187,9 @@ class RestoreConsensus:
         deadline = self.clock() + self.timeout
         while True:
             got = self.store.read(topic)
-            missing = [p for p in range(self.num_processes) if p not in got]
+            got = {p: d for p, d in got.items()
+                   if p in set(self._participants)}
+            missing = [p for p in self._participants if p not in got]
             if not missing:
                 return got
             if self.clock() > deadline:

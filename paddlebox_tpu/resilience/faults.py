@@ -95,6 +95,21 @@ site                        seam
                             ``shrink_skipped`` flight-recorder trigger)
                             without stalling training — the cadence
                             re-fires ``shrink_every_windows`` later
+``elastic.kv``              every membership-store operation
+                            (distributed/elastic.FileKVStore put / get /
+                            delete / list / mtime / touch; ctx carries
+                            ``op`` and ``key``): a transient ``fail``
+                            retries on the seeded RetryPolicy (site
+                            ``elastic.kv``) at the manager level — a
+                            lease refresh or alive-poll survives a
+                            flaky NFS round trip without a spurious
+                            scale event (chaos fault 8)
+``elastic.rendezvous``      each ``wait_for_np`` poll iteration
+                            (distributed/elastic.ElasticManager): a
+                            transient ``fail`` is one missed
+                            observation absorbed by the rendezvous
+                            window; on timeout the error names the
+                            hosts that never showed up
 ==========================  =============================================
 
 Fault kinds: ``fail`` (raise — ``exc=transient|crash|os`` picks the

@@ -965,6 +965,44 @@ def state_digest(trainer) -> str:
     return h.hexdigest()
 
 
+def elastic_state_digest(trainer) -> str:
+    """sha256 over a ShardedTrainer's LOGICAL state, invariant to the
+    table's shard count: every shard's rows are gathered, keyed by
+    feasign and sorted globally (the ``key % num_shards`` owner and the
+    row-id assignment order both cancel out), then the dense params /
+    optimizer leaves and the shard-REDUCED AUC (``_finalize_auc`` — the
+    same reduction ``dense_snapshot`` persists, so an 8-shard world and
+    its re-sharded 6-shard successor digest identically when they hold
+    the same model). The elastic gate (scripts/elastic_check.py)
+    compares churned runs against an unchurned oracle with it at every
+    common pass boundary."""
+    trainer.sync_table()
+    table = trainer.table
+    h = hashlib.sha256()
+    data = np.asarray(jax.device_get(table.state.data))
+    all_keys, all_rows = [], []
+    with table.host_lock:
+        per_shard = [table.indexes[s].items() for s in range(table.n)]
+    for s, (keys, rows) in enumerate(per_shard):
+        all_keys.append(np.ascontiguousarray(keys, np.uint64))
+        all_rows.append(data[s][rows])
+    keys = (np.concatenate(all_keys) if all_keys
+            else np.zeros(0, np.uint64))
+    rows = (np.concatenate(all_rows) if all_rows
+            else np.zeros((0, data.shape[-1]), np.float32))
+    order = np.argsort(keys, kind="stable")
+    h.update(np.ascontiguousarray(keys[order]).tobytes())
+    h.update(np.ascontiguousarray(rows[order]).tobytes())
+    for leaf in jax.tree_util.tree_leaves(
+            jax.device_get((trainer.state.params,
+                            trainer.state.opt_state))):
+        h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    for leaf in tuple(trainer._finalize_auc(trainer.state.auc)):
+        h.update(np.ascontiguousarray(
+            np.asarray(jax.device_get(leaf))).tobytes())
+    return h.hexdigest()
+
+
 def sharded_state_digest(trainer) -> str:
     """sha256 over a ShardedTrainer's RAW state bytes: dense params +
     the packed table shards + the per-shard AUC leaves. STRICTER than

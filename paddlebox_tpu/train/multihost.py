@@ -136,6 +136,180 @@ def make_straggler_watchdog(heartbeat_dir: Optional[str] = None,
     return wd.start() if start else wd
 
 
+# ---- elastic membership (distributed/elastic) --------------------------
+def make_elastic_manager(job_id: str, host: Optional[str] = None,
+                         np: Optional[int] = None,
+                         elastic_dir: Optional[str] = None,
+                         store=None, **kwargs):
+    """Build THIS process's elastic membership agent (FLAGS wiring —
+    docs/RESILIENCE.md §Elastic membership). ``elastic_dir`` (default
+    ``FLAGS.elastic_dir``) must be shared across hosts (NFS/FUSE); pass
+    ``store=`` (e.g. a ``TcpKVStore``) to skip the filesystem entirely.
+    ``host`` defaults to ``host<process_index>``, ``np`` to
+    ``jax.process_count()``; ``kwargs`` override any ``ElasticManager``
+    parameter (``min_np``/``max_np`` pick the FAULT_TOLERANCE vs ELASTIC
+    level, tests inject ``heartbeat_period``)."""
+    from paddlebox_tpu.config import FLAGS
+    from paddlebox_tpu.distributed.elastic import ElasticManager, FileKVStore
+    if store is None:
+        d = elastic_dir or FLAGS.elastic_dir
+        if not d:
+            raise ValueError(
+                "elastic membership needs a SHARED dir: pass "
+                "elastic_dir=/store= or set FLAGS.elastic_dir")
+        store = FileKVStore(d)
+    kw = dict(ttl=FLAGS.elastic_ttl_sec,
+              dead_checks=FLAGS.elastic_dead_checks)
+    kw.update(kwargs)
+    return ElasticManager(
+        store, job_id,
+        host if host is not None else f"host{jax.process_index()}",
+        np if np is not None else jax.process_count(), **kw)
+
+
+class ElasticController:
+    """Boundary membership decisions for an elastic stream job: wraps an
+    ``ElasticManager`` (+ optional ``RestoreConsensus``) behind the tiny
+    protocol the training loops poll at every completed pass/window
+    boundary — ``poll`` (did the world change?), ``agree_boundary``
+    (which step do the survivors resume from?), ``evict`` (the
+    watchdog's shrink-and-continue rung), ``publish``/``note_reshard``
+    (restart pointer + bookkeeping). The re-shard itself — rebuild the
+    world at the new size and re-import the boundary checkpoint — is the
+    caller's move (``ElasticStreamRunner.run`` is the reference driver).
+    """
+
+    def __init__(self, manager, consensus=None) -> None:
+        self.manager = manager
+        self.consensus = consensus
+
+    def poll(self) -> Optional[Dict]:
+        """One boundary membership check. None = steady world; else a
+        decision dict ``{hosts, np, lost, joined, ts}`` (hysteresis and
+        forced evictions already applied by the manager)."""
+        hosts = self.manager.scale_event()
+        if hosts is None:
+            return None
+        ev = dict(self.manager.last_event or {})
+        ev.setdefault("hosts", hosts)
+        ev["np"] = len(hosts)
+        return ev
+
+    def evict(self, host: str, reason: str = "") -> None:
+        self.manager.evict_host(host, reason)
+
+    def agree_boundary(self, local_step,
+                       survivors: Optional[list] = None):
+        """Consensus over the surviving world on the boundary step to
+        resume from (``RestoreConsensus.agree_restore_step`` — the mesh
+        min, so a rank whose boundary save lagged drags everyone to the
+        newest step ALL survivors hold). ``survivors`` narrows the
+        participant set first; with no consensus wired (single
+        controller), the local step IS the agreement."""
+        if self.consensus is None:
+            return local_step
+        if survivors is not None:
+            self.consensus.set_participants(survivors)
+        return self.consensus.agree_restore_step(local_step)
+
+    def publish(self, path: str, pass_id: int) -> None:
+        self.manager.publish_checkpoint(path, pass_id)
+
+    def note_reshard(self, old_np: int, new_np: int,
+                     step: int = -1) -> None:
+        self.manager.note_reshard(old_np, new_np, step=step)
+
+
+class ElasticStreamRunner:
+    """Windowed stream driver with pass-boundary membership churn — the
+    re-shard state machine (docs/RESILIENCE.md §Elastic membership):
+
+    per window: train → boundary save → publish restart pointer →
+    ``controller.poll()``; on a scale event: coordinated stop (the
+    boundary IS the stop point — completed-window state only, no data
+    rollback) → ``agree_boundary`` over the survivors → rebuild the
+    world at the new size (``make_world(np)`` — fresh mesh + trainer +
+    table with ``num_shards`` matching) → re-import the agreed boundary
+    checkpoint (``key % num_shards`` makes the re-shard a deterministic
+    re-import; ``CheckpointManager.restore`` replays it) → continue the
+    stream at the next window.
+
+    ``make_world(np) -> (trainer, checkpoint_manager)`` owns the
+    host-count → mesh mapping; every checkpoint manager must share one
+    root so the re-shard import sees the boundary save. ``controller``
+    is duck-typed (``ElasticController``, or a scripted schedule in
+    gates/oracles — same driver, so digest parity between a churned run
+    and its scheduled twin proves the detection machinery is a
+    training-math no-op). ``on_boundary(widx, trainer)`` runs after the
+    save and before the poll (gates age leases / wedge ranks there).
+
+    Returns one record per window: ``{window, np, step, digest,
+    train_sec, reshard?}`` — ``reshard`` carries {old_np, new_np,
+    agreed_step, digest_after, stall_sec} and ``digest_after`` must
+    equal the boundary ``digest`` (the lossless re-import proof the
+    elastic gate asserts)."""
+
+    def __init__(self, make_world, make_dataset, num_windows: int,
+                 controller=None, on_boundary=None,
+                 digest_fn=None, clock=None) -> None:
+        import time
+        from paddlebox_tpu.train.checkpoint import elastic_state_digest
+        self.make_world = make_world
+        self.make_dataset = make_dataset
+        self.num_windows = int(num_windows)
+        self.controller = controller
+        self.on_boundary = on_boundary
+        self.digest_fn = digest_fn or elastic_state_digest
+        self.clock = clock or time.monotonic
+
+    def run(self, start_np: int) -> list:
+        trainer, cm = self.make_world(start_np)
+        np_cur = int(start_np)
+        records = []
+        for widx in range(self.num_windows):
+            ds = self.make_dataset(widx)
+            t0 = self.clock()
+            trainer.train_pass(ds)
+            train_sec = self.clock() - t0
+            step = int(trainer.global_step)
+            cm.save(trainer)  # boundary base: re-shard import source
+            rec = {"window": widx, "np": np_cur, "step": step,
+                   "digest": self.digest_fn(trainer),
+                   "train_sec": train_sec}
+            if self.controller is not None:
+                self.controller.publish(cm.root, widx)
+                if self.on_boundary is not None:
+                    self.on_boundary(widx, trainer)
+                decision = self.controller.poll()
+                if decision is not None and decision["np"] != np_cur:
+                    rec["reshard"] = self._reshard(decision, step, np_cur)
+                    np_cur = int(decision["np"])
+                    trainer, cm = self._world
+            records.append(rec)
+        return records
+
+    def _reshard(self, decision: Dict, step: int, old_np: int) -> Dict:
+        t0 = self.clock()
+        agreed = self.controller.agree_boundary(
+            step, survivors=decision.get("survivor_ranks"))
+        new_np = int(decision["np"])
+        trainer, cm = self.make_world(new_np)
+        restored = cm.restore(trainer, step=agreed)
+        if restored != agreed:
+            raise RuntimeError(
+                f"elastic re-shard: agreed boundary step {agreed} did "
+                f"not restore (got {restored}) — the boundary save is "
+                "missing from the shared checkpoint root")
+        self.controller.note_reshard(old_np, new_np, step=agreed)
+        self._world = (trainer, cm)
+        return {"old_np": old_np, "new_np": new_np,
+                "agreed_step": int(agreed),
+                "lost": decision.get("lost", []),
+                "joined": decision.get("joined", []),
+                "digest_after": self.digest_fn(trainer),
+                "stall_sec": self.clock() - t0}
+
+
 # ---- consistent recovery (resilience/consensus) ------------------------
 def make_restore_consensus(consensus_dir: Optional[str] = None, **kwargs):
     """Build this process's restore-consensus client (same shared-dir

@@ -137,6 +137,15 @@ class Trainer:
         # last shrink window/rows, live rows — a restore replays to the
         # same live-key set and the daemon resumes its cadence from it
         self.lifecycle: Optional[Dict[str, float]] = None
+        # elastic membership poll (train/multihost.ElasticController
+        # .poll or equivalent): called at every completed window
+        # boundary, AFTER on_window_complete and BEFORE the save
+        # decision. A truthy decision is a scale event: the loop
+        # publishes a boundary checkpoint and returns (coordinated
+        # stop) so the launcher can rebuild the world at the new size
+        # and resume from the stream cursor — membership is only ever
+        # acted on at completed boundaries, never mid-pass
+        self.stream_membership: Optional[Callable[[], object]] = None
 
     # ---- host-side prefetch: batch build + dedup + row assign + H2D ----
     def _prefetch_iter(
@@ -944,6 +953,20 @@ class Trainer:
                 # requests take effect at THIS boundary (no training
                 # lands between the shrink and its base save)
                 self.on_window_complete(int(widx), dataset)
+            if self.stream_membership is not None:
+                decision = self.stream_membership()
+                if decision:
+                    # scale event at a COMPLETED boundary: persist the
+                    # boundary (checkpoint + stream cursor) and hand
+                    # control back — the launcher re-shards to the new
+                    # world and resumes from this cursor. No data
+                    # rollback: only completed-window state is saved.
+                    if checkpoint is not None:
+                        self._stream_boundary_save(dataset, checkpoint)
+                    totals["membership"] = decision
+                    log.warning("stream stop at window %d boundary for "
+                                "membership change: %s", widx, decision)
+                    return
             if checkpoint is not None and (
                     since_ckpt >= max(1, FLAGS.stream_ckpt_every_windows)
                     or self.stream_save_now):

@@ -31,6 +31,24 @@ def atomic_write_json(path: str, payload: dict,
     return path
 
 
+def atomic_write_bytes(path: str, data: bytes,
+                       fsync: bool = True) -> str:
+    """Raw-bytes sibling of :func:`atomic_write_json` — the same
+    write-tmp → flush → fsync → ``os.replace`` publish for stores whose
+    payloads are opaque (the elastic membership ``FileKVStore``)."""
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "wb") as fh:
+        fh.write(data)
+        if fsync:
+            fh.flush()
+            try:
+                os.fsync(fh.fileno())
+            except OSError:
+                pass  # some FUSE mounts reject fsync; rename still atomic
+    os.replace(tmp, path)
+    return path
+
+
 def read_json(path: str):
     """Read a JSON file published by :func:`atomic_write_json`;
     returns None on a missing/torn/foreign file (the caller's next

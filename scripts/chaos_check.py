@@ -26,6 +26,13 @@ injects, in ONE run:
    RetryPolicy retries it INSIDE the poll — the new version still
    adopts on that same poll, no refusal is booked, and the query path
    never sees a gap (the prior snapshot answers throughout),
+8. ``elastic.kv`` / ``elastic.rendezvous`` faults on the ELASTIC
+   membership plane (distributed/elastic.py; docs/RESILIENCE.md
+   §Elastic membership): a transient KV fault during the membership
+   list retried on the seeded RetryPolicy, a delayed-but-alive
+   heartbeat absorbed by the ``dead_checks`` hysteresis (no membership
+   flap, no spurious re-shard), and a rendezvous that times out on a
+   genuinely missing host DIAGNOSABLY — the error names the host,
 
 then asserts full recovery:
 
@@ -348,6 +355,65 @@ def _run_serving_chaos(workdir: str, seed: int) -> dict:
     }
 
 
+def _run_elastic_chaos(workdir: str, seed: int) -> dict:
+    """Fault (8): the elastic membership plane under chaos. A transient
+    ``elastic.kv`` fault during the membership list is retried on the
+    seeded RetryPolicy; a delayed-but-alive heartbeat (aged lease that
+    recovers) is absorbed by the ``dead_checks`` hysteresis with ZERO
+    membership flaps — the false-dead host never leaves, so no spurious
+    re-shard can fire; and a rendezvous on a genuinely missing host
+    times out naming the host (the on-call diagnosis, not a bare
+    timeout)."""
+    import time
+
+    from paddlebox_tpu.distributed.elastic import (ElasticManager,
+                                                   FileKVStore)
+    from paddlebox_tpu.resilience.faults import FaultPlan, installed
+
+    store = FileKVStore(os.path.join(workdir, "elastic_chaos"))
+    for h in ("e0", "e1"):
+        store.put(f"paddlebox/chaos/nodes/{h}",
+                  json.dumps({"host": h}).encode())
+    # huge TTL: "death" below is an explicit mtime age-out, never a race
+    mgr = ElasticManager(store, "chaos", "e0", 2, ttl=3600.0,
+                         heartbeat_period=0.05, dead_checks=2)
+
+    # (8a) transient KV fault while listing members: retried to success
+    with installed(FaultPlan.parse("elastic.kv:fail:nth=1",
+                                   seed=seed)) as plan:
+        alive = mgr.alive_hosts()
+    assert plan.stats()["elastic.kv:fail"]["fired"] == 1, plan.stats()
+    assert alive == ["e0", "e1"], (
+        f"retried membership list lost hosts: {alive}")
+
+    # (8b) delayed-but-alive heartbeat: one aged poll then a recovery —
+    # hysteresis must absorb it with no scale event in between
+    assert mgr.scale_event() is None            # baseline {e0, e1}
+    key1 = "paddlebox/chaos/nodes/e1"
+    old = time.time() - 7200.0
+    os.utime(store._path(key1), (old, old))
+    flap1 = mgr.scale_event()                   # miss 1: inside grace
+    store.touch(key1)                           # heartbeat catches up
+    flap2 = mgr.scale_event()                   # recovered: count reset
+    assert flap1 is None and flap2 is None, (
+        f"false-dead heartbeat flapped membership: {flap1} / {flap2}")
+
+    # (8c) e1 really gone: the rendezvous barrier times out NAMING it
+    store.delete(key1)
+    try:
+        mgr.wait_for_np(timeout=0.3)
+        raise AssertionError("wait_for_np must time out with e1 gone")
+    except TimeoutError as exc:
+        assert "e1" in str(exc), (
+            f"rendezvous timeout does not name the missing host: {exc}")
+    return {
+        "elastic_kv_fault_fired": plan.stats()["elastic.kv:fail"]["fired"],
+        "elastic_alive_after_fault": alive,
+        "elastic_false_dead_flapped": False,
+        "elastic_timeout_named": ["e1"],
+    }
+
+
 def run_scenario(workdir: str, seed: int) -> dict:
     """One full chaos run; returns the resilience outcome summary."""
     import optax
@@ -459,6 +525,11 @@ def run_scenario(workdir: str, seed: int) -> dict:
         # poll: retried inside the poll, no serving gap
         serving_outcome = _run_serving_chaos(workdir, seed)
 
+        # (8) elastic.kv / elastic.rendezvous seams on the membership
+        # plane: transient list retried, false-dead heartbeat absorbed
+        # by hysteresis, missing-host rendezvous diagnosed by name
+        elastic_outcome = _run_elastic_chaos(workdir, seed)
+
     # telemetry JSONL: final pass event carries nonzero counters
     with open(jsonl) as fh:
         events = [json.loads(line) for line in fh]
@@ -483,6 +554,7 @@ def run_scenario(workdir: str, seed: int) -> dict:
         **ssd_outcome,
         **artifact_outcome,
         **serving_outcome,
+        **elastic_outcome,
     )
     return outcome
 

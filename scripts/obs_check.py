@@ -25,7 +25,8 @@ ONE flight recorder so bundle sequence numbers are provable:
    threshold and back via ``evaluate_once``; each transition books
    ``pbox_alerts_active``/``pbox_alerts_fired_total`` + events, and
    the first fire dumps ONE ``slo_breach`` bundle (debounce eats the
-   storm).
+   storm); the two membership rules route to ONE separate
+   ``membership_change`` bundle.
 5. **manual dump** — ``hub.dump_blackbox(reason)`` → one ``manual``
    bundle.
 6. **rotation + torn tail** — a size-capped ``JsonlSink`` rotates into
@@ -243,6 +244,9 @@ def _run_alerts_leg(out: dict) -> None:
     hub.gauge("pbox_stream_lag_files", "").set(0.0)
     hub.gauge("pbox_quality_degraded", "").set(0.0)
     hub.gauge("pbox_online_windows_since_shrink", "").set(0.0)
+    hub.gauge("pbox_membership_degraded", "").set(0.0)
+    hub.counter("pbox_membership_scale_events_total", "").inc(
+        n=0, direction="lost")
     hist = hub.histogram("pbox_serving_latency_seconds", "",
                          buckets=SERVING_LATENCY_BUCKETS)
     for _ in range(50):
@@ -298,6 +302,19 @@ def _run_alerts_leg(out: dict) -> None:
         hub.gauge("pbox_stream_lag_files", "").set(lag)
         ev()
     hub.gauge("pbox_stream_lag_files", "").set(0.0)
+    ev()
+    # elastic membership rules (docs/RESILIENCE.md §Elastic
+    # membership): rank_dead trends the `lost` series of the scale
+    # counter — one lost rank fires, a flat window clears...
+    hub.counter("pbox_membership_scale_events_total", "").inc(
+        direction="lost")
+    ev()
+    ev()
+    # ...world_degraded is a plain threshold on the degraded gauge
+    # (1 while running below target np)
+    hub.gauge("pbox_membership_degraded", "").set(1.0)
+    ev()
+    hub.gauge("pbox_membership_degraded", "").set(0.0)
     ev()
 
     out["alerts_baseline_clean"] = baseline_clean
@@ -422,8 +439,11 @@ def run_obs_check(workdir: str, seed: int = 7) -> dict:
         for pth in rec.bundles():
             _check_bundle(pth)
         out["bundles_schema_ok"] = schema_ok
-        # the alerts leg fired 6 rules; debounce collapsed the storm
-        # into the single slo_breach bundle audited above
+        # the alerts leg fired every default rule; debounce collapsed
+        # the SLO storm into the single slo_breach bundle audited above
+        # (the two membership rules route to their own
+        # membership_change bundle — a topology fact, not an SLO
+        # breach — likewise collapsed to one by the debounce)
         out["slo_breach_suppressed"] = hub.counter(
             "pbox_flightrec_suppressed_total",
             "").value(trigger="slo_breach")

@@ -153,15 +153,16 @@ def fold(repo_root: Optional[str] = None,
     the multichip scaling rounds (``MULTICHIP_r0*``, ISSUE 11), the
     kernel-microbench rounds (``KERNELS_r0*``,
     ``scripts/profile_keypath.py --set kernels`` — ISSUE 12) and the
-    serving-lane rounds (``SERVE_r0*``, BENCH_MODE=serve — ISSUE 15),
-    so a rebuild keeps their gate history instead of silently dropping
-    it."""
+    serving-lane rounds (``SERVE_r0*``, BENCH_MODE=serve — ISSUE 15)
+    and the elastic-churn rounds (``ELASTIC_r0*``,
+    ``scripts/elastic_check.py --artifact`` — ISSUE 18), so a rebuild
+    keeps their gate history instead of silently dropping it."""
     root = repo_root or _repo_root()
     out = out_path or os.path.join(root, "BENCH_trajectory.json")
     rows: List[Dict] = []
     for pattern in ("BENCH_r[0-9]*.json", "MULTICHIP_r[0-9]*.json",
                     "KERNELS_r[0-9]*.json", "SERVE_r[0-9]*.json",
-                    "ONLINE_r[0-9]*.json"):
+                    "ONLINE_r[0-9]*.json", "ELASTIC_r[0-9]*.json"):
         for path in sorted(glob.glob(os.path.join(root, pattern))):
             rows.extend(parse_bench_artifact(path))
     data = {"version": 1, "rows": rows}

@@ -390,6 +390,40 @@ def alerts_summary(events: List[dict]) -> str:
     return line
 
 
+def membership_summary(events: List[dict]) -> str:
+    """Elastic membership timeline (distributed/elastic +
+    train/multihost; docs/RESILIENCE.md §Elastic membership): every
+    ``membership_change`` and completed ``reshard`` in order —
+    'membership: np=3 (lost h1) -> reshard 4->3 @step 2 -> np=4
+    (joined h1)'. Ends with a degraded flag when the run finished below
+    its target world size. Empty when the world never changed."""
+    rel = [e for e in events
+           if e.get("event") in ("membership_change", "reshard")]
+    if not rel:
+        return ""
+    bits = []
+    for e in rel:
+        if e.get("event") == "membership_change":
+            delta = []
+            if e.get("lost"):
+                delta.append("lost " + ",".join(e["lost"]))
+            if e.get("joined"):
+                delta.append("joined " + ",".join(e["joined"]))
+            bits.append(f"np={e.get('np', '?')}"
+                        + (f" ({'; '.join(delta)})" if delta else ""))
+        else:
+            bits.append(f"reshard {e.get('old_np', '?')}->"
+                        f"{e.get('new_np', '?')} @step {e.get('step', '?')}")
+    line = "membership: " + " -> ".join(bits)
+    changes = [e for e in rel if e.get("event") == "membership_change"]
+    if changes:
+        last = changes[-1]
+        np_, tgt = last.get("np"), last.get("target_np")
+        if isinstance(np_, int) and isinstance(tgt, int) and np_ < tgt:
+            line += f"; still degraded ({np_}/{tgt})"
+    return line
+
+
 def bundles_summary(events: List[dict]) -> str:
     """Flight-recorder bundle pointers (obs/flightrec): every
     ``blackbox_dump`` the run published, trigger + path — the first
@@ -423,6 +457,9 @@ def render_report(events: List[dict], show_events: bool = False) -> str:
     al_line = alerts_summary(events)
     if al_line:
         out.append(al_line)
+    mb_line = membership_summary(events)
+    if mb_line:
+        out.append(mb_line)
     bx_line = bundles_summary(events)
     if bx_line:
         out.append(bx_line)

@@ -217,7 +217,7 @@ def test_default_rules_cover_issue_slos():
     assert names == {"serving_staleness", "serving_p99", "stream_lag",
                      "pipeline_hang", "nan_rollback",
                      "auc_degradation", "shrink_overdue",
-                     "backlog_growth"}
+                     "backlog_growth", "rank_dead", "world_degraded"}
 
 
 def test_alertz_route_and_healthz_block(fresh_hub):
@@ -244,7 +244,7 @@ def test_alertz_route_and_healthz_block(fresh_hub):
         eng.evaluate_once()
         az = json.loads(urllib.request.urlopen(
             f"http://127.0.0.1:{port}/alertz", timeout=5).read())
-        assert az["firing"] == 0 and len(az["rules"]) == 8
+        assert az["firing"] == 0 and len(az["rules"]) == 10
     finally:
         srv.shutdown()
 

@@ -34,11 +34,12 @@ def test_obs_check_gate_deterministic(tmp_path):
     # hang leg
     assert out["hang_raised"]
     # bundle audit: exactly one bundle per trigger, in seq order, all
-    # five anomaly classes represented, every bundle schema-complete
+    # six anomaly classes represented (the membership rules route to
+    # their own membership_change bundle), every bundle schema-complete
     assert out["one_bundle_per_trigger"] and out["bundles_schema_ok"]
     assert out["bundle_triggers"] == [
         "nan_rollback", "reload_degrade", "pipeline_hang",
-        "slo_breach", "manual"]
+        "slo_breach", "membership_change", "manual"]
     assert out["bundles"] == sorted(out["bundles"])
     assert out["slo_breach_suppressed"] >= 1.0  # debounce ate the storm
     # alerts: quiet baseline, every default rule fired AND cleared,

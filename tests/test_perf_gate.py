@@ -55,9 +55,14 @@ def test_fold_builds_trajectory(perf_gate, tmp_path):
         (tmp_path / f"BENCH_{rnd}.json").write_text(json.dumps({
             "tail": json.dumps({"metric": "m", "value": val,
                                 "unit": "u"})}))
+    # the elastic-churn gate's artifact family folds in too (ISSUE 18)
+    (tmp_path / "ELASTIC_r01.json").write_text(json.dumps({
+        "tail": json.dumps({"metric": "elastic.reshard_stall_ms",
+                            "value": 120.0, "unit": "ms"})}))
     out = str(tmp_path / "BENCH_trajectory.json")
     data = perf_gate.fold(repo_root=str(tmp_path), out_path=out)
-    assert [r["value"] for r in data["rows"]] == [50.0, 80.0]
+    assert [r["value"] for r in data["rows"]] == [50.0, 80.0, 120.0]
+    assert data["rows"][2]["source"] == "ELASTIC_r01"
     on_disk = json.load(open(out))
     assert on_disk["rows"] == data["rows"]
 
