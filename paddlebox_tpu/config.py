@@ -125,6 +125,24 @@ class Flags:
     # the data_norm mean/scale applied in the same residency (summary
     # update and the sharded sync_stats psum stay outside, unchanged)
     use_pallas_cross_norm: bool = False
+    # device-resident key assignment (ops/pallas_index.py — ISSUE 19):
+    # route bulk row assignment (EmbeddingTable.bulk_assign_unique, the
+    # resident-pass build front) and the sharded plan's per-shard
+    # assign/lookup (ps/sharded.prepare_global) through an
+    # open-addressing hash index living in device HBM — first-seen
+    # dedup of raw 64-bit feature ids (ops/device_unique.
+    # dedup_keys_first_seen) + a Pallas linear-probe insert/lookup over
+    # a bucket array, with the host kv mirrored only for NEW keys (one
+    # O(new) append instead of the O(all keys) per-pass round trip).
+    # Row allocation is first-seen sequential, bit-identical to the
+    # host index when its free list is empty; any state the device
+    # index cannot mirror exactly (free-list holes after shrink,
+    # arena-slotted tables, probe/capacity overflow) degrades LOUDLY
+    # to the host path (warning + pbox_kernel_dispatch_total booking).
+    # Off (default) = the host index path, byte-for-byte today's
+    # program; parity + digest gates in tier-1
+    # (tests/test_pallas_index.py, tests/test_pallas_train_gate.py).
+    use_pallas_index: bool = False
 
     # --- fused computation-collective sharded step (ISSUE 11;
     # docs/PERFORMANCE.md §Sharded-step overlap) ---

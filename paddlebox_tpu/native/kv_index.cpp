@@ -437,6 +437,44 @@ int32_t kv_arena_export(void* p, int32_t* chunk_slot_out,
   return n;
 }
 
+// Standalone first-seen dedup — NO index instance, a call-local
+// open-addressing table over the batch only. One O(n) pass replaces the
+// python oracle's three (np.unique + argsort + rank scatter,
+// ps/table.dedup_first_seen): uniq_out gets the distinct keys in
+// first-occurrence order, first_out their first stream positions,
+// inv_out each key's unique rank. Buffers sized n. Returns the unique
+// count. (ISSUE 19 satellite: the stage=dedup build-seconds cut.)
+int64_t kv_dedup_first_seen(const uint64_t* in, int64_t n,
+                            uint64_t* uniq_out, int64_t* first_out,
+                            int32_t* inv_out) {
+  uint64_t cap = 64;
+  while (cap < static_cast<uint64_t>(n) * 2) cap <<= 1;
+  uint64_t mask = cap - 1;
+  std::vector<uint64_t> keys(cap);
+  std::vector<int32_t> pos(cap, -1);
+  int64_t u = 0;
+  constexpr int64_t PF = 16;
+  for (int64_t i = 0; i < n; ++i) {
+    if (i + PF < n) {
+      uint64_t ph = mix(in[i + PF]) & mask;
+      __builtin_prefetch(&pos[ph]);
+      __builtin_prefetch(&keys[ph]);
+    }
+    uint64_t k = in[i];
+    uint64_t h = mix(k) & mask;
+    while (pos[h] >= 0 && keys[h] != k) h = (h + 1) & mask;
+    if (pos[h] < 0) {
+      keys[h] = k;
+      pos[h] = static_cast<int32_t>(u);
+      uniq_out[u] = k;
+      first_out[u] = i;
+      ++u;
+    }
+    inv_out[i] = pos[h];
+  }
+  return u;
+}
+
 // dump all live (key,row) pairs; buffers must hold kv_size entries.
 void kv_items(void* p, uint64_t* keys_out, int32_t* rows_out) {
   const KvIndex* kv = static_cast<KvIndex*>(p);

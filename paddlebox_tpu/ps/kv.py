@@ -342,3 +342,25 @@ def make_kv(capacity: int):
     if lib is not None:
         return NativeKV(capacity, lib)
     return PyKV(capacity)
+
+
+def dedup_first_seen_native(keys: np.ndarray):
+    """Native one-pass first-seen dedup (kv_dedup_first_seen) — the fast
+    route of ps/table.dedup_first_seen. Returns (uniq, first_idx, inv)
+    with the oracle's exact dtypes, or None when the native library is
+    unavailable (callers keep the python path unchanged)."""
+    from paddlebox_tpu.native import load_native
+    lib = load_native()
+    if lib is None or not hasattr(lib, "kv_dedup_first_seen"):
+        return None
+    keys = np.ascontiguousarray(keys, np.uint64)
+    n = len(keys)
+    uniq = np.empty(max(n, 1), np.uint64)
+    first = np.empty(max(n, 1), np.int64)
+    inv = np.empty(max(n, 1), np.int32)
+    u = lib.kv_dedup_first_seen(
+        keys.ctypes.data_as(ctypes.c_void_p), n,
+        uniq.ctypes.data_as(ctypes.c_void_p),
+        first.ctypes.data_as(ctypes.c_void_p),
+        inv.ctypes.data_as(ctypes.c_void_p))
+    return uniq[:u].copy(), first[:u].copy(), inv[:n].astype(np.int64)

@@ -122,7 +122,8 @@ class ResidentPass:
         self.chunk_bits: Optional[int] = None
         # columnar side channels for the post-pass metric feed (or None)
         self.side = side
-        # per-stage build seconds (front/dedup/pack/h2d), set by
+        # per-stage build seconds (front/dedup/index_host/index_dev/
+        # pack/h2d), set by
         # build_streamed — the preloader mirrors them into
         # pbox_preload_build_seconds_total{stage=...}
         self.build_stats: Optional[Dict[str, float]] = None
@@ -191,7 +192,8 @@ class ResidentPass:
         emergency checkpoint) before raising PreloadBuildAborted.
 
         Per-stage seconds land in ``rp.build_stats``
-        (front/dedup/pack/h2d — docs/PERFORMANCE.md telemetry)."""
+        (front/dedup/index_host/index_dev/pack/h2d —
+        docs/PERFORMANCE.md telemetry)."""
         stats: Dict[str, float] = {}
         t0 = time.perf_counter()
         per_batch, floats, qmeta, trivial, nrec, side = cls._front(
@@ -231,8 +233,15 @@ class ResidentPass:
                         "wire")
         poll_preload_abort()
         t0 = time.perf_counter()
-        dedup, u_pad, k_max = cls._dedup_phase(per_batch, table, threads)
-        stats["dedup"] = time.perf_counter() - t0
+        dedup, u_pad, k_max = cls._dedup_phase(per_batch, table, threads,
+                                               stats=stats)
+        t_dedup = time.perf_counter() - t0
+        # the index stage (key→row assignment inside the dedup phase,
+        # host kv or device probe table) reports separately so the
+        # stall breakdown names the actual bottleneck; keep the stages
+        # a partition of the build wall
+        stats["dedup"] = max(0.0, t_dedup - stats.get("index_host", 0.0)
+                             - stats.get("index_dev", 0.0))
         poll_preload_abort()
         # wire formats decided ONCE from the dedup results — the exact
         # choice _encode_uniq/_encode_gidx make on the whole pass, so
@@ -710,10 +719,14 @@ class ResidentPass:
         return floats.astype(floats_dtype, copy=False), None
 
     @classmethod
-    def _dedup_phase(cls, per_batch, table, threads: int = 4):
+    def _dedup_phase(cls, per_batch, table, threads: int = 4,
+                     stats: Optional[Dict[str, float]] = None):
         """Pass-level dedup + row assignment (the FeedPass registration +
         DedupKeysAndFillIdx steps). Returns
-        ([(uniq_sorted, gidx)] per batch, u_pad, k_max).
+        ([(uniq_sorted, gidx)] per batch, u_pad, k_max). When ``stats``
+        is given and the bulk path runs, the assignment time the table
+        measured (host kv vs device probe table — see
+        EmbeddingTable.last_assign_seconds) lands in ``stats["index"]``.
 
         BULK path (FLAGS.bulk_pass_assign, default): concatenate every
         batch's keys, ONE first-seen dedup + assign round-trip under
@@ -733,6 +746,14 @@ class ResidentPass:
             keys_all = np.concatenate([k for k, *_ in per_batch])
             slots_all = np.concatenate([s for _, s, *_ in per_batch])
             rows_u, inv = bulk(keys_all, slots_all)
+            if stats is not None:
+                las = getattr(table, "last_assign_seconds", None)
+                if las:
+                    # split, not a single stage: a starved pipeline
+                    # must name WHICH half of assignment is slow (the
+                    # host kv walk vs the device probe-table insert)
+                    stats["index_host"] = las.get("index_host", 0.0)
+                    stats["index_dev"] = las.get("index_device", 0.0)
             rows_of_key = rows_u[inv]
             bounds = np.cumsum([0] + [len(k) for k, *_ in per_batch])
             poll_preload_abort()

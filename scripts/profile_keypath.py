@@ -30,6 +30,11 @@ Probe sets:
        separately from real-TPU rows via the backend suffix). When a
        trace span sink is attached each probe re-runs once inside a
        ``kernel.*`` span on the ``device.kernels`` lane.
+    index  the device-resident key index (ISSUE 19): open-addressing
+       insert / lookup / first-seen dedup over RAW 64-bit feature ids,
+       device (Pallas/XLA) vs host (python oracle, native C, host kv) —
+       with ``--record``, ``kernel.index.*.{shape}.{backend}`` raw
+       keys/s rows append the same way.
 
 ``PROF_ITERS`` / ``PROF_SHAPE`` env vars keep working (CLI wins).
 Sets 2 and 3 probe the ragged shape regardless of --shape (their
@@ -1021,11 +1026,213 @@ def run_set_kernels(shape: str, n_iter: int, record: bool = False,
                           "path": path or "(disabled)"}), flush=True)
 
 
+def _index_keys(shape: str, rng, vocab: int, k: int,
+                n_iter: int) -> np.ndarray:
+    """Raw 64-bit feature-id streams [n_iter, K] for the index probes:
+    ``uniform`` all-distinct ids (cold insert), ``zipf`` heavy-tailed
+    repeats (the CTR hot-key shape), anything else uniform draws over a
+    small vocab (collision-heavy warm stream). Every 7th id gets a
+    high-32 bit set so the probe covers ids that collide mod 2^32."""
+    out = np.empty((n_iter, k), np.uint64)
+    for i in range(n_iter):
+        if shape == "uniform":
+            ids = (np.arange(k, dtype=np.uint64)
+                   + np.uint64(i * k))
+        elif shape == "zipf":
+            ids = np.minimum(rng.zipf(1.3, size=k),
+                             vocab).astype(np.uint64)
+        else:
+            ids = rng.integers(0, vocab, size=k).astype(np.uint64)
+        ids[::7] |= np.uint64(1) << np.uint64(33)
+        out[i] = ids
+    return out
+
+
+def run_set_index(shape: str, n_iter: int, record: bool = False) -> None:
+    """The device-resident key index (ISSUE 19; ops/pallas_index.py):
+    open-addressing insert / lookup / first-seen dedup over RAW feature
+    ids, device (Pallas interpret or XLA while-loop) vs the host paths
+    (python dedup oracle, native C dedup, host kv assign/lookup). Emits
+    one JSON row per probe; with ``--record`` higher-is-better
+    ``kernel.index.{insert,lookup,dedup}*.{shape}.{backend}`` raw-keys/s
+    rows append to the perf_gate trajectory."""
+    from paddlebox_tpu.obs import trace
+    from paddlebox_tpu.ops.device_unique import dedup_keys_first_seen
+    from paddlebox_tpu.ops.pallas_index import (_pad_to_block, insert,
+                                                lookup, split_keys)
+    from paddlebox_tpu.ps.kv import dedup_first_seen_native, make_kv
+    from paddlebox_tpu.ps.table import (_dedup_first_seen_py,
+                                        dedup_first_seen)
+
+    backend = jax.default_backend()
+    if backend == "tpu":
+        k, vocab, cap = 1 << 17, 1 << 15, 1 << 20
+    else:
+        # interpret-mode round: the Pallas insert probes each key in a
+        # python fori_loop — keep it seconds (the row is gate HISTORY)
+        k, vocab, cap = 512, 192, 1 << 13
+    n_buckets = 1 << int(2 * cap - 1).bit_length()
+    rng = np.random.default_rng(0)
+    keys_np = _index_keys(shape, rng, vocab, k, n_iter)
+
+    timeit = make_timeit(n_iter)
+    rows_out = []
+
+    def probe(name, fn, *args, keys=k, unit="keys/sec"):
+        if trace.tracing_active():
+            with trace.span(f"kernel.{name}", lane=trace.LANE_KERNELS,
+                            shape=shape, backend=backend):
+                jax.block_until_ready(fn(*args))
+        ms = timeit(f"kernel.{name}.{shape}", fn, *args, backend=backend)
+        if record and ms > 0:
+            rows_out.append({
+                "source": "live",
+                "metric": f"kernel.{name}.{shape}.{backend}",
+                "value": round(keys / ms * 1000.0, 1),
+                "unit": unit, "shape": shape,
+            })
+
+    kp = _pad_to_block(keys_np[0]).shape[0]
+    hi_np = np.empty((n_iter, kp), np.int32)
+    lo_np = np.empty((n_iter, kp), np.int32)
+    for i in range(n_iter):
+        hi, lo = split_keys(keys_np[i])
+        hi_np[i] = _pad_to_block(hi)
+        lo_np[i] = _pad_to_block(lo)
+    hi_stack, lo_stack = jnp.asarray(hi_np), jnp.asarray(lo_np)
+
+    print(json.dumps({"probe": "shape", "K": k, "K_pad": kp,
+                      "VOCAB": vocab, "CAP": cap,
+                      "BUCKETS": n_buckets, "backend": backend}),
+          flush=True)
+
+    # ---- insert: open-addressing claim over the whole stream, state
+    # (buckets + row cursor) threaded through the loop — iteration 2+
+    # measures the warm (mostly-hits) pass shape ----
+    def make_insert(up):
+        @jax.jit
+        def run(hi_stack, lo_stack):
+            def body(i, carry):
+                bh, bl, br, nxt, acc = carry
+                bh, bl, br, rows, new, ovf = insert(
+                    bh, bl, br, hi_stack[i], lo_stack[i],
+                    jnp.int32(k), nxt, use_pallas=up)
+                nxt = nxt + jnp.sum(new[:k]).astype(jnp.int32)
+                return (bh, bl, br, nxt, acc + rows[0] + rows[k - 1])
+            init = (jnp.zeros(n_buckets, jnp.int32),
+                    jnp.zeros(n_buckets, jnp.int32),
+                    jnp.full(n_buckets, -1, jnp.int32),
+                    jnp.int32(0), jnp.zeros((), jnp.int32))
+            return jax.lax.fori_loop(0, n_iter, body, init)[4]
+        return run
+
+    probe("index.insert", make_insert(True), hi_stack, lo_stack)
+    probe("index.insert_xla", make_insert(False), hi_stack, lo_stack)
+
+    def p_insert_host():
+        # the host half of the seam: python first-seen dedup + kv
+        # assign (the EmbeddingTable.bulk_assign_unique host path)
+        kv = make_kv(cap)
+        acc = 0
+        for i in range(n_iter):
+            uniq, first, inv = dedup_first_seen(keys_np[i])
+            rows = kv.assign(uniq)
+            acc += int(rows[0])
+        return np.int64(acc)
+
+    probe("index.insert_host", p_insert_host)
+
+    # ---- lookup: probe a table warmed with the full key population ----
+    all_uniq = np.unique(keys_np.reshape(-1))
+    from paddlebox_tpu.ops.pallas_index import DeviceKeyIndex
+    dev = DeviceKeyIndex(cap, n_buckets=n_buckets)
+    out = dev.assign_unique(all_uniq)
+    assert out is not None, "probe table overflowed — raise CAP"
+
+    def make_lookup(up):
+        @jax.jit
+        def run(bh, bl, br, hi_stack, lo_stack):
+            def body(i, acc):
+                rows = lookup(bh, bl, br, hi_stack[i], lo_stack[i],
+                              jnp.int32(k), use_pallas=up)
+                return acc + rows[0] + rows[k - 1]
+            return jax.lax.fori_loop(0, n_iter, body,
+                                     jnp.zeros((), jnp.int32))
+        return run
+
+    probe("index.lookup", make_lookup(True), dev.bh, dev.bl, dev.br,
+          hi_stack, lo_stack)
+    probe("index.lookup_xla", make_lookup(False), dev.bh, dev.bl,
+          dev.br, hi_stack, lo_stack)
+
+    kv_warm = make_kv(cap)
+    kv_warm.assign(all_uniq)
+
+    def p_lookup_host():
+        acc = 0
+        for i in range(n_iter):
+            acc += int(kv_warm.lookup(keys_np[i])[0])
+        return np.int64(acc)
+
+    probe("index.lookup_host", p_lookup_host)
+
+    # ---- first-seen dedup of raw ids: device sort-based kernel vs the
+    # python oracle vs the native C open-addressing pass ----
+    @jax.jit
+    def p_dedup_dev(hi_stack, lo_stack):
+        def body(i, acc):
+            uh, ul, first, inv, nu = dedup_keys_first_seen(
+                hi_stack[i], lo_stack[i], jnp.int32(k))
+            return acc + uh[0] + inv[k - 1] + nu
+        return jax.lax.fori_loop(0, n_iter, body,
+                                 jnp.zeros((), jnp.int32))
+
+    probe("index.dedup", p_dedup_dev, hi_stack, lo_stack)
+
+    def p_dedup_host():
+        # the pure-python oracle, NOT dedup_first_seen (which routes to
+        # the native pass when available — probed separately below)
+        acc = 0
+        for i in range(n_iter):
+            uniq, first, inv = _dedup_first_seen_py(keys_np[i])
+            acc += len(uniq)
+        return np.int64(acc)
+
+    probe("index.dedup_host", p_dedup_host)
+
+    if dedup_first_seen_native(keys_np[0]) is not None:
+        def p_dedup_native():
+            acc = 0
+            for i in range(n_iter):
+                uniq, first, inv = dedup_first_seen_native(keys_np[i])
+                acc += len(uniq)
+            return np.int64(acc)
+
+        probe("index.dedup_native", p_dedup_native)
+    else:
+        print(json.dumps({"probe": "index.dedup_native",
+                          "skipped": "native lib unavailable"}),
+              flush=True)
+
+    if record and rows_out:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import perf_gate
+        dest = os.environ.get("BENCH_TRAJECTORY", "")
+        path = None if dest == "0" \
+            else (dest or perf_gate.default_trajectory_path())
+        for row in rows_out:
+            if path:
+                perf_gate.append_row(row, path)
+            print(json.dumps(row), flush=True)
+        print(json.dumps({"probe": "recorded", "rows": len(rows_out),
+                          "path": path or "(disabled)"}), flush=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="device key-path cost probes")
     ap.add_argument("--set", dest="probe_set", default="1",
-                    choices=("1", "2", "3", "all", "kernels"),
+                    choices=("1", "2", "3", "all", "kernels", "index"),
                     help="probe set to run (default 1)")
     ap.add_argument("--shape",
                     default=os.environ.get("PROF_SHAPE", "ragged"),
@@ -1050,10 +1257,16 @@ def main(argv=None) -> int:
                         probes=args.probes)
         print(json.dumps({"probe": "done"}), flush=True)
         return 0
+    if args.probe_set == "index":
+        shape = args.shape if args.shape != "thousand" else "ragged"
+        print(json.dumps({"probe": "set", "set": "index"}), flush=True)
+        run_set_index(shape, args.iters, record=args.record)
+        print(json.dumps({"probe": "done"}), flush=True)
+        return 0
     if args.shape == "zipf":
         # shape_dims() has no zipf branch — sets 1-3 would silently run
         # the uniform workload while claiming the heavy-tailed one
-        ap.error("--shape zipf is only valid with --set kernels")
+        ap.error("--shape zipf is only valid with --set kernels/index")
     sets = ("1", "2", "3") if args.probe_set == "all" \
         else (args.probe_set,)
     for ps in sets:

@@ -1,0 +1,451 @@
+"""Device-resident key index gates (ISSUE 19).
+
+Parity matrix for the ops/pallas_index open-addressing hash table and
+the ``FLAGS.use_pallas_index`` dispatch seam:
+
+- split/join 64-bit key halves roundtrip, including ids >= 2**32;
+- device first-seen dedup (ops/device_unique) is BITWISE against the
+  pure-python oracle (_dedup_first_seen_py) across collision-heavy,
+  zipf, uniform-distinct and hi-bits-collide-mod-2^32 streams;
+- the native one-pass dedup (kv_dedup_first_seen) matches the same
+  oracle (skipped when the library isn't buildable);
+- insert's Pallas and XLA formulations return identical rows/new/
+  overflow and each can read the other's bucket arrays;
+- probe and capacity overflow return None with the index state
+  UNCHANGED (functional rollback) — the seam's host fallback never
+  sees a half-committed table;
+- scatter_add_update Pallas vs XLA parity, including dropped -1/OOB
+  rows;
+- EmbeddingTable.bulk_assign_unique flag-on reproduces flag-off rows/
+  inverse/slot metadata exactly over multiple passes, overflow
+  degrades LOUDLY (warning + host dispatch booked) without changing
+  results, and ShardedEmbeddingTable.prepare_global/_eval flag parity
+  holds including the free-list-hole degrade path.
+"""
+
+import logging
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config import flags_scope
+from paddlebox_tpu.ops import pallas_index as pix
+from paddlebox_tpu.ops.device_unique import dedup_keys_first_seen
+from paddlebox_tpu.ps import EmbeddingTable
+from paddlebox_tpu.ps.kv import dedup_first_seen_native, make_kv
+from paddlebox_tpu.ps.sharded import ShardedEmbeddingTable
+from paddlebox_tpu.ps.table import _dedup_first_seen_py, dedup_first_seen
+
+
+def _make_streams():
+    rng = np.random.default_rng(7)
+    base = rng.integers(1, 2 ** 31, size=200).astype(np.uint64)
+    return {
+        # small vocab -> heavy duplicate + hash-collision pressure
+        "collision_heavy": rng.integers(1, 40, size=400).astype(np.uint64),
+        "zipf": np.minimum(rng.zipf(1.3, size=500), 4000).astype(np.uint64),
+        "uniform_distinct": rng.choice(
+            np.arange(1, 1 << 20, dtype=np.uint64), 300, replace=False),
+        # ids identical mod 2^32 — a 32-bit-truncating hash or compare
+        # would alias every pair
+        "hi64_collide_mod32": np.concatenate(
+            [base, base | (np.uint64(1) << np.uint64(33))]),
+    }
+
+
+STREAMS = _make_streams()
+
+
+# ---------------------------------------------------------------------------
+# key split/join + device dedup vs the python oracle
+# ---------------------------------------------------------------------------
+
+def test_split_join_roundtrip():
+    vals = np.array([0, 1, (1 << 32) - 1, 1 << 32, (1 << 33) | 5,
+                     0x8000000000000000, (1 << 64) - 1], np.uint64)
+    hi, lo = pix.split_keys(vals)
+    np.testing.assert_array_equal(pix.join_keys(hi, lo), vals)
+
+
+@pytest.mark.parametrize("name", sorted(STREAMS))
+def test_device_dedup_matches_oracle(name):
+    keys = STREAMS[name]
+    uniq_o, first_o, inv_o = _dedup_first_seen_py(keys)
+    hi, lo = pix.split_keys(keys)
+    uh, ul, first, inv, nu = dedup_keys_first_seen(
+        jnp.asarray(pix._pad_to_block(hi)),
+        jnp.asarray(pix._pad_to_block(lo)), jnp.int32(len(keys)))
+    u = int(nu)
+    assert u == len(uniq_o)
+    np.testing.assert_array_equal(
+        pix.join_keys(np.asarray(uh[:u]), np.asarray(ul[:u])), uniq_o)
+    np.testing.assert_array_equal(np.asarray(first[:u]), first_o)
+    np.testing.assert_array_equal(np.asarray(inv[:len(keys)]), inv_o)
+
+
+def test_device_dedup_empty():
+    z = jnp.zeros(pix._BK, jnp.int32)
+    *_, nu = dedup_keys_first_seen(z, z, jnp.int32(0))
+    assert int(nu) == 0
+
+
+def test_native_dedup_matches_oracle():
+    if dedup_first_seen_native(STREAMS["zipf"]) is None:
+        pytest.skip("native kv library unavailable")
+    for name, keys in STREAMS.items():
+        uniq_o, first_o, inv_o = _dedup_first_seen_py(keys)
+        uniq, first, inv = dedup_first_seen_native(keys)
+        np.testing.assert_array_equal(uniq, uniq_o, err_msg=name)
+        np.testing.assert_array_equal(first, first_o, err_msg=name)
+        np.testing.assert_array_equal(inv, inv_o, err_msg=name)
+
+
+def test_dedup_first_seen_public_route_matches_oracle():
+    """The seam everyone calls (native when buildable, python
+    otherwise) is bitwise against the oracle either way."""
+    for name, keys in STREAMS.items():
+        uniq_o, first_o, inv_o = _dedup_first_seen_py(keys)
+        uniq, first, inv = dedup_first_seen(keys)
+        np.testing.assert_array_equal(uniq, uniq_o, err_msg=name)
+        np.testing.assert_array_equal(first, first_o, err_msg=name)
+        np.testing.assert_array_equal(inv, inv_o, err_msg=name)
+
+
+# ---------------------------------------------------------------------------
+# insert/lookup: Pallas vs XLA formulations
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", sorted(STREAMS))
+def test_insert_pallas_vs_xla_identical(name):
+    uniq, _, _ = _dedup_first_seen_py(STREAMS[name])
+    n = len(uniq)
+    hi, lo = pix.split_keys(uniq)
+    kh = jnp.asarray(pix._pad_to_block(hi))
+    kl = jnp.asarray(pix._pad_to_block(lo))
+    nb = max(pix._BK * 2, 1 << int(2 * n - 1).bit_length())
+    outs = {}
+    for up in (True, False):
+        bh = jnp.zeros(nb, jnp.int32)
+        bl = jnp.zeros(nb, jnp.int32)
+        br = jnp.full(nb, -1, jnp.int32)
+        bh, bl, br, rows, new, ovf = pix.insert(
+            bh, bl, br, kh, kl, jnp.int32(n), jnp.int32(0), use_pallas=up)
+        outs[up] = (np.asarray(rows[:n]), np.asarray(new[:n]), bool(ovf),
+                    (bh, bl, br))
+    np.testing.assert_array_equal(outs[True][0], outs[False][0])
+    np.testing.assert_array_equal(outs[True][1], outs[False][1])
+    assert not outs[True][2] and not outs[False][2]
+    np.testing.assert_array_equal(outs[True][0], np.arange(n))
+    # cross-impl: a table built by one formulation is readable by the
+    # other (same hash, same probe order, same layout)
+    for built, probed in ((True, False), (False, True)):
+        bh, bl, br = outs[built][3]
+        rows = pix.lookup(bh, bl, br, kh, kl, jnp.int32(n),
+                          use_pallas=probed)
+        np.testing.assert_array_equal(np.asarray(rows[:n]), outs[built][0])
+
+
+# ---------------------------------------------------------------------------
+# DeviceKeyIndex: raw-id front door, misses, overflow rollback
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", sorted(STREAMS))
+def test_assign_raw_matches_oracle(name):
+    keys = STREAMS[name]
+    uniq_o, first_o, inv_o = _dedup_first_seen_py(keys)
+    dev = pix.DeviceKeyIndex(len(uniq_o) + 8)
+    uniq, first, inv, rows, new = dev.assign_raw(keys)
+    np.testing.assert_array_equal(uniq, uniq_o)
+    np.testing.assert_array_equal(first, first_o)
+    np.testing.assert_array_equal(inv, inv_o)
+    np.testing.assert_array_equal(rows, np.arange(len(uniq_o)))
+    assert new.all() and dev.next_row == len(uniq_o)
+    # re-assign is stable: same rows, nothing new
+    _, _, _, rows2, new2 = dev.assign_raw(keys)
+    np.testing.assert_array_equal(rows2, rows)
+    assert not new2.any() and dev.next_row == len(uniq_o)
+    # lookup agrees; unseen keys (and pads) miss with -1
+    np.testing.assert_array_equal(dev.lookup_rows(uniq_o),
+                                  np.arange(len(uniq_o)))
+    miss = np.array([1 << 60, (1 << 60) + 1], np.uint64)
+    np.testing.assert_array_equal(dev.lookup_rows(miss), [-1, -1])
+
+
+def test_assign_raw_empty():
+    dev = pix.DeviceKeyIndex(16)
+    uniq, first, inv, rows, new = dev.assign_raw(np.zeros(0, np.uint64))
+    assert (len(uniq), len(first), len(inv), len(rows), len(new)) == \
+        (0, 0, 0, 0, 0)
+    assert dev.next_row == 0
+    assert len(dev.lookup_rows(np.zeros(0, np.uint64))) == 0
+
+
+def test_probe_overflow_rolls_back():
+    # 600 distinct keys cannot fit 512 buckets: insert must flag
+    # overflow and assign_unique must leave the index UNTOUCHED
+    dev = pix.DeviceKeyIndex(1024, n_buckets=512)
+    before = np.asarray(dev.br).copy()
+    assert dev.assign_unique(np.arange(1, 601, dtype=np.uint64)) is None
+    assert dev.next_row == 0
+    np.testing.assert_array_equal(np.asarray(dev.br), before)
+    # the untouched state still serves a small assign
+    out = dev.assign_unique(np.arange(1, 9, dtype=np.uint64))
+    assert out is not None and dev.next_row == 8
+
+
+def test_capacity_overflow_rolls_back():
+    dev = pix.DeviceKeyIndex(4)
+    assert dev.assign_raw(np.arange(1, 11, dtype=np.uint64)) is None
+    assert dev.next_row == 0
+    out = dev.assign_raw(np.array([5, 6], np.uint64))
+    assert out is not None and dev.next_row == 2
+
+
+def test_seed_from_kv_dense_vs_holes():
+    kv = make_kv(64)
+    keys = np.array([11, 22, 33, 44, 55], np.uint64)
+    kv.assign(keys)
+    dev = pix.DeviceKeyIndex(64)
+    assert dev.seed_from_kv(kv)
+    k, r = kv.items()
+    np.testing.assert_array_equal(dev.lookup_rows(k), r.astype(np.int64))
+    # a free-list hole (released non-terminal row) kills density — no
+    # fresh mirror can reproduce the kv's row layout by insertion order
+    kv.release(np.array([22], np.uint64))
+    assert not pix.DeviceKeyIndex(64).seed_from_kv(kv)
+
+
+# ---------------------------------------------------------------------------
+# scatter_add_update
+# ---------------------------------------------------------------------------
+
+def test_scatter_add_update_parity():
+    rng = np.random.default_rng(11)
+    C, D, U = 70, 8, 33
+    vals = rng.normal(size=(C, D)).astype(np.float32)
+    deltas = rng.normal(size=(U, D)).astype(np.float32)
+    # duplicate-free rows spanning negative, in-range, and >= C —
+    # out-of-range rows must DROP on both impls
+    rows = (rng.choice(C + 20, size=U, replace=False).astype(np.int32)
+            - 10)
+    ref = vals.copy()
+    for i, r in enumerate(rows):
+        if 0 <= r < C:
+            ref[r] += deltas[i]
+    got_p = np.asarray(pix.scatter_add_update(
+        jnp.asarray(vals), jnp.asarray(rows), jnp.asarray(deltas),
+        use_pallas=True))
+    got_x = np.asarray(pix.scatter_add_update(
+        jnp.asarray(vals), jnp.asarray(rows), jnp.asarray(deltas),
+        use_pallas=False))
+    np.testing.assert_array_equal(got_p, ref)
+    np.testing.assert_array_equal(got_x, ref)
+
+
+# ---------------------------------------------------------------------------
+# EmbeddingTable seam: flag parity, loud degrade, lifecycle reset
+# ---------------------------------------------------------------------------
+
+def _bulk_stream(rng, n, vocab):
+    keys = rng.integers(1, vocab, size=n).astype(np.uint64)
+    return keys, (keys % 7).astype(np.int64)
+
+
+def test_table_bulk_assign_flag_parity():
+    rng = np.random.default_rng(5)
+    passes = [_bulk_stream(rng, 400, 900) for _ in range(3)]
+
+    def run(flag):
+        t = EmbeddingTable(mf_dim=4, capacity=1 << 11,
+                           unique_bucket_min=64)
+        outs = []
+        with flags_scope(use_pallas_index=flag):
+            for keys, slots in passes:
+                rows, inv = t.bulk_assign_unique(keys, slots)
+                outs.append((rows.copy(), inv.copy()))
+        return t, outs
+
+    t0, o0 = run(False)
+    t1, o1 = run(True)
+    for (r0, i0), (r1, i1) in zip(o0, o1):
+        np.testing.assert_array_equal(r1, r0)
+        np.testing.assert_array_equal(i1, i0)
+    np.testing.assert_array_equal(t1.slot_host, t0.slot_host)
+    k0, v0 = t0.index.items()
+    k1, v1 = t1.index.items()
+    s0, s1 = np.argsort(k0), np.argsort(k1)
+    np.testing.assert_array_equal(k1[s1], k0[s0])
+    np.testing.assert_array_equal(v1[s1], v0[s0])
+    # the device mirror tracked the host kv exactly
+    dev = t1._dev_index
+    assert dev is not None and not dev.degraded
+    assert dev.next_row == len(t1.index)
+    np.testing.assert_array_equal(dev.lookup_rows(k1),
+                                  v1.astype(np.int64))
+
+
+def test_table_seam_overflow_degrades_loudly():
+    from paddlebox_tpu.obs import MemorySink
+    from paddlebox_tpu.obs.hub import get_hub, reset_hub
+    rng = np.random.default_rng(9)
+    keys = rng.choice(np.arange(1, 1 << 30, dtype=np.uint64), 700,
+                      replace=False)
+    slots = (keys % 5).astype(np.int64)
+    t0 = EmbeddingTable(mf_dim=4, capacity=1 << 11)
+    with flags_scope(use_pallas_index=False):
+        r0, i0 = t0.bulk_assign_unique(keys, slots)
+
+    reset_hub()
+    hub = get_hub()
+    hub.add_sink(MemorySink())
+    records = []
+    handler = logging.Handler()
+    handler.emit = records.append
+    logging.getLogger("paddlebox_tpu").addHandler(handler)
+    try:
+        t1 = EmbeddingTable(mf_dim=4, capacity=1 << 11)
+        # plant a crippled mirror: 512 buckets cannot hold 700 uniques,
+        # so the first bulk assign probe-overflows
+        t1._dev_index = pix.DeviceKeyIndex(t1.capacity, n_buckets=512)
+        with flags_scope(use_pallas_index=True):
+            r1, i1 = t1.bulk_assign_unique(keys, slots)
+            r2, _ = t1.bulk_assign_unique(keys, slots)  # sticky
+        c = hub.counter("pbox_kernel_dispatch_total")
+        assert c.value(kernel="index.assign", impl="host") >= 2
+    finally:
+        logging.getLogger("paddlebox_tpu").removeHandler(handler)
+        reset_hub()
+    np.testing.assert_array_equal(r1, r0)
+    np.testing.assert_array_equal(i1, i0)
+    np.testing.assert_array_equal(r2, r0)
+    assert t1._dev_index.degraded
+    assert "overflow" in t1._dev_index.degrade_reason
+    assert any("degraded" in rec.getMessage() for rec in records), \
+        "degrade was silent — must warn"
+
+
+def test_table_reset_dev_index_reseeds():
+    t = EmbeddingTable(mf_dim=4, capacity=1 << 10)
+    keys = np.arange(1, 301, dtype=np.uint64)
+    slots = np.zeros(300, np.int64)
+    with flags_scope(use_pallas_index=True):
+        r1, _ = t.bulk_assign_unique(keys, slots)
+        assert t._dev_index is not None and not t._dev_index.degraded
+        # lifecycle mutation hook (load/merge/shrink call this): the
+        # mirror drops and re-seeds from the dense kv on next use
+        t._reset_dev_index()
+        assert t._dev_index is None
+        r2, _ = t.bulk_assign_unique(keys, slots)
+    np.testing.assert_array_equal(r2, r1)
+    dev = t._dev_index
+    assert dev is not None and not dev.degraded
+    assert dev.next_row == len(t.index)
+
+
+# ---------------------------------------------------------------------------
+# ShardedEmbeddingTable seam
+# ---------------------------------------------------------------------------
+
+def _sharded_batches(n, bs=8, S=3, k_pad=32, seed=0):
+    from paddlebox_tpu.data.batch import SlotBatch
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        nk = int(rng.integers(S, k_pad // 2))
+        keys = rng.choice(np.arange(1, 2000, dtype=np.uint64), nk,
+                          replace=False)
+        kp = np.zeros(k_pad, np.uint64)
+        kp[:nk] = keys
+        segs = np.full(k_pad, bs * S, np.int32)
+        segs[:nk] = np.sort(rng.integers(0, bs * S, size=nk)
+                            .astype(np.int32))
+        out.append(SlotBatch(
+            keys=kp, segments=segs, num_keys=nk,
+            dense=rng.normal(size=(bs, 4)).astype(np.float32),
+            label=rng.integers(0, 2, bs).astype(np.float32),
+            show=np.ones(bs, np.float32),
+            clk=rng.integers(0, 2, bs).astype(np.float32),
+            batch_size=bs, num_slots=S))
+    return out
+
+
+def _fields(x):
+    if hasattr(x, "_asdict"):
+        return x._asdict()
+    return vars(x)
+
+
+def _assert_plan_equal(got, want):
+    vg, vw = _fields(got), _fields(want)
+    assert vg.keys() == vw.keys()
+    for k in vg:
+        g, w = vg[k], vw[k]
+        if isinstance(w, np.ndarray) or hasattr(w, "dtype"):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w),
+                                          err_msg=k)
+        elif isinstance(w, (list, tuple)):
+            assert len(g) == len(w), k
+            for a, b in zip(g, w):
+                np.testing.assert_array_equal(np.asarray(a),
+                                              np.asarray(b), err_msg=k)
+        else:
+            assert g == w, k
+
+
+def _mk_sharded():
+    return ShardedEmbeddingTable(2, mf_dim=4, capacity_per_shard=256,
+                                 req_bucket_min=8, serve_bucket_min=8)
+
+
+def test_sharded_prepare_flag_parity():
+    def run(flag):
+        t = _mk_sharded()
+        with flags_scope(use_pallas_index=flag):
+            plan = t.prepare_global(_sharded_batches(2, seed=3))
+            # eval/read-only path: lookups only, misses stay misses
+            ev = t.prepare_global_eval(_sharded_batches(2, seed=4))
+        return t, plan, ev
+
+    t0, p0, e0 = run(False)
+    t1, p1, e1 = run(True)
+    _assert_plan_equal(p1, p0)
+    _assert_plan_equal(e1, e0)
+    for s in range(2):
+        k0, r0 = t0.indexes[s].items()
+        k1, r1 = t1.indexes[s].items()
+        o0, o1 = np.argsort(k0), np.argsort(k1)
+        np.testing.assert_array_equal(k1[o1], k0[o0])
+        np.testing.assert_array_equal(r1[o1], r0[o0])
+        np.testing.assert_array_equal(t1._touched[s], t0._touched[s])
+        dev = t1._dev_indexes[s]
+        assert dev is not None and not dev.degraded
+        assert dev.next_row == len(t1.indexes[s])
+
+
+def test_sharded_holes_degrade_loudly():
+    def run(flag):
+        t = _mk_sharded()
+        with flags_scope(use_pallas_index=flag):
+            t.prepare_global(_sharded_batches(2, seed=5))
+            # punch free-list holes behind the mirrors' back: release
+            # the EARLIEST row in each shard so the kv stops being dense
+            for s in range(2):
+                keys, rows = t.indexes[s].items()
+                victim = keys[np.argsort(rows)[0]]
+                t.indexes[s].release(np.array([victim], np.uint64))
+            plan = t.prepare_global(_sharded_batches(2, seed=6))
+        return t, plan
+
+    t0, p0 = run(False)
+    t1, p1 = run(True)
+    _assert_plan_equal(p1, p0)
+    for s in range(2):
+        k0, r0 = t0.indexes[s].items()
+        k1, r1 = t1.indexes[s].items()
+        o0, o1 = np.argsort(k0), np.argsort(k1)
+        np.testing.assert_array_equal(k1[o1], k0[o0])
+        np.testing.assert_array_equal(r1[o1], r0[o0])
+    assert any(
+        t1._dev_indexes[s] is not None and t1._dev_indexes[s].degraded
+        for s in range(2)), "no shard mirror degraded after kv holes"

@@ -391,8 +391,9 @@ def test_dma_reference_paths_refuse_real_tpu(monkeypatch):
 
 def test_kernel_dispatch_counter_books():
     """EVERY dispatch seam books pbox_kernel_dispatch_total{kernel,impl}
-    for both implementations — the seqpool seam (ISSUE 12) and the
-    three CTR-family seams (ISSUE 13)."""
+    for both implementations — the seqpool seam (ISSUE 12), the three
+    CTR-family seams (ISSUE 13), and the device key-index seam
+    (ISSUE 19: index.assign/index.lookup with impls pallas|host)."""
     from paddlebox_tpu.obs import MemorySink
     from paddlebox_tpu.obs.hub import get_hub, reset_hub
     from paddlebox_tpu.ops import (batch_fc, cross_norm_hadamard,
@@ -430,10 +431,27 @@ def test_kernel_dispatch_counter_books():
             run_all()
         with flags_scope(**{k: False for k in flags_on}):
             run_all()
+        # the ISSUE 19 device key-index seam: impls are pallas/host —
+        # the fallback is the authoritative host kv, not an XLA
+        # formulation, and BOTH routing decisions must book
+        from paddlebox_tpu.ps.sharded import ShardedEmbeddingTable
+        st = ShardedEmbeddingTable(2, mf_dim=4, capacity_per_shard=64,
+                                   req_bucket_min=8, serve_bucket_min=8)
+        keys0 = np.arange(2, 20, 2, dtype=np.uint64)  # shard-0-owned
+        with flags_scope(use_pallas_index=True):
+            st._shard_rows(0, keys0, assign=True)    # index.assign/pallas
+            st._shard_rows(0, keys0, assign=False)   # index.lookup/pallas
+            st._dev_index_for(0).degrade("test: force host fallback")
+            st._shard_rows(0, keys0, assign=True)    # index.assign/host
+            st._shard_rows(0, keys0, assign=False)   # index.lookup/host
         c = hub.counter("pbox_kernel_dispatch_total")
         for kernel in ("fused_embed_pool_cvm", "rank_attention",
                        "batch_fc", "cross_norm"):
             for impl in ("pallas", "xla"):
+                assert c.value(kernel=kernel, impl=impl) >= 1, \
+                    f"seam {kernel!r} never booked impl={impl!r}"
+        for kernel in ("index.assign", "index.lookup"):
+            for impl in ("pallas", "host"):
                 assert c.value(kernel=kernel, impl=impl) >= 1, \
                     f"seam {kernel!r} never booked impl={impl!r}"
     finally:
